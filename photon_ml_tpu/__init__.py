@@ -1,0 +1,34 @@
+"""photon_ml_tpu — a TPU-native (JAX/XLA/pjit/Pallas) framework with the
+capabilities of LinkedIn Photon-ML (GLMs + GAME/GLMix mixed-effect models).
+
+This is NOT a port of the Scala/Spark reference. The architecture is
+TPU-first:
+
+- Optimizers (L-BFGS / OWL-QN / TRON) are jit-compiled ``lax.while_loop``
+  programs that run entirely on device — no host round-trip per iteration
+  (reference: driver-resident Breeze loops, one broadcast + treeAggregate
+  per iteration; see SURVEY.md §3.1).
+- The distributed GLM objective shards samples over a ``data`` mesh axis and
+  reduces gradients/Hessian-vector products with ``lax.psum`` over ICI
+  (reference: ``DistributedGLMLossFunction`` + ``ValueAndGradientAggregator``
+  over Spark ``treeAggregate``).
+- GAME random effects turn millions of tiny per-entity solves into one big
+  vmap-batched, entity-sharded kernel (reference: ``RandomEffectCoordinate``
+  with per-entity Breeze solves inside Spark executors).
+
+Layer map (mirrors SURVEY.md §1, rebuilt TPU-first):
+
+- ``ops``      — pointwise losses, GLM objectives, segment reductions (L1/L2 math)
+- ``optim``    — device-resident optimizers + state tracking           (L1)
+- ``parallel`` — mesh construction, sharded objectives, collectives    (L2)
+- ``data``     — readers (LIBSVM/Avro), index maps, batching, stats    (L5)
+- ``models``   — GLM + GAME model classes                              (L3)
+- ``game``     — coordinates, coordinate descent, scores               (L3)
+- ``evaluation`` — distributed evaluators incl. per-entity multi-evals (L3)
+- ``estimators`` / ``transformers`` — fit/transform API                (L4)
+- ``cli``      — training/scoring drivers                              (L6)
+"""
+
+__version__ = "0.1.0"
+
+from photon_ml_tpu.types import TaskType  # noqa: F401

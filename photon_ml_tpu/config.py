@@ -1,0 +1,263 @@
+"""Typed configuration dataclasses.
+
+Reference parity: the spark.ml ``Param``/``ParamMap`` config surface of
+``GameTrainingDriver`` / ``GameScoringDriver`` plus the per-coordinate config
+objects (``FixedEffectCoordinateConfiguration``,
+``RandomEffectCoordinateConfiguration``, ``FeatureShardConfiguration``,
+``FixedEffectOptimizationConfiguration``,
+``RandomEffectOptimizationConfiguration``) — SURVEY.md §2.2/§2.3/§5.6.
+
+The TPU build replaces scopt+ParamMap with plain dataclasses that round-trip
+through JSON (``to_dict`` / ``from_dict``), so a driver invocation is fully
+described by one JSON document.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Sequence
+
+from photon_ml_tpu.types import (
+    DataValidationType,
+    ModelOutputMode,
+    NormalizationType,
+    OptimizerType,
+    RegularizationType,
+    TaskType,
+    VarianceComputationType,
+)
+
+
+def _to_jsonable(value: Any) -> Any:
+    if isinstance(value, enum.Enum):
+        return value.value
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return {f.name: _to_jsonable(getattr(value, f.name)) for f in dataclasses.fields(value)}
+    if isinstance(value, Mapping):
+        return {k: _to_jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_to_jsonable(v) for v in value]
+    return value
+
+
+class _JsonMixin:
+    def to_dict(self) -> dict:
+        return _to_jsonable(self)
+
+    def replace(self, **kwargs):
+        return dataclasses.replace(self, **kwargs)
+
+
+@dataclass(frozen=True)
+class RegularizationContext(_JsonMixin):
+    """L1/L2/elastic-net bookkeeping.
+
+    Parity: ``photon-lib::ml.optimization.RegularizationContext``. For
+    ELASTIC_NET, ``alpha`` is the L1 fraction: l1 = alpha * weight,
+    l2 = (1 - alpha) * weight.
+    """
+
+    regularization_type: RegularizationType = RegularizationType.NONE
+    alpha: float = 0.5  # elastic-net mixing; only used for ELASTIC_NET
+
+    def l1_weight(self, regularization_weight: float) -> float:
+        if self.regularization_type is RegularizationType.L1:
+            return regularization_weight
+        if self.regularization_type is RegularizationType.ELASTIC_NET:
+            return self.alpha * regularization_weight
+        return 0.0
+
+    def l2_weight(self, regularization_weight: float) -> float:
+        if self.regularization_type is RegularizationType.L2:
+            return regularization_weight
+        if self.regularization_type is RegularizationType.ELASTIC_NET:
+            return (1.0 - self.alpha) * regularization_weight
+        return 0.0
+
+
+@dataclass(frozen=True)
+class OptimizerConfig(_JsonMixin):
+    """Parity: ``photon-lib::ml.optimization.OptimizerConfig``.
+
+    ``tolerance`` is relative gradient-norm tolerance (converged when
+    ||g|| <= tolerance * max(1, ||g0||)), matching Breeze's convergence
+    check shape. ``max_iterations`` bounds the device loop trip count.
+    """
+
+    optimizer_type: OptimizerType = OptimizerType.LBFGS
+    max_iterations: int = 100
+    tolerance: float = 1e-7
+    # L-BFGS history size (Breeze default m=10 per SURVEY.md §2.1)
+    history_length: int = 10
+    # Backtracking line-search bound (fixed trip count under jit)
+    max_line_search_steps: int = 25
+    # TRON inner conjugate-gradient iteration bound
+    max_cg_iterations: int = 20
+
+
+@dataclass(frozen=True)
+class OptimizationConfig(_JsonMixin):
+    """One coordinate's optimization setup: optimizer + regularization +
+    down-sampling rate.
+
+    Parity: ``photon-api::ml.optimization.game.FixedEffectOptimizationConfiguration``
+    / ``RandomEffectOptimizationConfiguration``.
+    """
+
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    regularization: RegularizationContext = field(default_factory=RegularizationContext)
+    regularization_weight: float = 0.0
+    down_sampling_rate: float = 1.0
+
+
+@dataclass(frozen=True)
+class FeatureShardConfig(_JsonMixin):
+    """Parity: ``FeatureShardConfiguration`` — which feature bags make up a
+    shard, and whether the shard gets an intercept column.
+    """
+
+    feature_bags: tuple[str, ...] = ()
+    has_intercept: bool = True
+
+
+@dataclass(frozen=True)
+class FixedEffectCoordinateConfig(_JsonMixin):
+    """Parity: ``FixedEffectCoordinateConfiguration``."""
+
+    feature_shard_id: str = "global"
+    optimization: OptimizationConfig = field(default_factory=OptimizationConfig)
+
+
+@dataclass(frozen=True)
+class RandomEffectCoordinateConfig(_JsonMixin):
+    """Parity: ``RandomEffectCoordinateConfiguration``.
+
+    ``random_effect_type`` names the entity-id column (e.g. "userId").
+    ``active_data_upper_bound`` reservoir-samples each entity's training rows
+    (reference: ``numActiveDataPointsUpperBound``);
+    ``features_to_samples_ratio_upper_bound`` prunes per-entity features
+    (reference: ``numFeaturesToSamplesRatioUpperBound``).
+    """
+
+    random_effect_type: str = "entityId"
+    feature_shard_id: str = "per_entity"
+    optimization: OptimizationConfig = field(default_factory=OptimizationConfig)
+    active_data_upper_bound: int | None = None
+    features_to_samples_ratio_upper_bound: float | None = None
+    # TPU-specific: bucket geometry for the batched per-entity solver.
+    # Entities are grouped into buckets of padded sample count; None = auto.
+    sample_bucket_sizes: tuple[int, ...] | None = None
+
+
+@dataclass(frozen=True)
+class NormalizationConfig(_JsonMixin):
+    normalization_type: NormalizationType = NormalizationType.NONE
+
+
+@dataclass(frozen=True)
+class GameTrainingConfig(_JsonMixin):
+    """Full GAME training run configuration.
+
+    Parity: the ``GameTrainingDriver`` Param surface (SURVEY.md §2.3):
+    coordinate configurations + update sequence + descent iterations + task
+    type + normalization + evaluators + output mode + warm start + variance
+    + hyperparameter tuning.
+    """
+
+    task_type: TaskType = TaskType.LOGISTIC_REGRESSION
+    coordinate_update_sequence: tuple[str, ...] = ("fixed",)
+    coordinate_descent_iterations: int = 1
+    fixed_effect_coordinates: Mapping[str, FixedEffectCoordinateConfig] = field(
+        default_factory=dict
+    )
+    random_effect_coordinates: Mapping[str, RandomEffectCoordinateConfig] = field(
+        default_factory=dict
+    )
+    feature_shards: Mapping[str, FeatureShardConfig] = field(default_factory=dict)
+    normalization: NormalizationType = NormalizationType.NONE
+    evaluators: tuple[str, ...] = ()
+    output_mode: ModelOutputMode = ModelOutputMode.BEST
+    variance_computation: VarianceComputationType = VarianceComputationType.NONE
+    data_validation: DataValidationType = DataValidationType.VALIDATE_DISABLED
+    model_input_dir: str | None = None  # warm start
+    hyperparameter_tuning_iters: int = 0
+
+    def coordinate_config(self, cid: str):
+        if cid in self.fixed_effect_coordinates:
+            return self.fixed_effect_coordinates[cid]
+        if cid in self.random_effect_coordinates:
+            return self.random_effect_coordinates[cid]
+        raise KeyError(f"Unknown coordinate id: {cid!r}")
+
+
+@dataclass(frozen=True)
+class MeshConfig(_JsonMixin):
+    """Device-mesh geometry for the distributed runtime.
+
+    The reference's parallelism inventory (SURVEY.md §2.7) needs two logical
+    axes: ``data`` (sample sharding for fixed effects — the treeAggregate
+    analog) and ``entity`` (entity sharding for random effects). By default
+    both map onto all devices (the axes are used by different phases, so one
+    physical axis serves both).
+    """
+
+    data_axis: str = "data"
+    entity_axis: str = "entity"
+    # None = use all available devices on the data axis.
+    data_axis_size: int | None = None
+
+
+def _from_dict(cls, d: Mapping[str, Any]):
+    """Generic dataclass-from-JSON-dict: only keys present in ``d`` are
+    passed, so defaults live in exactly one place (the dataclass), and
+    nested dataclasses / enums / tuples are reconstructed from the field's
+    type annotation."""
+    import typing
+
+    hints = typing.get_type_hints(cls)
+    kwargs: dict[str, Any] = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in d:
+            continue
+        v = d[f.name]
+        kwargs[f.name] = _convert(hints[f.name], v)
+    return cls(**kwargs)
+
+
+def _convert(tp, v):
+    import collections.abc
+    import types
+    import typing
+
+    origin = typing.get_origin(tp)
+    args = typing.get_args(tp)
+    if origin is typing.Union or origin is types.UnionType:
+        if v is None:
+            return None
+        non_none = [a for a in args if a is not type(None)]
+        return _convert(non_none[0], v)
+    if isinstance(tp, type) and issubclass(tp, enum.Enum):
+        return tp(v)
+    if dataclasses.is_dataclass(tp):
+        return _from_dict(tp, v)
+    if origin in (tuple, collections.abc.Sequence) or tp is tuple:
+        inner = args[0] if args else str
+        return tuple(_convert(inner, x) for x in v)
+    if origin in (dict, collections.abc.Mapping):
+        val_tp = args[1] if len(args) == 2 else str
+        return {k: _convert(val_tp, x) for k, x in v.items()}
+    if tp is float:
+        return float(v)
+    if tp is int:
+        return int(v)
+    if tp is bool:
+        return bool(v)
+    return v
+
+
+def parse_config(d: Mapping[str, Any]) -> GameTrainingConfig:
+    """Build a ``GameTrainingConfig`` from a JSON-style dict (inverse of
+    ``to_dict``). Keys absent from the dict keep the dataclass defaults."""
+    return _from_dict(GameTrainingConfig, d)

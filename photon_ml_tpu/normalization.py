@@ -1,0 +1,138 @@
+"""Feature normalization applied *inside* the objective.
+
+Reference parity: ``photon-api::ml.normalization.NormalizationContext`` /
+``NormalizationType`` (SURVEY.md §2.2). The reference's key trick is kept:
+training data is NOT rewritten — scale factors and shifts are applied
+algebraically during objective/gradient evaluation and un-applied on the
+final model, with the intercept column exempt.
+
+TPU-first refinement: for a linear margin the per-feature affine transform
+folds into the *weight vector*, not the data:
+
+    margin_i = Σ_j (x_ij - s_j) f_j w_j + o_i
+             = (X @ u)_i - s·u + o_i          with u = f ⊙ w
+
+so normalized evaluation costs one elementwise multiply + one scalar dot on
+top of the unnormalized kernel — zero extra HBM traffic on the data.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.types import NormalizationType
+
+Array = jnp.ndarray
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["factors", "shifts"],
+    meta_fields=["intercept_index"],
+)
+@dataclass(frozen=True)
+class NormalizationContext:
+    """Per-feature affine transform x' = (x - shift) * factor.
+
+    ``intercept_index`` (static) marks the intercept column, which is exempt
+    (factor 1, shift 0) — the builders already bake that into the arrays; the
+    index is kept for model-space transforms and L2 masking.
+    """
+
+    factors: Array  # (d,)
+    shifts: Array  # (d,)
+    intercept_index: int | None = None
+
+    @property
+    def num_features(self) -> int:
+        return self.factors.shape[0]
+
+    def to_effective(self, w: Array) -> tuple[Array, Array]:
+        """Map model-space weights to (u, c): margin = X@u - c + offset."""
+        u = self.factors * w
+        return u, jnp.dot(self.shifts, u)
+
+    def grad_to_model_space(self, g_raw: Array, r_sum: Array) -> Array:
+        """Map a raw-data gradient contraction (Xᵀr, Σr) into model space:
+        ∂margin_i/∂w_j = f_j (x_ij - s_j)."""
+        return self.factors * (g_raw - self.shifts * r_sum)
+
+    def model_to_original_space(self, w: Array) -> tuple[Array, Array]:
+        """Un-apply normalization from trained coefficients.
+
+        Training optimizes w over normalized features; the equivalent model
+        over ORIGINAL features has coefficients f ⊙ w and an intercept
+        correction -s·(f ⊙ w). Returns (coefficients, intercept_delta); the
+        caller adds intercept_delta to the intercept coefficient (parity with
+        the reference's special intercept handling).
+        """
+        u = self.factors * w
+        delta = -jnp.dot(self.shifts, u)
+        if self.intercept_index is not None:
+            # intercept column has factor 1 / shift 0; its own coefficient
+            # passes through and absorbs the delta.
+            u = u.at[self.intercept_index].add(delta)
+            delta = jnp.zeros_like(delta)
+        return u, delta
+
+
+def no_normalization(num_features: int, intercept_index: int | None = None) -> NormalizationContext:
+    return NormalizationContext(
+        factors=jnp.ones((num_features,), jnp.float32),
+        shifts=jnp.zeros((num_features,), jnp.float32),
+        intercept_index=intercept_index,
+    )
+
+
+def build_normalization(
+    norm_type: NormalizationType,
+    means: np.ndarray,
+    variances: np.ndarray,
+    max_magnitudes: np.ndarray,
+    intercept_index: int | None = None,
+) -> NormalizationContext:
+    """Build a context from feature summary statistics.
+
+    Parity with the reference's four modes:
+    - NONE: identity.
+    - SCALE_WITH_STANDARD_DEVIATION: factor = 1/std, no shift.
+    - SCALE_WITH_MAX_MAGNITUDE: factor = 1/max|x|, no shift.
+    - STANDARDIZATION: factor = 1/std, shift = mean.
+    Features with zero std / zero max get factor 1 (no information → leave
+    untouched rather than blow up).
+    """
+    d = means.shape[0]
+    ones = np.ones(d, np.float32)
+    zeros = np.zeros(d, np.float32)
+    std = np.sqrt(np.maximum(variances, 0.0)).astype(np.float32)
+    inv_std = np.where(std > 0, 1.0 / np.where(std > 0, std, 1.0), 1.0).astype(np.float32)
+    maxmag = np.abs(max_magnitudes).astype(np.float32)
+    inv_max = np.where(maxmag > 0, 1.0 / np.where(maxmag > 0, maxmag, 1.0), 1.0).astype(np.float32)
+
+    if norm_type is NormalizationType.NONE:
+        factors, shifts = ones, zeros
+    elif norm_type is NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+        factors, shifts = inv_std, zeros
+    elif norm_type is NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+        factors, shifts = inv_max, zeros
+    elif norm_type is NormalizationType.STANDARDIZATION:
+        factors, shifts = inv_std, means.astype(np.float32).copy()
+    else:  # pragma: no cover
+        raise ValueError(f"unknown normalization type {norm_type}")
+
+    if intercept_index is not None:
+        factors = factors.copy()
+        shifts = shifts.copy()
+        factors[intercept_index] = 1.0
+        shifts[intercept_index] = 0.0
+
+    return NormalizationContext(
+        factors=jnp.asarray(factors),
+        shifts=jnp.asarray(shifts),
+        intercept_index=intercept_index,
+    )

@@ -1,0 +1,1 @@
+"""Numeric kernels: pointwise losses, GLM objectives, segment reductions."""

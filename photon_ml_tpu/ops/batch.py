@@ -1,0 +1,151 @@
+"""Device-resident sample batches for GLM training.
+
+Reference parity: the role of ``photon-api::ml.data.LabeledPoint`` /
+``LocalDataset`` (label, features, offset, weight per sample — SURVEY.md
+§2.2), redesigned columnar for TPU:
+
+- ``DenseBatch``: features as one ``(n, d)`` matrix — margins and gradient
+  contractions are single MXU matmuls. Used when d is modest (after feature
+  sharding / projection) or data is naturally dense.
+- ``SparseBatch``: features as padded per-row ``(n, k)`` (index, value)
+  pairs — the TPU-native CSR replacement (static shapes; XLA cannot tile
+  ragged rows). Margins are gathers + row sums; gradients are scatter-adds
+  (``.at[].add``) which XLA lowers to sorted segment sums. Padding uses
+  index 0 with value 0, which contributes exactly 0 to every contraction,
+  so no masking is needed in the kernels.
+
+Both carry ``weights`` that double as the padding row mask (padded rows get
+weight 0), so one code path handles ragged data under fixed shapes. The
+objective forces zero-weight rows to contribute exactly 0 (``jnp.where``, not
+``0 * x``), so padded rows may hold arbitrary — even loss-overflowing —
+values without poisoning the sums.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jnp.ndarray
+
+
+@partial(jax.tree_util.register_dataclass, data_fields=["X", "labels", "offsets", "weights"], meta_fields=[])
+@dataclass(frozen=True)
+class DenseBatch:
+    """Columnar batch with dense features.
+
+    X: (n, d) float; labels/offsets/weights: (n,) float.
+    Padded rows must have weights == 0 (and any finite values elsewhere).
+    """
+
+    X: Array
+    labels: Array
+    offsets: Array
+    weights: Array
+
+    @property
+    def num_features(self) -> int:
+        return self.X.shape[-1]
+
+    @property
+    def num_rows(self) -> int:
+        return self.X.shape[0]
+
+    def matvec(self, w: Array) -> Array:
+        """Margins X @ w — one MXU matmul."""
+        return self.X @ w
+
+    def rmatvec(self, r: Array) -> Array:
+        """Gradient contraction Xᵀ @ r — one MXU matmul."""
+        return self.X.T @ r
+
+    def rmatvec_sq(self, r: Array) -> Array:
+        """(X ⊙ X)ᵀ @ r — Hessian diagonal: Σ_i r_i x_ij²."""
+        return (self.X * self.X).T @ r
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["indices", "values", "labels", "offsets", "weights"],
+    meta_fields=["num_features"],
+)
+@dataclass(frozen=True)
+class SparseBatch:
+    """Columnar batch with padded sparse rows.
+
+    indices: (n, k) int32 feature ids, padded with 0.
+    values:  (n, k) float feature values, padded with 0.0.
+    num_features: static feature-space dimension d.
+    """
+
+    indices: Array
+    values: Array
+    labels: Array
+    offsets: Array
+    weights: Array
+    num_features: int = field(metadata=dict(static=True))
+
+    @property
+    def num_rows(self) -> int:
+        return self.indices.shape[0]
+
+    def matvec(self, w: Array) -> Array:
+        return jnp.sum(self.values * w[self.indices], axis=-1)
+
+    def rmatvec(self, r: Array) -> Array:
+        contrib = self.values * r[:, None]  # (n, k)
+        return jnp.zeros((self.num_features,), dtype=contrib.dtype).at[self.indices].add(contrib)
+
+    def rmatvec_sq(self, r: Array) -> Array:
+        contrib = self.values * self.values * r[:, None]
+        return jnp.zeros((self.num_features,), dtype=contrib.dtype).at[self.indices].add(contrib)
+
+
+Batch = DenseBatch | SparseBatch
+
+
+def dense_batch_from_numpy(
+    X: np.ndarray,
+    labels: np.ndarray,
+    offsets: np.ndarray | None = None,
+    weights: np.ndarray | None = None,
+    dtype=jnp.float32,
+) -> DenseBatch:
+    n = X.shape[0]
+    return DenseBatch(
+        X=jnp.asarray(X, dtype=dtype),
+        labels=jnp.asarray(labels, dtype=dtype),
+        offsets=jnp.zeros((n,), dtype) if offsets is None else jnp.asarray(offsets, dtype),
+        weights=jnp.ones((n,), dtype) if weights is None else jnp.asarray(weights, dtype),
+    )
+
+
+def pad_batch(batch: Batch, target_rows: int) -> Batch:
+    """Pad a batch to ``target_rows`` rows with zero-weight rows (static-shape
+    requirement for sharding: row count must divide the data axis)."""
+    n = batch.num_rows
+    if n == target_rows:
+        return batch
+    if n > target_rows:
+        raise ValueError(f"batch has {n} rows > target {target_rows}")
+    pad = target_rows - n
+    pad1 = lambda a: jnp.concatenate([a, jnp.zeros((pad,) + a.shape[1:], a.dtype)])
+    if isinstance(batch, DenseBatch):
+        return DenseBatch(
+            X=pad1(batch.X),
+            labels=pad1(batch.labels),
+            offsets=pad1(batch.offsets),
+            weights=pad1(batch.weights),
+        )
+    return SparseBatch(
+        indices=pad1(batch.indices),
+        values=pad1(batch.values),
+        labels=pad1(batch.labels),
+        offsets=pad1(batch.offsets),
+        weights=pad1(batch.weights),
+        num_features=batch.num_features,
+    )

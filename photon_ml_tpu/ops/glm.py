@@ -1,0 +1,177 @@
+"""GLM objective: fused value / gradient / Hessian-vector kernels.
+
+Reference parity: this module replaces the reference's entire objective
+stack — ``photon-lib::ml.function.{ObjectiveFunction,DiffFunction,
+TwiceDiffFunction}``, ``photon-api::ml.function.glm.DistributedGLMLossFunction``
+and ``SingleNodeGLMLossFunction``, and the aggregators
+(``ValueAndGradientAggregator``, ``HessianVectorAggregator``,
+``HessianMatrixAggregator``, ``HessianDiagonalAggregator``) — SURVEY.md §2.2.
+
+TPU-first design (vs the reference's broadcast + per-partition fold +
+treeAggregate):
+
+- One fused pass per evaluation: margins (MXU matmul) → pointwise loss
+  derivatives (VPU, fused by XLA) → gradient contraction (MXU matmul).
+- **The distributed and single-node objectives are the same code.** The
+  ``axis_name`` field selects the twin (SURVEY.md §4 "twin structure"): when
+  set, the objective is being traced inside ``shard_map`` over a mesh axis
+  and partial sums are reduced with ``lax.psum`` over ICI — the reference's
+  driver→executor broadcast *and* executor→driver treeAggregate both
+  collapse into that one collective, and the optimizer loop stays on device.
+- Loss semantics match the reference: objective = Σ_i weight_i·l(margin_i, y_i)
+  (+ 0.5·λ₂·‖w‖² over regularized coordinates). Sums, not means, so
+  regularization weights mean the same thing as in the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from photon_ml_tpu.normalization import NormalizationContext, no_normalization
+from photon_ml_tpu.ops.batch import Batch, DenseBatch
+from photon_ml_tpu.ops.losses import PointwiseLoss
+
+Array = jnp.ndarray
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["batch", "norm", "l2_weight", "reg_mask"],
+    meta_fields=["loss", "axis_name"],
+)
+@dataclass(frozen=True)
+class GLMObjective:
+    """Value/gradient/Hv contracts consumed by the optimizers.
+
+    Fields:
+      batch     — the (local shard of the) training data.
+      norm      — normalization applied inside evaluation (never to data).
+      l2_weight — scalar λ₂ (array so regularization grids don't recompile).
+      reg_mask  — (d,) 0/1 mask of regularized coordinates (intercept → 0).
+      loss      — pointwise loss namespace (static).
+      axis_name — mesh axis to psum over, or None for single-node (static).
+    """
+
+    batch: Batch
+    norm: NormalizationContext
+    l2_weight: Array
+    reg_mask: Array
+    loss: PointwiseLoss
+    axis_name: str | None = None
+
+    # -- collective hook (identity when single-node) --------------------------
+    def _reduce(self, x):
+        if self.axis_name is None:
+            return x
+        return lax.psum(x, self.axis_name)
+
+    def _weighted(self, x: Array) -> Array:
+        """weights * x, with zero-weight rows forced to exactly 0 so padding
+        can never poison the sums (0 * inf would be NaN — e.g. an overflowed
+        poisson loss on a padded row)."""
+        w = self.batch.weights
+        return jnp.where(w != 0.0, w * x, 0.0)
+
+    # -- margins --------------------------------------------------------------
+    def margins(self, w: Array) -> Array:
+        u, c = self.norm.to_effective(w)
+        return self.batch.matvec(u) - c + self.batch.offsets
+
+    # -- objective contracts ---------------------------------------------------
+    def _l2_term(self, w: Array) -> Array:
+        return 0.5 * self.l2_weight * jnp.sum(self.reg_mask * w * w)
+
+    def value(self, w: Array) -> Array:
+        m = self.margins(w)
+        local = jnp.sum(self._weighted(self.loss.value(m, self.batch.labels)))
+        return self._reduce(local) + self._l2_term(w)
+
+    def value_and_grad(self, w: Array) -> tuple[Array, Array]:
+        m = self.margins(w)
+        lv = self.loss.value(m, self.batch.labels)
+        r = self._weighted(self.loss.d1(m, self.batch.labels))
+        local = (
+            jnp.sum(self._weighted(lv)),
+            self.batch.rmatvec(r),
+            jnp.sum(r),
+        )
+        val, g_raw, r_sum = self._reduce(local)
+        g = self.norm.grad_to_model_space(g_raw, r_sum) + self.l2_weight * self.reg_mask * w
+        return val + self._l2_term(w), g
+
+    def grad(self, w: Array) -> Array:
+        return self.value_and_grad(w)[1]
+
+    def hvp(self, w: Array, v: Array) -> Array:
+        """Gauss-Newton/Hessian-vector product H·v = AᵀDA·v + λ₂·v (A = the
+        normalized design matrix, D = diag(weight·d2)). One forward matmul +
+        one reverse matmul; for TRON's CG loop this is the hot kernel."""
+        m = self.margins(w)
+        d2 = self._weighted(self.loss.d2(m, self.batch.labels))
+        v_eff = self.norm.factors * v
+        mv = self.batch.matvec(v_eff) - jnp.dot(self.norm.shifts, v_eff)
+        q = d2 * mv
+        local = (self.batch.rmatvec(q), jnp.sum(q))
+        hv_raw, q_sum = self._reduce(local)
+        hv = self.norm.grad_to_model_space(hv_raw, q_sum)
+        return hv + self.l2_weight * self.reg_mask * v
+
+    def hessian_diag(self, w: Array) -> Array:
+        """diag(H) — for VarianceComputationType.SIMPLE.
+
+        diag_j = f_j² [ Σ d2ᵢxᵢⱼ² − 2 s_j Σ d2ᵢxᵢⱼ + s_j² Σ d2ᵢ ] + λ₂·mask.
+        """
+        m = self.margins(w)
+        d2 = self._weighted(self.loss.d2(m, self.batch.labels))
+        local = (self.batch.rmatvec_sq(d2), self.batch.rmatvec(d2), jnp.sum(d2))
+        sq, lin, tot = self._reduce(local)
+        f, s = self.norm.factors, self.norm.shifts
+        diag = f * f * (sq - 2.0 * s * lin + s * s * tot)
+        return diag + self.l2_weight * self.reg_mask
+
+    def hessian(self, w: Array) -> Array:
+        """Full (d, d) Hessian — for VarianceComputationType.FULL. Dense
+        batches only (FULL variance is a small-d feature in the reference
+        too: it inverts a d×d matrix on the driver)."""
+        if not isinstance(self.batch, DenseBatch):
+            raise NotImplementedError(
+                "full Hessian requires a DenseBatch; use hessian_diag or hvp"
+            )
+        m = self.margins(w)
+        d2 = self._weighted(self.loss.d2(m, self.batch.labels))
+        Z = (self.batch.X - self.norm.shifts) * self.norm.factors
+        local = Z.T @ (d2[:, None] * Z)
+        h = self._reduce(local)
+        return h + jnp.diag(self.l2_weight * self.reg_mask)
+
+
+def make_objective(
+    batch: Batch,
+    loss: PointwiseLoss,
+    l2_weight: float | Array = 0.0,
+    norm: NormalizationContext | None = None,
+    intercept_index: int | None = None,
+    axis_name: str | None = None,
+) -> GLMObjective:
+    """Convenience constructor. ``intercept_index`` is excluded from L2
+    regularization (and from normalization if ``norm`` is built with it)."""
+    d = batch.num_features
+    if norm is None:
+        norm = no_normalization(d, intercept_index)
+    mask = jnp.ones((d,), jnp.float32)
+    if intercept_index is not None:
+        mask = mask.at[intercept_index].set(0.0)
+    return GLMObjective(
+        batch=batch,
+        norm=norm,
+        l2_weight=jnp.asarray(l2_weight, jnp.float32),
+        reg_mask=mask,
+        loss=loss,
+        axis_name=axis_name,
+    )

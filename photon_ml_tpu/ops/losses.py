@@ -1,0 +1,149 @@
+"""Pointwise GLM losses: ``l(margin, label)`` with first and second
+derivatives with respect to the margin.
+
+Reference parity: ``photon-api::ml.function.glm.PointwiseLossFunction`` and
+its implementations ``LogisticLossFunction``, ``SquaredLossFunction``,
+``PoissonLossFunction``, plus the smoothed hinge loss used by
+``DistributedSmoothedHingeLossFunction`` (SURVEY.md §2.2).
+
+Design: each loss is a namespace of three pure jnp functions
+(``value``, ``d1``, ``d2``) over (margin, label) arrays. The GLM objective
+calls them inside one fused pass so XLA fuses loss + reduction into the
+matmul epilogue. All math is elementwise (VPU); the surrounding matmuls
+(margins, gradient contractions) hit the MXU.
+
+Conventions (matching the reference):
+- margin = w·x + offset
+- logistic labels are 0/1; loss = log(1 + exp(-margin)) for y=1, i.e.
+  softplus(-sign * margin) with sign = 2y - 1 (numerically stable form).
+- Poisson uses the log link: loss = exp(margin) - y * margin.
+- squared loss = 0.5 * (margin - y)^2.
+- smoothed hinge (Rennie & Srebro): labels 0/1 mapped to ±1; piecewise
+  quadratic smoothing of the hinge on z = sign * margin.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.types import TaskType
+
+Array = jnp.ndarray
+
+
+@dataclass(frozen=True)
+class PointwiseLoss:
+    """A pointwise loss with derivatives w.r.t. the margin.
+
+    ``value``/``d1``/``d2`` map (margin, label) elementwise. ``mean`` is the
+    inverse link (prediction from margin), used by model classes for scoring.
+    """
+
+    name: str
+    value: Callable[[Array, Array], Array]
+    d1: Callable[[Array, Array], Array]
+    d2: Callable[[Array, Array], Array]
+    mean: Callable[[Array], Array]
+
+
+# --- logistic -----------------------------------------------------------------
+def _logistic_value(margin: Array, label: Array) -> Array:
+    sign = 2.0 * label - 1.0
+    return jax.nn.softplus(-sign * margin)
+
+
+def _logistic_d1(margin: Array, label: Array) -> Array:
+    # d/dm [softplus(-s m)] = -s * sigmoid(-s m) = sigmoid(m) - y   (for y in {0,1})
+    return jax.nn.sigmoid(margin) - label
+
+
+def _logistic_d2(margin: Array, label: Array) -> Array:
+    p = jax.nn.sigmoid(margin)
+    return p * (1.0 - p)
+
+
+logistic_loss = PointwiseLoss(
+    name="logistic",
+    value=_logistic_value,
+    d1=_logistic_d1,
+    d2=_logistic_d2,
+    mean=jax.nn.sigmoid,
+)
+
+
+# --- squared ------------------------------------------------------------------
+squared_loss = PointwiseLoss(
+    name="squared",
+    value=lambda m, y: 0.5 * (m - y) ** 2,
+    d1=lambda m, y: m - y,
+    d2=lambda m, y: jnp.ones_like(m),
+    mean=lambda m: m,
+)
+
+
+# --- poisson ------------------------------------------------------------------
+poisson_loss = PointwiseLoss(
+    name="poisson",
+    value=lambda m, y: jnp.exp(m) - y * m,
+    d1=lambda m, y: jnp.exp(m) - y,
+    d2=lambda m, y: jnp.exp(m),
+    mean=jnp.exp,
+)
+
+
+# --- smoothed hinge -----------------------------------------------------------
+def _smoothed_hinge_pieces(margin: Array, label: Array):
+    sign = 2.0 * label - 1.0
+    z = sign * margin
+    return sign, z
+
+
+def _smoothed_hinge_value(margin: Array, label: Array) -> Array:
+    # Rennie & Srebro smooth hinge on z = s*m:
+    #   z <= 0      : 0.5 - z
+    #   0 < z < 1   : 0.5 * (1 - z)^2
+    #   z >= 1      : 0
+    _, z = _smoothed_hinge_pieces(margin, label)
+    return jnp.where(z <= 0.0, 0.5 - z, jnp.where(z < 1.0, 0.5 * (1.0 - z) ** 2, 0.0))
+
+
+def _smoothed_hinge_d1(margin: Array, label: Array) -> Array:
+    sign, z = _smoothed_hinge_pieces(margin, label)
+    dz = jnp.where(z <= 0.0, -1.0, jnp.where(z < 1.0, z - 1.0, 0.0))
+    return sign * dz  # chain rule through z = s*m (s^2 = 1)
+
+
+def _smoothed_hinge_d2(margin: Array, label: Array) -> Array:
+    _, z = _smoothed_hinge_pieces(margin, label)
+    return jnp.where((z > 0.0) & (z < 1.0), 1.0, 0.0)
+
+
+smoothed_hinge_loss = PointwiseLoss(
+    name="smoothed_hinge",
+    value=_smoothed_hinge_value,
+    d1=_smoothed_hinge_d1,
+    d2=_smoothed_hinge_d2,
+    # SVM "mean" = raw margin (decision value), thresholded by callers
+    mean=lambda m: m,
+)
+
+
+LOSSES: dict[str, PointwiseLoss] = {
+    loss.name: loss
+    for loss in (logistic_loss, squared_loss, poisson_loss, smoothed_hinge_loss)
+}
+
+
+def loss_for_task(task: TaskType) -> PointwiseLoss:
+    """Select the pointwise loss for a task type (parity with how the
+    reference binds ``TaskType`` → ``PointwiseLossFunction``)."""
+    return {
+        TaskType.LOGISTIC_REGRESSION: logistic_loss,
+        TaskType.LINEAR_REGRESSION: squared_loss,
+        TaskType.POISSON_REGRESSION: poisson_loss,
+        TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: smoothed_hinge_loss,
+    }[task]

@@ -1,0 +1,94 @@
+"""Core shared types.
+
+Reference parity: ``photon-api::ml.Types`` (CoordinateId, FeatureShardId, REId,
+UniqueSampleId type aliases) and ``photon-api::ml.TaskType`` (SURVEY.md §2.2).
+
+In the TPU build, entity ids (``REId``) are *integer-encoded at ingest* (the
+reference carries strings through the cluster and hashes them during the
+group-by-entity shuffle; we build an entity index map once on the host so the
+device only ever sees dense ``int32`` ids — see ``data.entity_index``).
+"""
+
+from __future__ import annotations
+
+import enum
+
+# Type aliases (host-side). On device, entity ids are int32 arrays.
+CoordinateId = str
+FeatureShardId = str
+REType = str  # random-effect type, e.g. "userId" — the name of the id column
+REId = str  # a single entity's id value (host side; int-encoded for device)
+UniqueSampleId = int
+
+
+class TaskType(enum.Enum):
+    """Training task types.
+
+    Parity: ``photon-api::ml.TaskType`` — LOGISTIC_REGRESSION,
+    LINEAR_REGRESSION, POISSON_REGRESSION, SMOOTHED_HINGE_LOSS_LINEAR_SVM.
+    """
+
+    LOGISTIC_REGRESSION = "LOGISTIC_REGRESSION"
+    LINEAR_REGRESSION = "LINEAR_REGRESSION"
+    POISSON_REGRESSION = "POISSON_REGRESSION"
+    SMOOTHED_HINGE_LOSS_LINEAR_SVM = "SMOOTHED_HINGE_LOSS_LINEAR_SVM"
+
+    @property
+    def is_classification(self) -> bool:
+        return self in (
+            TaskType.LOGISTIC_REGRESSION,
+            TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+        )
+
+
+class OptimizerType(enum.Enum):
+    """Parity: ``photon-lib::ml.optimization.OptimizerType`` (LBFGS, TRON).
+
+    OWLQN is selected implicitly when L1 regularization is active, matching
+    the reference's behavior.
+    """
+
+    LBFGS = "LBFGS"
+    TRON = "TRON"
+
+
+class RegularizationType(enum.Enum):
+    """Parity: ``photon-lib::ml.optimization.RegularizationType``."""
+
+    NONE = "NONE"
+    L1 = "L1"
+    L2 = "L2"
+    ELASTIC_NET = "ELASTIC_NET"
+
+
+class NormalizationType(enum.Enum):
+    """Parity: ``photon-api::ml.normalization.NormalizationType``."""
+
+    NONE = "NONE"
+    SCALE_WITH_STANDARD_DEVIATION = "SCALE_WITH_STANDARD_DEVIATION"
+    SCALE_WITH_MAX_MAGNITUDE = "SCALE_WITH_MAX_MAGNITUDE"
+    STANDARDIZATION = "STANDARDIZATION"
+
+
+class VarianceComputationType(enum.Enum):
+    """Parity: ``photon-api::ml.optimization.VarianceComputationType``."""
+
+    NONE = "NONE"
+    SIMPLE = "SIMPLE"  # inverse of Hessian diagonal
+    FULL = "FULL"  # diagonal of inverse full Hessian
+
+
+class DataValidationType(enum.Enum):
+    """Parity: ``photon-client::ml.data.DataValidators`` modes."""
+
+    VALIDATE_FULL = "VALIDATE_FULL"
+    VALIDATE_SAMPLE = "VALIDATE_SAMPLE"
+    VALIDATE_DISABLED = "VALIDATE_DISABLED"
+
+
+class ModelOutputMode(enum.Enum):
+    """Parity: ``photon-client::ml.io.ModelOutputMode``."""
+
+    NONE = "NONE"
+    BEST = "BEST"
+    ALL = "ALL"

@@ -1,0 +1,37 @@
+"""Test harness setup.
+
+Parity with the reference's test strategy (SURVEY.md §4): the reference runs
+distributed code in local-mode Spark; we run collective code on a virtual
+8-device CPU mesh via ``xla_force_host_platform_device_count``, so every
+``shard_map``/psum code path executes in CI without TPU hardware. Must run
+before the first ``import jax`` anywhere in the test process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Double precision in tests: finite-difference derivative checks need it.
+os.environ["JAX_ENABLE_X64"] = "1"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment's sitecustomize registers an 'axon' TPU-relay PJRT plugin in
+# every interpreter and forces jax_platforms=axon via jax.config (so env vars
+# set here are too late). Initializing that backend blocks on the relay
+# socket, hanging the whole suite. Undo both before the first backend init:
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,170 @@
+"""GLM objective checks: manual grad/Hv/diag vs jax autodiff, dense vs
+sparse equivalence, normalization-in-objective vs pre-normalized data."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.normalization import build_normalization, no_normalization
+from photon_ml_tpu.ops.batch import DenseBatch, SparseBatch, dense_batch_from_numpy
+from photon_ml_tpu.ops.glm import make_objective
+from photon_ml_tpu.ops.losses import LOSSES
+from photon_ml_tpu.types import NormalizationType
+
+
+def _make_data(rng, n=48, d=7):
+    X = rng.normal(size=(n, d))
+    X[:, -1] = 1.0  # intercept column
+    w_true = rng.normal(size=d)
+    logits = X @ w_true
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.float64)
+    offsets = rng.normal(scale=0.1, size=n)
+    weights = rng.uniform(0.5, 2.0, size=n)
+    return X, y, offsets, weights
+
+
+def _sparse_from_dense(X):
+    n, d = X.shape
+    idx = np.tile(np.arange(d, dtype=np.int32), (n, 1))
+    return idx, X.astype(np.float32)
+
+
+@pytest.mark.parametrize("loss_name", list(LOSSES))
+def test_grad_matches_autodiff(loss_name, rng):
+    X, y, off, wt = _make_data(rng)
+    if loss_name == "poisson":
+        y = rng.poisson(1.5, size=len(y)).astype(np.float64)
+    batch = dense_batch_from_numpy(X, y, off, wt)
+    obj = make_objective(batch, LOSSES[loss_name], l2_weight=0.3, intercept_index=X.shape[1] - 1)
+    w = jnp.asarray(rng.normal(size=X.shape[1]), jnp.float32)
+    val, g = obj.value_and_grad(w)
+    g_auto = jax.grad(obj.value)(w)
+    np.testing.assert_allclose(g, g_auto, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(val, obj.value(w), rtol=1e-6)
+
+
+def test_hvp_matches_autodiff_hessian(rng):
+    X, y, off, wt = _make_data(rng, n=32, d=5)
+    batch = dense_batch_from_numpy(X, y, off, wt)
+    obj = make_objective(batch, LOSSES["logistic"], l2_weight=0.1, intercept_index=4)
+    w = jnp.asarray(rng.normal(size=5), jnp.float32)
+    v = jnp.asarray(rng.normal(size=5), jnp.float32)
+    H = jax.hessian(obj.value)(w)
+    np.testing.assert_allclose(obj.hvp(w, v), H @ v, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(obj.hessian(w), H, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(obj.hessian_diag(w), jnp.diag(H), rtol=1e-3, atol=1e-3)
+
+
+def test_sparse_dense_equivalence(rng):
+    X, y, off, wt = _make_data(rng, n=40, d=6)
+    dense = dense_batch_from_numpy(X, y, off, wt)
+    idx, vals = _sparse_from_dense(X)
+    sparse = SparseBatch(
+        indices=jnp.asarray(idx),
+        values=jnp.asarray(vals),
+        labels=jnp.asarray(y, jnp.float32),
+        offsets=jnp.asarray(off, jnp.float32),
+        weights=jnp.asarray(wt, jnp.float32),
+        num_features=6,
+    )
+    w = jnp.asarray(rng.normal(size=6), jnp.float32)
+    v = jnp.asarray(rng.normal(size=6), jnp.float32)
+    od = make_objective(dense, LOSSES["logistic"], l2_weight=0.2)
+    os_ = make_objective(sparse, LOSSES["logistic"], l2_weight=0.2)
+    vd, gd = od.value_and_grad(w)
+    vs, gs = os_.value_and_grad(w)
+    np.testing.assert_allclose(vd, vs, rtol=1e-5)
+    np.testing.assert_allclose(gd, gs, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(od.hvp(w, v), os_.hvp(w, v), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(od.hessian_diag(w), os_.hessian_diag(w), rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_padding_is_inert(rng):
+    """Padded (index 0, value 0) entries must contribute exactly nothing."""
+    d = 5
+    idx = np.array([[1, 2, 0], [3, 0, 0]], np.int32)
+    val = np.array([[1.0, 2.0, 0.0], [4.0, 0.0, 0.0]], np.float32)
+    sb = SparseBatch(
+        indices=jnp.asarray(idx),
+        values=jnp.asarray(val),
+        labels=jnp.asarray([1.0, 0.0]),
+        offsets=jnp.zeros(2),
+        weights=jnp.ones(2),
+        num_features=d,
+    )
+    w = jnp.asarray(rng.normal(size=d), jnp.float32)
+    m = sb.matvec(w)
+    np.testing.assert_allclose(m, [w[1] + 2 * w[2], 4 * w[3]], rtol=1e-6)
+    r = jnp.asarray([1.0, -2.0])
+    g = sb.rmatvec(r)
+    expected = np.zeros(d)
+    expected[1] += 1.0
+    expected[2] += 2.0
+    expected[3] += -8.0
+    np.testing.assert_allclose(g, expected, rtol=1e-6, atol=1e-7)
+
+
+def test_normalization_in_objective_equals_prenormalized_data(rng):
+    """The reference's key invariant: evaluating with NormalizationContext on
+    raw data == evaluating with no normalization on pre-transformed data."""
+    X, y, off, wt = _make_data(rng, n=30, d=6)
+    means = X.mean(axis=0)
+    variances = X.var(axis=0)
+    maxmag = np.abs(X).max(axis=0)
+    norm = build_normalization(
+        NormalizationType.STANDARDIZATION, means, variances, maxmag, intercept_index=5
+    )
+    raw = dense_batch_from_numpy(X, y, off, wt)
+    obj_norm = make_objective(raw, LOSSES["logistic"], l2_weight=0.1, norm=norm, intercept_index=5)
+
+    factors = np.asarray(norm.factors)
+    shifts = np.asarray(norm.shifts)
+    Xn = (X - shifts) * factors
+    pre = dense_batch_from_numpy(Xn, y, off, wt)
+    obj_pre = make_objective(pre, LOSSES["logistic"], l2_weight=0.1, intercept_index=5)
+
+    w = jnp.asarray(rng.normal(size=6), jnp.float32)
+    v = jnp.asarray(rng.normal(size=6), jnp.float32)
+    np.testing.assert_allclose(obj_norm.value(w), obj_pre.value(w), rtol=1e-5)
+    np.testing.assert_allclose(
+        obj_norm.value_and_grad(w)[1], obj_pre.value_and_grad(w)[1], rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(obj_norm.hvp(w, v), obj_pre.hvp(w, v), rtol=1e-4, atol=1e-4)
+
+
+def test_model_to_original_space_roundtrip(rng):
+    """A model trained in normalized space must score identically after
+    coefficients are mapped back to original space."""
+    X, y, off, wt = _make_data(rng, n=20, d=6)
+    means, variances = X.mean(0), X.var(0)
+    norm = build_normalization(
+        NormalizationType.STANDARDIZATION, means, variances, np.abs(X).max(0), intercept_index=5
+    )
+    w = jnp.asarray(rng.normal(size=6), jnp.float32)
+    # normalized-space margins
+    raw = dense_batch_from_numpy(X, y, off, wt)
+    obj = make_objective(raw, LOSSES["logistic"], norm=norm, intercept_index=5)
+    m_norm = obj.margins(w)
+    # original-space margins with mapped coefficients
+    w_orig, delta = norm.model_to_original_space(w)
+    m_orig = jnp.asarray(X, jnp.float32) @ w_orig + delta + jnp.asarray(off, jnp.float32)
+    np.testing.assert_allclose(m_norm, m_orig, rtol=1e-4, atol=1e-4)
+
+
+def test_zero_weight_rows_are_ignored(rng):
+    X, y, off, wt = _make_data(rng, n=20, d=4)
+    batch_full = dense_batch_from_numpy(X, y, off, wt)
+    # append garbage rows with zero weight
+    Xg = np.concatenate([X, rng.normal(size=(5, 4)) * 100], axis=0)
+    yg = np.concatenate([y, np.ones(5)])
+    offg = np.concatenate([off, np.full(5, 7.0)])
+    wtg = np.concatenate([wt, np.zeros(5)])
+    batch_pad = dense_batch_from_numpy(Xg, yg, offg, wtg)
+    w = jnp.asarray(rng.normal(size=4), jnp.float32)
+    o1 = make_objective(batch_full, LOSSES["logistic"], l2_weight=0.2)
+    o2 = make_objective(batch_pad, LOSSES["logistic"], l2_weight=0.2)
+    np.testing.assert_allclose(o1.value(w), o2.value(w), rtol=1e-5)
+    np.testing.assert_allclose(
+        o1.value_and_grad(w)[1], o2.value_and_grad(w)[1], rtol=1e-4, atol=1e-4
+    )

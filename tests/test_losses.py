@@ -1,0 +1,62 @@
+"""Derivative checks for every pointwise loss against finite differences —
+the reference does the same for its PointwiseLossFunctions (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.ops import losses
+
+ALL_LOSSES = list(losses.LOSSES.values())
+
+
+def _labels_for(loss, rng, n):
+    if loss.name == "squared":
+        return rng.normal(size=n)
+    if loss.name == "poisson":
+        return rng.poisson(2.0, size=n).astype(np.float64)
+    return rng.integers(0, 2, size=n).astype(np.float64)  # 0/1
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+def test_d1_matches_finite_difference(loss, rng):
+    n = 64
+    m = jnp.asarray(rng.normal(scale=2.0, size=n))
+    y = jnp.asarray(_labels_for(loss, rng, n))
+    eps = 1e-4
+    fd = (loss.value(m + eps, y) - loss.value(m - eps, y)) / (2 * eps)
+    np.testing.assert_allclose(loss.d1(m, y), fd, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+def test_d2_matches_finite_difference_of_d1(loss, rng):
+    n = 64
+    # keep away from the smoothed-hinge kinks at z ∈ {0, 1}
+    m = jnp.asarray(rng.normal(scale=2.0, size=n)) + 3e-2
+    y = jnp.asarray(_labels_for(loss, rng, n))
+    eps = 1e-4
+    fd = (loss.d1(m + eps, y) - loss.d1(m - eps, y)) / (2 * eps)
+    np.testing.assert_allclose(loss.d2(m, y), fd, rtol=1e-2, atol=2e-3)
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+def test_d1_matches_jax_grad(loss, rng):
+    m = jnp.asarray(rng.normal(size=16))
+    y = jnp.asarray(_labels_for(loss, rng, 16))
+    g = jax.vmap(jax.grad(lambda mi, yi: loss.value(mi, yi)))(m, y)
+    np.testing.assert_allclose(loss.d1(m, y), g, rtol=1e-6, atol=1e-6)
+
+
+def test_logistic_stability_extreme_margins():
+    m = jnp.asarray([-1e4, -100.0, 0.0, 100.0, 1e4])
+    y = jnp.asarray([1.0, 1.0, 1.0, 0.0, 0.0])
+    v = losses.logistic_loss.value(m, y)
+    assert bool(jnp.all(jnp.isfinite(v)))
+    np.testing.assert_allclose(v[2], np.log(2.0), rtol=1e-6)
+    assert float(v[0]) == pytest.approx(1e4, rel=1e-3)
+
+
+def test_poisson_mean_is_exp():
+    m = jnp.asarray([0.0, 1.0])
+    np.testing.assert_allclose(losses.poisson_loss.mean(m), np.exp([0.0, 1.0]), rtol=1e-6)
