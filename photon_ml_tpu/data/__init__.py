@@ -1,0 +1,16 @@
+"""Host-side data layer: readers, index maps, summaries, batching.
+
+Reference parity: ``photon-client``'s IO layer (SURVEY.md §2.3) — the Avro
+``DataReader``, ``IndexMap``/``PalDBIndexMap``, feature summarization — plus
+a LIBSVM reader for the benchmark configs. The TPU redesign does all
+grouping/sorting once at ingest on the host (replacing Spark's runtime
+shuffle) and hands the device fixed-shape, padded blocks.
+"""
+
+from photon_ml_tpu.data.index_map import IndexMap  # noqa: F401
+from photon_ml_tpu.data.libsvm import read_libsvm  # noqa: F401
+from photon_ml_tpu.data.summary import FeatureSummary, summarize  # noqa: F401
+from photon_ml_tpu.data.synthetic import (  # noqa: F401
+    synthetic_game_data,
+    synthetic_glm_data,
+)

@@ -1,0 +1,90 @@
+"""Feature summarization: per-feature statistics feeding normalization.
+
+Reference parity: ``photon-api::ml.stat.BasicStatisticalSummary`` (means,
+variances, min/max via Spark) and its use in building a
+``NormalizationContext`` (SURVEY.md §2.2); also the
+``FeatureSummarizationResultAvro`` output of the legacy driver (§5.5).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass
+
+import numpy as np
+
+from photon_ml_tpu.normalization import NormalizationContext, build_normalization
+from photon_ml_tpu.ops.batch import Batch, DenseBatch
+from photon_ml_tpu.types import NormalizationType
+
+
+@dataclass(frozen=True)
+class FeatureSummary:
+    """Weighted per-feature statistics over a dataset."""
+
+    mean: np.ndarray
+    variance: np.ndarray
+    min: np.ndarray
+    max: np.ndarray
+    max_magnitude: np.ndarray
+    num_nonzeros: np.ndarray
+    count: int
+
+    def to_json(self) -> str:
+        d = {k: (v.tolist() if isinstance(v, np.ndarray) else v) for k, v in asdict(self).items()}
+        return json.dumps(d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "FeatureSummary":
+        d = json.loads(s)
+        return cls(
+            mean=np.asarray(d["mean"]),
+            variance=np.asarray(d["variance"]),
+            min=np.asarray(d["min"]),
+            max=np.asarray(d["max"]),
+            max_magnitude=np.asarray(d["max_magnitude"]),
+            num_nonzeros=np.asarray(d["num_nonzeros"]),
+            count=d["count"],
+        )
+
+    def normalization(
+        self, norm_type: NormalizationType, intercept_index: int | None = None
+    ) -> NormalizationContext:
+        return build_normalization(
+            norm_type, self.mean, self.variance, self.max_magnitude, intercept_index
+        )
+
+
+def summarize(batch: Batch) -> FeatureSummary:
+    """Compute weighted feature statistics on host (numpy — ingest-time op).
+
+    Sparse semantics match the reference: zero entries participate in the
+    moments (a sparse feature's mean includes its implicit zeros).
+    """
+    if isinstance(batch, DenseBatch):
+        X = np.asarray(batch.X, np.float64)
+    else:
+        n = batch.num_rows
+        X = np.zeros((n, batch.num_features), np.float64)
+        idx = np.asarray(batch.indices)
+        val = np.asarray(batch.values, np.float64)
+        rows = np.repeat(np.arange(n), idx.shape[1])
+        # scatter-add so duplicate (row, col) pairs accumulate like the device path
+        np.add.at(X, (rows, idx.ravel()), val.ravel())
+    w = np.asarray(batch.weights, np.float64)
+    total = w.sum()
+    if total <= 0:
+        raise ValueError("summarize: total sample weight is zero")
+    mean = (w[:, None] * X).sum(0) / total
+    var = (w[:, None] * (X - mean) ** 2).sum(0) / total
+    active = w > 0
+    Xa = X[active]
+    return FeatureSummary(
+        mean=mean,
+        variance=var,
+        min=Xa.min(0) if Xa.size else np.zeros(X.shape[1]),
+        max=Xa.max(0) if Xa.size else np.zeros(X.shape[1]),
+        max_magnitude=np.abs(Xa).max(0) if Xa.size else np.zeros(X.shape[1]),
+        num_nonzeros=(Xa != 0).sum(0).astype(np.int64),
+        count=int(active.sum()),
+    )

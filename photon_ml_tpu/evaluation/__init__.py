@@ -1,0 +1,12 @@
+"""Evaluators: AUC, RMSE, per-loss metrics, per-entity multi-evaluators."""
+
+from photon_ml_tpu.evaluation.evaluators import (  # noqa: F401
+    EvaluationResults,
+    Evaluator,
+    auc_roc,
+    evaluate_all,
+    grouped_auc,
+    grouped_precision_at_k,
+    make_evaluator,
+    rmse,
+)

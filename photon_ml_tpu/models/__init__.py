@@ -1,0 +1,11 @@
+"""Model classes: GLM coefficient models and GAME composite models."""
+
+from photon_ml_tpu.models.glm import (  # noqa: F401
+    Coefficients,
+    GeneralizedLinearModel,
+    LinearRegressionModel,
+    LogisticRegressionModel,
+    PoissonRegressionModel,
+    SmoothedHingeLossLinearSVMModel,
+    model_for_task,
+)

@@ -1,0 +1,91 @@
+"""Generalized linear model classes.
+
+Reference parity: ``photon-api::ml.supervised.model.GeneralizedLinearModel``
+and subclasses (``classification.LogisticRegressionModel``,
+``classification.SmoothedHingeLossLinearSVMModel``,
+``regression.LinearRegressionModel``, ``regression.PoissonRegressionModel``)
+plus ``photon-api::ml.model.Coefficients`` (means + optional variances) —
+SURVEY.md §2.2.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.ops.batch import Batch
+from photon_ml_tpu.ops.losses import PointwiseLoss, loss_for_task
+from photon_ml_tpu.types import TaskType
+
+Array = jnp.ndarray
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["means", "variances"],
+    meta_fields=[],
+)
+@dataclass(frozen=True)
+class Coefficients:
+    """Model coefficients: means + optional per-coordinate variances
+    (produced by VarianceComputationType SIMPLE/FULL)."""
+
+    means: Array
+    variances: Array | None = None
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["coefficients"],
+    meta_fields=["task_type"],
+)
+@dataclass(frozen=True)
+class GeneralizedLinearModel:
+    """A GLM: coefficients + task type (which fixes loss and link)."""
+
+    coefficients: Coefficients
+    task_type: TaskType = TaskType.LOGISTIC_REGRESSION
+
+    @property
+    def loss(self) -> PointwiseLoss:
+        return loss_for_task(self.task_type)
+
+    def score(self, batch: Batch) -> Array:
+        """Raw margins w·x + offset (the quantity GAME coordinates
+        exchange)."""
+        return batch.matvec(self.coefficients.means) + batch.offsets
+
+    def predict(self, batch: Batch) -> Array:
+        """Mean response: inverse link applied to margins."""
+        return self.loss.mean(self.score(batch))
+
+
+class LogisticRegressionModel(GeneralizedLinearModel):
+    def __init__(self, coefficients: Coefficients):
+        super().__init__(coefficients, TaskType.LOGISTIC_REGRESSION)
+
+
+class LinearRegressionModel(GeneralizedLinearModel):
+    def __init__(self, coefficients: Coefficients):
+        super().__init__(coefficients, TaskType.LINEAR_REGRESSION)
+
+
+class PoissonRegressionModel(GeneralizedLinearModel):
+    def __init__(self, coefficients: Coefficients):
+        super().__init__(coefficients, TaskType.POISSON_REGRESSION)
+
+
+class SmoothedHingeLossLinearSVMModel(GeneralizedLinearModel):
+    def __init__(self, coefficients: Coefficients):
+        super().__init__(coefficients, TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM)
+
+
+def model_for_task(task: TaskType, coefficients: Coefficients) -> GeneralizedLinearModel:
+    return GeneralizedLinearModel(coefficients, task)

@@ -80,6 +80,17 @@ class NormalizationContext:
             delta = jnp.zeros_like(delta)
         return u, delta
 
+    def model_from_original_space(self, w_orig: Array) -> Array:
+        """Inverse of ``model_to_original_space`` (delta fully folded into the
+        intercept): map original-space coefficients into the space the
+        optimizer works in — used to warm-start from a saved model."""
+        w = w_orig / self.factors  # factors are 1 where undefined (builders)
+        if self.intercept_index is not None:
+            # forward: orig_int = w_int - s·(f⊙w); s has no intercept term
+            correction = jnp.dot(self.shifts, self.factors * w)
+            w = w.at[self.intercept_index].set(w_orig[self.intercept_index] + correction)
+        return w
+
 
 def no_normalization(num_features: int, intercept_index: int | None = None) -> NormalizationContext:
     return NormalizationContext(

@@ -1,0 +1,12 @@
+"""Device-resident batch optimizers (L-BFGS, OWL-QN, TRON).
+
+Reference parity: ``photon-lib::ml.optimization`` — the ``Optimizer`` trait
+and its ``LBFGS`` / ``OWLQN`` / ``TRON`` implementations (SURVEY.md §2.1).
+The reference runs these as driver-resident Breeze loops with one cluster
+round-trip per evaluation; here each optimizer is a jit-compiled
+``lax.while_loop`` that runs start-to-finish on device.
+"""
+
+from photon_ml_tpu.optim.common import OptimizationResult, make_optimizer  # noqa: F401
+from photon_ml_tpu.optim.lbfgs import lbfgs_minimize, owlqn_minimize  # noqa: F401
+from photon_ml_tpu.optim.tron import tron_minimize  # noqa: F401

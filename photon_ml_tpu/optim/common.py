@@ -1,0 +1,105 @@
+"""Shared optimizer machinery: results, convergence, state tracking.
+
+Reference parity: ``photon-lib::ml.optimization.{Optimizer, OptimizerState,
+OptimizationStatesTracker, OptimizerConfig}`` (SURVEY.md §2.1). The tracker
+is rebuilt as fixed-size device arrays written once per iteration (dynamic
+shapes are hostile to XLA), read back by the host after the solve.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.config import OptimizerConfig
+from photon_ml_tpu.types import OptimizerType
+
+Array = jnp.ndarray
+
+
+class ConvergenceReason(enum.IntEnum):
+    """Why the optimizer stopped (device-side int code)."""
+
+    MAX_ITERATIONS = 0
+    GRADIENT_CONVERGED = 1
+    OBJECTIVE_CONVERGED = 2  # relative function decrease below tolerance
+    LINE_SEARCH_FAILED = 3
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=[
+        "w",
+        "value",
+        "grad_norm",
+        "iterations",
+        "reason",
+        "loss_history",
+        "grad_norm_history",
+    ],
+    meta_fields=[],
+)
+@dataclass(frozen=True)
+class OptimizationResult:
+    """Solve output + per-iteration tracking (OptimizationStatesTracker
+    equivalent). ``loss_history[i]`` / ``grad_norm_history[i]`` are filled
+    for i <= iterations and hold the value at iterate i (i=0 is the initial
+    point); untouched slots are NaN."""
+
+    w: Array
+    value: Array
+    grad_norm: Array
+    iterations: Array  # int32
+    reason: Array  # int32, a ConvergenceReason value
+    loss_history: Array  # (max_iterations + 1,)
+    grad_norm_history: Array  # (max_iterations + 1,)
+
+    @property
+    def converged(self) -> Array:
+        return self.reason != ConvergenceReason.MAX_ITERATIONS
+
+    def summary(self) -> str:
+        """Host-side, human-readable run summary (PhotonLogger parity)."""
+        n = int(self.iterations)
+        lines = [
+            f"iterations={n} reason={ConvergenceReason(int(self.reason)).name} "
+            f"value={float(self.value):.6g} grad_norm={float(self.grad_norm):.3e}"
+        ]
+        losses = jax.device_get(self.loss_history)
+        gnorms = jax.device_get(self.grad_norm_history)
+        for i in range(n + 1):
+            lines.append(f"  iter {i:4d}: loss={losses[i]:.6g} |g|={gnorms[i]:.3e}")
+        return "\n".join(lines)
+
+
+def grad_converged(g_norm: Array, g0_norm: Array, tolerance: float) -> Array:
+    """Relative gradient-norm test (Breeze-style): ||g|| <= tol·max(1, ||g0||)."""
+    return g_norm <= tolerance * jnp.maximum(1.0, g0_norm)
+
+
+def select_minimize_fn(config: OptimizerConfig, l1_weight: float = 0.0) -> tuple[Callable, dict]:
+    """THE optimizer-selection rule (single source of truth, used by every
+    trainer): TRON if configured (rejecting L1, reference parity), else
+    OWL-QN when L1 is active, else L-BFGS. Returns (fn, extra_kwargs) where
+    ``fn(objective, w0, config, **extra_kwargs)`` runs the solve."""
+    from photon_ml_tpu.optim.lbfgs import lbfgs_minimize, owlqn_minimize
+    from photon_ml_tpu.optim.tron import tron_minimize
+
+    if config.optimizer_type is OptimizerType.TRON:
+        if l1_weight > 0.0:
+            raise ValueError("TRON does not support L1 regularization (reference parity)")
+        return tron_minimize, {}
+    if l1_weight > 0.0:
+        return owlqn_minimize, {"l1_weight": l1_weight}
+    return lbfgs_minimize, {}
+
+
+def make_optimizer(config: OptimizerConfig, l1_weight: float = 0.0) -> Callable:
+    """Bind an ``OptimizerConfig`` to ``minimize(objective, w0)``."""
+    fn, kwargs = select_minimize_fn(config, l1_weight)
+    return partial(fn, config=config, **kwargs)

@@ -1,0 +1,291 @@
+"""L-BFGS and OWL-QN as device-resident ``lax.while_loop`` programs.
+
+Reference parity: ``photon-lib::ml.optimization.LBFGS`` (wrapping
+``breeze.optimize.LBFGS``, history m=10) and ``OWLQN`` (orthant-wise L1
+variant, used whenever the L1 weight is positive) — SURVEY.md §2.1.
+
+TPU-first design:
+- The whole solve is one compiled program: two-loop recursion under
+  ``lax.fori_loop`` over a fixed-size ring buffer, backtracking Armijo line
+  search under ``lax.while_loop``, convergence checks on device. The
+  reference pays a driver↔cluster round-trip per objective evaluation; here
+  an "evaluation" is a fused matmul pass (+ one psum when sharded) and the
+  iteration loop never leaves the device.
+- History buffers are fixed (m, d) arrays with a ring index — no dynamic
+  shapes, so XLA compiles one tile layout for the whole run.
+- OWL-QN shares the implementation: the L1 machinery (pseudo-gradient,
+  orthant projection of direction and iterates) switches on statically, so
+  the plain L-BFGS path compiles with zero L1 overhead.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from photon_ml_tpu.config import OptimizerConfig
+from photon_ml_tpu.optim.common import (
+    ConvergenceReason,
+    OptimizationResult,
+    grad_converged,
+)
+
+Array = jnp.ndarray
+
+_ARMIJO_C1 = 1e-4
+_CURVATURE_EPS = 1e-10
+
+
+class _LbfgsState(NamedTuple):
+    w: Array
+    f: Array  # objective value at w (incl. L1 term for OWL-QN)
+    g: Array  # smooth gradient at w
+    pg: Array  # pseudo-gradient (== g when no L1)
+    S: Array  # (m, d) s-history ring
+    Y: Array  # (m, d) y-history ring
+    rho: Array  # (m,) 1/(sᵀy) ring
+    count: Array  # int32: number of pairs ever stored (ring head = count-1 mod m)
+    it: Array  # int32 iteration counter
+    reason: Array  # int32 ConvergenceReason; loop runs while MAX_ITERATIONS
+    done: Array  # bool
+    g0_norm: Array
+    loss_hist: Array
+    gnorm_hist: Array
+
+
+def _pseudo_gradient(w: Array, g: Array, l1w: Array) -> Array:
+    """OWL-QN pseudo-gradient: the minimal-norm subgradient of
+    f(w) + Σ l1wⱼ·|wⱼ|."""
+    gp = g + l1w
+    gm = g - l1w
+    at_zero = jnp.where(gp < 0.0, gp, jnp.where(gm > 0.0, gm, 0.0))
+    return jnp.where(w > 0.0, gp, jnp.where(w < 0.0, gm, at_zero))
+
+
+def _two_loop(pg: Array, S: Array, Y: Array, rho: Array, count: Array, m: int) -> Array:
+    """Two-loop recursion: returns r ≈ H⁻¹·pg using the ring-buffer history.
+    Unfilled slots contribute exactly zero (their alpha/beta are masked)."""
+    valid_n = jnp.minimum(count, m)
+
+    def bwd(i, carry):
+        q, alpha = carry
+        slot = jnp.mod(count - 1 - i, m)
+        valid = i < valid_n
+        a = jnp.where(valid, rho[slot] * jnp.dot(S[slot], q), 0.0)
+        q = q - a * Y[slot]
+        return q, alpha.at[slot].set(a)
+
+    q, alpha = lax.fori_loop(0, m, bwd, (pg, jnp.zeros((m,), pg.dtype)))
+
+    newest = jnp.mod(count - 1, m)
+    yy = jnp.dot(Y[newest], Y[newest])
+    gamma = jnp.where(count > 0, jnp.dot(S[newest], Y[newest]) / jnp.maximum(yy, 1e-30), 1.0)
+    r = gamma * q
+
+    def fwd(i, r):
+        slot = jnp.mod(count - valid_n + i, m)
+        valid = i < valid_n
+        beta = rho[slot] * jnp.dot(Y[slot], r)
+        r = r + jnp.where(valid, alpha[slot] - beta, 0.0) * S[slot]
+        return r
+
+    return lax.fori_loop(0, m, fwd, r)
+
+
+def _lbfgs_impl(
+    objective: Any,
+    w0: Array,
+    config: OptimizerConfig,
+    l1w: Array | None,
+) -> OptimizationResult:
+    """Shared L-BFGS / OWL-QN loop. ``l1w`` is None (static) for plain
+    L-BFGS, else the per-coordinate L1 weight vector (λ₁ · reg_mask)."""
+    m = config.history_length
+    T = config.max_iterations
+    use_l1 = l1w is not None
+    d = w0.shape[0]
+    dtype = w0.dtype
+
+    def full_value(w: Array) -> Array:
+        v = objective.value(w)
+        if use_l1:
+            v = v + jnp.sum(l1w * jnp.abs(w))
+        return v
+
+    def value_and_grads(w: Array):
+        f, g = objective.value_and_grad(w)
+        if use_l1:
+            f = f + jnp.sum(l1w * jnp.abs(w))
+            pg = _pseudo_gradient(w, g, l1w)
+        else:
+            pg = g
+        return f, g, pg
+
+    f0, g0, pg0 = value_and_grads(w0)
+    g0_norm = jnp.linalg.norm(pg0)
+
+    loss_hist = jnp.full((T + 1,), jnp.nan, dtype)
+    gnorm_hist = jnp.full((T + 1,), jnp.nan, dtype)
+    loss_hist = loss_hist.at[0].set(f0)
+    gnorm_hist = gnorm_hist.at[0].set(g0_norm)
+
+    init = _LbfgsState(
+        w=w0,
+        f=f0,
+        g=g0,
+        pg=pg0,
+        S=jnp.zeros((m, d), dtype),
+        Y=jnp.zeros((m, d), dtype),
+        rho=jnp.zeros((m,), dtype),
+        count=jnp.int32(0),
+        it=jnp.int32(0),
+        reason=jnp.int32(ConvergenceReason.MAX_ITERATIONS),
+        done=grad_converged(g0_norm, g0_norm, config.tolerance),
+        g0_norm=g0_norm,
+        loss_hist=loss_hist,
+        gnorm_hist=gnorm_hist,
+    )
+
+    def cond(st: _LbfgsState):
+        return jnp.logical_and(st.it < T, jnp.logical_not(st.done))
+
+    def body(st: _LbfgsState) -> _LbfgsState:
+        p = -_two_loop(st.pg, st.S, st.Y, st.rho, st.count, m)
+        if use_l1:
+            # constrain the search direction to the descent orthant
+            p = jnp.where(p * (-st.pg) > 0.0, p, 0.0)
+        # fall back to steepest descent if the direction isn't a descent dir
+        descent = jnp.dot(p, st.pg) < 0.0
+        p = jnp.where(descent, p, -st.pg)
+
+        if use_l1:
+            xi = jnp.where(st.w != 0.0, jnp.sign(st.w), jnp.sign(-st.pg))
+
+            def trial_point(t):
+                x = st.w + t * p
+                return jnp.where(jnp.sign(x) == xi, x, 0.0)
+
+        else:
+
+            def trial_point(t):
+                return st.w + t * p
+
+        # First iteration: the Hessian guess is the identity, so scale the
+        # initial step to unit length (Breeze does the same for iter 0).
+        p_norm = jnp.linalg.norm(p)
+        t0 = jnp.where(st.count == 0, 1.0 / jnp.maximum(1.0, p_norm), 1.0)
+
+        def ls_cond(carry):
+            t, f_new, w_new, k = carry
+            # Armijo on the (possibly projected) actual step
+            rhs = st.f + _ARMIJO_C1 * jnp.dot(st.pg, w_new - st.w)
+            insufficient = jnp.logical_or(f_new > rhs, jnp.isnan(f_new))
+            return jnp.logical_and(insufficient, k < config.max_line_search_steps)
+
+        def ls_body(carry):
+            t, _, _, k = carry
+            t_new = t * 0.5
+            w_new = trial_point(t_new)
+            return t_new, full_value(w_new), w_new, k + 1
+
+        w_try = trial_point(t0)
+        t, f_new, w_new, _ = lax.while_loop(
+            ls_cond, ls_body, (t0, full_value(w_try), w_try, jnp.int32(0))
+        )
+        rhs = st.f + _ARMIJO_C1 * jnp.dot(st.pg, w_new - st.w)
+        ls_ok = jnp.logical_and(f_new <= rhs, jnp.logical_not(jnp.isnan(f_new)))
+
+        f2, g2, pg2 = value_and_grads(w_new)
+        s = w_new - st.w
+        y = g2 - st.g
+        sy = jnp.dot(s, y)
+        store = jnp.logical_and(ls_ok, sy > _CURVATURE_EPS)
+        slot = jnp.mod(st.count, m)
+        S = jnp.where(store, st.S.at[slot].set(s), st.S)
+        Y = jnp.where(store, st.Y.at[slot].set(y), st.Y)
+        rho = jnp.where(store, st.rho.at[slot].set(1.0 / jnp.maximum(sy, _CURVATURE_EPS)), st.rho)
+        count = jnp.where(store, st.count + 1, st.count)
+
+        g2_norm = jnp.linalg.norm(pg2)
+        converged = grad_converged(g2_norm, st.g0_norm, config.tolerance)
+
+        # On line-search failure keep the old iterate and stop.
+        w_out = jnp.where(ls_ok, w_new, st.w)
+        f_out = jnp.where(ls_ok, f2, st.f)
+        g_out = jnp.where(ls_ok, g2, st.g)
+        pg_out = jnp.where(ls_ok, pg2, st.pg)
+        reason = jnp.where(
+            jnp.logical_not(ls_ok),
+            jnp.int32(ConvergenceReason.LINE_SEARCH_FAILED),
+            jnp.where(
+                converged,
+                jnp.int32(ConvergenceReason.GRADIENT_CONVERGED),
+                jnp.int32(ConvergenceReason.MAX_ITERATIONS),
+            ),
+        )
+        done = jnp.logical_or(jnp.logical_not(ls_ok), converged)
+
+        it = st.it + 1
+        loss_hist = st.loss_hist.at[it].set(f_out)
+        gnorm_hist = st.gnorm_hist.at[it].set(jnp.linalg.norm(pg_out))
+
+        return _LbfgsState(
+            w=w_out,
+            f=f_out,
+            g=g_out,
+            pg=pg_out,
+            S=S,
+            Y=Y,
+            rho=rho,
+            count=count,
+            it=it,
+            reason=reason,
+            done=done,
+            g0_norm=st.g0_norm,
+            loss_hist=loss_hist,
+            gnorm_hist=gnorm_hist,
+        )
+
+    final = lax.while_loop(cond, body, init)
+    # If we stopped because the initial point already satisfied the test:
+    reason = jnp.where(
+        jnp.logical_and(final.it == 0, final.done),
+        jnp.int32(ConvergenceReason.GRADIENT_CONVERGED),
+        final.reason,
+    )
+    return OptimizationResult(
+        w=final.w,
+        value=final.f,
+        grad_norm=jnp.linalg.norm(final.pg),
+        iterations=final.it,
+        reason=reason,
+        loss_history=final.loss_hist,
+        grad_norm_history=final.gnorm_hist,
+    )
+
+
+@partial(jax.jit, static_argnames=("config",))
+def lbfgs_minimize(objective: Any, w0: Array, config: OptimizerConfig) -> OptimizationResult:
+    """Minimize a smooth objective with L-BFGS.
+
+    ``objective`` is any pytree exposing ``value(w)`` and
+    ``value_and_grad(w)`` (e.g. ``GLMObjective``).
+    """
+    return _lbfgs_impl(objective, w0, config, None)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def owlqn_minimize(
+    objective: Any,
+    w0: Array,
+    config: OptimizerConfig,
+    l1_weight: Array | float,
+) -> OptimizationResult:
+    """Minimize objective(w) + λ₁·Σ|wⱼ| (over the objective's regularized
+    coordinates) with OWL-QN. Requires ``objective.reg_mask``."""
+    l1w = jnp.asarray(l1_weight, w0.dtype) * objective.reg_mask
+    return _lbfgs_impl(objective, w0, config, l1w)

@@ -1,0 +1,14 @@
+"""Distributed runtime: device meshes, sharded objectives, collectives.
+
+Replaces the reference's Spark communication layer (SURVEY.md §2.7):
+TorrentBroadcast + treeAggregate become ``lax.psum`` over ICI inside
+``shard_map``; the shuffle disappears entirely (entity grouping happens at
+ingest — see ``data.entity_index``).
+"""
+
+from photon_ml_tpu.parallel.mesh import data_mesh, local_device_count  # noqa: F401
+from photon_ml_tpu.parallel.distributed import (  # noqa: F401
+    DistributedTrainer,
+    shard_batch,
+    sharded_minimize,
+)

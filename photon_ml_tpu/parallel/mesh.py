@@ -1,0 +1,30 @@
+"""Mesh construction helpers.
+
+The framework uses one logical mesh with a ``data`` axis (sample sharding —
+the reference's executor data parallelism) and, for random effects, an
+``entity`` view of the same devices (entity sharding — the reference's
+``RandomEffectDatasetPartitioner``). On multi-host TPU slices the mesh spans
+all hosts (``jax.devices()`` is global under ``jax.distributed``), so the
+same code scales from 1 chip to a pod: XLA routes the psums over ICI/DCN.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+def data_mesh(
+    num_devices: int | None = None, axis_name: str = "data", devices=None
+) -> Mesh:
+    """A 1-D mesh over all (or the first ``num_devices``) devices."""
+    devs = list(devices if devices is not None else jax.devices())
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    return Mesh(np.array(devs), (axis_name,))

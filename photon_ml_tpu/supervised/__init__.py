@@ -1,0 +1,3 @@
+"""Single-model supervised training (legacy GLM driver parity)."""
+
+from photon_ml_tpu.supervised.training import GLMTrainingResult, train_glm  # noqa: F401

@@ -1,0 +1,183 @@
+"""Single-GLM training: regularization sweep with warm start, validation,
+model selection, optional coefficient variances.
+
+Reference parity: ``photon-client::ml.ModelTraining.trainGeneralizedLinearModel``
++ the legacy ``Driver`` pipeline (SURVEY.md §3.2): for each λ in ascending
+order, train (warm-starting from the previous λ's model), validate, select
+best; optionally compute coefficient variances from the Hessian.
+
+TPU-first: each λ's solve is one compiled device program (the optimizer
+while-loop); the sweep is a short host loop that re-enters the same compiled
+executable (shapes don't change with λ, and λ is a traced array, so there is
+exactly ONE compilation for the whole sweep).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.config import OptimizerConfig, RegularizationContext
+from photon_ml_tpu.evaluation import EvaluationResults, evaluate_all, make_evaluator
+from photon_ml_tpu.models import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.normalization import NormalizationContext
+from photon_ml_tpu.ops.batch import Batch
+from photon_ml_tpu.ops.glm import GLMObjective, make_objective
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.optim.common import OptimizationResult, select_minimize_fn
+from photon_ml_tpu.types import OptimizerType, TaskType, VarianceComputationType
+
+Array = jnp.ndarray
+
+
+@dataclass(frozen=True)
+class GLMTrainingResult:
+    """Per-λ models + diagnostics, and the selected best model."""
+
+    models: Mapping[float, GeneralizedLinearModel]
+    trackers: Mapping[float, OptimizationResult]
+    validation: Mapping[float, EvaluationResults]
+    best_weight: float | None
+
+    @property
+    def best_model(self) -> GeneralizedLinearModel:
+        if self.best_weight is None:
+            # no validation data: last λ (reference picks by validation;
+            # without it the sweep's final — most regularized — model)
+            return self.models[list(self.models)[-1]]
+        return self.models[self.best_weight]
+
+
+def _compute_variances(
+    obj: GLMObjective, w: Array, variance_type: VarianceComputationType
+) -> Array | None:
+    """Parity: ``VarianceComputationType`` — SIMPLE inverts the Hessian
+    diagonal; FULL takes the diagonal of the full Hessian inverse."""
+    if variance_type is VarianceComputationType.NONE:
+        return None
+    if variance_type is VarianceComputationType.SIMPLE:
+        return 1.0 / jnp.maximum(obj.hessian_diag(w), 1e-12)
+    H = obj.hessian(w)
+    d = H.shape[0]
+    Hinv = jnp.linalg.inv(H + 1e-9 * jnp.eye(d, dtype=H.dtype))
+    return jnp.diag(Hinv)
+
+
+def train_glm(
+    batch: Batch,
+    task: TaskType,
+    optimizer_config: OptimizerConfig | None = None,
+    regularization: RegularizationContext | None = None,
+    regularization_weights: Sequence[float] = (0.0,),
+    normalization: NormalizationContext | None = None,
+    intercept_index: int | None = None,
+    validation_batch: Batch | None = None,
+    evaluators: Sequence[str] = (),
+    variance_computation: VarianceComputationType = VarianceComputationType.NONE,
+    initial_model: GeneralizedLinearModel | None = None,
+    axis_name: str | None = None,
+) -> GLMTrainingResult:
+    """Train one GLM per regularization weight (ascending, warm-started),
+    validate each, and select the best by the first evaluator.
+
+    When ``axis_name`` is set the caller is responsible for invoking this
+    inside ``shard_map`` (the distributed layer wraps it); the code is
+    identical either way.
+    """
+    optimizer_config = optimizer_config or OptimizerConfig()
+    if regularization is None:
+        # default: nonzero weights imply plain L2 (asking for λ>0 with type
+        # NONE would silently train unregularized — an easy trap)
+        from photon_ml_tpu.types import RegularizationType
+
+        has_weights = any(w > 0 for w in regularization_weights)
+        regularization = RegularizationContext(
+            RegularizationType.L2 if has_weights else RegularizationType.NONE
+        )
+    elif regularization.regularization_type.value == "NONE" and any(
+        w > 0 for w in regularization_weights
+    ):
+        raise ValueError(
+            "regularization_weights > 0 with RegularizationType.NONE would be "
+            "silently ignored; pass an L1/L2/ELASTIC_NET context or drop the weights"
+        )
+    loss = loss_for_task(task)
+    d = batch.num_features
+    dtype = batch.labels.dtype
+
+    if normalization is not None and normalization.intercept_index is None:
+        if np.any(np.asarray(normalization.shifts) != 0.0):
+            raise ValueError(
+                "normalization with shifts (STANDARDIZATION) requires an "
+                "intercept column to absorb the shift on the output model"
+            )
+
+    # The optimizer works in NORMALIZED coefficient space; models are kept in
+    # ORIGINAL space (the reference un-applies factors on the final model).
+    if initial_model is not None:
+        w = jnp.asarray(initial_model.coefficients.means, dtype)
+        if normalization is not None:
+            w = normalization.model_from_original_space(w)
+    else:
+        w = jnp.zeros((d,), dtype)
+
+    specs = list(evaluators)
+    if validation_batch is not None and not specs:
+        specs = {
+            TaskType.LOGISTIC_REGRESSION: ["AUC"],
+            TaskType.LINEAR_REGRESSION: ["RMSE"],
+            TaskType.POISSON_REGRESSION: ["POISSON_LOSS"],
+            TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: ["AUC"],
+        }[task]
+    primary = make_evaluator(specs[0]) if specs else None
+
+    models: dict[float, GeneralizedLinearModel] = {}
+    trackers: dict[float, OptimizationResult] = {}
+    validation: dict[float, EvaluationResults] = {}
+    best_weight: float | None = None
+    best_value = float("nan")
+
+    # ascending λ with warm start (reference sweeps the same way)
+    for lam in sorted(regularization_weights):
+        l1 = regularization.l1_weight(lam)
+        l2 = regularization.l2_weight(lam)
+        obj = make_objective(
+            batch,
+            loss,
+            l2_weight=l2,
+            norm=normalization,
+            intercept_index=intercept_index,
+            axis_name=axis_name,
+        )
+        minimize_fn, extra = select_minimize_fn(optimizer_config, l1)
+        result = minimize_fn(obj, w, optimizer_config, **extra)
+        w = result.w  # warm start the next λ (normalized space)
+
+        variances = _compute_variances(obj, result.w, variance_computation)
+        w_model = result.w
+        if normalization is not None:
+            w_model, _ = normalization.model_to_original_space(result.w)
+            if variances is not None:
+                # linear map u = f⊙w ⇒ var scales by f² (diagonal approx.)
+                variances = normalization.factors**2 * variances
+        model = GeneralizedLinearModel(Coefficients(w_model, variances), task)
+        models[lam] = model
+        trackers[lam] = result
+
+        if validation_batch is not None and specs:
+            scores = model.predict(validation_batch)
+            res = evaluate_all(
+                specs, scores, validation_batch.labels, validation_batch.weights
+            )
+            validation[lam] = res
+            if primary is not None and (
+                best_weight is None or primary.better(res.primary, best_value)
+            ):
+                best_weight, best_value = lam, res.primary
+
+    return GLMTrainingResult(
+        models=models, trackers=trackers, validation=validation, best_weight=best_weight
+    )

@@ -1,0 +1,83 @@
+"""Data layer tests: LIBSVM parsing, index maps, summaries."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from photon_ml_tpu.data import IndexMap, read_libsvm, summarize
+from photon_ml_tpu.data.index_map import INTERCEPT_KEY, feature_key
+from photon_ml_tpu.ops.batch import dense_batch_from_numpy
+from photon_ml_tpu.types import NormalizationType
+
+LIBSVM_SAMPLE = """\
++1 1:0.5 3:1.5 10:2.0
+-1 2:1.0 # a comment
++1 1:-0.25
+-1 3:0.75 10:-1.0
+"""
+
+
+@pytest.fixture
+def libsvm_file(tmp_path):
+    p = tmp_path / "sample.txt"
+    p.write_text(LIBSVM_SAMPLE)
+    return str(p)
+
+
+def test_libsvm_dense_sparse_equivalence(libsvm_file, rng):
+    dense, ii_d = read_libsvm(libsvm_file, dense=True)
+    sparse, ii_s = read_libsvm(libsvm_file, dense=False)
+    assert ii_d == ii_s == 10  # 1-based max index 10 → 10 raw features, intercept at 10
+    assert dense.num_features == sparse.num_features == 11
+    np.testing.assert_allclose(dense.labels, [1, 0, 1, 0])
+    np.testing.assert_allclose(dense.labels, sparse.labels)
+    w = jnp.asarray(rng.normal(size=11))
+    np.testing.assert_allclose(dense.matvec(w), sparse.matvec(w), rtol=1e-6)
+    r = jnp.asarray(rng.normal(size=4))
+    np.testing.assert_allclose(dense.rmatvec(r), sparse.rmatvec(r), rtol=1e-6, atol=1e-7)
+
+
+def test_libsvm_out_of_range_index_rejected(libsvm_file):
+    with pytest.raises(ValueError, match="out of range"):
+        read_libsvm(libsvm_file, num_features=5)
+
+
+def test_index_map_roundtrip(tmp_path):
+    keys = [feature_key("age"), feature_key("country", "us"), feature_key("country", "uk")]
+    im = IndexMap.build(keys + keys, add_intercept=True)  # dupes ignored
+    assert len(im) == 4
+    assert im.intercept_index == 3
+    assert im.get(feature_key("country", "uk")) == 2
+    assert im.get("missing") == -1
+    assert feature_key("age") in im
+    looked = im.lookup_all(np.array([keys[0], "nope", keys[2], INTERCEPT_KEY]))
+    np.testing.assert_array_equal(looked, [0, -1, 2, 3])
+    path = str(tmp_path / "idx")
+    im.save(path)
+    im2 = IndexMap.load(path)
+    assert dict(im.items()) == dict(im2.items())
+
+
+def test_summary_and_normalization(rng):
+    X = rng.normal(loc=3.0, scale=2.0, size=(500, 4))
+    X[:, -1] = 1.0
+    batch = dense_batch_from_numpy(X, np.zeros(500))
+    s = summarize(batch)
+    np.testing.assert_allclose(s.mean, X.mean(0), rtol=1e-5)
+    np.testing.assert_allclose(s.variance, X.var(0), rtol=1e-5)
+    np.testing.assert_allclose(s.max_magnitude, np.abs(X).max(0), rtol=1e-6)
+    assert s.count == 500
+    s2 = type(s).from_json(s.to_json())
+    np.testing.assert_allclose(s2.mean, s.mean)
+    norm = s.normalization(NormalizationType.STANDARDIZATION, intercept_index=3)
+    np.testing.assert_allclose(np.asarray(norm.shifts)[:3], X.mean(0)[:3], rtol=1e-5)
+    assert float(norm.factors[3]) == 1.0 and float(norm.shifts[3]) == 0.0
+
+
+def test_summary_weighted(rng):
+    X = np.array([[1.0], [3.0], [100.0]])
+    batch = dense_batch_from_numpy(X, np.zeros(3), weights=np.array([1.0, 1.0, 0.0]))
+    s = summarize(batch)
+    np.testing.assert_allclose(s.mean, [2.0])
+    assert s.count == 2
+    np.testing.assert_allclose(s.max, [3.0])  # zero-weight row excluded from extremes

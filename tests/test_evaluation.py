@@ -1,0 +1,105 @@
+"""Evaluator tests: AUC vs a naive O(n²) reference, grouped metrics vs a
+per-group python loop, registry parsing."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.evaluation import (
+    auc_roc,
+    evaluate_all,
+    grouped_auc,
+    grouped_precision_at_k,
+    make_evaluator,
+    rmse,
+)
+
+
+def _naive_auc(scores, labels):
+    pos = scores[labels > 0]
+    neg = scores[labels <= 0]
+    if len(pos) == 0 or len(neg) == 0:
+        return float("nan")
+    wins = (pos[:, None] > neg[None, :]).sum() + 0.5 * (pos[:, None] == neg[None, :]).sum()
+    return wins / (len(pos) * len(neg))
+
+
+def test_auc_matches_naive(rng):
+    scores = rng.normal(size=200)
+    labels = (rng.uniform(size=200) < 0.4).astype(float)
+    np.testing.assert_allclose(float(auc_roc(scores, labels)), _naive_auc(scores, labels), rtol=1e-9)
+
+
+def test_auc_with_ties_and_weights(rng):
+    scores = rng.integers(0, 5, size=300).astype(float)  # heavy ties
+    labels = (rng.uniform(size=300) < 0.5).astype(float)
+    np.testing.assert_allclose(float(auc_roc(scores, labels)), _naive_auc(scores, labels), rtol=1e-9)
+    # weight-0 rows must be excluded
+    w = np.ones(300)
+    w[100:] = 0.0
+    np.testing.assert_allclose(
+        float(auc_roc(scores, labels, w)), _naive_auc(scores[:100], labels[:100]), rtol=1e-9
+    )
+
+
+def test_auc_degenerate_single_class():
+    assert np.isnan(float(auc_roc(np.array([1.0, 2.0]), np.array([1.0, 1.0]))))
+
+
+def test_rmse(rng):
+    s = rng.normal(size=50)
+    y = rng.normal(size=50)
+    np.testing.assert_allclose(float(rmse(s, y)), np.sqrt(np.mean((s - y) ** 2)), rtol=1e-6)
+
+
+def test_grouped_auc_matches_per_group_loop(rng):
+    n = 500
+    gids = rng.integers(0, 20, size=n)
+    scores = rng.normal(size=n)
+    labels = (rng.uniform(size=n) < 0.5).astype(float)
+    vals = []
+    for g in np.unique(gids):
+        m = gids == g
+        v = _naive_auc(scores[m], labels[m])
+        if not np.isnan(v):
+            vals.append(v)
+    np.testing.assert_allclose(grouped_auc(scores, labels, gids), np.mean(vals), rtol=1e-9)
+
+
+def test_grouped_precision_at_k_matches_loop(rng):
+    n = 400
+    k = 3
+    gids = rng.integers(0, 15, size=n)
+    scores = rng.normal(size=n)
+    labels = (rng.uniform(size=n) < 0.3).astype(float)
+    vals = []
+    for g in np.unique(gids):
+        m = gids == g
+        order = np.argsort(-scores[m])
+        top = labels[m][order][:k]
+        vals.append(top.sum() / min(m.sum(), k))
+    np.testing.assert_allclose(
+        grouped_precision_at_k(scores, labels, gids, k), np.mean(vals), rtol=1e-9
+    )
+
+
+def test_registry_parsing():
+    assert make_evaluator("AUC").larger_is_better
+    assert not make_evaluator("rmse").larger_is_better
+    e = make_evaluator("MULTI_AUC(userId)")
+    assert e.group_by == "userId"
+    e = make_evaluator("PRECISION_AT_K(5,songId)")
+    assert e.k == 5 and e.group_by == "songId"
+    with pytest.raises(ValueError):
+        make_evaluator("F1")
+
+
+def test_evaluate_all_with_groups(rng):
+    n = 100
+    scores = rng.normal(size=n)
+    labels = (rng.uniform(size=n) < 0.5).astype(float)
+    gids = {"userId": rng.integers(0, 5, size=n)}
+    res = evaluate_all(["AUC", "MULTI_AUC(userId)"], scores, labels, None, gids)
+    assert set(res.metrics) == {"AUC", "MULTI_AUC(userId)"}
+    assert res.primary == res.metrics["AUC"]
+    assert make_evaluator("AUC").better(0.9, 0.5)
+    assert make_evaluator("RMSE").better(0.1, 0.5)

@@ -1,0 +1,186 @@
+"""End-to-end GLM slice (bench configs A/B/C shape): data → train with λ
+sweep + warm start → validate → select best → variances."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.config import OptimizerConfig, RegularizationContext
+from photon_ml_tpu.data import synthetic_glm_data
+from photon_ml_tpu.data.libsvm import read_libsvm
+from photon_ml_tpu.ops.batch import dense_batch_from_numpy
+from photon_ml_tpu.supervised import train_glm
+from photon_ml_tpu.types import (
+    NormalizationType,
+    OptimizerType,
+    RegularizationType,
+    TaskType,
+    VarianceComputationType,
+)
+
+
+def _split(batch, n_train):
+    import jax
+
+    head = jax.tree.map(lambda a: a[:n_train], batch)
+    tail = jax.tree.map(lambda a: a[n_train:], batch)
+    return head, tail
+
+
+def test_logistic_sweep_warm_start_and_selection(rng):
+    batch, ii, w_true = synthetic_glm_data(rng, 1200, 8, TaskType.LOGISTIC_REGRESSION)
+    train, valid = _split(batch, 1000)
+    res = train_glm(
+        train,
+        TaskType.LOGISTIC_REGRESSION,
+        OptimizerConfig(max_iterations=100, tolerance=1e-8),
+        RegularizationContext(RegularizationType.L2),
+        regularization_weights=[0.1, 1.0, 10.0],
+        intercept_index=ii,
+        validation_batch=valid,
+        evaluators=["AUC", "LOGISTIC_LOSS"],
+    )
+    assert set(res.models) == {0.1, 1.0, 10.0}
+    assert res.best_weight in res.models
+    auc = res.validation[res.best_weight].metrics["AUC"]
+    assert auc > 0.7, f"AUC {auc} too low — model isn't learning"
+    # recovered direction should correlate with ground truth
+    w = np.asarray(res.best_model.coefficients.means)
+    cos = np.dot(w, w_true) / (np.linalg.norm(w) * np.linalg.norm(w_true))
+    assert cos > 0.8
+
+
+def test_linear_tron_with_normalization(rng):
+    batch, ii, w_true = synthetic_glm_data(rng, 800, 6, TaskType.LINEAR_REGRESSION)
+    # stretch features to make normalization matter
+    X = np.array(batch.X)  # writable copy
+    X[:, 0] *= 50.0
+    scaled = dense_batch_from_numpy(X, np.asarray(batch.labels))
+    from photon_ml_tpu.data import summarize
+
+    norm = summarize(scaled).normalization(NormalizationType.STANDARDIZATION, ii)
+    res = train_glm(
+        scaled,
+        TaskType.LINEAR_REGRESSION,
+        OptimizerConfig(optimizer_type=OptimizerType.TRON, max_iterations=60, tolerance=1e-10),
+        RegularizationContext(RegularizationType.L2),
+        regularization_weights=[1e-3],
+        normalization=norm,
+        intercept_index=ii,
+    )
+    model = res.best_model
+    # the returned model is in ORIGINAL feature space: predict directly
+    pred = np.asarray(model.predict(scaled))
+    resid = pred - np.asarray(batch.labels)
+    assert np.sqrt((resid**2).mean()) < 0.2
+    # validation in train_glm must agree with direct scoring
+    res2 = train_glm(
+        scaled,
+        TaskType.LINEAR_REGRESSION,
+        OptimizerConfig(optimizer_type=OptimizerType.TRON, max_iterations=60, tolerance=1e-10),
+        RegularizationContext(RegularizationType.L2),
+        regularization_weights=[1e-3],
+        normalization=norm,
+        intercept_index=ii,
+        validation_batch=scaled,
+        evaluators=["RMSE"],
+    )
+    reported = res2.validation[1e-3].metrics["RMSE"]
+    assert abs(reported - np.sqrt((resid**2).mean())) < 1e-3
+
+
+def test_poisson_and_variances(rng):
+    batch, ii, _ = synthetic_glm_data(rng, 600, 5, TaskType.POISSON_REGRESSION)
+    res = train_glm(
+        batch,
+        TaskType.POISSON_REGRESSION,
+        OptimizerConfig(max_iterations=100, tolerance=1e-8),
+        regularization_weights=[0.5],
+        intercept_index=ii,
+        variance_computation=VarianceComputationType.SIMPLE,
+    )
+    v_simple = np.asarray(res.best_model.coefficients.variances)
+    assert v_simple.shape == (6,) and np.all(v_simple > 0)
+    res_full = train_glm(
+        batch,
+        TaskType.POISSON_REGRESSION,
+        OptimizerConfig(max_iterations=100, tolerance=1e-8),
+        regularization_weights=[0.5],
+        intercept_index=ii,
+        variance_computation=VarianceComputationType.FULL,
+    )
+    v_full = np.asarray(res_full.best_model.coefficients.variances)
+    # SIMPLE (inverse diag) and FULL (diag of inverse) agree on order of magnitude
+    assert np.all(v_full > 0)
+    ratio = v_full / v_simple
+    assert np.all(ratio > 0.3) and np.all(ratio < 30)
+
+
+def test_elastic_net_produces_sparsity(rng):
+    batch, ii, _ = synthetic_glm_data(rng, 500, 12, TaskType.LOGISTIC_REGRESSION)
+    res = train_glm(
+        batch,
+        TaskType.LOGISTIC_REGRESSION,
+        OptimizerConfig(max_iterations=200, tolerance=1e-8),
+        RegularizationContext(RegularizationType.ELASTIC_NET, alpha=0.9),
+        regularization_weights=[30.0],
+        intercept_index=ii,
+    )
+    w = np.asarray(res.best_model.coefficients.means)
+    assert (w[:-1] == 0).sum() > 0, "elastic net at high λ should zero some coords"
+    assert abs(w[-1]) > 0  # intercept unpenalized
+
+
+def test_warm_start_from_initial_model(rng):
+    # float64: the test asserts re-convergence at the optimum, which needs
+    # gradient norms far below float32 resolution
+    batch, ii, _ = synthetic_glm_data(
+        rng, 400, 6, TaskType.LOGISTIC_REGRESSION, dtype=np.float64
+    )
+    res1 = train_glm(
+        batch,
+        TaskType.LOGISTIC_REGRESSION,
+        OptimizerConfig(max_iterations=100, tolerance=1e-8),
+        regularization_weights=[1.0],
+        intercept_index=ii,
+    )
+    res2 = train_glm(
+        batch,
+        TaskType.LOGISTIC_REGRESSION,
+        OptimizerConfig(max_iterations=100, tolerance=1e-8),
+        regularization_weights=[1.0],
+        intercept_index=ii,
+        initial_model=res1.best_model,
+    )
+    t1, t2 = res1.trackers[1.0], res2.trackers[1.0]
+    # the warm-started solve begins exactly where the cold one ended...
+    np.testing.assert_allclose(float(t2.loss_history[0]), float(t1.value), rtol=1e-12)
+    np.testing.assert_allclose(float(t2.grad_norm_history[0]), float(t1.grad_norm), rtol=1e-9)
+    # ...and never degrades it
+    assert float(t2.value) <= float(t1.value) + 1e-12
+
+
+def test_libsvm_end_to_end(tmp_path, rng):
+    # synthesize a tiny LIBSVM file and train on it (config A shape)
+    n, d = 300, 20
+    X = (rng.uniform(size=(n, d)) < 0.3) * rng.normal(size=(n, d))
+    w_true = rng.normal(size=d)
+    y = np.where(X @ w_true > 0, 1, -1)
+    lines = []
+    for i in range(n):
+        nz = np.flatnonzero(X[i])
+        feats = " ".join(f"{j+1}:{X[i, j]:.6f}" for j in nz)
+        lines.append(f"{y[i]} {feats}")
+    p = tmp_path / "train.libsvm"
+    p.write_text("\n".join(lines) + "\n")
+    batch, ii = read_libsvm(str(p), num_features=d)
+    res = train_glm(
+        batch,
+        TaskType.LOGISTIC_REGRESSION,
+        OptimizerConfig(max_iterations=100, tolerance=1e-7),
+        regularization_weights=[0.01],
+        intercept_index=ii,
+        validation_batch=batch,
+        evaluators=["AUC"],
+    )
+    assert res.validation[0.01].metrics["AUC"] > 0.95  # separable-ish training fit
