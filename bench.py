@@ -19,7 +19,13 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 from __future__ import annotations
 
 import json
+import os
 import time
+
+# The CPU proxy must measure ONE core (it models one Spark executor core).
+# BLAS pools size themselves at first numpy import, so pin before importing.
+for _v in ("OMP_NUM_THREADS", "OPENBLAS_NUM_THREADS", "MKL_NUM_THREADS"):
+    os.environ.setdefault(_v, "1")
 
 import numpy as np
 

@@ -51,6 +51,20 @@ def parse_libsvm(
     return y, rows_idx, rows_val
 
 
+def _feature_dim(
+    rows_idx: list[np.ndarray], num_features: int | None, add_intercept: bool
+) -> tuple[int, int, int | None]:
+    """Shared index-derivation/bounds-check for both packing paths.
+    Returns (d_raw, d_total, intercept_index); the intercept always gets
+    the LAST column."""
+    max_idx = max((int(r.max()) for r in rows_idx if len(r)), default=-1)
+    d_raw = num_features if num_features is not None else max_idx + 1
+    if max_idx >= d_raw:
+        raise ValueError(f"feature index {max_idx} out of range for num_features={d_raw}")
+    intercept_index = d_raw if add_intercept else None
+    return d_raw, d_raw + (1 if add_intercept else 0), intercept_index
+
+
 def to_padded_sparse(
     labels: np.ndarray,
     rows_idx: list[np.ndarray],
@@ -68,12 +82,7 @@ def to_padded_sparse(
     import jax.numpy as jnp
 
     n = len(rows_idx)
-    max_idx = max((int(r.max()) for r in rows_idx if len(r)), default=-1)
-    d_raw = num_features if num_features is not None else max_idx + 1
-    if max_idx >= d_raw:
-        raise ValueError(f"feature index {max_idx} out of range for num_features={d_raw}")
-    intercept_index = d_raw if add_intercept else None
-    d = d_raw + (1 if add_intercept else 0)
+    d_raw, d, intercept_index = _feature_dim(rows_idx, num_features, add_intercept)
     k = max((len(r) for r in rows_idx), default=0) + (1 if add_intercept else 0)
     k = max(k, 1)
     k = -(-k // pad_to_multiple) * pad_to_multiple
@@ -116,17 +125,11 @@ def read_libsvm(
             labels, rows_idx, rows_val, num_features=num_features, add_intercept=add_intercept
         )
     n = len(rows_idx)
-    max_idx = max((int(r.max()) for r in rows_idx if len(r)), default=-1)
-    d_raw = num_features if num_features is not None else max_idx + 1
-    if max_idx >= d_raw:
-        raise ValueError(f"feature index {max_idx} out of range for num_features={d_raw}")
-    d = d_raw + (1 if add_intercept else 0)
+    d_raw, d, intercept_index = _feature_dim(rows_idx, num_features, add_intercept)
     X = np.zeros((n, d), np.float32)
     for i, (ri, rv) in enumerate(zip(rows_idx, rows_val)):
         # accumulate duplicate indices (the sparse path's scatter-add does)
         np.add.at(X[i], ri, rv)
-    intercept_index = None
-    if add_intercept:
-        X[:, d_raw] = 1.0
-        intercept_index = d_raw
+    if intercept_index is not None:
+        X[:, intercept_index] = 1.0
     return dense_batch_from_numpy(X, labels), intercept_index

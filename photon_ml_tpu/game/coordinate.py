@@ -1,0 +1,228 @@
+"""Per-coordinate train/score units.
+
+Reference parity: ``photon-api::ml.algorithm.{Coordinate,
+FixedEffectCoordinate, RandomEffectCoordinate}`` (SURVEY.md §2.2, §3.1).
+A coordinate binds one effect's data view + optimization problem and
+exposes ``train(offsets, initial)`` / ``score(model)``; coordinate descent
+drives them through residual offsets.
+
+TPU-first: both coordinates train through compiled device programs keyed on
+static geometry — re-entered, not recompiled, every descent iteration:
+- fixed effect → the sample-sharded ``sharded_minimize`` psum path
+  (HOT LOOP 1 of §3.1);
+- random effect → the vmap-batched bucket solver (HOT LOOP 2).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Protocol
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from photon_ml_tpu.config import OptimizationConfig
+from photon_ml_tpu.game.data import EntityBuckets, EntityGrouping, GameBatch
+from photon_ml_tpu.game.random_effect import (
+    RandomEffectTrainingResult,
+    train_random_effects,
+)
+from photon_ml_tpu.game.models import FixedEffectModel, GameSubModel, RandomEffectModel
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.normalization import NormalizationContext
+from photon_ml_tpu.ops.glm import make_objective
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.optim.common import OptimizationResult, select_minimize_fn
+from photon_ml_tpu.parallel.distributed import sharded_minimize
+from photon_ml_tpu.types import TaskType, VarianceComputationType
+
+Array = jnp.ndarray
+
+
+class Coordinate(Protocol):
+    """The contract coordinate descent drives."""
+
+    coordinate_id: str
+
+    def train(
+        self, offsets: Array, initial: GameSubModel | None
+    ) -> tuple[GameSubModel, Any]: ...
+
+    def score(self, model: GameSubModel) -> Array: ...
+
+
+@dataclass(frozen=True)
+class FixedEffectCoordinate:
+    """Distributed single-GLM solve over all samples of one feature shard.
+
+    ``train_rows``/``train_weight_scale`` implement per-coordinate
+    down-sampling (parity: the reference's ``DownSampler`` applied to the
+    fixed-effect coordinate): training sees the subset with corrected
+    weights; scoring always sees every sample.
+    """
+
+    coordinate_id: str
+    batch: GameBatch
+    feature_shard_id: str
+    config: OptimizationConfig
+    task_type: TaskType
+    intercept_index: int | None = None
+    normalization: NormalizationContext | None = None
+    variance_computation: VarianceComputationType = VarianceComputationType.NONE
+    mesh: Mesh | None = None
+    axis_name: str = "data"
+    train_rows: Array | None = None  # int32 row subset (down-sampling)
+    train_weight_scale: Array | None = None  # per-subset-row weight correction
+
+    def _training_batch(self, offsets: Array):
+        shard = self.batch.features[self.feature_shard_id]
+        if self.train_rows is None:
+            return shard.to_batch(self.batch.labels, offsets, self.batch.weights)
+        rows = self.train_rows
+        w = self.batch.weights[rows]
+        if self.train_weight_scale is not None:
+            w = w * self.train_weight_scale
+        return jax.tree.map(lambda a: a[rows], shard).to_batch(
+            self.batch.labels[rows], offsets[rows], w
+        )
+
+    def train(
+        self, offsets: Array, initial: GameSubModel | None = None
+    ) -> tuple[FixedEffectModel, OptimizationResult]:
+        train_batch = self._training_batch(offsets)
+        d = train_batch.num_features
+        if initial is not None:
+            w0 = jnp.asarray(initial.model.coefficients.means, jnp.float32)
+            if self.normalization is not None:
+                w0 = self.normalization.model_from_original_space(w0)
+        else:
+            w0 = jnp.zeros((d,), jnp.float32)
+
+        opt = self.config
+        loss = loss_for_task(self.task_type)
+        l1 = opt.regularization.l1_weight(opt.regularization_weight)
+        l2 = opt.regularization.l2_weight(opt.regularization_weight)
+        minimize_fn, extra = select_minimize_fn(opt.optimizer, l1)
+
+        if self.mesh is not None:
+            result = sharded_minimize(
+                minimize_fn,
+                train_batch,
+                w0,
+                opt.optimizer,
+                self.mesh,
+                loss,
+                l2_weight=l2,
+                norm=self.normalization,
+                intercept_index=self.intercept_index,
+                axis_name=self.axis_name,
+                **extra,
+            )
+        else:
+            obj = make_objective(
+                train_batch,
+                loss,
+                l2_weight=l2,
+                norm=self.normalization,
+                intercept_index=self.intercept_index,
+            )
+            result = minimize_fn(obj, w0, opt.optimizer, **extra)
+
+        w = result.w
+        variances = None
+        if self.variance_computation is not VarianceComputationType.NONE:
+            obj = make_objective(
+                train_batch,
+                loss,
+                l2_weight=l2,
+                norm=self.normalization,
+                intercept_index=self.intercept_index,
+            )
+            if self.variance_computation is VarianceComputationType.SIMPLE:
+                variances = 1.0 / jnp.maximum(obj.hessian_diag(w), 1e-12)
+            else:
+                H = obj.hessian(w)
+                variances = jnp.diag(
+                    jnp.linalg.inv(H + 1e-9 * jnp.eye(H.shape[0], dtype=H.dtype))
+                )
+        if self.normalization is not None:
+            w, _ = self.normalization.model_to_original_space(w)
+            if variances is not None:
+                variances = self.normalization.factors**2 * variances
+        model = FixedEffectModel(
+            model=GeneralizedLinearModel(Coefficients(w, variances), self.task_type),
+            feature_shard_id=self.feature_shard_id,
+        )
+        return model, result
+
+    def score(self, model: FixedEffectModel) -> Array:
+        return model.score(self.batch)
+
+
+@dataclass(frozen=True)
+class RandomEffectCoordinate:
+    """Per-entity batched solves over one feature shard + entity column.
+
+    The grouping/bucketing (the reference's shuffle + partitioner) is done
+    once at construction; ``train`` re-enters the compiled bucket kernels
+    with fresh residual offsets each descent iteration.
+    """
+
+    coordinate_id: str
+    batch: GameBatch
+    feature_shard_id: str
+    random_effect_type: str
+    config: OptimizationConfig
+    grouping: EntityGrouping
+    buckets: EntityBuckets
+    task_type: TaskType
+    num_entities: int
+    intercept_index: int | None = None
+    variance_computation: VarianceComputationType = VarianceComputationType.NONE
+    mesh: Mesh | None = None
+    axis_name: str = "data"
+
+    def train(
+        self, offsets: Array, initial: GameSubModel | None = None
+    ) -> tuple[RandomEffectModel, RandomEffectTrainingResult]:
+        opt = self.config
+        loss = loss_for_task(self.task_type)
+        l1 = opt.regularization.l1_weight(opt.regularization_weight)
+        l2 = opt.regularization.l2_weight(opt.regularization_weight)
+        W0 = None
+        if initial is not None:
+            W0 = initial.coefficients
+            if W0.shape[0] != self.num_entities:
+                raise ValueError(
+                    f"warm-start entity count {W0.shape[0]} != {self.num_entities}"
+                )
+        result = train_random_effects(
+            self.batch.features[self.feature_shard_id],
+            np.asarray(self.batch.labels),
+            offsets,
+            np.asarray(self.batch.weights),
+            self.buckets,
+            self.num_entities,
+            loss,
+            opt.optimizer,
+            l2_weight=l2,
+            l1_weight=l1,
+            intercept_index=self.intercept_index,
+            initial_coefficients=W0,
+            variance_computation=self.variance_computation,
+            mesh=self.mesh,
+            axis_name=self.axis_name,
+        )
+        model = RandomEffectModel(
+            coefficients=result.coefficients,
+            variances=result.variances,
+            random_effect_type=self.random_effect_type,
+            feature_shard_id=self.feature_shard_id,
+            task_type=self.task_type,
+        )
+        return model, result
+
+    def score(self, model: RandomEffectModel) -> Array:
+        return model.score(self.batch)

@@ -1,0 +1,339 @@
+"""GAME data structures: columnar batches, entity grouping, bucketing.
+
+Reference parity (SURVEY.md §2.2):
+- ``photon-api::ml.data.GameDatum`` (response, offset, weight, per-shard
+  feature vectors, id-tag map) → ``GameBatch``: one columnar structure whose
+  arrays live on device; id tags are integer-encoded at ingest.
+- ``photon-api::ml.data.FixedEffectDataset`` → a ``Batch`` view over one
+  feature shard (``GameBatch.batch_for``).
+- ``photon-api::ml.data.RandomEffectDataset`` (activeData per-entity
+  ``LocalDataset``s built by a group-by-entity shuffle, plus
+  ``RandomEffectDatasetPartitioner`` balancing, ``numActiveDataPointsUpperBound``
+  reservoir down-sampling) → ``EntityGrouping`` + ``EntityBuckets``: ONE
+  host-side sort by entity id at ingest, then entities padded into
+  fixed-capacity buckets so the per-entity solves run as a single vmapped
+  kernel per bucket. No runtime shuffle exists (SURVEY.md §7 design table).
+
+TPU-first notes:
+- Bucket capacities are powers of two: every entity in a bucket is padded to
+  the bucket's capacity with zero-weight rows, so each bucket is one static
+  (k, C, d) tensor — XLA compiles ONE program per (C, d) geometry, reused
+  across buckets and coordinate-descent iterations.
+- Entities whose sample count exceeds ``active_upper_bound`` are reservoir
+  down-sampled at ingest (active set); their remaining rows stay "passive":
+  scored by the coordinate, never trained on — exactly the reference's
+  active/passive split.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.ops.batch import Batch, DenseBatch, SparseBatch
+
+Array = jnp.ndarray
+
+
+# ---------------------------------------------------------------------------
+# Per-shard feature containers (features only; labels/offsets/weights are
+# global columns of the GameBatch)
+# ---------------------------------------------------------------------------
+@partial(jax.tree_util.register_dataclass, data_fields=["X"], meta_fields=[])
+@dataclass(frozen=True)
+class DenseFeatures:
+    """(n, d) dense feature block for one shard."""
+
+    X: Array
+
+    @property
+    def num_features(self) -> int:
+        return self.X.shape[-1]
+
+    @property
+    def num_rows(self) -> int:
+        return self.X.shape[0]
+
+    def to_batch(self, labels: Array, offsets: Array, weights: Array) -> DenseBatch:
+        return DenseBatch(X=self.X, labels=labels, offsets=offsets, weights=weights)
+
+    def score(self, w: Array) -> Array:
+        return self.X @ w
+
+    def take(self, idx: np.ndarray) -> "DenseFeatures":
+        return DenseFeatures(X=jnp.asarray(np.asarray(self.X)[idx]))
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["indices", "values"],
+    meta_fields=["num_features"],
+)
+@dataclass(frozen=True)
+class SparseFeatures:
+    """Padded sparse rows for one shard: (n, k) indices/values, pad = (0, 0.0)."""
+
+    indices: Array
+    values: Array
+    num_features: int = field(metadata=dict(static=True))
+
+    @property
+    def num_rows(self) -> int:
+        return self.indices.shape[0]
+
+    def to_batch(self, labels: Array, offsets: Array, weights: Array) -> SparseBatch:
+        return SparseBatch(
+            indices=self.indices,
+            values=self.values,
+            labels=labels,
+            offsets=offsets,
+            weights=weights,
+            num_features=self.num_features,
+        )
+
+    def score(self, w: Array) -> Array:
+        return jnp.sum(self.values * w[self.indices], axis=-1)
+
+    def take(self, idx: np.ndarray) -> "SparseFeatures":
+        return SparseFeatures(
+            indices=jnp.asarray(np.asarray(self.indices)[idx]),
+            values=jnp.asarray(np.asarray(self.values)[idx]),
+            num_features=self.num_features,
+        )
+
+
+Features = DenseFeatures | SparseFeatures
+
+
+# ---------------------------------------------------------------------------
+# GameBatch — the GameDatum columnar equivalent
+# ---------------------------------------------------------------------------
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["labels", "offsets", "weights", "features", "id_tags"],
+    meta_fields=[],
+)
+@dataclass(frozen=True)
+class GameBatch:
+    """Columnar GAME dataset (device-resident).
+
+    ``features[shard_id]`` — per-shard feature container.
+    ``id_tags[tag]`` — (n,) int32 entity ids; used both as random-effect
+    entity columns and as grouping keys for Multi* evaluators (the
+    reference's ``GameDatum.idTagToValueMap`` serves the same double duty).
+    """
+
+    labels: Array
+    offsets: Array
+    weights: Array
+    features: dict[str, Features]
+    id_tags: dict[str, Array]
+
+    @property
+    def num_rows(self) -> int:
+        return self.labels.shape[0]
+
+    def batch_for(self, shard_id: str, offsets: Array | None = None) -> Batch:
+        """A ``Batch`` view for one coordinate: shard features + global
+        labels/weights + caller-supplied offsets (the residual scores during
+        coordinate descent)."""
+        off = self.offsets if offsets is None else offsets
+        return self.features[shard_id].to_batch(self.labels, off, self.weights)
+
+    def host_id_tags(self) -> dict[str, np.ndarray]:
+        return {k: np.asarray(v) for k, v in self.id_tags.items()}
+
+
+def make_game_batch(
+    labels: np.ndarray,
+    features: Mapping[str, np.ndarray | Features],
+    id_tags: Mapping[str, np.ndarray] | None = None,
+    offsets: np.ndarray | None = None,
+    weights: np.ndarray | None = None,
+    dtype=jnp.float32,
+) -> GameBatch:
+    """Build a device GameBatch from host arrays. Dense 2-D feature arrays
+    become ``DenseFeatures``; prebuilt containers pass through."""
+    n = len(labels)
+    feats: dict[str, Features] = {}
+    for sid, f in features.items():
+        if isinstance(f, (DenseFeatures, SparseFeatures)):
+            feats[sid] = f
+        else:
+            feats[sid] = DenseFeatures(X=jnp.asarray(f, dtype))
+    return GameBatch(
+        labels=jnp.asarray(labels, dtype),
+        offsets=jnp.zeros((n,), dtype) if offsets is None else jnp.asarray(offsets, dtype),
+        weights=jnp.ones((n,), dtype) if weights is None else jnp.asarray(weights, dtype),
+        features=feats,
+        id_tags={k: jnp.asarray(v, jnp.int32) for k, v in (id_tags or {}).items()},
+    )
+
+
+# ---------------------------------------------------------------------------
+# Entity grouping — the ingest-time "shuffle"
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class EntityGrouping:
+    """Per-entity segment layout of one random-effect coordinate's samples.
+
+    Replaces the reference's group-by-entity Spark shuffle + custom
+    partitioner: one argsort by entity id gives contiguous segments.
+    ``active_rows[j]`` are the (at most ``active_upper_bound``) sample rows
+    entity j trains on; passive rows are everything else (scored only).
+    """
+
+    num_entities: int
+    counts: np.ndarray  # (E,) total samples per entity
+    active_counts: np.ndarray  # (E,) samples actually trained on
+    active_rows: list[np.ndarray]  # E arrays of row indices into the batch
+
+
+def group_by_entity(
+    entity_ids: np.ndarray,
+    num_entities: int | None = None,
+    active_upper_bound: int | None = None,
+    seed: int = 0,
+) -> EntityGrouping:
+    """Group sample rows by integer entity id (host-side, vectorized).
+
+    ``active_upper_bound`` reservoir-samples each larger entity's rows
+    (parity: ``numActiveDataPointsUpperBound`` in ``RandomEffectDataset``).
+    """
+    entity_ids = np.asarray(entity_ids)
+    if num_entities is None:
+        num_entities = int(entity_ids.max()) + 1 if len(entity_ids) else 0
+    order = np.argsort(entity_ids, kind="stable")
+    sorted_ids = entity_ids[order]
+    counts = np.bincount(entity_ids, minlength=num_entities)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+
+    rng = np.random.default_rng(seed)
+    active_rows: list[np.ndarray] = []
+    active_counts = np.minimum(
+        counts, active_upper_bound if active_upper_bound is not None else counts.max(initial=0)
+    )
+    for e in range(num_entities):
+        seg = order[starts[e] : starts[e] + counts[e]]
+        if active_upper_bound is not None and counts[e] > active_upper_bound:
+            seg = rng.choice(seg, size=active_upper_bound, replace=False)
+        active_rows.append(seg)
+    return EntityGrouping(
+        num_entities=num_entities,
+        counts=counts,
+        active_counts=active_counts,
+        active_rows=active_rows,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Bucketing — variable-size entities → fixed-geometry tensors
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class EntityBuckets:
+    """Entities grouped by padded sample capacity.
+
+    For bucket b: ``entity_ids[b]`` is (k_b,), ``row_indices[b]`` is
+    (k_b, C_b) with -1 padding. Gathering batch rows with these indices (and
+    zeroing weight where index < 0) yields the (k_b, C_b, …) tensors the
+    batched solver consumes. Each distinct C_b compiles one XLA program.
+    """
+
+    capacities: tuple[int, ...]
+    entity_ids: list[np.ndarray]
+    row_indices: list[np.ndarray]
+
+    @property
+    def num_entities(self) -> int:
+        return sum(len(e) for e in self.entity_ids)
+
+
+def default_capacities(max_count: int, smallest: int = 8, growth: int = 4) -> tuple[int, ...]:
+    """Geometric capacity ladder: [8, 32, 128, ...] up to max_count.
+
+    ``growth=4`` bounds padding waste at 4× worst-case while keeping the
+    number of compiled bucket geometries ~log₄(max/min) — the XLA-compile
+    count is the real cost of fine-grained ladders.
+    """
+    caps = [smallest]
+    while caps[-1] < max_count:
+        caps.append(caps[-1] * growth)
+    return tuple(caps)
+
+
+def bucket_entities(
+    grouping: EntityGrouping,
+    capacities: tuple[int, ...] | None = None,
+) -> EntityBuckets:
+    """Assign each entity (with ≥1 active sample) to the smallest bucket
+    capacity ≥ its active count; build padded row-index matrices."""
+    active = np.flatnonzero(grouping.active_counts > 0)
+    if len(active) == 0:
+        return EntityBuckets(capacities=(), entity_ids=[], row_indices=[])
+    max_count = int(grouping.active_counts[active].max())
+    if capacities is None:
+        capacities = default_capacities(max_count)
+    caps = np.asarray(sorted(capacities))
+    if caps[-1] < max_count:
+        raise ValueError(
+            f"largest bucket capacity {caps[-1]} < max active entity size {max_count}"
+        )
+    # smallest capacity >= count, per entity
+    slot = np.searchsorted(caps, grouping.active_counts[active])
+    ent_ids: list[np.ndarray] = []
+    row_idx: list[np.ndarray] = []
+    used_caps: list[int] = []
+    for b, cap in enumerate(caps):
+        members = active[slot == b]
+        if len(members) == 0:
+            continue
+        rows = np.full((len(members), cap), -1, dtype=np.int64)
+        for i, e in enumerate(members):
+            seg = grouping.active_rows[e]
+            rows[i, : len(seg)] = seg
+        used_caps.append(int(cap))
+        ent_ids.append(members.astype(np.int64))
+        row_idx.append(rows)
+    return EntityBuckets(capacities=tuple(used_caps), entity_ids=ent_ids, row_indices=row_idx)
+
+
+def gather_bucket(
+    features: Features,
+    labels: np.ndarray,
+    offsets: np.ndarray,
+    weights: np.ndarray,
+    row_indices: np.ndarray,
+) -> Batch:
+    """Materialize one bucket's (k, C, …) batched Batch from host columns.
+
+    Padded slots (row index -1) get weight 0 — inert in the objective
+    (`GLMObjective._weighted` forces their loss/grad contributions to 0).
+    """
+    idx = np.maximum(row_indices, 0)
+    mask = (row_indices >= 0).astype(np.float32)
+    lab = np.asarray(labels)[idx] * mask
+    off = np.asarray(offsets)[idx] * mask
+    wgt = np.asarray(weights)[idx] * mask
+    if isinstance(features, DenseFeatures):
+        X = np.asarray(features.X)[idx]  # (k, C, d)
+        return DenseBatch(
+            X=jnp.asarray(X),
+            labels=jnp.asarray(lab),
+            offsets=jnp.asarray(off),
+            weights=jnp.asarray(wgt),
+        )
+    ind = np.asarray(features.indices)[idx]  # (k, C, nnz)
+    val = np.asarray(features.values)[idx] * mask[..., None]
+    return SparseBatch(
+        indices=jnp.asarray(ind),
+        values=jnp.asarray(val),
+        labels=jnp.asarray(lab),
+        offsets=jnp.asarray(off),
+        weights=jnp.asarray(wgt),
+        num_features=features.num_features,
+    )

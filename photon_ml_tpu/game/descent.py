@@ -1,0 +1,132 @@
+"""Block coordinate descent over GAME coordinates.
+
+Reference parity: ``photon-api::ml.algorithm.CoordinateDescent`` (SURVEY.md
+§2.2, §3.1): iterate the configured coordinate sequence for N outer
+iterations; for each coordinate, the training offsets are
+``base_offsets + total_score − this coordinate's score`` (residual
+exchange); retrain, update that coordinate's scores; track per-iteration
+validation metrics.
+
+Coordinates present in the initial (warm-start) model but absent from the
+update sequence are "locked": they keep contributing scores but are never
+retrained — matching the reference's treatment of pre-trained coordinates.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.evaluation import EvaluationResults, evaluate_all
+from photon_ml_tpu.game.coordinate import Coordinate
+from photon_ml_tpu.game.data import GameBatch
+from photon_ml_tpu.game.models import GameModel
+from photon_ml_tpu.types import TaskType
+
+Array = jnp.ndarray
+
+
+@dataclass(frozen=True)
+class CoordinateDescentResult:
+    model: GameModel
+    # validation_history[i][cid] — metrics after training cid in outer iter i
+    validation_history: list[dict[str, EvaluationResults]]
+    trackers: dict[str, list[Any]]  # cid → per-iteration optimizer trackers
+    training_scores: dict[str, Array]  # final per-coordinate scores
+
+    @property
+    def final_validation(self) -> EvaluationResults | None:
+        if not self.validation_history:
+            return None
+        last = self.validation_history[-1]
+        if not last:
+            return None
+        return last[list(last)[-1]]
+
+
+class CoordinateDescent:
+    """Drives coordinates through residual-offset retraining.
+
+    ``coordinates`` must share one training ``GameBatch`` (they hold views
+    of it); ``validation_batch`` is scored with the evolving full model
+    after each coordinate update, mirroring the reference's per-iteration
+    validation tracking.
+    """
+
+    def __init__(
+        self,
+        coordinates: Mapping[str, Coordinate],
+        batch: GameBatch,
+        task_type: TaskType,
+        validation_batch: GameBatch | None = None,
+        evaluators: Sequence[str] = (),
+        logger: Callable[[str], None] | None = None,
+    ):
+        self.coordinates = dict(coordinates)
+        self.batch = batch
+        self.task_type = task_type
+        self.validation_batch = validation_batch
+        self.evaluators = list(evaluators)
+        self._log = logger or (lambda msg: None)
+
+    def run(
+        self,
+        update_sequence: Sequence[str],
+        num_iterations: int,
+        initial_model: GameModel | None = None,
+    ) -> CoordinateDescentResult:
+        for cid in update_sequence:
+            if cid not in self.coordinates:
+                raise KeyError(f"update sequence names unknown coordinate {cid!r}")
+
+        model = initial_model or GameModel(models={}, task_type=self.task_type)
+        n = self.batch.num_rows
+        zeros = jnp.zeros((n,), self.batch.labels.dtype)
+        # warm-start scores for every coordinate already in the model
+        # (including locked ones not in the update sequence)
+        scores: dict[str, Array] = {}
+        for cid, sub in model.models.items():
+            coord = self.coordinates.get(cid)
+            scores[cid] = coord.score(sub) if coord is not None else sub.score(self.batch)
+
+        trackers: dict[str, list[Any]] = {cid: [] for cid in update_sequence}
+        validation_history: list[dict[str, EvaluationResults]] = []
+
+        for it in range(num_iterations):
+            iter_validation: dict[str, EvaluationResults] = {}
+            for cid in update_sequence:
+                coord = self.coordinates[cid]
+                # offsets = base + scores of every OTHER coordinate
+                offsets = self.batch.offsets
+                for other, s in scores.items():
+                    if other != cid:
+                        offsets = offsets + s
+                sub_model, tracker = coord.train(offsets, model.models.get(cid))
+                scores[cid] = coord.score(sub_model)
+                model = model.updated(cid, sub_model)
+                trackers[cid].append(tracker)
+
+                if self.validation_batch is not None and self.evaluators:
+                    vscores = model.score(self.validation_batch)
+                    res = evaluate_all(
+                        self.evaluators,
+                        vscores,
+                        self.validation_batch.labels,
+                        self.validation_batch.weights,
+                        group_ids=self.validation_batch.host_id_tags(),
+                    )
+                    iter_validation[cid] = res
+                    self._log(f"iter {it} coordinate {cid}: {res}")
+                else:
+                    self._log(f"iter {it} coordinate {cid}: trained")
+            validation_history.append(iter_validation)
+
+        return CoordinateDescentResult(
+            model=model,
+            validation_history=validation_history,
+            trackers=trackers,
+            training_scores=scores,
+        )

@@ -1,0 +1,129 @@
+"""GAME model classes: fixed-effect, random-effect, and their container.
+
+Reference parity: ``photon-api::ml.model.{GameModel, FixedEffectModel,
+RandomEffectModel}`` (SURVEY.md §2.2). The reference keeps the fixed effect
+as one broadcast coefficient vector and each random effect as an
+``RDD[(REId, GeneralizedLinearModel)]``; here a random-effect model is one
+(E, d) device matrix (entities are integer-encoded at ingest), so scoring a
+batch is a gather + row-dot instead of an RDD join (§3.3's shuffle
+boundary).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.game.data import GameBatch
+from photon_ml_tpu.game.random_effect import random_effect_scores
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel  # noqa: F401  (re-exported via models)
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.types import TaskType
+
+Array = jnp.ndarray
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["model"],
+    meta_fields=["feature_shard_id"],
+)
+@dataclass(frozen=True)
+class FixedEffectModel:
+    """One global GLM over a feature shard.
+
+    Parity: ``photon-api::ml.model.FixedEffectModel`` (broadcast coefficient
+    vector; here device-replicated via pjit sharding, no broadcast step).
+    """
+
+    model: GeneralizedLinearModel
+    feature_shard_id: str
+
+    def score(self, batch: GameBatch) -> Array:
+        """Raw contribution w·x per sample (no offsets — coordinate scores
+        are pure contributions; offsets are summed by the caller)."""
+        return batch.features[self.feature_shard_id].score(self.model.coefficients.means)
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["coefficients", "variances"],
+    meta_fields=["random_effect_type", "feature_shard_id", "task_type"],
+)
+@dataclass(frozen=True)
+class RandomEffectModel:
+    """Per-entity GLMs as one (E, d) coefficient matrix.
+
+    Parity: ``photon-api::ml.model.RandomEffectModel`` (RDD of per-entity
+    models → a single sharded device matrix).
+    """
+
+    coefficients: Array  # (E, d)
+    variances: Array | None
+    random_effect_type: str  # the entity-id tag this effect keys on
+    feature_shard_id: str
+    task_type: TaskType = TaskType.LOGISTIC_REGRESSION
+
+    @property
+    def num_entities(self) -> int:
+        return self.coefficients.shape[0]
+
+    def score(self, batch: GameBatch) -> Array:
+        """w_{e(i)}·x_i per sample. Samples whose entity id is out of range
+        (unseen at training: id < 0 or >= E) contribute 0 — parity with the
+        reference scoring data for entities absent from the model RDD."""
+        ids = batch.id_tags[self.random_effect_type]
+        in_range = (ids >= 0) & (ids < self.num_entities)
+        safe_ids = jnp.where(in_range, ids, 0)
+        raw = random_effect_scores(
+            batch.features[self.feature_shard_id], safe_ids, self.coefficients
+        )
+        return jnp.where(in_range, raw, 0.0)
+
+    def model_for_entity(self, entity: int) -> GeneralizedLinearModel:
+        """Materialize one entity's GLM (host-side convenience / IO)."""
+        var = None if self.variances is None else self.variances[entity]
+        return GeneralizedLinearModel(
+            Coefficients(self.coefficients[entity], var), self.task_type
+        )
+
+
+GameSubModel = FixedEffectModel | RandomEffectModel
+
+
+@dataclass(frozen=True)
+class GameModel:
+    """Container of per-coordinate models (parity:
+    ``photon-api::ml.model.GameModel``). ``score`` sums coordinate
+    contributions + data offsets; ``predict`` applies the task's inverse
+    link."""
+
+    models: Mapping[str, GameSubModel] = field(default_factory=dict)
+    task_type: TaskType = TaskType.LOGISTIC_REGRESSION
+
+    def __getitem__(self, coordinate_id: str) -> GameSubModel:
+        return self.models[coordinate_id]
+
+    def __contains__(self, coordinate_id: str) -> bool:
+        return coordinate_id in self.models
+
+    def coordinate_scores(self, batch: GameBatch) -> dict[str, Array]:
+        return {cid: m.score(batch) for cid, m in self.models.items()}
+
+    def score(self, batch: GameBatch) -> Array:
+        total = batch.offsets
+        for m in self.models.values():
+            total = total + m.score(batch)
+        return total
+
+    def predict(self, batch: GameBatch) -> Array:
+        return loss_for_task(self.task_type).mean(self.score(batch))
+
+    def updated(self, coordinate_id: str, model: GameSubModel) -> "GameModel":
+        models = dict(self.models)
+        models[coordinate_id] = model
+        return GameModel(models=models, task_type=self.task_type)

@@ -1,0 +1,197 @@
+"""Batched per-entity random-effect training.
+
+Reference parity (SURVEY.md §2.2, §3.1 HOT LOOP 2): the reference's
+``RandomEffectCoordinate.trainModel`` runs ``activeData.mapValues { localDataset
+=> SingleNodeOptimizationProblem.run }`` — millions of serial Breeze solves
+inside Spark executors after a group-by-entity shuffle.
+
+TPU-native redesign (SURVEY.md §7): entities are padded into fixed-capacity
+buckets at ingest (``game.data``); each bucket's solves run as ONE
+``vmap``-batched device kernel — the per-entity L-BFGS/OWL-QN/TRON
+``lax.while_loop`` is *batched over entities*, so the MXU sees (k, C, d)
+matmuls instead of k tiny (C, d) ones, and per-entity convergence is just
+the batched loop's per-lane ``done`` mask. Entity lanes shard over the mesh
+axis with zero communication (the problems are independent — the reference
+exploits the same structure with its partitioner; here the "partitioner" is
+a sharding annotation).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.config import OptimizerConfig
+from photon_ml_tpu.game.data import (
+    EntityBuckets,
+    Features,
+    DenseFeatures,
+    gather_bucket,
+)
+from photon_ml_tpu.ops.batch import Batch
+from photon_ml_tpu.ops.glm import make_objective
+from photon_ml_tpu.ops.losses import PointwiseLoss
+from photon_ml_tpu.optim.common import select_minimize_fn
+from photon_ml_tpu.types import VarianceComputationType
+
+Array = jnp.ndarray
+
+
+@dataclass(frozen=True)
+class RandomEffectTrainingResult:
+    """Per-entity models as one (E, d) coefficient matrix.
+
+    The reference keeps ``RDD[(REId, GeneralizedLinearModel)]``; here the
+    whole random-effect model is a single device matrix (plus optional
+    variances), gathered per sample at scoring time. Entities with no active
+    data keep their warm-start row (zeros for a cold start).
+    """
+
+    coefficients: Array  # (E, d)
+    variances: Array | None  # (E, d) when SIMPLE variance is requested
+    loss_values: np.ndarray  # (E,) final per-entity objective (NaN if untrained)
+    iterations: np.ndarray  # (E,) int solver iterations (0 if untrained)
+    converged: np.ndarray  # (E,) bool
+
+
+def _pad_rows(k: int, n_dev: int) -> int:
+    return -(-k // n_dev) * n_dev
+
+
+@partial(jax.jit, static_argnames=("minimize_fn", "loss", "config", "intercept_index", "compute_variance"))
+def _solve_bucket(
+    bucket_batch: Batch,
+    w0: Array,  # (k, d)
+    l2_weight: Array,
+    minimize_fn: Any,
+    loss: PointwiseLoss,
+    config: OptimizerConfig,
+    intercept_index: int | None,
+    compute_variance: bool,
+    **minimize_kwargs,
+):
+    """One bucket = one compiled program: vmap the device-resident optimizer
+    over the entity lane. Re-entered (not recompiled) every coordinate-descent
+    iteration and for every bucket sharing this (C, d) geometry."""
+
+    def solve_one(batch: Batch, w0_e: Array):
+        obj = make_objective(
+            batch, loss, l2_weight=l2_weight, intercept_index=intercept_index
+        )
+        res = minimize_fn(obj, w0_e, config, **minimize_kwargs)
+        var = obj.hessian_diag(res.w) if compute_variance else jnp.zeros_like(res.w)
+        return res.w, res.value, res.iterations, res.reason, var
+
+    return jax.vmap(solve_one)(bucket_batch, w0)
+
+
+def train_random_effects(
+    features: Features,
+    labels: np.ndarray,
+    offsets: np.ndarray | Array,
+    weights: np.ndarray,
+    buckets: EntityBuckets,
+    num_entities: int,
+    loss: PointwiseLoss,
+    config: OptimizerConfig,
+    l2_weight: float = 0.0,
+    l1_weight: float = 0.0,
+    intercept_index: int | None = None,
+    initial_coefficients: Array | None = None,  # (E, d) warm start
+    variance_computation: VarianceComputationType = VarianceComputationType.NONE,
+    mesh: Mesh | None = None,
+    axis_name: str = "data",
+) -> RandomEffectTrainingResult:
+    """Train all entities' GLMs; returns the (E, d) coefficient matrix.
+
+    When ``mesh`` is given, each bucket's entity lane is sharded over
+    ``axis_name`` (lanes padded with zero-weight entities to divide evenly);
+    XLA partitions the batched solve with no collectives — the TPU analog of
+    the reference's ``RandomEffectDatasetPartitioner`` balancing.
+    """
+    d = features.num_features
+    if variance_computation is VarianceComputationType.FULL:
+        raise NotImplementedError(
+            "FULL per-entity variance is not supported (the reference computes "
+            "variances per entity via Hessian diagonals too); use SIMPLE"
+        )
+    compute_variance = variance_computation is VarianceComputationType.SIMPLE
+    minimize_fn, extra = select_minimize_fn(config, l1_weight)
+
+    if initial_coefficients is None:
+        W = jnp.zeros((num_entities, d), jnp.float32)
+    else:
+        W = jnp.asarray(initial_coefficients, jnp.float32)
+    V = jnp.zeros((num_entities, d), jnp.float32) if compute_variance else None
+    loss_values = np.full((num_entities,), np.nan, np.float64)
+    iterations = np.zeros((num_entities,), np.int64)
+    converged = np.zeros((num_entities,), bool)
+
+    l2 = jnp.asarray(l2_weight, jnp.float32)
+    n_dev = mesh.shape[axis_name] if mesh is not None else 1
+
+    for ent_ids, row_idx in zip(buckets.entity_ids, buckets.row_indices):
+        k = len(ent_ids)
+        bucket_batch = gather_bucket(features, labels, offsets, weights, row_idx)
+        w0 = W[jnp.asarray(ent_ids)]
+        if n_dev > 1:
+            k_pad = _pad_rows(k, n_dev)
+            if k_pad != k:
+                pad = k_pad - k
+                bucket_batch = jax.tree.map(
+                    lambda a: jnp.concatenate(
+                        [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)]
+                    ),
+                    bucket_batch,
+                )
+                w0 = jnp.concatenate([w0, jnp.zeros((pad, d), w0.dtype)])
+            sharding = NamedSharding(mesh, P(axis_name))
+            bucket_batch = jax.tree.map(
+                lambda a: jax.device_put(a, sharding), bucket_batch
+            )
+            w0 = jax.device_put(w0, sharding)
+
+        w_b, f_b, it_b, reason_b, var_b = _solve_bucket(
+            bucket_batch,
+            w0,
+            l2,
+            minimize_fn=minimize_fn,
+            loss=loss,
+            config=config,
+            intercept_index=intercept_index,
+            compute_variance=compute_variance,
+            **extra,
+        )
+        ids = jnp.asarray(ent_ids)
+        W = W.at[ids].set(w_b[:k])
+        if compute_variance:
+            V = V.at[ids].set(1.0 / jnp.maximum(var_b[:k], 1e-12))
+        loss_values[ent_ids] = np.asarray(f_b[:k], np.float64)
+        iterations[ent_ids] = np.asarray(it_b[:k])
+        converged[ent_ids] = np.asarray(reason_b[:k]) != 0  # != MAX_ITERATIONS
+
+    return RandomEffectTrainingResult(
+        coefficients=W,
+        variances=V,
+        loss_values=loss_values,
+        iterations=iterations,
+        converged=converged,
+    )
+
+
+def random_effect_scores(features: Features, entity_ids: Array, W: Array) -> Array:
+    """Per-sample scores w_{e(i)}·x_i — one gather + row-dot on device.
+
+    Replaces the reference's RDD join of data against the per-entity model
+    RDD (§3.3 "shuffle/join boundary"): the model is a device matrix, so
+    scoring is a memory gather, not a shuffle.
+    """
+    if isinstance(features, DenseFeatures):
+        return jnp.einsum("nd,nd->n", features.X, W[entity_ids])
+    return jnp.sum(features.values * W[entity_ids[:, None], features.indices], axis=-1)

@@ -1,4 +1,9 @@
-"""Model classes: GLM coefficient models and GAME composite models."""
+"""Model classes: GLM coefficient models and GAME composite models.
+
+The GAME composite classes live in ``photon_ml_tpu.game.models`` (they need
+the GAME data structures); they are re-exported here so the public surface
+mirrors the reference's ``ml.model`` package (SURVEY.md §2.2).
+"""
 
 from photon_ml_tpu.models.glm import (  # noqa: F401
     Coefficients,
@@ -9,3 +14,12 @@ from photon_ml_tpu.models.glm import (  # noqa: F401
     SmoothedHingeLossLinearSVMModel,
     model_for_task,
 )
+_GAME_MODELS = ("FixedEffectModel", "GameModel", "GameSubModel", "RandomEffectModel")
+
+
+def __getattr__(name):  # lazy re-export avoids models ↔ game import cycle
+    if name in _GAME_MODELS:
+        import photon_ml_tpu.game.models as _gm
+
+        return getattr(_gm, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

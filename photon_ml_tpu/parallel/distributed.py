@@ -12,6 +12,10 @@ a replicated copy of the coefficients; the objective's partial sums meet in
 a single ``lax.psum`` over ICI per evaluation. Broadcast and aggregation
 collapse into that one collective, and the optimizer loop itself never
 leaves the device — there is no driver in the loop at all.
+
+The solve entry point is one module-level jitted function keyed on static
+(optimizer, loss, config, mesh) — re-entered, never recompiled, across
+regularization sweeps and coordinate-descent iterations.
 """
 
 from __future__ import annotations
@@ -27,9 +31,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from photon_ml_tpu.config import OptimizerConfig
 from photon_ml_tpu.normalization import NormalizationContext
 from photon_ml_tpu.ops.batch import Batch, pad_batch
-from photon_ml_tpu.ops.glm import GLMObjective, make_objective
+from photon_ml_tpu.ops.glm import make_objective
 from photon_ml_tpu.ops.losses import PointwiseLoss
-from photon_ml_tpu.optim.common import OptimizationResult
+from photon_ml_tpu.optim.common import OptimizationResult, select_minimize_fn
 
 Array = jnp.ndarray
 
@@ -48,8 +52,56 @@ def shard_batch(batch: Batch, mesh: Mesh, axis_name: str = "data") -> Batch:
     return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "minimize_fn",
+        "loss",
+        "config",
+        "intercept_index",
+        "axis_name",
+        "mesh",
+        "use_l1",
+    ),
+)
+def _sharded_solve(
+    batch: Batch,
+    w0: Array,
+    l2_weight: Array,
+    l1_weight: Array,
+    norm: NormalizationContext | None,
+    *,
+    minimize_fn: Callable,
+    loss: PointwiseLoss,
+    config: OptimizerConfig,
+    intercept_index: int | None,
+    axis_name: str,
+    mesh: Mesh,
+    use_l1: bool,
+) -> OptimizationResult:
+    def solve(local_batch, w0, l2w, l1w, norm_):
+        obj = make_objective(
+            local_batch,
+            loss,
+            l2_weight=l2w,
+            norm=norm_,
+            intercept_index=intercept_index,
+            axis_name=axis_name,
+        )
+        kwargs = {"l1_weight": l1w} if use_l1 else {}
+        return minimize_fn(obj, w0, config, **kwargs)
+
+    return jax.shard_map(
+        solve,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(), P(), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(batch, w0, l2_weight, l1_weight, norm)
+
+
 def sharded_minimize(
-    minimize_fn: Callable[[Any, Array, OptimizerConfig], OptimizationResult],
+    minimize_fn: Callable[..., OptimizationResult],
     batch: Batch,
     w0: Array,
     config: OptimizerConfig,
@@ -59,6 +111,7 @@ def sharded_minimize(
     norm: NormalizationContext | None = None,
     intercept_index: int | None = None,
     axis_name: str = "data",
+    l1_weight: float | Array | None = None,
     **minimize_kwargs,
 ) -> OptimizationResult:
     """Run a device-resident optimizer over a row-sharded batch.
@@ -69,30 +122,26 @@ def sharded_minimize(
     psum over the mesh (the twin structure of SURVEY.md §4, collapsed to
     one code path).
     """
+    if "l1_weight" in minimize_kwargs:
+        l1_weight = minimize_kwargs.pop("l1_weight")
+    if minimize_kwargs:
+        raise TypeError(f"unsupported kwargs: {sorted(minimize_kwargs)}")
     batch = shard_batch(batch, mesh, axis_name)
-
-    @jax.jit
-    def run(batch: Batch, w0: Array) -> OptimizationResult:
-        def solve(local_batch: Batch, w0: Array) -> OptimizationResult:
-            obj = make_objective(
-                local_batch,
-                loss,
-                l2_weight=l2_weight,
-                norm=norm,
-                intercept_index=intercept_index,
-                axis_name=axis_name,
-            )
-            return minimize_fn(obj, w0, config, **minimize_kwargs)
-
-        return jax.shard_map(
-            solve,
-            mesh=mesh,
-            in_specs=(P(axis_name), P()),
-            out_specs=P(),
-            check_vma=False,
-        )(batch, w0)
-
-    return run(batch, w0)
+    use_l1 = l1_weight is not None
+    return _sharded_solve(
+        batch,
+        w0,
+        jnp.asarray(l2_weight, jnp.float32),
+        jnp.asarray(0.0 if l1_weight is None else l1_weight, jnp.float32),
+        norm,
+        minimize_fn=minimize_fn,
+        loss=loss,
+        config=config,
+        intercept_index=intercept_index,
+        axis_name=axis_name,
+        mesh=mesh,
+        use_l1=use_l1,
+    )
 
 
 @dataclass(frozen=True)
@@ -112,8 +161,6 @@ class DistributedTrainer:
     axis_name: str = "data"
 
     def train(self, batch: Batch, w0: Array) -> OptimizationResult:
-        from photon_ml_tpu.optim.common import select_minimize_fn
-
         fn, kwargs = select_minimize_fn(self.config, self.l1_weight)
         return sharded_minimize(
             fn,

@@ -76,6 +76,7 @@ def train_glm(
     intercept_index: int | None = None,
     validation_batch: Batch | None = None,
     evaluators: Sequence[str] = (),
+    validation_group_ids: Mapping[str, np.ndarray] | None = None,
     variance_computation: VarianceComputationType = VarianceComputationType.NONE,
     initial_model: GeneralizedLinearModel | None = None,
     axis_name: str | None = None,
@@ -168,9 +169,18 @@ def train_glm(
         trackers[lam] = result
 
         if validation_batch is not None and specs:
-            scores = model.predict(validation_batch)
+            # evaluators consume RAW scores (margins + offsets), matching the
+            # reference: loss evaluators re-apply the pointwise loss to the
+            # margin; AUC is rank-invariant; RMSE on a linear task sees the
+            # prediction (identity link). Feeding inverse-link predictions
+            # here would evaluate e.g. the Poisson loss at exp(exp(m)).
+            scores = model.score(validation_batch)
             res = evaluate_all(
-                specs, scores, validation_batch.labels, validation_batch.weights
+                specs,
+                scores,
+                validation_batch.labels,
+                validation_batch.weights,
+                group_ids=validation_group_ids,
             )
             validation[lam] = res
             if primary is not None and (
