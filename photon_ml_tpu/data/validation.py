@@ -1,0 +1,110 @@
+"""Pre-training data validation.
+
+Reference parity: ``photon-client::ml.data.DataValidators`` (SURVEY.md §2.3):
+finite-ness checks on features/labels/offsets/weights and per-task label
+domain checks (binary labels for logistic/hinge, non-negative for Poisson),
+with modes VALIDATE_FULL / VALIDATE_SAMPLE / VALIDATE_DISABLED.
+
+Host-side numpy: validation runs at ingest, before data is shipped to
+device (shipping bad rows and detecting NaNs after a compiled step is the
+expensive way to find out).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+
+from photon_ml_tpu.types import DataValidationType, TaskType
+
+_SAMPLE_FRACTION = 0.1
+_MIN_SAMPLE = 1024
+
+
+class DataValidationError(ValueError):
+    """Raised when input data fails validation."""
+
+
+def _sample_rows(n: int, mode: DataValidationType, seed: int) -> np.ndarray | slice:
+    if mode is DataValidationType.VALIDATE_FULL:
+        return slice(None)
+    k = max(_MIN_SAMPLE, int(n * _SAMPLE_FRACTION))
+    if k >= n:
+        return slice(None)
+    return np.random.default_rng(seed).choice(n, size=k, replace=False)
+
+
+def _check_finite(name: str, a: np.ndarray) -> None:
+    if not np.isfinite(a).all():
+        bad = int((~np.isfinite(a)).sum())
+        raise DataValidationError(f"{name}: {bad} non-finite value(s)")
+
+
+def validate_labels(labels: np.ndarray, task: TaskType) -> None:
+    """Per-task label domain checks (parity with the reference's validators)."""
+    _check_finite("labels", labels)
+    if task.is_classification:
+        if not np.isin(labels, (0.0, 1.0)).all():
+            raise DataValidationError(
+                f"{task.value} requires binary labels in {{0, 1}}; "
+                f"found values outside that set"
+            )
+    elif task is TaskType.POISSON_REGRESSION:
+        if (labels < 0).any():
+            raise DataValidationError("POISSON_REGRESSION requires non-negative labels")
+
+
+def validate_arrays(
+    task: TaskType,
+    labels: np.ndarray,
+    features: Mapping[str, np.ndarray] | np.ndarray,
+    offsets: np.ndarray | None = None,
+    weights: np.ndarray | None = None,
+    mode: DataValidationType = DataValidationType.VALIDATE_FULL,
+    seed: int = 0,
+) -> None:
+    """Validate host arrays before batching. Raises ``DataValidationError``.
+
+    ``features`` may be one array or a mapping of shard → array (dense
+    values or sparse value arrays — any ndarray is checked for finiteness).
+    """
+    if mode is DataValidationType.VALIDATE_DISABLED:
+        return
+    labels = np.asarray(labels)
+    rows = _sample_rows(labels.shape[0], mode, seed)
+    validate_labels(labels[rows], task)
+    feats = features if isinstance(features, Mapping) else {"features": features}
+    for sid, f in feats.items():
+        _check_finite(f"features[{sid}]", np.asarray(f)[rows])
+    if offsets is not None:
+        _check_finite("offsets", np.asarray(offsets)[rows])
+    if weights is not None:
+        w = np.asarray(weights)[rows]
+        _check_finite("weights", w)
+        if (w < 0).any():
+            raise DataValidationError("weights must be non-negative")
+
+
+def validate_game_batch(batch, task: TaskType, mode: DataValidationType, seed: int = 0) -> None:
+    """Validate a built ``GameBatch`` (host transfer of the checked columns).
+
+    Sparse shards check their value arrays (indices are ingest-produced ints).
+    """
+    if mode is DataValidationType.VALIDATE_DISABLED:
+        return
+    from photon_ml_tpu.game.data import DenseFeatures
+
+    feats = {
+        sid: np.asarray(f.X if isinstance(f, DenseFeatures) else f.values)
+        for sid, f in batch.features.items()
+    }
+    validate_arrays(
+        task,
+        np.asarray(batch.labels),
+        feats,
+        offsets=np.asarray(batch.offsets),
+        weights=np.asarray(batch.weights),
+        mode=mode,
+        seed=seed,
+    )

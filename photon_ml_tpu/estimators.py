@@ -1,0 +1,282 @@
+"""GAME estimator: grid fit + model selection.
+
+Reference parity: ``photon-api::ml.estimators.GameEstimator`` (SURVEY.md
+§2.2, §3.1): ``fit(data, validationData, configurations)`` returns one
+``(GameModel, Option[EvaluationResults], configuration)`` per optimization
+configuration in the grid; the driver selects the best by the primary
+validation evaluator.
+
+TPU-first notes:
+- All ingest-time work that does not depend on the optimization
+  configuration — data validation, per-shard normalization statistics,
+  entity grouping/bucketing (the reference's shuffle) — happens ONCE per
+  ``fit`` and is shared across the whole grid.
+- Each grid entry re-enters the same compiled device programs (the
+  geometry — shapes, bucket capacities, mesh — is identical across the
+  grid; only λ and co. change, and those are traced scalars).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Mapping, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from photon_ml_tpu.config import (
+    GameTrainingConfig,
+    OptimizationConfig,
+    RandomEffectCoordinateConfig,
+)
+from photon_ml_tpu.data.validation import validate_game_batch
+from photon_ml_tpu.data.summary import summarize
+from photon_ml_tpu.evaluation import EvaluationResults, evaluate_all, make_evaluator
+from photon_ml_tpu.game.coordinate import (
+    Coordinate,
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.game.data import (
+    EntityBuckets,
+    EntityGrouping,
+    GameBatch,
+    bucket_entities,
+    group_by_entity,
+)
+from photon_ml_tpu.game.descent import CoordinateDescent, CoordinateDescentResult
+from photon_ml_tpu.game.models import GameModel
+from photon_ml_tpu.normalization import NormalizationContext
+from photon_ml_tpu.sampling import down_sample
+from photon_ml_tpu.types import NormalizationType, TaskType
+
+Array = jnp.ndarray
+
+# One grid entry: per-coordinate optimization configurations.
+GameOptimizationConfiguration = Mapping[str, OptimizationConfig]
+
+
+_DEFAULT_EVALUATORS = {
+    TaskType.LOGISTIC_REGRESSION: ("AUC",),
+    TaskType.LINEAR_REGRESSION: ("RMSE",),
+    TaskType.POISSON_REGRESSION: ("POISSON_LOSS",),
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: ("AUC",),
+}
+
+
+@dataclass(frozen=True)
+class GameResult:
+    """One grid entry's outcome (parity: the reference's ``GameResult``
+    triple (model, evaluations, configuration))."""
+
+    model: GameModel
+    evaluation: EvaluationResults | None
+    configuration: dict[str, OptimizationConfig]
+    descent: CoordinateDescentResult
+
+
+class GameEstimator:
+    """Fits GAME models over a grid of optimization configurations.
+
+    ``intercept_indices`` maps feature-shard id → intercept column (or
+    None); shards absent from the mapping are treated as intercept-free.
+    """
+
+    def __init__(
+        self,
+        config: GameTrainingConfig,
+        mesh: Mesh | None = None,
+        intercept_indices: Mapping[str, int | None] | None = None,
+        logger: Callable[[str], None] | None = None,
+        seed: int = 0,
+    ):
+        self.config = config
+        self.mesh = mesh
+        self.intercept_indices = dict(intercept_indices or {})
+        self._log = logger or (lambda msg: None)
+        self.seed = seed
+
+    # -- ingest-time preparation (config-grid independent) ------------------
+
+    def _normalization_contexts(self, batch: GameBatch) -> dict[str, NormalizationContext]:
+        """Per-shard normalization from feature summaries (reference:
+        ``BasicStatisticalSummary`` → ``NormalizationContext`` per shard)."""
+        if self.config.normalization is NormalizationType.NONE:
+            return {}
+        contexts: dict[str, NormalizationContext] = {}
+        fixed_shards = {
+            c.feature_shard_id for c in self.config.fixed_effect_coordinates.values()
+        }
+        for sid in fixed_shards:
+            summary = summarize(batch.batch_for(sid))
+            contexts[sid] = summary.normalization(
+                self.config.normalization, self.intercept_indices.get(sid)
+            )
+        return contexts
+
+    def _entity_layouts(
+        self, batch: GameBatch
+    ) -> dict[str, tuple[EntityGrouping, EntityBuckets, int]]:
+        """Group + bucket each random-effect coordinate's entities (the
+        ingest-time replacement for the reference's group-by-entity shuffle)."""
+        layouts: dict[str, tuple[EntityGrouping, EntityBuckets, int]] = {}
+        for cid, cfg in self.config.random_effect_coordinates.items():
+            ids = np.asarray(batch.id_tags[cfg.random_effect_type])
+            num_entities = int(ids.max()) + 1 if len(ids) else 0
+            grouping = group_by_entity(
+                ids,
+                num_entities=num_entities,
+                active_upper_bound=cfg.active_data_upper_bound,
+                seed=self.seed,
+            )
+            buckets = bucket_entities(grouping, cfg.sample_bucket_sizes)
+            layouts[cid] = (grouping, buckets, num_entities)
+        return layouts
+
+    def _build_coordinates(
+        self,
+        batch: GameBatch,
+        configuration: GameOptimizationConfiguration,
+        norm_contexts: Mapping[str, NormalizationContext],
+        entity_layouts: Mapping[str, tuple[EntityGrouping, EntityBuckets, int]],
+    ) -> dict[str, Coordinate]:
+        coordinates: dict[str, Coordinate] = {}
+        task = self.config.task_type
+        for cid in self.config.coordinate_update_sequence:
+            opt = configuration[cid]
+            coord_cfg = self.config.coordinate_config(cid)
+            if isinstance(coord_cfg, RandomEffectCoordinateConfig):
+                grouping, buckets, num_entities = entity_layouts[cid]
+                coordinates[cid] = RandomEffectCoordinate(
+                    coordinate_id=cid,
+                    batch=batch,
+                    feature_shard_id=coord_cfg.feature_shard_id,
+                    random_effect_type=coord_cfg.random_effect_type,
+                    config=opt,
+                    grouping=grouping,
+                    buckets=buckets,
+                    task_type=task,
+                    num_entities=num_entities,
+                    intercept_index=self.intercept_indices.get(coord_cfg.feature_shard_id),
+                    variance_computation=self.config.variance_computation,
+                    mesh=self.mesh,
+                )
+            else:
+                train_rows = None
+                weight_scale = None
+                if opt.down_sampling_rate < 1.0:
+                    rows, scale = down_sample(
+                        task,
+                        np.asarray(batch.labels),
+                        opt.down_sampling_rate,
+                        seed=self.seed,
+                    )
+                    train_rows = jnp.asarray(rows, jnp.int32)
+                    weight_scale = None if scale is None else jnp.asarray(scale)
+                coordinates[cid] = FixedEffectCoordinate(
+                    coordinate_id=cid,
+                    batch=batch,
+                    feature_shard_id=coord_cfg.feature_shard_id,
+                    config=opt,
+                    task_type=task,
+                    intercept_index=self.intercept_indices.get(coord_cfg.feature_shard_id),
+                    normalization=norm_contexts.get(coord_cfg.feature_shard_id),
+                    variance_computation=self.config.variance_computation,
+                    mesh=self.mesh,
+                    train_rows=train_rows,
+                    train_weight_scale=weight_scale,
+                )
+        return coordinates
+
+    # -- fit ----------------------------------------------------------------
+
+    def _evaluator_specs(self) -> tuple[str, ...]:
+        return tuple(self.config.evaluators) or _DEFAULT_EVALUATORS[self.config.task_type]
+
+    def fit(
+        self,
+        batch: GameBatch,
+        validation_batch: GameBatch | None = None,
+        configurations: Sequence[GameOptimizationConfiguration] | None = None,
+        initial_model: GameModel | None = None,
+    ) -> list[GameResult]:
+        """Train one GAME model per grid configuration.
+
+        ``configurations`` defaults to the single configuration embedded in
+        ``self.config`` (each coordinate's own ``OptimizationConfig``).
+        ``initial_model`` warm-starts every grid entry (reference:
+        ``modelInputDirectory``).
+        """
+        cfg = self.config
+        validate_game_batch(batch, cfg.task_type, cfg.data_validation, self.seed)
+        if validation_batch is not None:
+            validate_game_batch(
+                validation_batch, cfg.task_type, cfg.data_validation, self.seed
+            )
+
+        if configurations is None:
+            configurations = [
+                {
+                    cid: cfg.coordinate_config(cid).optimization
+                    for cid in cfg.coordinate_update_sequence
+                }
+            ]
+
+        norm_contexts = self._normalization_contexts(batch)
+        entity_layouts = self._entity_layouts(batch)
+        specs = self._evaluator_specs()
+
+        results: list[GameResult] = []
+        for i, configuration in enumerate(configurations):
+            self._log(f"grid entry {i + 1}/{len(configurations)}: {configuration}")
+            coordinates = self._build_coordinates(
+                batch, configuration, norm_contexts, entity_layouts
+            )
+            descent = CoordinateDescent(
+                coordinates,
+                batch,
+                cfg.task_type,
+                validation_batch=validation_batch,
+                evaluators=specs if validation_batch is not None else (),
+                logger=self._log,
+            )
+            cd_result = descent.run(
+                cfg.coordinate_update_sequence,
+                cfg.coordinate_descent_iterations,
+                initial_model=initial_model,
+            )
+            evaluation = None
+            if validation_batch is not None:
+                scores = cd_result.model.score(validation_batch)
+                evaluation = evaluate_all(
+                    specs,
+                    scores,
+                    validation_batch.labels,
+                    validation_batch.weights,
+                    group_ids=validation_batch.host_id_tags(),
+                )
+                self._log(f"grid entry {i + 1}: validation {evaluation}")
+            results.append(
+                GameResult(
+                    model=cd_result.model,
+                    evaluation=evaluation,
+                    configuration=dict(configuration),
+                    descent=cd_result,
+                )
+            )
+        return results
+
+    def select_best(self, results: Sequence[GameResult]) -> GameResult:
+        """Pick the grid entry with the best primary validation metric
+        (parity: the driver's model selection). Falls back to the first
+        result when nothing was evaluated."""
+        specs = self._evaluator_specs()
+        primary = make_evaluator(specs[0])
+        best = None
+        for r in results:
+            if r.evaluation is None:
+                continue
+            if best is None or primary.better(r.evaluation.primary, best.evaluation.primary):
+                best = r
+        return best if best is not None else results[0]

@@ -27,12 +27,16 @@ from photon_ml_tpu.config import OptimizationConfig
 from photon_ml_tpu.game.data import EntityBuckets, EntityGrouping, GameBatch
 from photon_ml_tpu.game.random_effect import (
     RandomEffectTrainingResult,
-    train_random_effects,
+    prepare_buckets,
+    train_prepared,
 )
 from photon_ml_tpu.game.models import FixedEffectModel, GameSubModel, RandomEffectModel
 from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
-from photon_ml_tpu.normalization import NormalizationContext
-from photon_ml_tpu.ops.glm import make_objective
+from photon_ml_tpu.normalization import (
+    NormalizationContext,
+    require_intercept_for_shifts,
+)
+from photon_ml_tpu.ops.glm import compute_variances, make_objective
 from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.optim.common import OptimizationResult, select_minimize_fn
 from photon_ml_tpu.parallel.distributed import sharded_minimize
@@ -88,6 +92,9 @@ class FixedEffectCoordinate:
             self.batch.labels[rows], offsets[rows], w
         )
 
+    def __post_init__(self):
+        require_intercept_for_shifts(self.normalization)
+
     def train(
         self, offsets: Array, initial: GameSubModel | None = None
     ) -> tuple[FixedEffectModel, OptimizationResult]:
@@ -140,13 +147,7 @@ class FixedEffectCoordinate:
                 norm=self.normalization,
                 intercept_index=self.intercept_index,
             )
-            if self.variance_computation is VarianceComputationType.SIMPLE:
-                variances = 1.0 / jnp.maximum(obj.hessian_diag(w), 1e-12)
-            else:
-                H = obj.hessian(w)
-                variances = jnp.diag(
-                    jnp.linalg.inv(H + 1e-9 * jnp.eye(H.shape[0], dtype=H.dtype))
-                )
+            variances = compute_variances(obj, w, self.variance_computation)
         if self.normalization is not None:
             w, _ = self.normalization.model_to_original_space(w)
             if variances is not None:
@@ -184,6 +185,23 @@ class RandomEffectCoordinate:
     mesh: Mesh | None = None
     axis_name: str = "data"
 
+    @property
+    def _prepared(self):
+        """Bucket tensors staged to device ONCE (cached on the instance);
+        each descent iteration only gathers fresh offsets on device."""
+        cached = self.__dict__.get("_prepared_cache")
+        if cached is None:
+            cached = prepare_buckets(
+                self.batch.features[self.feature_shard_id],
+                np.asarray(self.batch.labels),
+                np.asarray(self.batch.weights),
+                self.buckets,
+                self.mesh,
+                self.axis_name,
+            )
+            object.__setattr__(self, "_prepared_cache", cached)
+        return cached
+
     def train(
         self, offsets: Array, initial: GameSubModel | None = None
     ) -> tuple[RandomEffectModel, RandomEffectTrainingResult]:
@@ -198,12 +216,10 @@ class RandomEffectCoordinate:
                 raise ValueError(
                     f"warm-start entity count {W0.shape[0]} != {self.num_entities}"
                 )
-        result = train_random_effects(
-            self.batch.features[self.feature_shard_id],
-            np.asarray(self.batch.labels),
-            offsets,
-            np.asarray(self.batch.weights),
-            self.buckets,
+        result = train_prepared(
+            self._prepared,
+            jnp.asarray(offsets),
+            self.batch.features[self.feature_shard_id].num_features,
             self.num_entities,
             loss,
             opt.optimizer,

@@ -206,23 +206,35 @@ def group_by_entity(
     (parity: ``numActiveDataPointsUpperBound`` in ``RandomEffectDataset``).
     """
     entity_ids = np.asarray(entity_ids)
+    if len(entity_ids) and entity_ids.min() < 0:
+        raise ValueError(
+            "group_by_entity: negative entity ids (the unseen-entity sentinel "
+            "-1 is a scoring-time concept; training ids must be dense >= 0)"
+        )
+    max_id = int(entity_ids.max()) + 1 if len(entity_ids) else 0
     if num_entities is None:
-        num_entities = int(entity_ids.max()) + 1 if len(entity_ids) else 0
+        num_entities = max_id
+    elif num_entities < max_id:
+        raise ValueError(
+            f"group_by_entity: num_entities={num_entities} < max entity id + 1 = {max_id}"
+        )
     order = np.argsort(entity_ids, kind="stable")
-    sorted_ids = entity_ids[order]
     counts = np.bincount(entity_ids, minlength=num_entities)
-    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
 
     rng = np.random.default_rng(seed)
-    active_rows: list[np.ndarray] = []
+    # one vectorized split into per-entity segments (the "shuffle");
+    # np.split on zero segments still yields one empty array — guard E=0
+    active_rows = (
+        np.split(order, np.cumsum(counts)[:-1]) if num_entities else []
+    )
     active_counts = np.minimum(
         counts, active_upper_bound if active_upper_bound is not None else counts.max(initial=0)
     )
-    for e in range(num_entities):
-        seg = order[starts[e] : starts[e] + counts[e]]
-        if active_upper_bound is not None and counts[e] > active_upper_bound:
-            seg = rng.choice(seg, size=active_upper_bound, replace=False)
-        active_rows.append(seg)
+    if active_upper_bound is not None:
+        for e in np.flatnonzero(counts > active_upper_bound):
+            active_rows[e] = rng.choice(
+                active_rows[e], size=active_upper_bound, replace=False
+            )
     return EntityGrouping(
         num_entities=num_entities,
         counts=counts,

@@ -95,17 +95,22 @@ class CoordinateDescent:
         trackers: dict[str, list[Any]] = {cid: [] for cid in update_sequence}
         validation_history: list[dict[str, EvaluationResults]] = []
 
+        # running total of base offsets + every coordinate's score, so the
+        # per-coordinate residual is one subtraction (total − own score), not
+        # an O(K²) re-sum over the other coordinates
+        total = self.batch.offsets
+        for s in scores.values():
+            total = total + s
+
         for it in range(num_iterations):
             iter_validation: dict[str, EvaluationResults] = {}
             for cid in update_sequence:
                 coord = self.coordinates[cid]
-                # offsets = base + scores of every OTHER coordinate
-                offsets = self.batch.offsets
-                for other, s in scores.items():
-                    if other != cid:
-                        offsets = offsets + s
+                offsets = total - scores[cid] if cid in scores else total
                 sub_model, tracker = coord.train(offsets, model.models.get(cid))
-                scores[cid] = coord.score(sub_model)
+                new_score = coord.score(sub_model)
+                total = offsets + new_score
+                scores[cid] = new_score
                 model = model.updated(cid, sub_model)
                 trackers[cid].append(tracker)
 

@@ -18,6 +18,7 @@ a sharding annotation).
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 from functools import partial
 from typing import Any
@@ -62,6 +63,59 @@ class RandomEffectTrainingResult:
 
 def _pad_rows(k: int, n_dev: int) -> int:
     return -(-k // n_dev) * n_dev
+
+
+@dataclass(frozen=True)
+class PreparedBucket:
+    """One bucket's device-resident static tensors, built ONCE at coordinate
+    construction. Coordinate descent changes only the offsets, so ``train``
+    gathers fresh offsets on device and re-enters the compiled solver — no
+    host round-trip of features/labels/weights per iteration."""
+
+    entity_ids: np.ndarray  # (k,) original entity ids (host)
+    static: Batch  # (k_pad, C, …) features/labels/weights; offsets zero
+    row_idx: Array  # (k_pad, C) int32 device, clipped to >= 0
+    mask: Array  # (k_pad, C) 1.0 where the slot holds a real sample
+    num_real: int  # k (before device-count padding)
+
+
+def prepare_buckets(
+    features: Features,
+    labels: np.ndarray,
+    weights: np.ndarray,
+    buckets: EntityBuckets,
+    mesh: Mesh | None = None,
+    axis_name: str = "data",
+) -> list[PreparedBucket]:
+    """Gather every bucket's static tensors to device (padding the entity
+    lane to divide the mesh axis, and sharding over it when given)."""
+    n_dev = mesh.shape[axis_name] if mesh is not None else 1
+    zeros_off = np.zeros_like(np.asarray(labels))
+    prepared: list[PreparedBucket] = []
+    for ent_ids, row_idx in zip(buckets.entity_ids, buckets.row_indices):
+        k = len(ent_ids)
+        static = gather_bucket(features, labels, zeros_off, weights, row_idx)
+        idx = jnp.asarray(np.maximum(row_idx, 0), jnp.int32)
+        mask = jnp.asarray((row_idx >= 0).astype(np.float32))
+        if n_dev > 1:
+            k_pad = _pad_rows(k, n_dev)
+            if k_pad != k:
+                pad = k_pad - k
+                pad0 = lambda a: jnp.concatenate(
+                    [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)]
+                )
+                static = jax.tree.map(pad0, static)
+                idx, mask = pad0(idx), pad0(mask)
+            sharding = NamedSharding(mesh, P(axis_name))
+            static = jax.tree.map(lambda a: jax.device_put(a, sharding), static)
+            idx = jax.device_put(idx, sharding)
+            mask = jax.device_put(mask, sharding)
+        prepared.append(
+            PreparedBucket(
+                entity_ids=ent_ids, static=static, row_idx=idx, mask=mask, num_real=k
+            )
+        )
+    return prepared
 
 
 @partial(jax.jit, static_argnames=("minimize_fn", "loss", "config", "intercept_index", "compute_variance"))
@@ -115,7 +169,43 @@ def train_random_effects(
     XLA partitions the batched solve with no collectives — the TPU analog of
     the reference's ``RandomEffectDatasetPartitioner`` balancing.
     """
-    d = features.num_features
+    prepared = prepare_buckets(features, labels, weights, buckets, mesh, axis_name)
+    return train_prepared(
+        prepared,
+        jnp.asarray(offsets),
+        features.num_features,
+        num_entities,
+        loss,
+        config,
+        l2_weight=l2_weight,
+        l1_weight=l1_weight,
+        intercept_index=intercept_index,
+        initial_coefficients=initial_coefficients,
+        variance_computation=variance_computation,
+        mesh=mesh,
+        axis_name=axis_name,
+    )
+
+
+def train_prepared(
+    prepared: list[PreparedBucket],
+    offsets: Array,  # (n,) current residual offsets (device)
+    num_features: int,
+    num_entities: int,
+    loss: PointwiseLoss,
+    config: OptimizerConfig,
+    l2_weight: float = 0.0,
+    l1_weight: float = 0.0,
+    intercept_index: int | None = None,
+    initial_coefficients: Array | None = None,
+    variance_computation: VarianceComputationType = VarianceComputationType.NONE,
+    mesh: Mesh | None = None,
+    axis_name: str = "data",
+) -> RandomEffectTrainingResult:
+    """Solve every prepared bucket against the current offsets. Only the
+    offsets are gathered per call (on device); everything else was staged by
+    ``prepare_buckets``."""
+    d = num_features
     if variance_computation is VarianceComputationType.FULL:
         raise NotImplementedError(
             "FULL per-entity variance is not supported (the reference computes "
@@ -134,27 +224,18 @@ def train_random_effects(
     converged = np.zeros((num_entities,), bool)
 
     l2 = jnp.asarray(l2_weight, jnp.float32)
-    n_dev = mesh.shape[axis_name] if mesh is not None else 1
+    sharding = NamedSharding(mesh, P(axis_name)) if mesh is not None else None
 
-    for ent_ids, row_idx in zip(buckets.entity_ids, buckets.row_indices):
-        k = len(ent_ids)
-        bucket_batch = gather_bucket(features, labels, offsets, weights, row_idx)
-        w0 = W[jnp.asarray(ent_ids)]
-        if n_dev > 1:
-            k_pad = _pad_rows(k, n_dev)
-            if k_pad != k:
-                pad = k_pad - k
-                bucket_batch = jax.tree.map(
-                    lambda a: jnp.concatenate(
-                        [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)]
-                    ),
-                    bucket_batch,
-                )
-                w0 = jnp.concatenate([w0, jnp.zeros((pad, d), w0.dtype)])
-            sharding = NamedSharding(mesh, P(axis_name))
-            bucket_batch = jax.tree.map(
-                lambda a: jax.device_put(a, sharding), bucket_batch
+    for pb in prepared:
+        k = pb.num_real
+        off_b = offsets[pb.row_idx] * pb.mask  # (k_pad, C), on device
+        bucket_batch = dataclasses.replace(pb.static, offsets=off_b)
+        w0 = W[jnp.asarray(pb.entity_ids)]
+        if pb.static.labels.shape[0] != k:  # entity lane was padded for the mesh
+            w0 = jnp.concatenate(
+                [w0, jnp.zeros((pb.static.labels.shape[0] - k, d), w0.dtype)]
             )
+        if sharding is not None:
             w0 = jax.device_put(w0, sharding)
 
         w_b, f_b, it_b, reason_b, var_b = _solve_bucket(
@@ -168,13 +249,13 @@ def train_random_effects(
             compute_variance=compute_variance,
             **extra,
         )
-        ids = jnp.asarray(ent_ids)
+        ids = jnp.asarray(pb.entity_ids)
         W = W.at[ids].set(w_b[:k])
         if compute_variance:
             V = V.at[ids].set(1.0 / jnp.maximum(var_b[:k], 1e-12))
-        loss_values[ent_ids] = np.asarray(f_b[:k], np.float64)
-        iterations[ent_ids] = np.asarray(it_b[:k])
-        converged[ent_ids] = np.asarray(reason_b[:k]) != 0  # != MAX_ITERATIONS
+        loss_values[pb.entity_ids] = np.asarray(f_b[:k], np.float64)
+        iterations[pb.entity_ids] = np.asarray(it_b[:k])
+        converged[pb.entity_ids] = np.asarray(reason_b[:k]) != 0  # != MAX_ITERATIONS
 
     return RandomEffectTrainingResult(
         coefficients=W,

@@ -92,6 +92,21 @@ class NormalizationContext:
         return w
 
 
+def require_intercept_for_shifts(norm: "NormalizationContext | None") -> None:
+    """A shifted transform (STANDARDIZATION) without an intercept column
+    cannot be un-applied on the output model — the constant -s·(f⊙w) would
+    be silently dropped. Shared guard for every training entry point."""
+    if (
+        norm is not None
+        and norm.intercept_index is None
+        and np.any(np.asarray(norm.shifts) != 0.0)
+    ):
+        raise ValueError(
+            "normalization with shifts (STANDARDIZATION) requires an "
+            "intercept column to absorb the shift on the output model"
+        )
+
+
 def no_normalization(num_features: int, intercept_index: int | None = None) -> NormalizationContext:
     return NormalizationContext(
         factors=jnp.ones((num_features,), jnp.float32),

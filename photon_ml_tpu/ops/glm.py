@@ -36,6 +36,7 @@ from jax import lax
 from photon_ml_tpu.normalization import NormalizationContext, no_normalization
 from photon_ml_tpu.ops.batch import Batch, DenseBatch
 from photon_ml_tpu.ops.losses import PointwiseLoss
+from photon_ml_tpu.types import VarianceComputationType
 
 Array = jnp.ndarray
 
@@ -149,6 +150,26 @@ class GLMObjective:
         local = Z.T @ (d2[:, None] * Z)
         h = self._reduce(local)
         return h + jnp.diag(self.l2_weight * self.reg_mask)
+
+
+def compute_variances(
+    obj: GLMObjective, w: Array, variance_type: VarianceComputationType
+) -> Array | None:
+    """Coefficient variances from the Hessian at the optimum.
+
+    Parity: ``photon-api::ml.optimization.VarianceComputationType`` — SIMPLE
+    inverts the Hessian diagonal; FULL takes the diagonal of the full
+    Hessian inverse. Shared by the GLM sweep and the GAME fixed-effect
+    coordinate (one implementation, one set of numerical guards).
+    """
+    if variance_type is VarianceComputationType.NONE:
+        return None
+    if variance_type is VarianceComputationType.SIMPLE:
+        return 1.0 / jnp.maximum(obj.hessian_diag(w), 1e-12)
+    H = obj.hessian(w)
+    d = H.shape[0]
+    Hinv = jnp.linalg.inv(H + 1e-9 * jnp.eye(d, dtype=H.dtype))
+    return jnp.diag(Hinv)
 
 
 def make_objective(

@@ -1,0 +1,70 @@
+"""Per-coordinate down-sampling.
+
+Reference parity: ``photon-api::ml.sampling.{DownSampler,
+BinaryClassificationDownSampler, DefaultDownSampler}`` (SURVEY.md §2.2) —
+used per-coordinate (especially the fixed effect) to shrink the training set:
+
+- Binary classification: keep ALL positives, Bernoulli-sample negatives at
+  ``rate`` and multiply the kept negatives' weights by ``1/rate`` so the
+  objective stays an unbiased estimate of the full-data objective.
+- Default (regression tasks): uniform Bernoulli sample at ``rate`` with no
+  weight correction (matching the reference's plain ``RDD.sample``).
+
+TPU-first note: down-sampling happens on the host at ingest as *row-index
+selection*. The selected rows form the coordinate's training batch (a
+gather); scoring always uses every row. This replaces the reference's
+per-trainModel RDD sample with a seeded, reproducible index computation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from photon_ml_tpu.types import TaskType
+
+
+def default_down_sample(
+    num_rows: int, rate: float, rng: np.random.Generator
+) -> tuple[np.ndarray, np.ndarray | None]:
+    """Uniform Bernoulli sample of rows. Returns (rows, weight_scale=None).
+
+    Parity: ``DefaultDownSampler`` — no weight correction.
+    """
+    if not 0.0 < rate < 1.0:
+        raise ValueError(f"down-sampling rate must be in (0, 1), got {rate}")
+    keep = rng.uniform(size=num_rows) < rate
+    return np.flatnonzero(keep), None
+
+
+def binary_classification_down_sample(
+    labels: np.ndarray, rate: float, rng: np.random.Generator
+) -> tuple[np.ndarray, np.ndarray]:
+    """Negative down-sampling for imbalanced binary data.
+
+    Keeps every positive (label > 0), samples negatives at ``rate``, and
+    returns per-kept-row weight multipliers (1 for positives, 1/rate for
+    kept negatives). Parity: ``BinaryClassificationDownSampler``.
+    """
+    if not 0.0 < rate < 1.0:
+        raise ValueError(f"down-sampling rate must be in (0, 1), got {rate}")
+    labels = np.asarray(labels)
+    positive = labels > 0
+    keep = positive | (rng.uniform(size=labels.shape[0]) < rate)
+    rows = np.flatnonzero(keep)
+    scale = np.where(positive[rows], 1.0, 1.0 / rate).astype(np.float32)
+    return rows, scale
+
+
+def down_sample(
+    task: TaskType,
+    labels: np.ndarray,
+    rate: float,
+    seed: int = 0,
+) -> tuple[np.ndarray, np.ndarray | None]:
+    """Task-appropriate down-sampling (the reference's driver picks the
+    sampler the same way: classification → negative down-sampling, else
+    uniform). Returns (row_indices, weight_scale_or_None)."""
+    rng = np.random.default_rng(seed)
+    if task.is_classification:
+        return binary_classification_down_sample(labels, rate, rng)
+    return default_down_sample(len(labels), rate, rng)

@@ -23,9 +23,12 @@ import numpy as np
 from photon_ml_tpu.config import OptimizerConfig, RegularizationContext
 from photon_ml_tpu.evaluation import EvaluationResults, evaluate_all, make_evaluator
 from photon_ml_tpu.models import Coefficients, GeneralizedLinearModel
-from photon_ml_tpu.normalization import NormalizationContext
+from photon_ml_tpu.normalization import (
+    NormalizationContext,
+    require_intercept_for_shifts,
+)
 from photon_ml_tpu.ops.batch import Batch
-from photon_ml_tpu.ops.glm import GLMObjective, make_objective
+from photon_ml_tpu.ops.glm import GLMObjective, compute_variances, make_objective
 from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.optim.common import OptimizationResult, select_minimize_fn
 from photon_ml_tpu.types import OptimizerType, TaskType, VarianceComputationType
@@ -49,21 +52,6 @@ class GLMTrainingResult:
             # without it the sweep's final — most regularized — model)
             return self.models[list(self.models)[-1]]
         return self.models[self.best_weight]
-
-
-def _compute_variances(
-    obj: GLMObjective, w: Array, variance_type: VarianceComputationType
-) -> Array | None:
-    """Parity: ``VarianceComputationType`` — SIMPLE inverts the Hessian
-    diagonal; FULL takes the diagonal of the full Hessian inverse."""
-    if variance_type is VarianceComputationType.NONE:
-        return None
-    if variance_type is VarianceComputationType.SIMPLE:
-        return 1.0 / jnp.maximum(obj.hessian_diag(w), 1e-12)
-    H = obj.hessian(w)
-    d = H.shape[0]
-    Hinv = jnp.linalg.inv(H + 1e-9 * jnp.eye(d, dtype=H.dtype))
-    return jnp.diag(Hinv)
 
 
 def train_glm(
@@ -109,12 +97,7 @@ def train_glm(
     d = batch.num_features
     dtype = batch.labels.dtype
 
-    if normalization is not None and normalization.intercept_index is None:
-        if np.any(np.asarray(normalization.shifts) != 0.0):
-            raise ValueError(
-                "normalization with shifts (STANDARDIZATION) requires an "
-                "intercept column to absorb the shift on the output model"
-            )
+    require_intercept_for_shifts(normalization)
 
     # The optimizer works in NORMALIZED coefficient space; models are kept in
     # ORIGINAL space (the reference un-applies factors on the final model).
@@ -157,7 +140,7 @@ def train_glm(
         result = minimize_fn(obj, w, optimizer_config, **extra)
         w = result.w  # warm start the next λ (normalized space)
 
-        variances = _compute_variances(obj, result.w, variance_computation)
+        variances = compute_variances(obj, result.w, variance_computation)
         w_model = result.w
         if normalization is not None:
             w_model, _ = normalization.model_to_original_space(result.w)

@@ -1,0 +1,58 @@
+"""GAME transformer: score a dataset with a trained model.
+
+Reference parity: ``photon-api::ml.transformers.GameTransformer`` (SURVEY.md
+§2.2, §3.3): fixed effect scored via a broadcast dot-product, random effects
+via a join on entity id, contributions summed (+ link function for
+predictions), optional evaluation of the scored data.
+
+TPU-first: there is no broadcast and no join. The fixed-effect coefficient
+vector is device-resident; each random-effect model is an (E, d) matrix, so
+per-sample scoring is a gather + row-dot — the reference's shuffle/join
+boundary becomes an HBM gather.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.evaluation import EvaluationResults, evaluate_all
+from photon_ml_tpu.game.data import GameBatch
+from photon_ml_tpu.game.models import GameModel
+
+Array = jnp.ndarray
+
+
+class GameTransformer:
+    """Scores ``GameBatch``es with a ``GameModel``."""
+
+    def __init__(self, model: GameModel, logger: Callable[[str], None] | None = None):
+        self.model = model
+        self._log = logger or (lambda msg: None)
+
+    def transform(self, batch: GameBatch) -> Array:
+        """Raw scores: Σ coordinate contributions + data offsets (the
+        reference's ``ModelDataScores``)."""
+        return self.model.score(batch)
+
+    def predict(self, batch: GameBatch) -> Array:
+        """Mean response (inverse link applied to the raw score)."""
+        return self.model.predict(batch)
+
+    def transform_with_evaluation(
+        self, batch: GameBatch, evaluators: Sequence[str]
+    ) -> tuple[Array, EvaluationResults]:
+        """Score and evaluate in one pass (parity: scoring driver's optional
+        evaluation of scored data). Evaluators consume RAW scores — loss
+        metrics re-apply the pointwise loss to the margin."""
+        scores = self.transform(batch)
+        results = evaluate_all(
+            list(evaluators),
+            scores,
+            batch.labels,
+            batch.weights,
+            group_ids=batch.host_id_tags(),
+        )
+        self._log(f"scoring evaluation: {results}")
+        return scores, results

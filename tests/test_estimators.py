@@ -1,0 +1,256 @@
+"""Estimator/Transformer API tests: grid fit, model selection, scoring,
+down-sampling, data validation.
+
+Mirrors the reference's ``GameEstimatorIntegTest`` strategy (SURVEY.md §4):
+fit on synthetic GLMix data with known generating effects, assert the grid
+returns one result per configuration and selection picks the best validation
+metric.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.config import (
+    FixedEffectCoordinateConfig,
+    GameTrainingConfig,
+    OptimizationConfig,
+    OptimizerConfig,
+    RandomEffectCoordinateConfig,
+    RegularizationContext,
+)
+from photon_ml_tpu.data.synthetic import synthetic_game_data
+from photon_ml_tpu.data.validation import DataValidationError, validate_arrays
+from photon_ml_tpu.estimators import GameEstimator
+from photon_ml_tpu.game import make_game_batch
+from photon_ml_tpu.sampling import binary_classification_down_sample, down_sample
+from photon_ml_tpu.transformers import GameTransformer
+from photon_ml_tpu.types import (
+    DataValidationType,
+    NormalizationType,
+    RegularizationType,
+    TaskType,
+)
+
+OPT = OptimizerConfig(max_iterations=50, tolerance=1e-8)
+
+
+def _game_batches(rng, n=600, task=TaskType.LOGISTIC_REGRESSION):
+    data = synthetic_game_data(
+        rng, n, d_fixed=5, effects={"userId": (20, 3)}, task=task
+    )
+    split = int(n * 0.7)
+    def mk(lo, hi):
+        return make_game_batch(
+            data.y[lo:hi],
+            {
+                "global": data.X[lo:hi],
+                "per_user": data.entity_X["userId"][lo:hi],
+            },
+            id_tags={"userId": data.entity_ids["userId"][lo:hi]},
+        )
+    return mk(0, split), mk(split, n), data
+
+
+def _config(task=TaskType.LOGISTIC_REGRESSION, **kwargs):
+    return GameTrainingConfig(
+        task_type=task,
+        coordinate_update_sequence=("fixed", "per_user"),
+        coordinate_descent_iterations=2,
+        fixed_effect_coordinates={
+            "fixed": FixedEffectCoordinateConfig(
+                feature_shard_id="global",
+                optimization=OptimizationConfig(optimizer=OPT),
+            )
+        },
+        random_effect_coordinates={
+            "per_user": RandomEffectCoordinateConfig(
+                random_effect_type="userId",
+                feature_shard_id="per_user",
+                optimization=OptimizationConfig(
+                    optimizer=OPT,
+                    regularization=RegularizationContext(RegularizationType.L2),
+                    regularization_weight=1.0,
+                ),
+            )
+        },
+        **kwargs,
+    )
+
+
+class TestGameEstimator:
+    def test_fit_returns_one_result_per_configuration(self, rng):
+        train, val, _ = _game_batches(rng)
+        cfg = _config()
+        est = GameEstimator(cfg, intercept_indices={"global": 5})
+        l2 = RegularizationContext(RegularizationType.L2)
+        grid = [
+            {
+                "fixed": OptimizationConfig(optimizer=OPT),
+                "per_user": OptimizationConfig(
+                    optimizer=OPT, regularization=l2, regularization_weight=lam
+                ),
+            }
+            for lam in (0.1, 10.0)
+        ]
+        results = est.fit(train, val, configurations=grid)
+        assert len(results) == 2
+        for r, g in zip(results, grid):
+            assert r.evaluation is not None
+            assert r.configuration == g
+            assert set(r.model.models) == {"fixed", "per_user"}
+        best = est.select_best(results)
+        assert best in results
+        # AUC: higher is better — best must dominate
+        assert all(best.evaluation.primary >= r.evaluation.primary for r in results)
+
+    def test_fit_beats_fixed_only_on_glmix_data(self, rng):
+        """The random effect must add real lift on data generated with
+        per-entity effects (the GLMix premise)."""
+        train, val, _ = _game_batches(rng, n=800)
+        full = GameEstimator(_config(), intercept_indices={"global": 5})
+        full_res = full.fit(train, val)[0]
+
+        fixed_only_cfg = _config().replace(
+            coordinate_update_sequence=("fixed",), random_effect_coordinates={}
+        )
+        fixed_only = GameEstimator(fixed_only_cfg, intercept_indices={"global": 5})
+        fixed_res = fixed_only.fit(train, val)[0]
+        assert full_res.evaluation.primary > fixed_res.evaluation.primary
+
+    def test_default_configuration_comes_from_config(self, rng):
+        train, _, _ = _game_batches(rng, n=300)
+        cfg = _config()
+        est = GameEstimator(cfg, intercept_indices={"global": 5})
+        results = est.fit(train)
+        assert len(results) == 1
+        assert results[0].evaluation is None
+        assert results[0].configuration["per_user"].regularization_weight == 1.0
+
+    def test_normalization_path(self, rng):
+        train, val, _ = _game_batches(rng, n=400)
+        cfg = _config(normalization=NormalizationType.STANDARDIZATION)
+        est = GameEstimator(cfg, intercept_indices={"global": 5})
+        results = est.fit(train, val)
+        assert np.isfinite(results[0].evaluation.primary)
+
+    def test_down_sampling_path(self, rng):
+        train, val, _ = _game_batches(rng, n=500)
+        cfg = _config()
+        grid = [
+            {
+                "fixed": OptimizationConfig(optimizer=OPT, down_sampling_rate=0.5),
+                "per_user": cfg.random_effect_coordinates["per_user"].optimization,
+            }
+        ]
+        est = GameEstimator(cfg, intercept_indices={"global": 5})
+        results = est.fit(train, val, configurations=grid)
+        assert np.isfinite(results[0].evaluation.primary)
+        # down-sampled training must still produce a usable model
+        assert results[0].evaluation.primary > 0.5
+
+    def test_warm_start_initial_model(self, rng):
+        train, val, _ = _game_batches(rng, n=400)
+        cfg = _config()
+        est = GameEstimator(cfg, intercept_indices={"global": 5})
+        first = est.fit(train, val)[0]
+        warm = est.fit(train, val, initial_model=first.model)[0]
+        assert np.isfinite(warm.evaluation.primary)
+
+
+class TestGameTransformer:
+    def test_transform_matches_model_score(self, rng):
+        train, val, _ = _game_batches(rng, n=400)
+        est = GameEstimator(_config(), intercept_indices={"global": 5})
+        result = est.fit(train)[0]
+        t = GameTransformer(result.model)
+        np.testing.assert_allclose(
+            np.asarray(t.transform(val)), np.asarray(result.model.score(val))
+        )
+        # predictions are probabilities for logistic
+        p = np.asarray(t.predict(val))
+        assert ((p >= 0) & (p <= 1)).all()
+
+    def test_transform_with_evaluation(self, rng):
+        train, val, _ = _game_batches(rng, n=400)
+        est = GameEstimator(_config(), intercept_indices={"global": 5})
+        result = est.fit(train)[0]
+        scores, ev = GameTransformer(result.model).transform_with_evaluation(
+            val, ["AUC", "LOGISTIC_LOSS"]
+        )
+        assert scores.shape[0] == val.num_rows
+        assert np.isfinite(ev.primary)
+
+
+class TestDownSampling:
+    def test_binary_keeps_all_positives_and_reweights(self, rng):
+        labels = (rng.uniform(size=2000) < 0.2).astype(np.float32)
+        rows, scale = binary_classification_down_sample(labels, 0.25, rng)
+        kept = labels[rows]
+        assert kept.sum() == labels.sum()  # every positive kept
+        np.testing.assert_allclose(scale[kept > 0], 1.0)
+        np.testing.assert_allclose(scale[kept == 0], 4.0)
+        # ~25% of negatives kept
+        frac = (kept == 0).sum() / (labels == 0).sum()
+        assert 0.15 < frac < 0.35
+
+    def test_default_uniform(self, rng):
+        rows, scale = down_sample(
+            TaskType.LINEAR_REGRESSION, np.zeros(4000, np.float32), 0.5, seed=3
+        )
+        assert scale is None
+        assert 0.4 < len(rows) / 4000 < 0.6
+
+    def test_bad_rate_raises(self, rng):
+        with pytest.raises(ValueError):
+            down_sample(TaskType.LINEAR_REGRESSION, np.zeros(10), 1.5)
+
+
+class TestDataValidation:
+    def test_nan_features_rejected(self):
+        X = np.ones((10, 3))
+        X[3, 1] = np.nan
+        with pytest.raises(DataValidationError):
+            validate_arrays(TaskType.LINEAR_REGRESSION, np.zeros(10), X)
+
+    def test_logistic_requires_binary_labels(self):
+        with pytest.raises(DataValidationError):
+            validate_arrays(
+                TaskType.LOGISTIC_REGRESSION, np.array([0.0, 2.0]), np.ones((2, 1))
+            )
+
+    def test_poisson_requires_nonnegative(self):
+        with pytest.raises(DataValidationError):
+            validate_arrays(
+                TaskType.POISSON_REGRESSION, np.array([1.0, -1.0]), np.ones((2, 1))
+            )
+
+    def test_negative_weights_rejected(self):
+        with pytest.raises(DataValidationError):
+            validate_arrays(
+                TaskType.LINEAR_REGRESSION,
+                np.zeros(2),
+                np.ones((2, 1)),
+                weights=np.array([1.0, -1.0]),
+            )
+
+    def test_disabled_mode_skips(self):
+        X = np.full((4, 2), np.nan)
+        validate_arrays(
+            TaskType.LINEAR_REGRESSION,
+            np.zeros(4),
+            X,
+            mode=DataValidationType.VALIDATE_DISABLED,
+        )
+
+    def test_estimator_validates_when_enabled(self, rng):
+        train, _, _ = _game_batches(rng, n=200)
+        bad = make_game_batch(
+            np.asarray(train.labels) + np.nan,
+            {k: np.asarray(v.X) for k, v in train.features.items()},
+            id_tags=train.host_id_tags(),
+        )
+        cfg = _config(data_validation=DataValidationType.VALIDATE_FULL)
+        est = GameEstimator(cfg, intercept_indices={"global": 5})
+        with pytest.raises(DataValidationError):
+            est.fit(bad)
