@@ -1,0 +1,11 @@
+"""Drivers / CLI layer.
+
+Reference parity: ``photon-client``'s driver layer (SURVEY.md §2.3, §3) —
+``GameTrainingDriver`` (``python -m photon_ml_tpu.cli.train``),
+``GameScoringDriver`` (``cli.score``), the legacy single-GLM ``Driver``
+(``cli.train_glm``), ``FeatureIndexingDriver`` (``cli.index_features``) and
+``NameAndTermFeatureBagsDriver`` (``cli.name_term_bags``).
+
+scopt + spark.ml ParamMaps are replaced by argparse + one JSON config
+document (``GameTrainingConfig.to_dict`` round-trip).
+"""

@@ -1,0 +1,12 @@
+"""Shared CLI helpers."""
+
+from __future__ import annotations
+
+import json
+
+from photon_ml_tpu.config import GameTrainingConfig, parse_config
+
+
+def load_training_config(path: str) -> GameTrainingConfig:
+    with open(path) as f:
+        return parse_config(json.load(f))

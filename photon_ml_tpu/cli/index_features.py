@@ -1,0 +1,60 @@
+"""Feature indexing driver.
+
+Reference parity: ``photon-client::ml.index.FeatureIndexingDriver``
+(SURVEY.md §2.3, §3.5): an offline job that scans data, collects distinct
+(name, term) pairs per feature shard, and writes index stores that training
+jobs load instead of re-scanning (the reference writes partitioned PalDB
+stores; here each shard's map persists as one mmap-loadable ``.npz`` — see
+``data.index_map``).
+
+Usage:
+    python -m photon_ml_tpu.cli.index_features \\
+        --data data/train --config config.json --output-dir index/
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+from photon_ml_tpu.cli.common import load_training_config
+from photon_ml_tpu.io.data_reader import AvroDataReader
+from photon_ml_tpu.io.avro import iter_avro_directory
+from photon_ml_tpu.utils import PhotonLogger, timed
+
+
+def run(data: list[str], output_dir: str, config_path: str | None = None,
+        logger: PhotonLogger | None = None):
+    logger = logger or PhotonLogger(output_dir)
+    shards = None
+    if config_path:
+        shards = dict(load_training_config(config_path).feature_shards)
+    reader = AvroDataReader(shards)
+    with timed(logger, "scan data"):
+        records = []
+        for p in data:
+            records.extend(iter_avro_directory(p))
+        maps = reader.build_index_maps(records)
+    with timed(logger, "write index stores"):
+        sizes = {}
+        for sid, imap in maps.items():
+            imap.save(os.path.join(output_dir, sid))
+            sizes[sid] = imap.size
+        with open(os.path.join(output_dir, "_sizes.json"), "w") as f:
+            json.dump(sizes, f)
+    logger.info(f"index maps written: {sizes}")
+    return maps
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description="Feature indexing driver")
+    p.add_argument("--data", required=True, nargs="+")
+    p.add_argument("--config", default=None)
+    p.add_argument("--output-dir", required=True)
+    args = p.parse_args(argv)
+    run(args.data, args.output_dir, config_path=args.config)
+
+
+if __name__ == "__main__":
+    main()

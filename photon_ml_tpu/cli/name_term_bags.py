@@ -1,0 +1,53 @@
+"""Name-and-term feature-bag driver.
+
+Reference parity: ``photon-client::ml.cli.NameAndTermFeatureBagsDriver``
+(SURVEY.md §2.3): collects the distinct (name, term) pairs of each feature
+bag across the data and writes them as bag lists (used downstream to define
+feature shards). Output: one JSON file per bag with its sorted pairs.
+
+Usage:
+    python -m photon_ml_tpu.cli.name_term_bags \\
+        --data data/train --bags features userFeatures --output-dir bags/
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+from photon_ml_tpu.io.avro import iter_avro_directory
+from photon_ml_tpu.utils import PhotonLogger, timed
+
+
+def run(data: list[str], bags: list[str], output_dir: str,
+        logger: PhotonLogger | None = None) -> dict[str, list[tuple[str, str]]]:
+    logger = logger or PhotonLogger(output_dir)
+    seen: dict[str, set[tuple[str, str]]] = {b: set() for b in bags}
+    with timed(logger, "scan data"):
+        for p in data:
+            for rec in iter_avro_directory(p):
+                for bag in bags:
+                    for ntv in rec.get(bag) or ():
+                        seen[bag].add((ntv["name"], ntv["term"]))
+    os.makedirs(output_dir, exist_ok=True)
+    out: dict[str, list[tuple[str, str]]] = {}
+    for bag, pairs in seen.items():
+        out[bag] = sorted(pairs)
+        with open(os.path.join(output_dir, f"{bag}.json"), "w") as f:
+            json.dump([{"name": n, "term": t} for n, t in out[bag]], f, indent=2)
+        logger.info(f"bag {bag}: {len(pairs)} distinct name-term pairs")
+    return out
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description="Name-and-term feature bags driver")
+    p.add_argument("--data", required=True, nargs="+")
+    p.add_argument("--bags", required=True, nargs="+")
+    p.add_argument("--output-dir", required=True)
+    args = p.parse_args(argv)
+    run(args.data, args.bags, args.output_dir)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+"""GAME scoring driver.
+
+Reference parity: ``photon-client::ml.cli.game.scoring.GameScoringDriver``
+(SURVEY.md §2.3, §3.3): load model + data, score via ``GameTransformer``,
+write ``ScoringResultAvro``, optional evaluation.
+
+Usage:
+    python -m photon_ml_tpu.cli.score \\
+        --model-dir out/ --data data/test --output-dir scores/ \\
+        [--evaluators AUC LOGISTIC_LOSS] [--feature-shards config.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+from photon_ml_tpu.cli.common import load_training_config
+from photon_ml_tpu.config import FeatureShardConfig
+from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.io.data_reader import AvroDataReader
+from photon_ml_tpu.io.model_io import load_game_model
+from photon_ml_tpu.io.results import write_scoring_results
+from photon_ml_tpu.game.models import RandomEffectModel
+from photon_ml_tpu.transformers import GameTransformer
+from photon_ml_tpu.utils import PhotonLogger, timed
+
+
+def run(
+    model_dir: str,
+    data: list[str],
+    output_dir: str,
+    evaluators: list[str] | None = None,
+    feature_shards: dict[str, FeatureShardConfig] | None = None,
+    logger: PhotonLogger | None = None,
+):
+    """``model_dir`` is a training output dir (contains ``best/``,
+    ``index-maps/``, ``entity-maps.json``) or a bare model dir with the
+    maps alongside."""
+    logger = logger or PhotonLogger(output_dir)
+
+    best_dir = os.path.join(model_dir, "best")
+    if os.path.isdir(best_dir):
+        game_dir = best_dir
+        maps_root = model_dir
+    else:
+        game_dir = model_dir
+        maps_root = os.path.dirname(model_dir.rstrip("/"))
+
+    with timed(logger, "load model + maps"):
+        index_maps = {}
+        imap_dir = os.path.join(maps_root, "index-maps")
+        if os.path.isdir(imap_dir):
+            for fn in os.listdir(imap_dir):
+                if fn.endswith(".npz"):
+                    index_maps[fn[:-4]] = IndexMap.load(os.path.join(imap_dir, fn))
+        entity_maps = {}
+        em_path = os.path.join(maps_root, "entity-maps.json")
+        if os.path.exists(em_path):
+            with open(em_path) as f:
+                entity_maps = json.load(f)
+        entity_ids = None
+        if entity_maps:
+            entity_ids = {
+                cid: entity_maps[retype]
+                for cid, retype in _random_effects(game_dir).items()
+                if retype in entity_maps
+            }
+        model = load_game_model(game_dir, index_maps=index_maps, entity_ids=entity_ids)
+
+    id_tags = tuple(
+        sub.random_effect_type
+        for sub in model.models.values()
+        if isinstance(sub, RandomEffectModel)
+    )
+    reader = AvroDataReader(feature_shards)
+    with timed(logger, "read scoring data"):
+        ds = reader.read(
+            data,
+            id_tags=id_tags,
+            index_maps=index_maps or None,
+            entity_maps={t: entity_maps[t] for t in id_tags} if entity_maps else None,
+        )
+
+    transformer = GameTransformer(model, logger=logger)
+    with timed(logger, "score"):
+        if evaluators:
+            scores, results = transformer.transform_with_evaluation(ds.batch, evaluators)
+            metrics = dict(results.metrics)
+        else:
+            scores = transformer.transform(ds.batch)
+            metrics = None
+
+    with timed(logger, "write scores"):
+        write_scoring_results(
+            os.path.join(output_dir, "scores", "part-00000.avro"),
+            np.asarray(scores),
+            uids=ds.uids,
+            labels=ds.labels,
+        )
+        if metrics is not None:
+            with open(os.path.join(output_dir, "metrics.json"), "w") as f:
+                json.dump(metrics, f, indent=2)
+    return scores, metrics
+
+
+def _random_effects(game_dir: str) -> dict:
+    """cid → random_effect_type from the model's metadata (pre-load peek)."""
+    with open(os.path.join(game_dir, "metadata.json")) as f:
+        meta = json.load(f)
+    return {
+        cid: info["random_effect_type"]
+        for cid, info in meta["coordinates"].items()
+        if info["type"] == "random"
+    }
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description="GAME scoring driver")
+    p.add_argument("--model-dir", required=True)
+    p.add_argument("--data", required=True, nargs="+")
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--evaluators", nargs="*", default=None)
+    p.add_argument(
+        "--config", default=None, help="training config JSON (for feature shards)"
+    )
+    args = p.parse_args(argv)
+    shards = None
+    if args.config:
+        shards = dict(load_training_config(args.config).feature_shards)
+    run(
+        args.model_dir,
+        args.data,
+        args.output_dir,
+        evaluators=args.evaluators,
+        feature_shards=shards,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,251 @@
+"""GAME training driver.
+
+Reference parity: ``photon-client::ml.cli.game.training.GameTrainingDriver``
+(SURVEY.md §2.3, §3.1). Stages: read data → feature/entity maps → (optional)
+validation read against frozen maps → warm start → estimator grid fit →
+(optional) Bayesian hyperparameter loop → model selection → write models +
+index/entity maps + metrics.
+
+Usage:
+    python -m photon_ml_tpu.cli.train \\
+        --config config.json --train-data data/train \\
+        [--validation-data data/val] --output-dir out/
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+
+from photon_ml_tpu.cli.common import load_training_config
+from photon_ml_tpu.config import GameTrainingConfig
+from photon_ml_tpu.estimators import GameEstimator, GameResult
+from photon_ml_tpu.io.data_reader import AvroDataReader, GameDataset
+from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+from photon_ml_tpu.types import ModelOutputMode
+from photon_ml_tpu.utils import PhotonLogger, timed
+
+
+def run(
+    config: GameTrainingConfig,
+    train_data: list[str],
+    output_dir: str,
+    validation_data: list[str] | None = None,
+    index_map_dir: str | None = None,
+    logger: PhotonLogger | None = None,
+    mesh=None,
+) -> GameResult:
+    logger = logger or PhotonLogger(output_dir)
+    id_tags = tuple(
+        cfg.random_effect_type for cfg in config.random_effect_coordinates.values()
+    )
+    reader = AvroDataReader(config.feature_shards or None)
+
+    # prepareFeatureMaps parity: load prebuilt index stores when given
+    # (FeatureIndexingDriver output), else build from the data
+    prebuilt = None
+    if index_map_dir:
+        from photon_ml_tpu.data.index_map import IndexMap
+
+        prebuilt = {
+            fn[:-4]: IndexMap.load(os.path.join(index_map_dir, fn))
+            for fn in os.listdir(index_map_dir)
+            if fn.endswith(".npz")
+        }
+        logger.info(f"loaded index maps: { {s: m.size for s, m in prebuilt.items()} }")
+
+    # Warm start: re-use the saved run's entity maps so the saved model's
+    # dense entity rows stay valid; new entities get appended ids.
+    warm_tag_maps = (
+        _load_entity_maps(config.model_input_dir) if config.model_input_dir else None
+    )
+    with timed(logger, "read training data"):
+        train = reader.read(
+            train_data,
+            id_tags=id_tags,
+            index_maps=prebuilt,
+            entity_maps=warm_tag_maps,
+            extend_entities=warm_tag_maps is not None,
+        )
+        logger.info(
+            f"train: {train.batch.num_rows} rows, shards "
+            f"{ {s: m.size for s, m in train.index_maps.items()} }"
+        )
+
+    val: GameDataset | None = None
+    if validation_data:
+        with timed(logger, "read validation data"):
+            val = reader.read(
+                validation_data,
+                id_tags=id_tags,
+                index_maps=train.index_maps,
+                entity_maps=train.entity_maps,
+            )
+
+    initial_model = None
+    if config.model_input_dir:
+        with timed(logger, "load warm-start model"):
+            entity_ids = None
+            if warm_tag_maps:
+                # entity-maps.json is keyed by id tag; the loader wants
+                # coordinate id → (entity string → dense id)
+                entity_ids = {
+                    cid: warm_tag_maps[c.random_effect_type]
+                    for cid, c in config.random_effect_coordinates.items()
+                    if c.random_effect_type in warm_tag_maps
+                }
+            initial_model = load_game_model(
+                config.model_input_dir,
+                index_maps=train.index_maps,
+                entity_ids=entity_ids,
+            )
+            initial_model = _pad_random_effects(initial_model, train, config)
+
+    estimator = GameEstimator(
+        config,
+        mesh=mesh,
+        intercept_indices=train.intercept_indices,
+        logger=logger,
+    )
+    with timed(logger, "estimator grid fit"):
+        results = estimator.fit(
+            train.batch,
+            None if val is None else val.batch,
+            initial_model=initial_model,
+        )
+
+    if config.hyperparameter_tuning_iters > 0:
+        if val is None:
+            raise ValueError("hyperparameter tuning requires validation data")
+        from photon_ml_tpu.hyperparameter.tuning import tune_game_hyperparameters
+
+        with timed(logger, "hyperparameter tuning"):
+            results = list(results) + tune_game_hyperparameters(
+                estimator,
+                train.batch,
+                val.batch,
+                results,
+                config.hyperparameter_tuning_iters,
+            )
+
+    best = estimator.select_best(results)
+    logger.info(f"selected configuration: { {c: o.regularization_weight for c, o in best.configuration.items()} }")
+
+    with timed(logger, "write models"):
+        entity_names = train.entity_names()
+        by_cid = {
+            cid: entity_names[cfg.random_effect_type]
+            for cid, cfg in config.random_effect_coordinates.items()
+        }
+        save_game_model(
+            best.model,
+            os.path.join(output_dir, "best"),
+            index_maps=train.index_maps,
+            entity_names=by_cid,
+        )
+        if config.output_mode is ModelOutputMode.ALL:
+            for i, r in enumerate(results):
+                save_game_model(
+                    r.model,
+                    os.path.join(output_dir, "models", f"{i:04d}"),
+                    index_maps=train.index_maps,
+                    entity_names=by_cid,
+                )
+        _save_maps(output_dir, train)
+
+    metrics = {
+        "results": [
+            {
+                "configuration": {
+                    cid: opt.to_dict() for cid, opt in r.configuration.items()
+                },
+                "metrics": dict(r.evaluation.metrics) if r.evaluation else None,
+            }
+            for r in results
+        ],
+        # identity, not ==: GameResult holds device arrays (ambiguous __eq__)
+        "best_index": next(i for i, r in enumerate(results) if r is best),
+    }
+    with open(os.path.join(output_dir, "metrics.json"), "w") as f:
+        json.dump(metrics, f, indent=2)
+    return best
+
+
+def _pad_random_effects(model, train: GameDataset, config: GameTrainingConfig):
+    """Grow each warm-start random-effect matrix to the current entity count
+    (new entities start from zero rows — the reference also cold-starts
+    entities absent from the loaded model)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.models import RandomEffectModel
+
+    for cid, c in config.random_effect_coordinates.items():
+        sub = model.models.get(cid)
+        if not isinstance(sub, RandomEffectModel):
+            continue
+        e_new = len(train.entity_maps[c.random_effect_type])
+        if sub.num_entities < e_new:
+            pad = e_new - sub.num_entities
+            W = jnp.concatenate(
+                [sub.coefficients, jnp.zeros((pad, sub.coefficients.shape[1]),
+                                             sub.coefficients.dtype)]
+            )
+            V = sub.variances
+            if V is not None:
+                V = jnp.concatenate([V, jnp.zeros((pad, V.shape[1]), V.dtype)])
+            import dataclasses
+
+            model = model.updated(
+                cid, dataclasses.replace(sub, coefficients=W, variances=V)
+            )
+    return model
+
+
+def _save_maps(output_dir: str, ds: GameDataset) -> None:
+    """Persist the ingest dictionaries next to the model so scoring and
+    warm starts line columns/entities up (the reference ships PalDB stores
+    and entity-id RDDs the same way)."""
+    for sid, imap in ds.index_maps.items():
+        imap.save(os.path.join(output_dir, "index-maps", sid))
+    with open(os.path.join(output_dir, "entity-maps.json"), "w") as f:
+        json.dump(ds.entity_maps, f)
+
+
+def _load_entity_maps(model_dir: str) -> dict | None:
+    # entity maps live one level above the model dir when written by run()
+    for candidate in (
+        os.path.join(model_dir, "entity-maps.json"),
+        os.path.join(os.path.dirname(model_dir.rstrip("/")), "entity-maps.json"),
+    ):
+        if os.path.exists(candidate):
+            with open(candidate) as f:
+                raw = json.load(f)
+            return raw
+    return None
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description="GAME training driver")
+    p.add_argument("--config", required=True, help="GameTrainingConfig JSON file")
+    p.add_argument("--train-data", required=True, nargs="+")
+    p.add_argument("--validation-data", nargs="*", default=None)
+    p.add_argument("--index-maps", default=None, help="FeatureIndexingDriver output dir")
+    p.add_argument("--output-dir", required=True)
+    args = p.parse_args(argv)
+
+    config = load_training_config(args.config)
+    logger = PhotonLogger(args.output_dir)
+    run(
+        config,
+        args.train_data,
+        args.output_dir,
+        validation_data=args.validation_data,
+        index_map_dir=args.index_maps,
+        logger=logger,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,235 @@
+"""Legacy single-GLM training driver.
+
+Reference parity: ``photon-client::ml.Driver`` + ``ml.DriverStage`` +
+``ml.ModelTraining`` (SURVEY.md §2.3, §3.2): a staged pipeline
+(INIT → PROCESSED → TRAINED → VALIDATED) that trains one GLM per
+regularization weight (ascending, warm-started), validates each, selects
+the best, and writes per-λ models + feature summary + best model.
+
+Input formats: LIBSVM (benchmark config A) or TrainingExampleAvro files.
+
+Usage:
+    python -m photon_ml_tpu.cli.train_glm \\
+        --task LOGISTIC_REGRESSION --train-data a9a.libsvm --format libsvm \\
+        --regularization L2 --weights 0.1 1 10 --output-dir out/
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+from photon_ml_tpu.config import OptimizerConfig, RegularizationContext
+from photon_ml_tpu.data.libsvm import read_libsvm
+from photon_ml_tpu.data.summary import summarize
+from photon_ml_tpu.data.validation import validate_arrays
+from photon_ml_tpu.io.data_reader import AvroDataReader
+from photon_ml_tpu.io.model_io import save_glm
+from photon_ml_tpu.io.results import write_feature_summary
+from photon_ml_tpu.supervised.training import train_glm
+from photon_ml_tpu.types import (
+    DataValidationType,
+    NormalizationType,
+    OptimizerType,
+    RegularizationType,
+    TaskType,
+    VarianceComputationType,
+)
+from photon_ml_tpu.utils import PhotonLogger, timed
+
+STAGES = ("INIT", "PROCESSED", "TRAINED", "VALIDATED")
+
+
+def _read(paths: list[str], fmt: str, index_maps=None, num_features=None):
+    if fmt == "libsvm":
+        if len(paths) != 1:
+            raise ValueError("libsvm input takes exactly one file")
+        batch, intercept_index = read_libsvm(paths[0], num_features=num_features)
+        return batch, intercept_index, None
+    reader = AvroDataReader()
+    ds = reader.read(paths, index_maps=index_maps)
+    sid = next(iter(ds.index_maps))
+    return (
+        ds.batch.batch_for(sid),
+        ds.intercept_indices[sid],
+        ds,
+    )
+
+
+def run(
+    task: TaskType,
+    train_data: list[str],
+    output_dir: str,
+    data_format: str = "libsvm",
+    validation_data: list[str] | None = None,
+    regularization: RegularizationType = RegularizationType.L2,
+    weights: list[float] = (1.0,),
+    optimizer: OptimizerType = OptimizerType.LBFGS,
+    max_iterations: int = 100,
+    tolerance: float = 1e-7,
+    normalization: NormalizationType = NormalizationType.NONE,
+    summarize_features: bool = False,
+    variance_computation: VarianceComputationType = VarianceComputationType.NONE,
+    validate: DataValidationType = DataValidationType.VALIDATE_DISABLED,
+    logger: PhotonLogger | None = None,
+):
+    logger = logger or PhotonLogger(output_dir)
+    stage_file = os.path.join(output_dir, "_stage")
+
+    def advance(stage: str) -> None:
+        os.makedirs(output_dir, exist_ok=True)
+        with open(stage_file, "w") as f:
+            f.write(stage)
+        logger.info(f"stage → {stage}")
+
+    advance("INIT")
+    with timed(logger, "read training data"):
+        batch, intercept_index, train_ds = _read(train_data, data_format)
+    if validate is not DataValidationType.VALIDATE_DISABLED:
+        with timed(logger, "validate data"):
+            validate_arrays(
+                task,
+                np.asarray(batch.labels),
+                np.asarray(batch.X)
+                if hasattr(batch, "X")
+                else np.asarray(batch.values),
+                offsets=np.asarray(batch.offsets),
+                weights=np.asarray(batch.weights),
+                mode=validate,
+            )
+
+    norm_context = None
+    if summarize_features or normalization is not NormalizationType.NONE:
+        with timed(logger, "summarize features"):
+            summary = summarize(batch)
+            if summarize_features:
+                write_feature_summary(
+                    os.path.join(output_dir, "summary", "part-00000.avro"),
+                    summary,
+                    None if train_ds is None else next(iter(train_ds.index_maps.values())),
+                )
+            if normalization is not NormalizationType.NONE:
+                norm_context = summary.normalization(normalization, intercept_index)
+    advance("PROCESSED")
+
+    val_batch = None
+    if validation_data:
+        with timed(logger, "read validation data"):
+            val_batch, _, _ = _read(
+                validation_data,
+                data_format,
+                index_maps=None if train_ds is None else train_ds.index_maps,
+                # libsvm: pin the validation feature space to the training one
+                num_features=(
+                    batch.num_features - (1 if intercept_index is not None else 0)
+                    if data_format == "libsvm"
+                    else None
+                ),
+            )
+
+    with timed(logger, "train"):
+        result = train_glm(
+            batch,
+            task,
+            optimizer_config=OptimizerConfig(
+                optimizer_type=optimizer,
+                max_iterations=max_iterations,
+                tolerance=tolerance,
+            ),
+            regularization=RegularizationContext(regularization),
+            regularization_weights=list(weights),
+            normalization=norm_context,
+            intercept_index=intercept_index,
+            validation_batch=val_batch,
+            variance_computation=variance_computation,
+        )
+    advance("TRAINED")
+
+    imap = (
+        None if train_ds is None else next(iter(train_ds.index_maps.values()))
+    )
+    with timed(logger, "write models"):
+        for lam, model in result.models.items():
+            save_glm(
+                model,
+                os.path.join(output_dir, "models", f"lambda-{lam:g}", "model.avro"),
+                index_map=imap,
+                model_id=f"lambda-{lam:g}",
+            )
+        save_glm(
+            result.best_model,
+            os.path.join(output_dir, "best", "model.avro"),
+            index_map=imap,
+            model_id="best",
+        )
+
+    report = {
+        "task": task.value,
+        "weights": sorted(float(w) for w in weights),
+        "best_weight": result.best_weight,
+        "validation": {
+            str(lam): dict(ev.metrics) for lam, ev in result.validation.items()
+        },
+        "trackers": {
+            str(lam): {
+                "iterations": int(t.iterations),
+                "converged": bool(t.converged),
+            }
+            for lam, t in result.trackers.items()
+        },
+    }
+    with open(os.path.join(output_dir, "report.json"), "w") as f:
+        json.dump(report, f, indent=2)
+    advance("VALIDATED")
+    return result
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description="Single-GLM training driver (legacy)")
+    p.add_argument("--task", required=True, choices=[t.value for t in TaskType])
+    p.add_argument("--train-data", required=True, nargs="+")
+    p.add_argument("--validation-data", nargs="*", default=None)
+    p.add_argument("--format", default="libsvm", choices=["libsvm", "avro"])
+    p.add_argument(
+        "--regularization", default="L2", choices=[r.value for r in RegularizationType]
+    )
+    p.add_argument("--weights", nargs="+", type=float, default=[1.0])
+    p.add_argument("--optimizer", default="LBFGS", choices=[o.value for o in OptimizerType])
+    p.add_argument("--max-iterations", type=int, default=100)
+    p.add_argument("--tolerance", type=float, default=1e-7)
+    p.add_argument(
+        "--normalization", default="NONE", choices=[n.value for n in NormalizationType]
+    )
+    p.add_argument("--summarize-features", action="store_true")
+    p.add_argument(
+        "--variance", default="NONE", choices=[v.value for v in VarianceComputationType]
+    )
+    p.add_argument(
+        "--validate", default="VALIDATE_DISABLED",
+        choices=[v.value for v in DataValidationType],
+    )
+    p.add_argument("--output-dir", required=True)
+    args = p.parse_args(argv)
+    run(
+        TaskType(args.task),
+        args.train_data,
+        args.output_dir,
+        data_format=args.format,
+        validation_data=args.validation_data,
+        regularization=RegularizationType(args.regularization),
+        weights=args.weights,
+        optimizer=OptimizerType(args.optimizer),
+        max_iterations=args.max_iterations,
+        tolerance=args.tolerance,
+        normalization=NormalizationType(args.normalization),
+        summarize_features=args.summarize_features,
+        variance_computation=VarianceComputationType(args.variance),
+        validate=DataValidationType(args.validate),
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -183,6 +183,13 @@ class GameTrainingConfig(_JsonMixin):
     data_validation: DataValidationType = DataValidationType.VALIDATE_DISABLED
     model_input_dir: str | None = None  # warm start
     hyperparameter_tuning_iters: int = 0
+    # Per-coordinate regularization-weight lists; the training grid is their
+    # cross-product (reference: per-coordinate regularizationWeights in the
+    # coordinate configurations drive the GameEstimator grid). Coordinates
+    # absent from the map keep their single configured weight.
+    regularization_weight_grid: Mapping[str, tuple[float, ...]] = field(
+        default_factory=dict
+    )
 
     def coordinate_config(self, cid: str):
         if cid in self.fixed_effect_coordinates:

@@ -76,6 +76,36 @@ class GameResult:
     descent: CoordinateDescentResult
 
 
+def build_configuration_grid(
+    config: GameTrainingConfig,
+) -> list[dict[str, OptimizationConfig]]:
+    """Cross-product of per-coordinate regularization-weight lists
+    (``config.regularization_weight_grid``); coordinates without a list keep
+    their single configured weight. Parity: the reference's grid over
+    ``GameOptimizationConfiguration``s."""
+    import dataclasses
+    import itertools
+
+    cids = list(config.coordinate_update_sequence)
+    unknown = set(config.regularization_weight_grid) - set(cids)
+    if unknown:
+        raise ValueError(
+            f"regularization_weight_grid names unknown coordinate(s) {sorted(unknown)}; "
+            f"update sequence is {cids}"
+        )
+    axes: list[list[OptimizationConfig]] = []
+    for cid in cids:
+        base = config.coordinate_config(cid).optimization
+        weights = config.regularization_weight_grid.get(cid)
+        if weights:
+            axes.append(
+                [dataclasses.replace(base, regularization_weight=float(w)) for w in weights]
+            )
+        else:
+            axes.append([base])
+    return [dict(zip(cids, combo)) for combo in itertools.product(*axes)]
+
+
 class GameEstimator:
     """Fits GAME models over a grid of optimization configurations.
 
@@ -203,10 +233,10 @@ class GameEstimator:
     ) -> list[GameResult]:
         """Train one GAME model per grid configuration.
 
-        ``configurations`` defaults to the single configuration embedded in
-        ``self.config`` (each coordinate's own ``OptimizationConfig``).
-        ``initial_model`` warm-starts every grid entry (reference:
-        ``modelInputDirectory``).
+        ``configurations`` defaults to ``build_configuration_grid(self.config)``
+        — the cross-product of ``regularization_weight_grid`` (a single
+        configuration when no weight lists are set). ``initial_model``
+        warm-starts every grid entry (reference: ``modelInputDirectory``).
         """
         cfg = self.config
         validate_game_batch(batch, cfg.task_type, cfg.data_validation, self.seed)
@@ -216,12 +246,7 @@ class GameEstimator:
             )
 
         if configurations is None:
-            configurations = [
-                {
-                    cid: cfg.coordinate_config(cid).optimization
-                    for cid in cfg.coordinate_update_sequence
-                }
-            ]
+            configurations = build_configuration_grid(cfg)
 
         norm_contexts = self._normalization_contexts(batch)
         entity_layouts = self._entity_layouts(batch)

@@ -1,0 +1,26 @@
+"""Bayesian hyperparameter auto-tuning.
+
+Reference parity: ``photon-lib::ml.hyperparameter.*`` (SURVEY.md §2.1) —
+``GaussianProcessSearch`` (GP surrogate + expected improvement),
+``RandomSearch``, ``GaussianProcessEstimator``/``GaussianProcessModel``,
+``criteria.ExpectedImprovement``, kernels (``Matern52``, ``RBF``),
+``SobolSequence``, ``sampler.SliceSampler``.
+
+Host-side numpy throughout: the search runs on the driver between full
+distributed retrains (§3.4), so its cost is noise next to one refit — no
+reason to jit it.
+"""
+
+from photon_ml_tpu.hyperparameter.kernels import Matern52, RBF, StationaryKernel  # noqa: F401
+from photon_ml_tpu.hyperparameter.gp import (  # noqa: F401
+    GaussianProcessEstimator,
+    GaussianProcessModel,
+)
+from photon_ml_tpu.hyperparameter.criteria import expected_improvement  # noqa: F401
+from photon_ml_tpu.hyperparameter.sobol import sobol_sequence  # noqa: F401
+from photon_ml_tpu.hyperparameter.sampler import slice_sample  # noqa: F401
+from photon_ml_tpu.hyperparameter.search import (  # noqa: F401
+    GaussianProcessSearch,
+    RandomSearch,
+    SearchRange,
+)

@@ -1,0 +1,18 @@
+"""Sobol quasi-random sequences.
+
+Reference parity: ``photon-lib::ml.hyperparameter.SobolSequence`` — used to
+seed the search and to draw the candidate pool the acquisition function is
+maximized over. Delegates to scipy's direction-number implementation
+(scrambled Owen variant), which replaces the reference's hand-rolled tables.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.stats import qmc
+
+
+def sobol_sequence(num_points: int, num_dims: int, seed: int = 0) -> np.ndarray:
+    """``num_points`` scrambled-Sobol points in [0, 1)^num_dims."""
+    sampler = qmc.Sobol(d=num_dims, scramble=True, seed=seed)
+    return sampler.random(num_points)

@@ -1,0 +1,75 @@
+"""The driver's hyperparameter auto-tuning loop.
+
+Reference parity: SURVEY.md §3.4 — after the grid fit, the driver seeds a
+``GaussianProcessSearch`` with (config vector, validation metric)
+observations and iterates: fit GP → argmax EI over Sobol candidates → full
+distributed retrain → append observation.
+
+The tuned vector is each coordinate's regularization weight (log scale),
+matching the reference's tuning target.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+from photon_ml_tpu.estimators import GameEstimator, GameResult
+from photon_ml_tpu.evaluation import make_evaluator
+from photon_ml_tpu.game.data import GameBatch
+from photon_ml_tpu.hyperparameter.search import GaussianProcessSearch, SearchRange
+
+# log-λ search box (the reference's tuner works on a comparable range)
+_DEFAULT_RANGE = SearchRange(lo=1e-4, hi=1e4, log_scale=True)
+
+
+def tune_game_hyperparameters(
+    estimator: GameEstimator,
+    batch: GameBatch,
+    validation_batch: GameBatch,
+    prior_results: Sequence[GameResult],
+    num_iterations: int,
+    seed: int = 0,
+) -> list[GameResult]:
+    """Run ``num_iterations`` Bayesian-tuning refits; returns the new
+    results (caller appends them to the grid results for final selection)."""
+    cfg = estimator.config
+    cids = list(cfg.coordinate_update_sequence)
+    specs = estimator._evaluator_specs()
+    primary = make_evaluator(specs[0])
+    sign = -1.0 if primary.larger_is_better else 1.0  # search minimizes
+
+    search = GaussianProcessSearch(
+        ranges=[_DEFAULT_RANGE] * len(cids), seed=seed, num_init=0
+    )
+    for r in prior_results:
+        if r.evaluation is None:
+            continue
+        x = np.array(
+            [
+                np.clip(
+                    r.configuration[cid].regularization_weight,
+                    _DEFAULT_RANGE.lo,
+                    _DEFAULT_RANGE.hi,
+                )
+                for cid in cids
+            ]
+        )
+        search.observe(x, sign * r.evaluation.primary)
+
+    results: list[GameResult] = []
+    for _ in range(num_iterations):
+        x = search.suggest()
+        configuration = {
+            cid: dataclasses.replace(
+                cfg.coordinate_config(cid).optimization,
+                regularization_weight=float(x[i]),
+            )
+            for i, cid in enumerate(cids)
+        }
+        fit = estimator.fit(batch, validation_batch, configurations=[configuration])[0]
+        search.observe(x, sign * fit.evaluation.primary)
+        results.append(fit)
+    return results

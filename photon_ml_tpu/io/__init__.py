@@ -1,0 +1,24 @@
+"""IO layer: Avro codec + schemas, data readers, model save/load.
+
+Reference parity: ``photon-client``'s IO stack (SURVEY.md §2.3/§2.4) —
+``AvroDataReader``, ``ModelProcessingUtils``, ``photon-avro-schemas`` —
+rebuilt host-side. The Avro container codec is implemented here in pure
+Python (the image ships no avro library); files interchange with any Avro
+tooling, so models written by the reference load here and vice versa.
+"""
+
+from photon_ml_tpu.io.avro import read_avro_file, write_avro_file  # noqa: F401
+from photon_ml_tpu.io.schemas import (  # noqa: F401
+    BAYESIAN_LINEAR_MODEL_SCHEMA,
+    FEATURE_SUMMARIZATION_RESULT_SCHEMA,
+    NAME_TERM_VALUE_SCHEMA,
+    SCORING_RESULT_SCHEMA,
+    TRAINING_EXAMPLE_SCHEMA,
+)
+from photon_ml_tpu.io.model_io import (  # noqa: F401
+    load_game_model,
+    load_glm,
+    save_game_model,
+    save_glm,
+)
+from photon_ml_tpu.io.data_reader import AvroDataReader, GameDataset  # noqa: F401

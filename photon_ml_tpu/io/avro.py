@@ -1,0 +1,391 @@
+"""Pure-Python Apache Avro object-container-file codec.
+
+Reference parity: the role of the Avro runtime underneath
+``photon-client::ml.data.avro.AvroUtils`` (SURVEY.md §2.3). The runtime
+itself is not part of the reference, but its FORMAT is the interchange
+contract (``TrainingExampleAvro``, ``BayesianLinearModelAvro``, …), so this
+module implements the Avro 1.x spec directly: binary encoding (zigzag
+varints, length-prefixed strings/bytes, blocked arrays/maps, union indexes,
+in-order record fields) and the container framing (magic ``Obj\\x01``,
+metadata map with ``avro.schema``/``avro.codec``, 16-byte sync marker,
+sync-delimited blocks; ``null`` and ``deflate`` codecs).
+
+Scope: the types our schemas use — null, boolean, int, long, float, double,
+bytes, string, record, array, map, union, enum, fixed. Schemas are plain
+dicts (JSON), with named-type references resolved against the file's schema.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, BinaryIO, Iterable, Iterator
+
+MAGIC = b"Obj\x01"
+SYNC_SIZE = 16
+
+_PRIMITIVES = {"null", "boolean", "int", "long", "float", "double", "bytes", "string"}
+
+
+# ---------------------------------------------------------------------------
+# schema handling
+# ---------------------------------------------------------------------------
+def _normalize(schema: Any) -> Any:
+    """'string' → {'type': 'string'}; lists (unions) stay lists."""
+    if isinstance(schema, str):
+        return {"type": schema}
+    return schema
+
+
+def _collect_named(schema: Any, registry: dict[str, Any]) -> None:
+    """Register named types (record/enum/fixed) so later references by name
+    resolve (Avro allows a named type to be defined once and referenced)."""
+    if isinstance(schema, list):
+        for s in schema:
+            _collect_named(s, registry)
+        return
+    if not isinstance(schema, dict):
+        return
+    t = schema.get("type")
+    if t in ("record", "enum", "fixed"):
+        name = schema.get("name")
+        if name:
+            registry[name] = schema
+            ns = schema.get("namespace")
+            if ns:
+                registry[f"{ns}.{name}"] = schema
+    if t == "record":
+        for f in schema.get("fields", ()):
+            _collect_named(f.get("type"), registry)
+    elif t == "array":
+        _collect_named(schema.get("items"), registry)
+    elif t == "map":
+        _collect_named(schema.get("values"), registry)
+
+
+def _resolve(schema: Any, registry: dict[str, Any]) -> Any:
+    if isinstance(schema, str) and schema not in _PRIMITIVES:
+        if schema not in registry:
+            raise ValueError(f"unresolved Avro type reference: {schema!r}")
+        return registry[schema]
+    return schema
+
+
+# ---------------------------------------------------------------------------
+# binary decoder
+# ---------------------------------------------------------------------------
+class _Decoder:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def read(self, n: int) -> bytes:
+        b = self.data[self.pos : self.pos + n]
+        if len(b) != n:
+            raise EOFError("truncated Avro data")
+        self.pos += n
+        return b
+
+    def read_long(self) -> int:
+        shift = 0
+        acc = 0
+        while True:
+            b = self.data[self.pos]
+            self.pos += 1
+            acc |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        return (acc >> 1) ^ -(acc & 1)  # zigzag
+
+    def read_value(self, schema: Any, registry: dict[str, Any]) -> Any:
+        schema = _resolve(schema, registry)
+        if isinstance(schema, list):  # union
+            idx = self.read_long()
+            return self.read_value(schema[idx], registry)
+        schema = _normalize(schema)
+        t = schema["type"]
+        if isinstance(t, (dict, list)):  # e.g. {"type": {"type": "array", ...}}
+            return self.read_value(t, registry)
+        if t == "null":
+            return None
+        if t == "boolean":
+            return self.read(1) != b"\x00"
+        if t in ("int", "long"):
+            return self.read_long()
+        if t == "float":
+            return struct.unpack("<f", self.read(4))[0]
+        if t == "double":
+            return struct.unpack("<d", self.read(8))[0]
+        if t == "bytes":
+            return bytes(self.read(self.read_long()))
+        if t == "string":
+            return self.read(self.read_long()).decode("utf-8")
+        if t == "fixed":
+            return bytes(self.read(schema["size"]))
+        if t == "enum":
+            return schema["symbols"][self.read_long()]
+        if t == "array":
+            out = []
+            while True:
+                count = self.read_long()
+                if count == 0:
+                    break
+                if count < 0:
+                    count = -count
+                    self.read_long()  # block byte size — unused when parsing all
+                for _ in range(count):
+                    out.append(self.read_value(schema["items"], registry))
+            return out
+        if t == "map":
+            out = {}
+            while True:
+                count = self.read_long()
+                if count == 0:
+                    break
+                if count < 0:
+                    count = -count
+                    self.read_long()
+                for _ in range(count):
+                    k = self.read(self.read_long()).decode("utf-8")
+                    out[k] = self.read_value(schema["values"], registry)
+            return out
+        if t == "record":
+            return {
+                f["name"]: self.read_value(f["type"], registry)
+                for f in schema["fields"]
+            }
+        raise ValueError(f"unsupported Avro type: {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# binary encoder
+# ---------------------------------------------------------------------------
+class _Encoder:
+    def __init__(self):
+        self.buf = bytearray()
+
+    def write_long(self, v: int) -> None:
+        v = (v << 1) ^ (v >> 63)  # zigzag (Python ints: arithmetic shift ok)
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                self.buf.append(b | 0x80)
+            else:
+                self.buf.append(b)
+                break
+
+    def write_value(self, schema: Any, value: Any, registry: dict[str, Any]) -> None:
+        schema = _resolve(schema, registry)
+        if isinstance(schema, list):  # union: first branch whose type matches
+            idx = self._union_index(schema, value, registry)
+            self.write_long(idx)
+            self.write_value(schema[idx], value, registry)
+            return
+        schema = _normalize(schema)
+        t = schema["type"]
+        if isinstance(t, (dict, list)):
+            self.write_value(t, value, registry)
+            return
+        if t == "null":
+            return
+        if t == "boolean":
+            self.buf.append(1 if value else 0)
+        elif t in ("int", "long"):
+            self.write_long(int(value))
+        elif t == "float":
+            self.buf += struct.pack("<f", float(value))
+        elif t == "double":
+            self.buf += struct.pack("<d", float(value))
+        elif t == "bytes":
+            self.write_long(len(value))
+            self.buf += value
+        elif t == "string":
+            raw = value.encode("utf-8")
+            self.write_long(len(raw))
+            self.buf += raw
+        elif t == "fixed":
+            if len(value) != schema["size"]:
+                raise ValueError("fixed size mismatch")
+            self.buf += value
+        elif t == "enum":
+            self.write_long(schema["symbols"].index(value))
+        elif t == "array":
+            if value:
+                self.write_long(len(value))
+                for item in value:
+                    self.write_value(schema["items"], item, registry)
+            self.write_long(0)
+        elif t == "map":
+            if value:
+                self.write_long(len(value))
+                for k, v in value.items():
+                    raw = k.encode("utf-8")
+                    self.write_long(len(raw))
+                    self.buf += raw
+                    self.write_value(schema["values"], v, registry)
+            self.write_long(0)
+        elif t == "record":
+            for f in schema["fields"]:
+                fv = value.get(f["name"], f.get("default"))
+                self.write_value(f["type"], fv, registry)
+        else:
+            raise ValueError(f"unsupported Avro type: {t!r}")
+
+    def _union_index(self, union: list, value: Any, registry: dict[str, Any]) -> int:
+        def kind(s):
+            s = _normalize(_resolve(s, registry))
+            return s["type"]
+
+        for i, s in enumerate(union):
+            k = kind(s)
+            if value is None and k == "null":
+                return i
+            if value is not None and k != "null":
+                # match Python type to branch where distinguishable
+                if isinstance(value, bool):
+                    if k == "boolean":
+                        return i
+                elif isinstance(value, str):
+                    if k in ("string", "enum"):
+                        return i
+                elif isinstance(value, (bytes, bytearray)):
+                    if k in ("bytes", "fixed"):
+                        return i
+                elif isinstance(value, int) and k in ("int", "long"):
+                    return i
+                elif isinstance(value, float) and k in ("float", "double"):
+                    return i
+                elif isinstance(value, dict) and k in ("record", "map"):
+                    return i
+                elif isinstance(value, (list, tuple)) and k == "array":
+                    return i
+        # fall back: first non-null branch (numeric promotions int→double etc.)
+        for i, s in enumerate(union):
+            if kind(s) != "null" and value is not None:
+                return i
+        raise ValueError(f"no union branch for value {value!r}")
+
+
+# ---------------------------------------------------------------------------
+# container files
+# ---------------------------------------------------------------------------
+def write_avro_file(
+    path: str,
+    schema: dict,
+    records: Iterable[dict],
+    codec: str = "deflate",
+    sync_interval: int = 4000,
+) -> None:
+    """Write records to an Avro object container file."""
+    registry: dict[str, Any] = {}
+    _collect_named(schema, registry)
+    sync = os.urandom(SYNC_SIZE)
+
+    header = _Encoder()
+    header.buf += MAGIC
+    meta = {
+        "avro.schema": json.dumps(schema).encode(),
+        "avro.codec": codec.encode(),
+    }
+    header.write_long(len(meta))
+    for k, v in meta.items():
+        raw = k.encode()
+        header.write_long(len(raw))
+        header.buf += raw
+        header.write_long(len(v))
+        header.buf += v
+    header.write_long(0)
+    header.buf += sync
+
+    def flush_block(out: BinaryIO, enc: _Encoder, count: int) -> None:
+        if count == 0:
+            return
+        data = bytes(enc.buf)
+        if codec == "deflate":
+            data = zlib.compress(data)[2:-4]  # raw deflate per spec
+        elif codec != "null":
+            raise ValueError(f"unsupported codec {codec!r}")
+        blk = _Encoder()
+        blk.write_long(count)
+        blk.write_long(len(data))
+        out.write(bytes(blk.buf))
+        out.write(data)
+        out.write(sync)
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as out:
+        out.write(bytes(header.buf))
+        enc = _Encoder()
+        count = 0
+        for rec in records:
+            enc.write_value(schema, rec, registry)
+            count += 1
+            if count >= sync_interval:
+                flush_block(out, enc, count)
+                enc = _Encoder()
+                count = 0
+        flush_block(out, enc, count)
+
+
+def read_avro_file(path: str) -> tuple[dict, list[dict]]:
+    """Read an Avro object container file → (schema, records)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:4] != MAGIC:
+        raise ValueError(f"{path}: not an Avro container file")
+    dec = _Decoder(data)
+    dec.pos = 4
+    meta: dict[str, bytes] = {}
+    while True:
+        count = dec.read_long()
+        if count == 0:
+            break
+        if count < 0:
+            count = -count
+            dec.read_long()
+        for _ in range(count):
+            k = dec.read(dec.read_long()).decode()
+            v = bytes(dec.read(dec.read_long()))
+            meta[k] = v
+    schema = json.loads(meta["avro.schema"])
+    codec = meta.get("avro.codec", b"null").decode()
+    sync = dec.read(SYNC_SIZE)
+
+    registry: dict[str, Any] = {}
+    _collect_named(schema, registry)
+
+    records: list[dict] = []
+    while dec.pos < len(data):
+        count = dec.read_long()
+        size = dec.read_long()
+        block = bytes(dec.read(size))
+        if codec == "deflate":
+            block = zlib.decompress(block, wbits=-15)
+        elif codec != "null":
+            raise ValueError(f"unsupported codec {codec!r}")
+        bdec = _Decoder(block)
+        for _ in range(count):
+            records.append(bdec.read_value(schema, registry))
+        if dec.read(SYNC_SIZE) != sync:
+            raise ValueError(f"{path}: sync marker mismatch (corrupt file)")
+    return schema, records
+
+
+def iter_avro_directory(path: str) -> Iterator[dict]:
+    """Read every ``*.avro`` file under ``path`` (a file or a directory of
+    part files, like the reference's HDFS output dirs), yielding records."""
+    if os.path.isfile(path):
+        yield from read_avro_file(path)[1]
+        return
+    names = sorted(
+        n for n in os.listdir(path) if n.endswith(".avro") and not n.startswith(".")
+    )
+    if not names:
+        raise FileNotFoundError(f"no .avro files under {path}")
+    for n in names:
+        yield from read_avro_file(os.path.join(path, n))[1]

@@ -1,0 +1,298 @@
+"""GAME / GLM model save & load as Avro.
+
+Reference parity: ``photon-client::ml.data.avro.ModelProcessingUtils``
+(SURVEY.md §2.3): fixed effect → one ``BayesianLinearModelAvro`` (list of
+(name, term, mean, variance) coefficients); random effects → partitioned
+Avro of per-entity models (modelId = entity id); sparsity-threshold
+filtering on save; loads back into a ``GameModel`` for warm start / scoring.
+
+Directory layout (mirrors the reference's HDFS output):
+
+    <dir>/metadata.json
+    <dir>/fixed-effect/<cid>/coefficients/part-00000.avro
+    <dir>/random-effect/<cid>/coefficients/part-00000.avro
+
+Feature naming: with an ``IndexMap`` the real (name, term) keys are written
+(byte-compatible interchange with the reference); without one, synthetic
+names ``f<index>`` are used and parsed back on load.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Mapping, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.index_map import DELIMITER, INTERCEPT_KEY, IndexMap
+from photon_ml_tpu.game.models import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.io.avro import iter_avro_directory, read_avro_file, write_avro_file
+from photon_ml_tpu.io.schemas import BAYESIAN_LINEAR_MODEL_SCHEMA
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.types import TaskType
+
+_SYNTHETIC = re.compile(r"^f(\d+)$")
+
+
+def _split_key(key: str) -> tuple[str, str]:
+    if DELIMITER in key:
+        name, term = key.split(DELIMITER, 1)
+        return name, term
+    return key, ""
+
+
+def _index_to_key(index_map: IndexMap | None, d: int) -> list[tuple[str, str]]:
+    if index_map is None:
+        return [(f"f{i}", "") for i in range(d)]
+    keys: list[tuple[str, str]] = [("", "")] * d
+    for key, i in index_map.items():
+        keys[i] = _split_key(key)
+    return keys
+
+
+def _coefficients_to_record(
+    model_id: str,
+    coefficients: Coefficients,
+    keys: Sequence[tuple[str, str]],
+    task: TaskType,
+    sparsity_threshold: float,
+) -> dict:
+    means = np.asarray(coefficients.means, np.float64)
+    variances = (
+        None if coefficients.variances is None else np.asarray(coefficients.variances, np.float64)
+    )
+    keep = np.flatnonzero(np.abs(means) > sparsity_threshold)
+    mean_recs = [
+        {"name": keys[i][0], "term": keys[i][1], "value": float(means[i])} for i in keep
+    ]
+    var_recs = None
+    if variances is not None:
+        var_recs = [
+            {"name": keys[i][0], "term": keys[i][1], "value": float(variances[i])}
+            for i in keep
+        ]
+    return {
+        "modelId": model_id,
+        "modelClass": "GeneralizedLinearModel",
+        "lossFunction": task.value,
+        "means": mean_recs,
+        "variances": var_recs,
+    }
+
+
+def _record_to_coefficients(
+    record: dict, index_map: IndexMap | None, num_features: int | None
+) -> Coefficients:
+    def key_index(name: str, term: str) -> int:
+        if index_map is not None:
+            return index_map.get(f"{name}{DELIMITER}{term}" if term else name)
+        if name == INTERCEPT_KEY:
+            # synthetic naming puts the intercept at the last index
+            return (num_features or 0) - 1
+        m = _SYNTHETIC.match(name)
+        if m is None:
+            raise ValueError(
+                f"feature {name!r} needs an IndexMap to resolve (not synthetic)"
+            )
+        return int(m.group(1))
+
+    pairs = [(key_index(r["name"], r["term"]), r["value"]) for r in record["means"]]
+    pairs = [(i, v) for i, v in pairs if i >= 0]  # unknown features dropped
+    if num_features is None:
+        num_features = (max(i for i, _ in pairs) + 1) if pairs else 0
+        if index_map is not None:
+            num_features = index_map.size
+    means = np.zeros((num_features,), np.float32)
+    for i, v in pairs:
+        means[i] = v
+    variances = None
+    if record.get("variances"):
+        variances = np.zeros((num_features,), np.float32)
+        for r in record["variances"]:
+            i = key_index(r["name"], r["term"])
+            if i >= 0:
+                variances[i] = r["value"]
+    return Coefficients(
+        jnp.asarray(means), None if variances is None else jnp.asarray(variances)
+    )
+
+
+# ---------------------------------------------------------------------------
+# single GLM
+# ---------------------------------------------------------------------------
+def save_glm(
+    model: GeneralizedLinearModel,
+    path: str,
+    index_map: IndexMap | None = None,
+    model_id: str = "global",
+    sparsity_threshold: float = 0.0,
+) -> None:
+    keys = _index_to_key(index_map, model.coefficients.dim)
+    rec = _coefficients_to_record(
+        model_id, model.coefficients, keys, model.task_type, sparsity_threshold
+    )
+    write_avro_file(path, BAYESIAN_LINEAR_MODEL_SCHEMA, [rec])
+
+
+def load_glm(
+    path: str,
+    index_map: IndexMap | None = None,
+    num_features: int | None = None,
+    task: TaskType | None = None,
+) -> GeneralizedLinearModel:
+    _, records = read_avro_file(path)
+    if len(records) != 1:
+        raise ValueError(f"{path}: expected one model record, found {len(records)}")
+    rec = records[0]
+    coeffs = _record_to_coefficients(rec, index_map, num_features)
+    task = task or TaskType(rec.get("lossFunction") or "LOGISTIC_REGRESSION")
+    return GeneralizedLinearModel(coeffs, task)
+
+
+# ---------------------------------------------------------------------------
+# GAME models
+# ---------------------------------------------------------------------------
+def save_game_model(
+    model: GameModel,
+    directory: str,
+    index_maps: Mapping[str, IndexMap] | None = None,
+    entity_names: Mapping[str, Sequence[str]] | None = None,
+    sparsity_threshold: float = 0.0,
+    records_per_part: int = 100_000,
+) -> None:
+    """Write a GameModel to ``directory`` (reference: HDFS model dir).
+
+    ``index_maps``: feature-shard id → IndexMap (real feature names).
+    ``entity_names``: coordinate id → dense-entity-id → original entity
+    string (for interchange; defaults to the dense id's decimal string).
+    """
+    index_maps = index_maps or {}
+    entity_names = entity_names or {}
+    meta: dict = {"task_type": model.task_type.value, "coordinates": {}}
+    for cid, sub in model.models.items():
+        if isinstance(sub, FixedEffectModel):
+            keys = _index_to_key(
+                index_maps.get(sub.feature_shard_id), sub.model.coefficients.dim
+            )
+            rec = _coefficients_to_record(
+                cid, sub.model.coefficients, keys, model.task_type, sparsity_threshold
+            )
+            out = os.path.join(
+                directory, "fixed-effect", cid, "coefficients", "part-00000.avro"
+            )
+            write_avro_file(out, BAYESIAN_LINEAR_MODEL_SCHEMA, [rec])
+            meta["coordinates"][cid] = {
+                "type": "fixed",
+                "feature_shard_id": sub.feature_shard_id,
+                "dim": int(sub.model.coefficients.dim),
+            }
+        elif isinstance(sub, RandomEffectModel):
+            W = np.asarray(sub.coefficients, np.float64)
+            V = None if sub.variances is None else np.asarray(sub.variances, np.float64)
+            keys = _index_to_key(index_maps.get(sub.feature_shard_id), W.shape[1])
+            names = entity_names.get(cid)
+
+            def records():
+                for e in range(W.shape[0]):
+                    coeffs = Coefficients(
+                        W[e], None if V is None else V[e]
+                    )
+                    model_id = names[e] if names is not None else str(e)
+                    yield _coefficients_to_record(
+                        model_id, coeffs, keys, model.task_type, sparsity_threshold
+                    )
+
+            out_dir = os.path.join(directory, "random-effect", cid, "coefficients")
+            os.makedirs(out_dir, exist_ok=True)
+            part, buf = 0, []
+            for rec in records():
+                buf.append(rec)
+                if len(buf) >= records_per_part:
+                    write_avro_file(
+                        os.path.join(out_dir, f"part-{part:05d}.avro"),
+                        BAYESIAN_LINEAR_MODEL_SCHEMA,
+                        buf,
+                    )
+                    part, buf = part + 1, []
+            write_avro_file(
+                os.path.join(out_dir, f"part-{part:05d}.avro"),
+                BAYESIAN_LINEAR_MODEL_SCHEMA,
+                buf,
+            )
+            meta["coordinates"][cid] = {
+                "type": "random",
+                "feature_shard_id": sub.feature_shard_id,
+                "random_effect_type": sub.random_effect_type,
+                "num_entities": int(W.shape[0]),
+                "dim": int(W.shape[1]),
+                "has_variances": V is not None,
+            }
+        else:  # pragma: no cover
+            raise TypeError(f"unknown sub-model type {type(sub)}")
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory, "metadata.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+
+
+def load_game_model(
+    directory: str,
+    index_maps: Mapping[str, IndexMap] | None = None,
+    entity_ids: Mapping[str, Mapping[str, int]] | None = None,
+) -> GameModel:
+    """Load a GameModel written by :func:`save_game_model` (or the
+    reference's layout with a metadata.json added). ``entity_ids`` maps
+    coordinate id → original entity string → dense id; defaults to parsing
+    modelId as the dense integer id."""
+    index_maps = index_maps or {}
+    entity_ids = entity_ids or {}
+    with open(os.path.join(directory, "metadata.json")) as f:
+        meta = json.load(f)
+    task = TaskType(meta["task_type"])
+    models: dict = {}
+    for cid, info in meta["coordinates"].items():
+        # size from the CURRENT index map when given (warm start onto data
+        # whose feature space grew), else the saved dim
+        imap = index_maps.get(info["feature_shard_id"])
+        dim = imap.size if imap is not None else info["dim"]
+        if info["type"] == "fixed":
+            path = os.path.join(
+                directory, "fixed-effect", cid, "coefficients", "part-00000.avro"
+            )
+            _, records = read_avro_file(path)
+            coeffs = _record_to_coefficients(records[0], imap, dim)
+            models[cid] = FixedEffectModel(
+                model=GeneralizedLinearModel(coeffs, task),
+                feature_shard_id=info["feature_shard_id"],
+            )
+        else:
+            E, d = info["num_entities"], dim
+            W = np.zeros((E, d), np.float32)
+            V = np.zeros((E, d), np.float32) if info.get("has_variances") else None
+            id_map = entity_ids.get(cid)
+            for rec in iter_avro_directory(
+                os.path.join(directory, "random-effect", cid, "coefficients")
+            ):
+                e = (
+                    id_map[rec["modelId"]]
+                    if id_map is not None
+                    else int(rec["modelId"])
+                )
+                coeffs = _record_to_coefficients(rec, imap, d)
+                W[e] = np.asarray(coeffs.means)
+                if V is not None and coeffs.variances is not None:
+                    V[e] = np.asarray(coeffs.variances)
+            models[cid] = RandomEffectModel(
+                coefficients=jnp.asarray(W),
+                variances=None if V is None else jnp.asarray(V),
+                random_effect_type=info["random_effect_type"],
+                feature_shard_id=info["feature_shard_id"],
+                task_type=task,
+            )
+    return GameModel(models=models, task_type=task)

@@ -1,0 +1,69 @@
+"""Scoring-result and feature-summary Avro writers.
+
+Reference parity: the scoring driver's ``ScoringResultAvro`` output and the
+legacy driver's ``FeatureSummarizationResultAvro`` output (SURVEY.md §2.3,
+§5.5).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.data.summary import FeatureSummary
+from photon_ml_tpu.io.avro import write_avro_file
+from photon_ml_tpu.io.model_io import _index_to_key
+from photon_ml_tpu.io.schemas import (
+    FEATURE_SUMMARIZATION_RESULT_SCHEMA,
+    SCORING_RESULT_SCHEMA,
+)
+
+
+def write_scoring_results(
+    path: str,
+    scores: np.ndarray,
+    uids: Sequence | None = None,
+    labels: np.ndarray | None = None,
+    metadata: Sequence[Mapping[str, str]] | None = None,
+) -> None:
+    scores = np.asarray(scores, np.float64)
+
+    def records():
+        for i in range(len(scores)):
+            uid = None if uids is None else uids[i]
+            if uid is not None and not isinstance(uid, (str, int)):
+                uid = str(uid)
+            yield {
+                "uid": uid,
+                "predictionScore": float(scores[i]),
+                "label": None if labels is None else float(labels[i]),
+                "metadataMap": dict(metadata[i]) if metadata is not None else None,
+            }
+
+    write_avro_file(path, SCORING_RESULT_SCHEMA, records())
+
+
+def write_feature_summary(
+    path: str, summary: FeatureSummary, index_map: IndexMap | None = None
+) -> None:
+    d = len(summary.mean)
+    keys = _index_to_key(index_map, d)
+
+    def records():
+        for i in range(d):
+            yield {
+                "featureName": keys[i][0],
+                "featureTerm": keys[i][1],
+                "metrics": {
+                    "mean": float(summary.mean[i]),
+                    "variance": float(summary.variance[i]),
+                    "min": float(summary.min[i]),
+                    "max": float(summary.max[i]),
+                    "maxMagnitude": float(summary.max_magnitude[i]),
+                    "numNonzeros": float(summary.num_nonzeros[i]),
+                },
+            }
+
+    write_avro_file(path, FEATURE_SUMMARIZATION_RESULT_SCHEMA, records())

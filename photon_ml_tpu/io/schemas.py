@@ -1,0 +1,95 @@
+"""Avro schemas for data and model interchange.
+
+Reference parity: ``photon-avro-schemas`` (SURVEY.md §2.4) — the
+``com.linkedin.photon.avro.generated`` record shapes:
+``TrainingExampleAvro``, ``NameTermValueAvro``, ``BayesianLinearModelAvro``,
+``ScoringResultAvro``, ``FeatureSummarizationResultAvro``. Field sets follow
+the upstream schemas [M — the survey's reference mount was empty; the
+shapes below are the upstream-documented ones: features as
+(name, term, value) records, nullable offset/weight/uid, a metadata map
+carrying the entity-id tags, model coefficients as name-term records with
+means and optional variances].
+"""
+
+from __future__ import annotations
+
+NAMESPACE = "com.linkedin.photon.avro.generated"
+
+NAME_TERM_VALUE_SCHEMA = {
+    "type": "record",
+    "name": "NameTermValueAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "term", "type": "string"},
+        {"name": "value", "type": "double"},
+    ],
+}
+
+TRAINING_EXAMPLE_SCHEMA = {
+    "type": "record",
+    "name": "TrainingExampleAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "uid", "type": ["null", "string", "long"], "default": None},
+        {"name": "response", "type": "double"},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "features", "type": {"type": "array", "items": NAME_TERM_VALUE_SCHEMA}},
+        {
+            "name": "metadataMap",
+            "type": ["null", {"type": "map", "values": "string"}],
+            "default": None,
+        },
+    ],
+}
+
+BAYESIAN_LINEAR_MODEL_SCHEMA = {
+    "type": "record",
+    "name": "BayesianLinearModelAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "modelId", "type": "string"},
+        {"name": "modelClass", "type": ["null", "string"], "default": None},
+        {"name": "lossFunction", "type": ["null", "string"], "default": None},
+        {
+            "name": "means",
+            "type": {"type": "array", "items": "NameTermValueAvro"},
+        },
+        {
+            "name": "variances",
+            "type": ["null", {"type": "array", "items": "NameTermValueAvro"}],
+            "default": None,
+        },
+    ],
+}
+# NameTermValueAvro must be defined before first reference when both appear
+# in one file's schema; model files embed the full definition:
+BAYESIAN_LINEAR_MODEL_SCHEMA["fields"][3]["type"]["items"] = NAME_TERM_VALUE_SCHEMA
+
+SCORING_RESULT_SCHEMA = {
+    "type": "record",
+    "name": "ScoringResultAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "uid", "type": ["null", "string", "long"], "default": None},
+        {"name": "predictionScore", "type": "double"},
+        {"name": "label", "type": ["null", "double"], "default": None},
+        {
+            "name": "metadataMap",
+            "type": ["null", {"type": "map", "values": "string"}],
+            "default": None,
+        },
+    ],
+}
+
+FEATURE_SUMMARIZATION_RESULT_SCHEMA = {
+    "type": "record",
+    "name": "FeatureSummarizationResultAvro",
+    "namespace": NAMESPACE,
+    "fields": [
+        {"name": "featureName", "type": "string"},
+        {"name": "featureTerm", "type": "string"},
+        {"name": "metrics", "type": {"type": "map", "values": "double"}},
+    ],
+}

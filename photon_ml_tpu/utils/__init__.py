@@ -1,0 +1,3 @@
+"""Shared utilities: logging, stage timing."""
+
+from photon_ml_tpu.utils.logging import PhotonLogger, timed  # noqa: F401

@@ -1,0 +1,78 @@
+"""Leveled run logging + stage timers.
+
+Reference parity: ``photon-client::ml.util.PhotonLogger`` (a leveled log
+file written into the job's output directory) and the ``Timed { }`` stage
+wrappers that log wall-time per driver stage (SURVEY.md §5.1/§5.5).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import time
+from typing import Iterator, TextIO
+
+
+class PhotonLogger:
+    """Logs to stderr and (optionally) a file in the output directory.
+
+    Levels: DEBUG < INFO < WARN < ERROR. The instance is callable with a
+    plain message (INFO) so it can be passed anywhere a ``logger`` callback
+    is accepted (estimator, coordinate descent).
+    """
+
+    LEVELS = {"DEBUG": 10, "INFO": 20, "WARN": 30, "ERROR": 40}
+
+    def __init__(
+        self,
+        output_dir: str | None = None,
+        level: str = "INFO",
+        stream: TextIO | None = None,
+        filename: str = "photon.log",
+    ):
+        self.level = self.LEVELS[level.upper()]
+        self.stream = stream if stream is not None else sys.stderr
+        self._file = None
+        if output_dir is not None:
+            os.makedirs(output_dir, exist_ok=True)
+            self._file = open(os.path.join(output_dir, filename), "a")
+
+    def log(self, level: str, msg: str) -> None:
+        if self.LEVELS[level] < self.level:
+            return
+        line = f"[{time.strftime('%Y-%m-%d %H:%M:%S')}] {level:5s} {msg}"
+        print(line, file=self.stream)
+        if self._file is not None:
+            print(line, file=self._file, flush=True)
+
+    def debug(self, msg: str) -> None:
+        self.log("DEBUG", msg)
+
+    def info(self, msg: str) -> None:
+        self.log("INFO", msg)
+
+    def warn(self, msg: str) -> None:
+        self.log("WARN", msg)
+
+    def error(self, msg: str) -> None:
+        self.log("ERROR", msg)
+
+    def __call__(self, msg: str) -> None:
+        self.info(msg)
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+@contextlib.contextmanager
+def timed(logger: PhotonLogger, stage: str) -> Iterator[None]:
+    """Log a stage's wall time (the reference's ``Timed`` wrapper)."""
+    logger.info(f"{stage}: started")
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        logger.info(f"{stage}: finished in {time.perf_counter() - t0:.2f}s")

@@ -1,0 +1,303 @@
+"""End-to-end driver tests on small Avro/LIBSVM fixtures in tmpdirs.
+
+Mirrors the reference's driver integration tests (SURVEY.md §4):
+``GameTrainingDriverIntegTest`` / ``GameScoringDriverIntegTest`` — full
+driver ``run`` with config files pointing at small fixtures; asserts output
+model files exist/parse, metrics clear thresholds, warm start works.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import index_features, name_term_bags, score, train, train_glm
+from photon_ml_tpu.config import (
+    FeatureShardConfig,
+    FixedEffectCoordinateConfig,
+    GameTrainingConfig,
+    OptimizationConfig,
+    OptimizerConfig,
+    RandomEffectCoordinateConfig,
+    RegularizationContext,
+)
+from photon_ml_tpu.data.synthetic import synthetic_game_data
+from photon_ml_tpu.io import TRAINING_EXAMPLE_SCHEMA, read_avro_file, write_avro_file
+from photon_ml_tpu.types import RegularizationType, TaskType
+from photon_ml_tpu.utils import PhotonLogger
+
+OPT = OptimizerConfig(max_iterations=40, tolerance=1e-7)
+
+
+def _quiet(tmp_path):
+    import io as _io
+
+    return PhotonLogger(None, stream=_io.StringIO())
+
+
+def _write_game_avro(path, rng, n=300, seed_offset=0, data=None, lo=0, hi=None):
+    """GLMix-ish records: global features + per-user membership. Pass a
+    shared ``data`` (+ ``lo``/``hi`` slice) so train/validation files come
+    from ONE generating model."""
+    if data is None:
+        data = synthetic_game_data(rng, n, d_fixed=3, effects={"userId": (8, 2)})
+    hi = hi if hi is not None else data.X.shape[0]
+    recs = []
+    for i in range(lo, hi):
+        feats = [
+            {"name": "g", "term": str(j), "value": float(data.X[i, j])} for j in range(3)
+        ]
+        ufeats = [
+            {"name": "u", "term": str(j), "value": float(data.entity_X["userId"][i, j])}
+            for j in range(2)
+        ]
+        recs.append(
+            {
+                "uid": f"s{seed_offset + i}",
+                "response": float(data.y[i]),
+                "offset": None,
+                "weight": None,
+                "features": feats,
+                "userFeatures": ufeats,
+                "metadataMap": {"userId": f"user_{data.entity_ids['userId'][i]}"},
+            }
+        )
+    schema = json.loads(json.dumps(TRAINING_EXAMPLE_SCHEMA))
+    schema["fields"].insert(
+        5,
+        {
+            "name": "userFeatures",
+            "type": {"type": "array", "items": "NameTermValueAvro"},
+            "default": [],
+        },
+    )
+    write_avro_file(path, schema, recs)
+
+
+def _game_config(**kwargs):
+    kwargs.setdefault("coordinate_descent_iterations", 1)
+    return GameTrainingConfig(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinate_update_sequence=("fixed", "per_user"),
+        fixed_effect_coordinates={
+            "fixed": FixedEffectCoordinateConfig(
+                feature_shard_id="global",
+                optimization=OptimizationConfig(optimizer=OPT),
+            )
+        },
+        random_effect_coordinates={
+            "per_user": RandomEffectCoordinateConfig(
+                random_effect_type="userId",
+                feature_shard_id="per_user",
+                optimization=OptimizationConfig(
+                    optimizer=OPT,
+                    regularization=RegularizationContext(RegularizationType.L2),
+                    regularization_weight=1.0,
+                ),
+            )
+        },
+        feature_shards={
+            "global": FeatureShardConfig(feature_bags=("features",), has_intercept=True),
+            "per_user": FeatureShardConfig(feature_bags=("userFeatures",), has_intercept=False),
+        },
+        evaluators=("AUC",),
+        **kwargs,
+    )
+
+
+class TestGameTrainingDriver:
+    def test_train_then_score_roundtrip(self, tmp_path, rng):
+        train_path = str(tmp_path / "train.avro")
+        val_path = str(tmp_path / "val.avro")
+        data = synthetic_game_data(rng, 400, d_fixed=3, effects={"userId": (8, 2)})
+        _write_game_avro(train_path, rng, data=data, lo=0, hi=300)
+        _write_game_avro(val_path, rng, data=data, lo=300, hi=400, seed_offset=1000)
+        out = str(tmp_path / "out")
+
+        cfg = _game_config()
+        best = train.run(
+            cfg, [train_path], out, validation_data=[val_path], logger=_quiet(tmp_path)
+        )
+        assert best.evaluation is not None and best.evaluation.primary > 0.5
+        # artifacts
+        assert os.path.isdir(os.path.join(out, "best", "fixed-effect", "fixed"))
+        assert os.path.isdir(os.path.join(out, "best", "random-effect", "per_user"))
+        assert os.path.exists(os.path.join(out, "metrics.json"))
+        assert os.path.exists(os.path.join(out, "entity-maps.json"))
+        assert os.path.exists(os.path.join(out, "index-maps", "global.npz"))
+
+        # scoring driver consumes the training output directly
+        score_out = str(tmp_path / "scores")
+        scores, metrics = score.run(
+            out,
+            [val_path],
+            score_out,
+            evaluators=["AUC"],
+            feature_shards=dict(cfg.feature_shards),
+            logger=_quiet(tmp_path),
+        )
+        assert metrics["AUC"] > 0.5
+        _, recs = read_avro_file(
+            os.path.join(score_out, "scores", "part-00000.avro")
+        )
+        assert len(recs) == 100
+        assert recs[0]["uid"].startswith("s1")
+
+    def test_grid_and_output_mode_all(self, tmp_path, rng):
+        train_path = str(tmp_path / "train.avro")
+        val_path = str(tmp_path / "val.avro")
+        data = synthetic_game_data(rng, 280, d_fixed=3, effects={"userId": (8, 2)})
+        _write_game_avro(train_path, rng, data=data, lo=0, hi=200)
+        _write_game_avro(val_path, rng, data=data, lo=200, hi=280, seed_offset=500)
+        out = str(tmp_path / "out")
+        from photon_ml_tpu.types import ModelOutputMode
+
+        cfg = _game_config(
+            regularization_weight_grid={"per_user": (0.1, 10.0)},
+            output_mode=ModelOutputMode.ALL,
+        )
+        train.run(
+            cfg, [train_path], out, validation_data=[val_path], logger=_quiet(tmp_path)
+        )
+        with open(os.path.join(out, "metrics.json")) as f:
+            metrics = json.load(f)
+        assert len(metrics["results"]) == 2
+        assert os.path.isdir(os.path.join(out, "models", "0000"))
+        assert os.path.isdir(os.path.join(out, "models", "0001"))
+
+    def test_warm_start_from_saved_model(self, tmp_path, rng):
+        train_path = str(tmp_path / "train.avro")
+        _write_game_avro(train_path, rng, n=200)
+        out1 = str(tmp_path / "out1")
+        cfg = _game_config()
+        train.run(cfg, [train_path], out1, logger=_quiet(tmp_path))
+
+        out2 = str(tmp_path / "out2")
+        cfg2 = _game_config(model_input_dir=os.path.join(out1, "best"))
+        best = train.run(cfg2, [train_path], out2, logger=_quiet(tmp_path))
+        assert set(best.model.models) == {"fixed", "per_user"}
+
+    def test_warm_start_aligns_entities_across_data_order(self, tmp_path, rng):
+        """Dense entity ids are first-seen order, so re-reading shuffled data
+        permutes them; warm start must still map each entity STRING to its
+        saved coefficients (zero CD iterations ⇒ the loaded model passes
+        through untouched and can be compared row by row)."""
+        data = synthetic_game_data(rng, 150, d_fixed=3, effects={"userId": (6, 2)})
+        p1 = str(tmp_path / "t1.avro")
+        _write_game_avro(p1, rng, data=data)
+        out1 = str(tmp_path / "out1")
+        train.run(_game_config(), [p1], out1, logger=_quiet(tmp_path))
+
+        # shuffled record order → different first-seen entity order
+        perm = rng.permutation(150)
+        data2 = type(data)(
+            X=data.X[perm], y=data.y[perm],
+            entity_ids={k: v[perm] for k, v in data.entity_ids.items()},
+            entity_X={k: v[perm] for k, v in data.entity_X.items()},
+            w_fixed=data.w_fixed, w_entity=data.w_entity,
+            intercept_index=data.intercept_index,
+        )
+        p2 = str(tmp_path / "t2.avro")
+        _write_game_avro(p2, rng, data=data2)
+        out2 = str(tmp_path / "out2")
+        cfg2 = _game_config(
+            model_input_dir=os.path.join(out1, "best"),
+            coordinate_descent_iterations=0,
+        )
+        best = train.run(cfg2, [p2], out2, logger=_quiet(tmp_path))
+
+        with open(os.path.join(out1, "entity-maps.json")) as f:
+            map1 = json.load(f)["userId"]
+        with open(os.path.join(out2, "entity-maps.json")) as f:
+            map2 = json.load(f)["userId"]
+        from photon_ml_tpu.data.index_map import IndexMap
+        from photon_ml_tpu.io import load_game_model
+
+        imaps = {
+            sid: IndexMap.load(os.path.join(out1, "index-maps", f"{sid}.npz"))
+            for sid in ("global", "per_user")
+        }
+        m1 = load_game_model(
+            os.path.join(out1, "best"), index_maps=imaps,
+            entity_ids={"per_user": map1},
+        )
+        W1 = np.asarray(m1["per_user"].coefficients)
+        W2 = np.asarray(best.model["per_user"].coefficients)
+        for name, e1 in map1.items():
+            np.testing.assert_allclose(
+                W2[map2[name]], W1[e1], rtol=1e-5,
+                err_msg=f"entity {name} misaligned across warm start",
+            )
+
+
+class TestLegacyGLMDriver:
+    def test_staged_pipeline_libsvm(self, tmp_path, rng):
+        # small synthetic libsvm file
+        lines = []
+        w = np.array([1.0, -2.0, 0.5])
+        for _ in range(200):
+            x = rng.normal(size=3)
+            y = 1 if rng.uniform() < 1 / (1 + np.exp(-x @ w)) else -1
+            feats = " ".join(f"{j + 1}:{x[j]:.5f}" for j in range(3))
+            lines.append(f"{y} {feats}")
+        path = str(tmp_path / "train.libsvm")
+        with open(path, "w") as f:
+            f.write("\n".join(lines))
+
+        out = str(tmp_path / "out")
+        result = train_glm.run(
+            TaskType.LOGISTIC_REGRESSION,
+            [path],
+            out,
+            validation_data=[path],
+            weights=[0.01, 1.0],
+            summarize_features=True,
+            logger=_quiet(tmp_path),
+        )
+        assert open(os.path.join(out, "_stage")).read() == "VALIDATED"
+        assert os.path.exists(os.path.join(out, "best", "model.avro"))
+        assert os.path.exists(os.path.join(out, "models", "lambda-0.01", "model.avro"))
+        assert os.path.exists(os.path.join(out, "summary", "part-00000.avro"))
+        with open(os.path.join(out, "report.json")) as f:
+            report = json.load(f)
+        assert report["best_weight"] in (0.01, 1.0)
+        auc = report["validation"][str(report["best_weight"])]["AUC"]
+        assert auc > 0.7
+
+
+class TestIndexingDrivers:
+    def test_feature_indexing_and_reuse(self, tmp_path, rng):
+        data_path = str(tmp_path / "train.avro")
+        _write_game_avro(data_path, rng, n=100)
+        cfg = _game_config()
+        cfg_path = str(tmp_path / "config.json")
+        with open(cfg_path, "w") as f:
+            json.dump(cfg.to_dict(), f)
+
+        idx_out = str(tmp_path / "index")
+        maps = index_features.run(
+            [data_path], idx_out, config_path=cfg_path, logger=_quiet(tmp_path)
+        )
+        assert maps["global"].size == 4  # 3 features + intercept
+        assert maps["per_user"].size == 2
+        assert os.path.exists(os.path.join(idx_out, "global.npz"))
+
+        # training consumes the prebuilt maps
+        out = str(tmp_path / "out")
+        train.run(
+            cfg, [data_path], out, index_map_dir=idx_out, logger=_quiet(tmp_path)
+        )
+        assert os.path.isdir(os.path.join(out, "best"))
+
+    def test_name_term_bags(self, tmp_path, rng):
+        data_path = str(tmp_path / "train.avro")
+        _write_game_avro(data_path, rng, n=50)
+        out = str(tmp_path / "bags")
+        bags = name_term_bags.run(
+            [data_path], ["features", "userFeatures"], out, logger=_quiet(tmp_path)
+        )
+        assert bags["features"] == [("g", "0"), ("g", "1"), ("g", "2")]
+        assert bags["userFeatures"] == [("u", "0"), ("u", "1")]
+        with open(os.path.join(out, "features.json")) as f:
+            assert len(json.load(f)) == 3

@@ -1,0 +1,142 @@
+"""Hyperparameter tuning tests: kernels, GP regression, EI, Sobol, slice
+sampler, and the search loop on closed-form objectives (mirroring the
+reference's optimizer-vs-known-optimum test style, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.hyperparameter import (
+    GaussianProcessEstimator,
+    GaussianProcessSearch,
+    Matern52,
+    RBF,
+    RandomSearch,
+    SearchRange,
+    expected_improvement,
+    slice_sample,
+    sobol_sequence,
+)
+
+
+class TestKernels:
+    @pytest.mark.parametrize("kernel_cls", [RBF, Matern52])
+    def test_psd_and_unit_diagonal(self, kernel_cls, rng):
+        X = rng.normal(size=(20, 3))
+        k = kernel_cls(amplitude=1.0, lengthscales=0.7, noise=0.0)
+        K = k(X)
+        np.testing.assert_allclose(np.diag(K), 1.0 + 1e-10, rtol=1e-6)
+        evals = np.linalg.eigvalsh(K)
+        assert evals.min() > -1e-8
+        np.testing.assert_allclose(K, K.T)
+
+    def test_noise_only_on_self_covariance(self, rng):
+        X = rng.normal(size=(5, 2))
+        k = Matern52(noise=0.5)
+        assert k(X)[0, 0] > k(X, X.copy())[0, 0]  # diag noise only when Z is None
+
+    def test_param_roundtrip(self):
+        k = Matern52(amplitude=2.0, noise=0.1, lengthscales=np.array([0.5, 2.0]))
+        p = k.log_params(2)
+        k2 = Matern52().with_params(p)
+        assert np.isclose(k2.amplitude, 2.0)
+        assert np.isclose(k2.noise, 0.1)
+        np.testing.assert_allclose(k2.lengthscales, [0.5, 2.0])
+
+    def test_ard_lengthscales_change_covariance(self, rng):
+        X = np.array([[0.0, 0.0], [1.0, 0.0], [0.0, 1.0]])
+        k = RBF(lengthscales=np.array([0.3, 3.0]), noise=0.0)
+        K = k(X)
+        assert K[0, 1] < K[0, 2]  # dim 0 decays faster
+
+
+class TestGP:
+    def test_interpolates_smooth_function(self, rng):
+        X = np.linspace(0, 1, 12)[:, None]
+        y = np.sin(2 * np.pi * X[:, 0])
+        model = GaussianProcessEstimator(num_kernel_samples=4, seed=0).fit(X, y)
+        Z = np.linspace(0.05, 0.95, 7)[:, None]
+        mean, std = model.predict(Z)
+        np.testing.assert_allclose(mean, np.sin(2 * np.pi * Z[:, 0]), atol=0.25)
+        assert (std > 0).all()
+
+    def test_uncertainty_grows_off_data(self):
+        X = np.linspace(0.4, 0.6, 8)[:, None]
+        y = X[:, 0] ** 2
+        model = GaussianProcessEstimator(num_kernel_samples=4, seed=1).fit(X, y)
+        _, std_in = model.predict(np.array([[0.5]]))
+        _, std_out = model.predict(np.array([[0.0]]))
+        assert std_out[0] > std_in[0]
+
+
+class TestCriteria:
+    def test_ei_prefers_low_mean_then_high_std(self):
+        ei = expected_improvement(
+            mean=np.array([0.0, 1.0]), std=np.array([0.1, 0.1]), best=0.5
+        )
+        assert ei[0] > ei[1]
+        ei2 = expected_improvement(
+            mean=np.array([1.0, 1.0]), std=np.array([0.01, 1.0]), best=0.5
+        )
+        assert ei2[1] > ei2[0]
+
+    def test_ei_nonnegative(self, rng):
+        ei = expected_improvement(rng.normal(size=50), np.abs(rng.normal(size=50)), 0.0)
+        assert (ei >= 0).all()
+
+
+class TestSobol:
+    def test_range_and_spread(self):
+        pts = sobol_sequence(64, 3, seed=0)
+        assert pts.shape == (64, 3)
+        assert (pts >= 0).all() and (pts < 1).all()
+        # low-discrepancy: every axis covers both halves about evenly
+        frac = (pts < 0.5).mean(0)
+        np.testing.assert_allclose(frac, 0.5, atol=0.1)
+
+
+class TestSliceSampler:
+    def test_samples_standard_normal(self, rng):
+        log_density = lambda x: float(-0.5 * np.sum(x**2))
+        samples = slice_sample(
+            np.zeros(1), log_density, num_samples=400, rng=rng, burn_in=50
+        )
+        assert abs(samples.mean()) < 0.25
+        assert 0.7 < samples.std() < 1.4
+
+
+class TestSearch:
+    def test_search_range_roundtrip(self):
+        r = SearchRange(1e-3, 1e3, log_scale=True)
+        for v in (1e-3, 1.0, 1e3):
+            assert np.isclose(r.from_unit(r.to_unit(v)), v)
+
+    def test_random_search_covers_space(self):
+        s = RandomSearch([SearchRange(0, 1), SearchRange(-5, 5)], seed=0)
+        pts = np.stack([s.suggest() for _ in range(16)])
+        assert (pts[:, 0] >= 0).all() and (pts[:, 0] <= 1).all()
+        assert (pts[:, 1] >= -5).all() and (pts[:, 1] <= 5).all()
+
+    def test_gp_search_finds_quadratic_minimum(self):
+        """The search must localize the minimum of a smooth 1-D objective
+        far better than its seeding phase alone."""
+        target = 0.3
+        f = lambda x: (x[0] - target) ** 2
+        s = GaussianProcessSearch([SearchRange(0.0, 1.0)], seed=3, num_init=4)
+        for _ in range(14):
+            x = s.suggest()
+            s.observe(x, f(x))
+        best_x, best_y = s.best
+        assert abs(best_x[0] - target) < 0.08
+        assert best_y < 0.01
+
+    def test_gp_search_log_scale_dimension(self):
+        target = np.log(1.0)
+        f = lambda x: (np.log(x[0]) - target) ** 2
+        s = GaussianProcessSearch(
+            [SearchRange(1e-3, 1e3, log_scale=True)], seed=5, num_init=4
+        )
+        for _ in range(14):
+            x = s.suggest()
+            s.observe(x, f(x))
+        best_x, best_y = s.best
+        assert 0.2 < best_x[0] < 5.0

@@ -1,0 +1,286 @@
+"""IO tests: Avro codec round-trips, model save/load, data reader.
+
+Mirrors the reference's ``AvroDataReaderIntegTest`` / model-IO tests
+(SURVEY.md §4) on small in-tmpdir fixtures.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.config import FeatureShardConfig
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.game.models import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.io import (
+    AvroDataReader,
+    BAYESIAN_LINEAR_MODEL_SCHEMA,
+    TRAINING_EXAMPLE_SCHEMA,
+    load_game_model,
+    load_glm,
+    read_avro_file,
+    save_game_model,
+    save_glm,
+    write_avro_file,
+)
+from photon_ml_tpu.io.results import write_scoring_results
+from photon_ml_tpu.io.avro import iter_avro_directory
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.types import TaskType
+
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+class TestAvroCodec:
+    @pytest.mark.parametrize("codec", ["null", "deflate"])
+    def test_roundtrip_training_examples(self, tmp_path, codec):
+        recs = [
+            {
+                "uid": f"u{i}",
+                "response": float(i % 2),
+                "offset": 0.5 if i % 3 == 0 else None,
+                "weight": None,
+                "features": [
+                    {"name": "age", "term": "", "value": float(i)},
+                    {"name": "country", "term": "us", "value": 1.0},
+                ],
+                "metadataMap": {"userId": f"user_{i % 5}"},
+            }
+            for i in range(10)
+        ]
+        path = str(tmp_path / "data.avro")
+        write_avro_file(path, TRAINING_EXAMPLE_SCHEMA, recs, codec=codec)
+        schema, out = read_avro_file(path)
+        assert schema["name"] == "TrainingExampleAvro"
+        assert out == recs
+
+    def test_multiple_blocks(self, tmp_path):
+        recs = [
+            {"uid": None, "response": float(i), "offset": None, "weight": None,
+             "features": [], "metadataMap": None}
+            for i in range(250)
+        ]
+        path = str(tmp_path / "blocks.avro")
+        write_avro_file(path, TRAINING_EXAMPLE_SCHEMA, recs, sync_interval=100)
+        _, out = read_avro_file(path)
+        assert [r["response"] for r in out] == [float(i) for i in range(250)]
+
+    def test_negative_and_large_longs(self, tmp_path):
+        schema = {
+            "type": "record", "name": "R",
+            "fields": [{"name": "v", "type": "long"}],
+        }
+        vals = [0, -1, 1, -(2**40), 2**40, 2**62, -(2**62)]
+        path = str(tmp_path / "longs.avro")
+        write_avro_file(path, schema, [{"v": v} for v in vals])
+        _, out = read_avro_file(path)
+        assert [r["v"] for r in out] == vals
+
+    def test_corrupt_sync_detected(self, tmp_path):
+        path = str(tmp_path / "x.avro")
+        write_avro_file(
+            path, {"type": "record", "name": "R", "fields": [{"name": "v", "type": "long"}]},
+            [{"v": 1}], codec="null",
+        )
+        raw = bytearray(open(path, "rb").read())
+        raw[-1] ^= 0xFF  # flip a sync byte
+        open(path, "wb").write(raw)
+        with pytest.raises(ValueError, match="sync"):
+            read_avro_file(path)
+
+    def test_iter_directory(self, tmp_path):
+        schema = {"type": "record", "name": "R", "fields": [{"name": "v", "type": "long"}]}
+        for p in range(3):
+            write_avro_file(
+                str(tmp_path / f"part-{p}.avro"), schema, [{"v": p}]
+            )
+        assert [r["v"] for r in iter_avro_directory(str(tmp_path))] == [0, 1, 2]
+
+
+# ---------------------------------------------------------------------------
+# model IO
+# ---------------------------------------------------------------------------
+class TestModelIO:
+    def test_glm_roundtrip_synthetic_names(self, tmp_path):
+        w = jnp.asarray(np.array([0.5, -1.5, 0.0, 2.0], np.float32))
+        var = jnp.asarray(np.array([0.1, 0.2, 0.3, 0.4], np.float32))
+        m = GeneralizedLinearModel(Coefficients(w, var), TaskType.LINEAR_REGRESSION)
+        path = str(tmp_path / "m.avro")
+        save_glm(m, path)
+        m2 = load_glm(path, num_features=4)
+        np.testing.assert_allclose(np.asarray(m2.coefficients.means), np.asarray(w))
+        assert m2.task_type is TaskType.LINEAR_REGRESSION
+        # zero coefficient: variance record also filtered with it (sparsity)
+        assert np.asarray(m2.coefficients.variances)[0] == pytest.approx(0.1)
+
+    def test_glm_roundtrip_with_index_map(self, tmp_path):
+        imap = IndexMap.build(
+            [feature_key("age"), feature_key("country", "us")], add_intercept=True
+        )
+        w = jnp.asarray(np.array([1.0, 2.0, 3.0], np.float32))
+        m = GeneralizedLinearModel(Coefficients(w), TaskType.LOGISTIC_REGRESSION)
+        path = str(tmp_path / "m.avro")
+        save_glm(m, path, index_map=imap)
+        m2 = load_glm(path, index_map=imap)
+        np.testing.assert_allclose(np.asarray(m2.coefficients.means), np.asarray(w))
+        # raw record uses real names
+        _, recs = read_avro_file(path)
+        names = {r["name"] for r in recs[0]["means"]}
+        assert "age" in names and "country" in names
+
+    def test_load_into_grown_feature_space(self, tmp_path):
+        """Warm start onto data with NEW features: the loader must size
+        coefficients from the new index map and re-resolve shared features
+        by name-term key (positions may shift)."""
+        old_map = IndexMap.build(["a", "b"], add_intercept=True)
+        w = jnp.asarray(np.array([1.0, 2.0, 3.0], np.float32))
+        m = GeneralizedLinearModel(Coefficients(w), TaskType.LOGISTIC_REGRESSION)
+        path = str(tmp_path / "m.avro")
+        save_glm(m, path, index_map=old_map)
+
+        new_map = IndexMap.build(["zzz", "b", "a", "extra"], add_intercept=True)
+        m2 = load_glm(path, index_map=new_map)
+        assert m2.coefficients.dim == new_map.size == 5
+        out = np.asarray(m2.coefficients.means)
+        assert out[new_map.get("a")] == pytest.approx(1.0)
+        assert out[new_map.get("b")] == pytest.approx(2.0)
+        assert out[new_map.intercept_index] == pytest.approx(3.0)
+        assert out[new_map.get("zzz")] == 0.0
+
+    def test_sparsity_threshold(self, tmp_path):
+        w = jnp.asarray(np.array([1e-9, 5.0], np.float32))
+        m = GeneralizedLinearModel(Coefficients(w), TaskType.LOGISTIC_REGRESSION)
+        path = str(tmp_path / "m.avro")
+        save_glm(m, path, sparsity_threshold=1e-6)
+        _, recs = read_avro_file(path)
+        assert len(recs[0]["means"]) == 1
+
+    def test_game_model_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(0)
+        fixed = FixedEffectModel(
+            model=GeneralizedLinearModel(
+                Coefficients(jnp.asarray(rng.normal(size=4).astype(np.float32)))
+            ),
+            feature_shard_id="global",
+        )
+        W = rng.normal(size=(7, 3)).astype(np.float32)
+        re = RandomEffectModel(
+            coefficients=jnp.asarray(W),
+            variances=None,
+            random_effect_type="userId",
+            feature_shard_id="per_user",
+            task_type=TaskType.LOGISTIC_REGRESSION,
+        )
+        model = GameModel(
+            models={"fixed": fixed, "per_user": re},
+            task_type=TaskType.LOGISTIC_REGRESSION,
+        )
+        d = str(tmp_path / "game_model")
+        names = [f"user_{i}" for i in range(7)]
+        save_game_model(model, d, entity_names={"per_user": names})
+        loaded = load_game_model(
+            d, entity_ids={"per_user": {n: i for i, n in enumerate(names)}}
+        )
+        assert set(loaded.models) == {"fixed", "per_user"}
+        np.testing.assert_allclose(
+            np.asarray(loaded["per_user"].coefficients), W, rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(loaded["fixed"].model.coefficients.means),
+            np.asarray(fixed.model.coefficients.means),
+            rtol=1e-6,
+        )
+
+
+# ---------------------------------------------------------------------------
+# data reader
+# ---------------------------------------------------------------------------
+def _write_training_data(path, n=40, seed=0):
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        recs.append(
+            {
+                "uid": f"s{i}",
+                "response": float(rng.integers(0, 2)),
+                "offset": None,
+                "weight": 2.0 if i == 0 else None,
+                "features": [
+                    {"name": "x", "term": "a", "value": float(rng.normal())},
+                    {"name": "x", "term": "b", "value": float(rng.normal())},
+                ],
+                "metadataMap": {"userId": f"user_{i % 4}"},
+            }
+        )
+    write_avro_file(path, TRAINING_EXAMPLE_SCHEMA, recs)
+    return recs
+
+
+class TestAvroDataReader:
+    def test_read_builds_batch_and_maps(self, tmp_path):
+        path = str(tmp_path / "train.avro")
+        recs = _write_training_data(path)
+        reader = AvroDataReader(
+            {"global": FeatureShardConfig(feature_bags=("features",), has_intercept=True)}
+        )
+        ds = reader.read(path, id_tags=("userId",))
+        assert ds.batch.num_rows == 40
+        # 2 features + intercept
+        assert ds.index_maps["global"].size == 3
+        ii = ds.intercept_indices["global"]
+        X = np.asarray(ds.batch.features["global"].X)
+        np.testing.assert_allclose(X[:, ii], 1.0)
+        assert ds.batch.id_tags["userId"].max() == 3
+        assert len(ds.entity_maps["userId"]) == 4
+        np.testing.assert_allclose(np.asarray(ds.batch.weights)[0], 2.0)
+        assert ds.uids[0] == "s0"
+
+    def test_read_validation_with_frozen_maps(self, tmp_path):
+        train_path = str(tmp_path / "train.avro")
+        _write_training_data(train_path, seed=0)
+        reader = AvroDataReader()
+        ds = reader.read(train_path, id_tags=("userId",))
+
+        # validation data with an unseen user and unseen feature
+        recs = [
+            {
+                "uid": None,
+                "response": 1.0,
+                "offset": None,
+                "weight": None,
+                "features": [
+                    {"name": "x", "term": "a", "value": 1.0},
+                    {"name": "zzz", "term": "", "value": 9.0},  # unseen: dropped
+                ],
+                "metadataMap": {"userId": "user_999"},  # unseen: -1
+            }
+        ]
+        val_path = str(tmp_path / "val.avro")
+        write_avro_file(val_path, TRAINING_EXAMPLE_SCHEMA, recs)
+        vds = reader.read(
+            val_path,
+            id_tags=("userId",),
+            index_maps=ds.index_maps,
+            entity_maps=ds.entity_maps,
+        )
+        assert vds.index_maps["global"].size == 3
+        assert vds.batch.id_tags["userId"][0] == -1
+        X = np.asarray(vds.batch.features["global"].X)
+        assert X[0].sum() == pytest.approx(2.0)  # x,a=1 + intercept=1
+
+    def test_scoring_results_roundtrip(self, tmp_path):
+        path = str(tmp_path / "scores.avro")
+        write_scoring_results(
+            path, np.array([0.25, 0.75]), uids=["a", "b"], labels=np.array([0.0, 1.0])
+        )
+        _, recs = read_avro_file(path)
+        assert recs[0]["predictionScore"] == pytest.approx(0.25)
+        assert recs[1]["uid"] == "b"
+        assert recs[1]["label"] == pytest.approx(1.0)
