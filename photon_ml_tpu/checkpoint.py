@@ -1,0 +1,114 @@
+"""Iteration-level checkpointing of coordinate-descent training.
+
+Reference parity-plus: the reference has NO optimizer-state checkpointing —
+only model warm start from a saved directory (SURVEY.md §5.4, which notes
+the TPU build "should exceed the reference here"). This module checkpoints
+the full GAME model plus descent progress after every outer iteration, so a
+preempted job resumes mid-descent instead of restarting (TPU preemption is
+routine; Spark lineage recovery has no analog here).
+
+Format: one ``.npz`` per checkpoint holding every coordinate's arrays +
+a JSON sidecar with progress (outer iteration, task type, coordinate
+metadata). Writes are atomic (tmp + rename), keeping the last checkpoint
+valid under preemption mid-write.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.models import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.types import TaskType
+
+
+@dataclass(frozen=True)
+class DescentCheckpoint:
+    """A resumable descent state: the model + the NEXT outer iteration."""
+
+    model: GameModel
+    next_iteration: int
+
+
+def save_checkpoint(directory: str, model: GameModel, next_iteration: int) -> None:
+    os.makedirs(directory, exist_ok=True)
+    arrays: dict[str, np.ndarray] = {}
+    meta: dict = {
+        "task_type": model.task_type.value,
+        "next_iteration": next_iteration,
+        "coordinates": {},
+    }
+    for cid, sub in model.models.items():
+        if isinstance(sub, FixedEffectModel):
+            arrays[f"{cid}__means"] = np.asarray(sub.model.coefficients.means)
+            if sub.model.coefficients.variances is not None:
+                arrays[f"{cid}__variances"] = np.asarray(
+                    sub.model.coefficients.variances
+                )
+            meta["coordinates"][cid] = {
+                "type": "fixed",
+                "feature_shard_id": sub.feature_shard_id,
+            }
+        elif isinstance(sub, RandomEffectModel):
+            arrays[f"{cid}__means"] = np.asarray(sub.coefficients)
+            if sub.variances is not None:
+                arrays[f"{cid}__variances"] = np.asarray(sub.variances)
+            meta["coordinates"][cid] = {
+                "type": "random",
+                "feature_shard_id": sub.feature_shard_id,
+                "random_effect_type": sub.random_effect_type,
+            }
+        else:  # pragma: no cover
+            raise TypeError(f"unknown sub-model {type(sub)}")
+
+    tmp_npz = os.path.join(directory, ".ckpt.npz.tmp")
+    np.savez(tmp_npz, **arrays)
+    os.replace(tmp_npz, os.path.join(directory, "ckpt.npz"))
+    tmp_meta = os.path.join(directory, ".ckpt.json.tmp")
+    with open(tmp_meta, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp_meta, os.path.join(directory, "ckpt.json"))
+
+
+def load_checkpoint(directory: str) -> DescentCheckpoint | None:
+    """The latest checkpoint in ``directory``, or None if there isn't one."""
+    meta_path = os.path.join(directory, "ckpt.json")
+    npz_path = os.path.join(directory, "ckpt.npz")
+    if not (os.path.exists(meta_path) and os.path.exists(npz_path)):
+        return None
+    with open(meta_path) as f:
+        meta = json.load(f)
+    z = np.load(npz_path)
+    task = TaskType(meta["task_type"])
+    models: dict = {}
+    for cid, info in meta["coordinates"].items():
+        means = jnp.asarray(z[f"{cid}__means"])
+        variances = (
+            jnp.asarray(z[f"{cid}__variances"]) if f"{cid}__variances" in z else None
+        )
+        if info["type"] == "fixed":
+            models[cid] = FixedEffectModel(
+                model=GeneralizedLinearModel(Coefficients(means, variances), task),
+                feature_shard_id=info["feature_shard_id"],
+            )
+        else:
+            models[cid] = RandomEffectModel(
+                coefficients=means,
+                variances=variances,
+                random_effect_type=info["random_effect_type"],
+                feature_shard_id=info["feature_shard_id"],
+                task_type=task,
+            )
+    return DescentCheckpoint(
+        model=GameModel(models=models, task_type=task),
+        next_iteration=int(meta["next_iteration"]),
+    )

@@ -114,6 +114,7 @@ def run(
             train.batch,
             None if val is None else val.batch,
             initial_model=initial_model,
+            checkpoint_dir=os.path.join(output_dir, "checkpoints"),
         )
 
     if config.hyperparameter_tuning_iters > 0:

@@ -146,6 +146,10 @@ class RandomEffectCoordinateConfig(_JsonMixin):
     optimization: OptimizationConfig = field(default_factory=OptimizationConfig)
     active_data_upper_bound: int | None = None
     features_to_samples_ratio_upper_bound: float | None = None
+    # Shared random projection (reference: ``RandomProjection`` /
+    # ``ProjectionMatrix``): project this coordinate's features to the given
+    # dimension before the per-entity solves. None = off.
+    random_projection_dim: int | None = None
     # TPU-specific: bucket geometry for the batched per-entity solver.
     # Entities are grouped into buckets of padded sample count; None = auto.
     sample_bucket_sizes: tuple[int, ...] | None = None

@@ -178,6 +178,15 @@ class GameEstimator:
             coord_cfg = self.config.coordinate_config(cid)
             if isinstance(coord_cfg, RandomEffectCoordinateConfig):
                 grouping, buckets, num_entities = entity_layouts[cid]
+                projector = None
+                if coord_cfg.random_projection_dim is not None:
+                    from photon_ml_tpu.game.projector import RandomProjector
+
+                    projector = RandomProjector.build(
+                        batch.features[coord_cfg.feature_shard_id].num_features,
+                        coord_cfg.random_projection_dim,
+                        seed=self.seed,
+                    )
                 coordinates[cid] = RandomEffectCoordinate(
                     coordinate_id=cid,
                     batch=batch,
@@ -191,6 +200,8 @@ class GameEstimator:
                     intercept_index=self.intercept_indices.get(coord_cfg.feature_shard_id),
                     variance_computation=self.config.variance_computation,
                     mesh=self.mesh,
+                    features_to_samples_ratio=coord_cfg.features_to_samples_ratio_upper_bound,
+                    projector=projector,
                 )
             else:
                 train_rows = None
@@ -230,6 +241,7 @@ class GameEstimator:
         validation_batch: GameBatch | None = None,
         configurations: Sequence[GameOptimizationConfiguration] | None = None,
         initial_model: GameModel | None = None,
+        checkpoint_dir: str | None = None,
     ) -> list[GameResult]:
         """Train one GAME model per grid configuration.
 
@@ -270,6 +282,11 @@ class GameEstimator:
                 cfg.coordinate_update_sequence,
                 cfg.coordinate_descent_iterations,
                 initial_model=initial_model,
+                checkpoint_dir=(
+                    None
+                    if checkpoint_dir is None
+                    else f"{checkpoint_dir}/config-{i:04d}"
+                ),
             )
             evaluation = None
             if validation_batch is not None:

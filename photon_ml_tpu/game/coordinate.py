@@ -31,6 +31,7 @@ from photon_ml_tpu.game.random_effect import (
     train_prepared,
 )
 from photon_ml_tpu.game.models import FixedEffectModel, GameSubModel, RandomEffectModel
+from photon_ml_tpu.game.projector import RandomProjector
 from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_ml_tpu.normalization import (
     NormalizationContext,
@@ -184,6 +185,21 @@ class RandomEffectCoordinate:
     variance_computation: VarianceComputationType = VarianceComputationType.NONE
     mesh: Mesh | None = None
     axis_name: str = "data"
+    # per-entity subspace projection (numFeaturesToSamplesRatioUpperBound)
+    features_to_samples_ratio: float | None = None
+    # shared random projection (ProjectionMatrix); trained coefficients are
+    # mapped back to the original space, so the model/scores are unchanged
+    projector: "RandomProjector | None" = None
+
+    def _features(self):
+        feats = self.batch.features[self.feature_shard_id]
+        if self.projector is not None:
+            from photon_ml_tpu.game.data import DenseFeatures
+
+            if not isinstance(feats, DenseFeatures):
+                raise ValueError("random projection requires dense features")
+            return DenseFeatures(X=self.projector.project_features(feats.X))
+        return feats
 
     @property
     def _prepared(self):
@@ -192,12 +208,14 @@ class RandomEffectCoordinate:
         cached = self.__dict__.get("_prepared_cache")
         if cached is None:
             cached = prepare_buckets(
-                self.batch.features[self.feature_shard_id],
+                self._features(),
                 np.asarray(self.batch.labels),
                 np.asarray(self.batch.weights),
                 self.buckets,
                 self.mesh,
                 self.axis_name,
+                features_to_samples_ratio=self.features_to_samples_ratio,
+                intercept_index=None if self.projector is not None else self.intercept_index,
             )
             object.__setattr__(self, "_prepared_cache", cached)
         return cached
@@ -216,24 +234,35 @@ class RandomEffectCoordinate:
                 raise ValueError(
                     f"warm-start entity count {W0.shape[0]} != {self.num_entities}"
                 )
+            if self.projector is not None:
+                # approximate: P has no exact inverse; P is near-orthogonal
+                # (JL), so projecting the original-space warm start is the
+                # standard choice
+                W0 = W0 @ self.projector.matrix
         result = train_prepared(
             self._prepared,
             jnp.asarray(offsets),
-            self.batch.features[self.feature_shard_id].num_features,
+            self._features().num_features,
             self.num_entities,
             loss,
             opt.optimizer,
             l2_weight=l2,
             l1_weight=l1,
-            intercept_index=self.intercept_index,
+            intercept_index=None if self.projector is not None else self.intercept_index,
             initial_coefficients=W0,
             variance_computation=self.variance_computation,
             mesh=self.mesh,
             axis_name=self.axis_name,
         )
+        coefficients = result.coefficients
+        variances = result.variances
+        if self.projector is not None:
+            # back to original space, score-exactly: (XP)w_p = X(P w_p)
+            coefficients = self.projector.coefficients_to_original(coefficients)
+            variances = None  # diagonal variances don't survive a dense map
         model = RandomEffectModel(
-            coefficients=result.coefficients,
-            variances=result.variances,
+            coefficients=coefficients,
+            variances=variances,
             random_effect_type=self.random_effect_type,
             feature_shard_id=self.feature_shard_id,
             task_type=self.task_type,

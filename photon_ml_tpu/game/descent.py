@@ -77,12 +77,30 @@ class CoordinateDescent:
         update_sequence: Sequence[str],
         num_iterations: int,
         initial_model: GameModel | None = None,
+        checkpoint_dir: str | None = None,
     ) -> CoordinateDescentResult:
+        """``checkpoint_dir`` enables resumable descent: the model is
+        checkpointed after every outer iteration, and an existing checkpoint
+        in the directory restarts from where it left off (exceeds the
+        reference, which only supports whole-model warm start —
+        SURVEY.md §5.4)."""
         for cid in update_sequence:
             if cid not in self.coordinates:
                 raise KeyError(f"update sequence names unknown coordinate {cid!r}")
 
+        start_iteration = 0
         model = initial_model or GameModel(models={}, task_type=self.task_type)
+        if checkpoint_dir is not None:
+            from photon_ml_tpu.checkpoint import load_checkpoint
+
+            ckpt = load_checkpoint(checkpoint_dir)
+            if ckpt is not None:
+                model = ckpt.model
+                start_iteration = ckpt.next_iteration
+                self._log(
+                    f"resuming coordinate descent from checkpoint at outer "
+                    f"iteration {start_iteration}"
+                )
         n = self.batch.num_rows
         zeros = jnp.zeros((n,), self.batch.labels.dtype)
         # warm-start scores for every coordinate already in the model
@@ -102,7 +120,7 @@ class CoordinateDescent:
         for s in scores.values():
             total = total + s
 
-        for it in range(num_iterations):
+        for it in range(start_iteration, num_iterations):
             iter_validation: dict[str, EvaluationResults] = {}
             for cid in update_sequence:
                 coord = self.coordinates[cid]
@@ -128,6 +146,10 @@ class CoordinateDescent:
                 else:
                     self._log(f"iter {it} coordinate {cid}: trained")
             validation_history.append(iter_validation)
+            if checkpoint_dir is not None:
+                from photon_ml_tpu.checkpoint import save_checkpoint
+
+                save_checkpoint(checkpoint_dir, model, next_iteration=it + 1)
 
         return CoordinateDescentResult(
             model=model,

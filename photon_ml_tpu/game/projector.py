@@ -1,0 +1,76 @@
+"""Feature projectors for random-effect coordinates.
+
+Reference parity: ``photon-api::ml.projector.*`` (SURVEY.md §2.2) —
+``IndexMapProjection`` (per-entity: drop features the entity never saw,
+train in its own subspace, map coefficients back) and ``RandomProjection``
+(``ProjectionMatrix``/``ProjectionMatrixBroadcast``: one shared Gaussian
+matrix per coordinate).
+
+TPU-native redesign:
+- **Per-entity subspace** (the index-map projection): instead of per-entity
+  ragged column sets, each bucket gets a fixed-width column map
+  ``columns (k, p)`` holding every entity's top-``p`` most-frequent feature
+  columns; bucket features are gathered to ``(k, C, p)``, solved at width
+  ``p``, and coefficients scattered back into the dense ``(E, d)`` matrix.
+  ``p`` is derived from the reference's ``numFeaturesToSamplesRatioUpperBound``
+  knob: p = min(d, ceil(ratio · C)) per bucket. One gather at prepare time,
+  zero ragged shapes, and the MXU sees (C, p) instead of (C, d) matmuls.
+- **Random projection**: one ``(d, p)`` Gaussian matrix per coordinate,
+  applied to the shard features ONCE at prepare time (a single MXU matmul);
+  trained coefficients map back exactly via ``w = P @ w_p`` (scores are
+  identical: (XP)·w_p = X·(P w_p)), so the stored model stays in the
+  original feature space and scoring is unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+Array = jnp.ndarray
+
+
+def entity_top_columns(
+    X: np.ndarray,  # (k, C, d) bucket features (zero-padded slots)
+    p: int,
+    always_include: int | None = None,
+) -> np.ndarray:
+    """Each entity's ``p`` most-frequent (by nonzero count, ties → lower
+    index) feature columns, sorted ascending. ``always_include`` (the
+    intercept) is forced into every entity's set."""
+    counts = (X != 0).sum(axis=1).astype(np.int64)  # (k, d)
+    if always_include is not None:
+        counts[:, always_include] = np.iinfo(np.int64).max
+    # stable top-p: sort by (-count, index)
+    order = np.argsort(-counts, axis=1, kind="stable")[:, :p]  # (k, p)
+    return np.sort(order, axis=1)
+
+
+@dataclass(frozen=True)
+class RandomProjector:
+    """Shared Gaussian projection for one coordinate (parity:
+    ``ProjectionMatrix`` + ``ProjectionMatrixBroadcast`` — here the matrix
+    is just a device array; pjit replicates it, no broadcast step)."""
+
+    matrix: Array  # (d, p), entries ~ N(0, 1/p)
+
+    @classmethod
+    def build(cls, num_features: int, projected_dim: int, seed: int = 0) -> "RandomProjector":
+        rng = np.random.default_rng(seed)
+        P = rng.normal(scale=1.0 / np.sqrt(projected_dim),
+                       size=(num_features, projected_dim)).astype(np.float32)
+        return cls(matrix=jnp.asarray(P))
+
+    @property
+    def projected_dim(self) -> int:
+        return self.matrix.shape[1]
+
+    def project_features(self, X: Array) -> Array:
+        """(…, d) → (…, p): one MXU matmul."""
+        return X @ self.matrix
+
+    def coefficients_to_original(self, w_projected: Array) -> Array:
+        """(…, p) → (…, d), exactly score-preserving: (XP)w_p = X(Pw_p)."""
+        return w_projected @ self.matrix.T

@@ -35,7 +35,7 @@ from photon_ml_tpu.game.data import (
     DenseFeatures,
     gather_bucket,
 )
-from photon_ml_tpu.ops.batch import Batch
+from photon_ml_tpu.ops.batch import Batch, DenseBatch
 from photon_ml_tpu.ops.glm import make_objective
 from photon_ml_tpu.ops.losses import PointwiseLoss
 from photon_ml_tpu.optim.common import select_minimize_fn
@@ -70,13 +70,19 @@ class PreparedBucket:
     """One bucket's device-resident static tensors, built ONCE at coordinate
     construction. Coordinate descent changes only the offsets, so ``train``
     gathers fresh offsets on device and re-enters the compiled solver — no
-    host round-trip of features/labels/weights per iteration."""
+    host round-trip of features/labels/weights per iteration.
+
+    ``columns`` (set when per-entity subspace projection is active) holds
+    each entity's selected feature columns (k_pad, p); the static features
+    are already gathered to that width, and solutions scatter back through
+    it into the full (E, d) matrix."""
 
     entity_ids: np.ndarray  # (k,) original entity ids (host)
     static: Batch  # (k_pad, C, …) features/labels/weights; offsets zero
     row_idx: Array  # (k_pad, C) int32 device, clipped to >= 0
     mask: Array  # (k_pad, C) 1.0 where the slot holds a real sample
     num_real: int  # k (before device-count padding)
+    columns: Array | None = None  # (k_pad, p) int32 per-entity column map
 
 
 def prepare_buckets(
@@ -86,9 +92,20 @@ def prepare_buckets(
     buckets: EntityBuckets,
     mesh: Mesh | None = None,
     axis_name: str = "data",
+    features_to_samples_ratio: float | None = None,
+    intercept_index: int | None = None,
 ) -> list[PreparedBucket]:
     """Gather every bucket's static tensors to device (padding the entity
-    lane to divide the mesh axis, and sharding over it when given)."""
+    lane to divide the mesh axis, and sharding over it when given).
+
+    ``features_to_samples_ratio`` activates per-entity subspace projection
+    (parity: ``numFeaturesToSamplesRatioUpperBound`` + ``IndexMapProjection``,
+    SURVEY.md §2.2): each bucket solves at width
+    p = min(d, ceil(ratio · capacity)) over each entity's most-frequent
+    columns. Dense features only (sparse rows are already width-bounded).
+    """
+    from photon_ml_tpu.game.projector import entity_top_columns
+
     n_dev = mesh.shape[axis_name] if mesh is not None else 1
     zeros_off = np.zeros_like(np.asarray(labels))
     prepared: list[PreparedBucket] = []
@@ -97,6 +114,33 @@ def prepare_buckets(
         static = gather_bucket(features, labels, zeros_off, weights, row_idx)
         idx = jnp.asarray(np.maximum(row_idx, 0), jnp.int32)
         mask = jnp.asarray((row_idx >= 0).astype(np.float32))
+        columns = None
+        if (
+            features_to_samples_ratio is not None
+            and isinstance(static, DenseBatch)
+        ):
+            d = static.X.shape[-1]
+            capacity = static.X.shape[1]
+            p = min(d, max(1, int(np.ceil(features_to_samples_ratio * capacity))))
+            if p < d:
+                if intercept_index is not None and intercept_index != d - 1:
+                    raise ValueError(
+                        "subspace projection requires the intercept at the "
+                        "last column (framework convention)"
+                    )
+                cols = entity_top_columns(
+                    np.asarray(static.X), p, always_include=intercept_index
+                )  # (k, p) sorted ascending → intercept (=d-1) lands at p-1
+                Xp = np.take_along_axis(
+                    np.asarray(static.X), cols[:, None, :], axis=2
+                )  # (k, C, p)
+                static = DenseBatch(
+                    X=jnp.asarray(Xp),
+                    labels=static.labels,
+                    offsets=static.offsets,
+                    weights=static.weights,
+                )
+                columns = jnp.asarray(cols, jnp.int32)
         if n_dev > 1:
             k_pad = _pad_rows(k, n_dev)
             if k_pad != k:
@@ -106,13 +150,21 @@ def prepare_buckets(
                 )
                 static = jax.tree.map(pad0, static)
                 idx, mask = pad0(idx), pad0(mask)
+            if columns is not None and columns.shape[0] != static.labels.shape[0]:
+                pad = static.labels.shape[0] - columns.shape[0]
+                columns = jnp.concatenate(
+                    [columns, jnp.zeros((pad, columns.shape[1]), columns.dtype)]
+                )
             sharding = NamedSharding(mesh, P(axis_name))
             static = jax.tree.map(lambda a: jax.device_put(a, sharding), static)
             idx = jax.device_put(idx, sharding)
             mask = jax.device_put(mask, sharding)
+            if columns is not None:
+                columns = jax.device_put(columns, sharding)
         prepared.append(
             PreparedBucket(
-                entity_ids=ent_ids, static=static, row_idx=idx, mask=mask, num_real=k
+                entity_ids=ent_ids, static=static, row_idx=idx, mask=mask,
+                num_real=k, columns=columns,
             )
         )
     return prepared
@@ -235,6 +287,14 @@ def train_prepared(
             w0 = jnp.concatenate(
                 [w0, jnp.zeros((pb.static.labels.shape[0] - k, d), w0.dtype)]
             )
+        solve_intercept = intercept_index
+        if pb.columns is not None:
+            # subspace projection: solve at width p over each entity's own
+            # columns; the intercept (always the last full-space column by
+            # framework convention) lands at slot p-1
+            w0 = jnp.take_along_axis(w0, pb.columns, axis=1)
+            if intercept_index is not None:
+                solve_intercept = pb.columns.shape[1] - 1
         if sharding is not None:
             w0 = jax.device_put(w0, sharding)
 
@@ -245,14 +305,26 @@ def train_prepared(
             minimize_fn=minimize_fn,
             loss=loss,
             config=config,
-            intercept_index=intercept_index,
+            intercept_index=solve_intercept,
             compute_variance=compute_variance,
             **extra,
         )
         ids = jnp.asarray(pb.entity_ids)
-        W = W.at[ids].set(w_b[:k])
-        if compute_variance:
-            V = V.at[ids].set(1.0 / jnp.maximum(var_b[:k], 1e-12))
+        if pb.columns is not None:
+            cols = pb.columns[:k]
+            # coefficients outside an entity's subspace are 0 (reference:
+            # projected training never touches them)
+            W = W.at[ids].set(0.0)
+            W = W.at[ids[:, None], cols].set(w_b[:k])
+            if compute_variance:
+                V = V.at[ids].set(0.0)
+                V = V.at[ids[:, None], cols].set(
+                    1.0 / jnp.maximum(var_b[:k], 1e-12)
+                )
+        else:
+            W = W.at[ids].set(w_b[:k])
+            if compute_variance:
+                V = V.at[ids].set(1.0 / jnp.maximum(var_b[:k], 1e-12))
         loss_values[pb.entity_ids] = np.asarray(f_b[:k], np.float64)
         iterations[pb.entity_ids] = np.asarray(it_b[:k])
         converged[pb.entity_ids] = np.asarray(reason_b[:k]) != 0  # != MAX_ITERATIONS

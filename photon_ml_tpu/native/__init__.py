@@ -1,0 +1,12 @@
+"""Native (C++) runtime components.
+
+Reference parity: the reference's only native-adjacent dependency surface is
+PalDB's off-heap memory-mapped stores (SURVEY.md §2.3/§2.6). This package
+provides the TPU-host equivalent: a C++ mmap hash store for feature-index
+maps (``index_store.cc``), compiled on demand with the system toolchain and
+bound via ctypes. Import degrades gracefully — callers fall back to the
+pure-numpy ``IndexMap`` when no compiler is available.
+"""
+
+from photon_ml_tpu.native.build import load_library, native_available  # noqa: F401
+from photon_ml_tpu.native.index_store import NativeIndexStore  # noqa: F401

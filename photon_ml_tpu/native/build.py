@@ -1,0 +1,83 @@
+"""On-demand compilation + ctypes loading of the native library.
+
+The shared object is built once per machine into a cache directory (keyed
+by a source hash, so source edits rebuild automatically) with the system
+C++ toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+_SOURCE = os.path.join(os.path.dirname(__file__), "index_store.cc")
+_LIB = None
+_TRIED = False
+
+
+def _cache_dir() -> str:
+    root = os.environ.get("PHOTON_ML_TPU_CACHE") or os.path.join(
+        tempfile.gettempdir(), "photon_ml_tpu_native"
+    )
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def _build() -> str:
+    with open(_SOURCE, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_cache_dir(), f"libphotonidx-{digest}.so")
+    if os.path.exists(out):
+        return out
+    tmp = out + f".build-{os.getpid()}"
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SOURCE, "-o", tmp],
+        check=True,
+        capture_output=True,
+    )
+    os.replace(tmp, out)  # atomic against concurrent builders
+    return out
+
+
+def load_library():
+    """The ctypes library with typed signatures, or None when unavailable."""
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    try:
+        lib = ctypes.CDLL(_build())
+    except (OSError, subprocess.CalledProcessError, FileNotFoundError):
+        _LIB = None
+        return None
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.pidx_build.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, u64p, ctypes.c_uint64, i64p,
+    ]
+    lib.pidx_build.restype = ctypes.c_int
+    lib.pidx_open.argtypes = [ctypes.c_char_p]
+    lib.pidx_open.restype = ctypes.c_void_p
+    lib.pidx_close.argtypes = [ctypes.c_void_p]
+    lib.pidx_size.argtypes = [ctypes.c_void_p]
+    lib.pidx_size.restype = ctypes.c_uint64
+    lib.pidx_num_slots.argtypes = [ctypes.c_void_p]
+    lib.pidx_num_slots.restype = ctypes.c_uint64
+    lib.pidx_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+    lib.pidx_get.restype = ctypes.c_int64
+    lib.pidx_get_many.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, u64p, ctypes.c_uint64, i64p,
+    ]
+    lib.pidx_entry.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64, i64p,
+    ]
+    lib.pidx_entry.restype = ctypes.c_int64
+    _LIB = lib
+    return lib
+
+
+def native_available() -> bool:
+    return load_library() is not None

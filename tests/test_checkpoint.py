@@ -1,0 +1,145 @@
+"""Checkpoint/resume tests: atomic save/load round-trips and mid-descent
+resume equivalence (the interrupted+resumed run must produce the same model
+as an uninterrupted one)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.checkpoint import load_checkpoint, save_checkpoint
+from photon_ml_tpu.config import (
+    OptimizationConfig,
+    OptimizerConfig,
+    RegularizationContext,
+)
+from photon_ml_tpu.data.synthetic import synthetic_game_data
+from photon_ml_tpu.game import (
+    CoordinateDescent,
+    FixedEffectCoordinate,
+    GameModel,
+    RandomEffectCoordinate,
+    bucket_entities,
+    group_by_entity,
+    make_game_batch,
+)
+from photon_ml_tpu.game.models import FixedEffectModel, RandomEffectModel
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.types import RegularizationType, TaskType
+
+OPT = OptimizerConfig(max_iterations=40, tolerance=1e-9)
+
+
+def _cd(rng, n=400):
+    data = synthetic_game_data(rng, n, d_fixed=4, effects={"userId": (10, 3)})
+    batch = make_game_batch(
+        data.y,
+        {"global": data.X, "per_user": data.entity_X["userId"]},
+        id_tags={"userId": data.entity_ids["userId"]},
+    )
+    grouping = group_by_entity(np.asarray(batch.id_tags["userId"]))
+    buckets = bucket_entities(grouping)
+    l2 = RegularizationContext(RegularizationType.L2)
+    coords = {
+        "fixed": FixedEffectCoordinate(
+            coordinate_id="fixed",
+            batch=batch,
+            feature_shard_id="global",
+            config=OptimizationConfig(optimizer=OPT),
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            intercept_index=4,
+        ),
+        "per_user": RandomEffectCoordinate(
+            coordinate_id="per_user",
+            batch=batch,
+            feature_shard_id="per_user",
+            random_effect_type="userId",
+            config=OptimizationConfig(
+                optimizer=OPT, regularization=l2, regularization_weight=1.0
+            ),
+            grouping=grouping,
+            buckets=buckets,
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            num_entities=grouping.num_entities,
+        ),
+    }
+    return CoordinateDescent(coords, batch, TaskType.LOGISTIC_REGRESSION)
+
+
+class TestCheckpointRoundtrip:
+    def test_save_load(self, tmp_path, rng):
+        fixed = FixedEffectModel(
+            model=GeneralizedLinearModel(
+                Coefficients(
+                    jnp.asarray(rng.normal(size=5).astype(np.float32)),
+                    jnp.asarray(np.abs(rng.normal(size=5)).astype(np.float32)),
+                )
+            ),
+            feature_shard_id="global",
+        )
+        re = RandomEffectModel(
+            coefficients=jnp.asarray(rng.normal(size=(6, 3)).astype(np.float32)),
+            variances=None,
+            random_effect_type="userId",
+            feature_shard_id="per_user",
+        )
+        model = GameModel(models={"f": fixed, "r": re}, task_type=TaskType.LOGISTIC_REGRESSION)
+        d = str(tmp_path / "ckpt")
+        save_checkpoint(d, model, next_iteration=3)
+        ckpt = load_checkpoint(d)
+        assert ckpt.next_iteration == 3
+        np.testing.assert_allclose(
+            np.asarray(ckpt.model["f"].model.coefficients.means),
+            np.asarray(fixed.model.coefficients.means),
+        )
+        np.testing.assert_allclose(
+            np.asarray(ckpt.model["f"].model.coefficients.variances),
+            np.asarray(fixed.model.coefficients.variances),
+        )
+        np.testing.assert_allclose(
+            np.asarray(ckpt.model["r"].coefficients), np.asarray(re.coefficients)
+        )
+        assert ckpt.model["r"].random_effect_type == "userId"
+
+    def test_missing_returns_none(self, tmp_path):
+        assert load_checkpoint(str(tmp_path / "nope")) is None
+
+
+class TestDescentResume:
+    def test_resume_matches_uninterrupted(self, tmp_path, rng):
+        seq = ("fixed", "per_user")
+        # uninterrupted 3-iteration run
+        full = _cd(rng).run(seq, 3)
+
+        # run 2 iterations with checkpointing, then "crash" and resume to 3
+        rng2 = np.random.default_rng(42)  # same data as rng fixture
+        ckpt_dir = str(tmp_path / "ck")
+        cd = _cd(rng2)
+        cd.run(seq, 2, checkpoint_dir=ckpt_dir)
+        assert os.path.exists(os.path.join(ckpt_dir, "ckpt.npz"))
+        resumed = _cd(np.random.default_rng(42)).run(seq, 3, checkpoint_dir=ckpt_dir)
+
+        np.testing.assert_allclose(
+            np.asarray(resumed.model["fixed"].model.coefficients.means),
+            np.asarray(full.model["fixed"].model.coefficients.means),
+            rtol=1e-4, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(resumed.model["per_user"].coefficients),
+            np.asarray(full.model["per_user"].coefficients),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_completed_checkpoint_short_circuits(self, tmp_path, rng):
+        ckpt_dir = str(tmp_path / "ck")
+        cd = _cd(rng)
+        first = cd.run(("fixed", "per_user"), 2, checkpoint_dir=ckpt_dir)
+        # a rerun starts at next_iteration=2 == num_iterations: no training
+        rerun = _cd(np.random.default_rng(42)).run(
+            ("fixed", "per_user"), 2, checkpoint_dir=ckpt_dir
+        )
+        np.testing.assert_allclose(
+            np.asarray(rerun.model["fixed"].model.coefficients.means),
+            np.asarray(first.model["fixed"].model.coefficients.means),
+        )

@@ -1,0 +1,68 @@
+"""Native mmap index store tests: build/open/lookup/bulk/iterate, parity
+with the pure-Python IndexMap, and persistence across handles."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.native import NativeIndexStore, native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no C++ toolchain for the native store"
+)
+
+
+@pytest.fixture
+def store_path(tmp_path):
+    return str(tmp_path / "features.pidx")
+
+
+class TestNativeIndexStore:
+    def test_build_get_missing(self, store_path):
+        s = NativeIndexStore.build(store_path, [("alpha", 0), ("beta", 1), ("g\x01us", 2)])
+        assert s.size == 3
+        assert s.get("alpha") == 0
+        assert s.get("g\x01us") == 2
+        assert s.get("nope") == -1
+        assert "beta" in s and "nope" not in s
+
+    def test_bulk_lookup(self, store_path):
+        n = 5000
+        items = [(f"feat_{i}\x01term_{i % 7}", i) for i in range(n)]
+        s = NativeIndexStore.build(store_path, items)
+        keys = [k for k, _ in items] + ["missing_1", "missing_2"]
+        out = s.lookup_all(keys)
+        np.testing.assert_array_equal(out[:n], np.arange(n))
+        np.testing.assert_array_equal(out[n:], [-1, -1])
+
+    def test_persistence_across_handles(self, store_path):
+        NativeIndexStore.build(store_path, [("x", 7)]).close()
+        s2 = NativeIndexStore(store_path)
+        assert s2.get("x") == 7
+
+    def test_items_roundtrip(self, store_path):
+        items = {f"k{i}": i for i in range(100)}
+        s = NativeIndexStore.build(store_path, items.items())
+        assert dict(s.items()) == items
+
+    def test_duplicate_key_rejected(self, store_path):
+        with pytest.raises(ValueError, match="duplicate"):
+            NativeIndexStore.build(store_path, [("a", 0), ("a", 1)])
+
+    def test_parity_with_python_index_map(self, store_path, rng):
+        keys = [f"name_{i}\x01term_{rng.integers(0, 5)}" for i in range(1000)]
+        imap = IndexMap.build(keys, add_intercept=True)
+        s = NativeIndexStore.build(store_path, imap.items())
+        assert s.size == imap.size
+        queries = np.array(keys[::7] + ["zzz_unknown"])
+        np.testing.assert_array_equal(s.lookup_all(queries), imap.lookup_all(queries))
+
+    def test_empty_store(self, store_path):
+        s = NativeIndexStore.build(store_path, [])
+        assert s.size == 0
+        assert s.get("anything") == -1
+
+    def test_unicode_keys(self, store_path):
+        s = NativeIndexStore.build(store_path, [("héllo", 1), ("日本語", 2)])
+        assert s.get("héllo") == 1
+        assert s.get("日本語") == 2

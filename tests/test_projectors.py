@@ -1,0 +1,153 @@
+"""Projector tests: per-entity subspace (index-map projection analog) and
+shared random projection, standalone and through the estimator."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.config import (
+    FixedEffectCoordinateConfig,
+    GameTrainingConfig,
+    OptimizationConfig,
+    OptimizerConfig,
+    RandomEffectCoordinateConfig,
+    RegularizationContext,
+)
+from photon_ml_tpu.data.synthetic import synthetic_game_data
+from photon_ml_tpu.estimators import GameEstimator
+from photon_ml_tpu.game import (
+    bucket_entities,
+    group_by_entity,
+    make_game_batch,
+    train_random_effects,
+)
+from photon_ml_tpu.game.projector import RandomProjector, entity_top_columns
+from photon_ml_tpu.game.random_effect import prepare_buckets, train_prepared
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.types import RegularizationType, TaskType
+
+OPT = OptimizerConfig(max_iterations=60, tolerance=1e-9)
+
+
+class TestEntityTopColumns:
+    def test_selects_most_frequent_sorted(self):
+        X = np.zeros((1, 5, 4))
+        X[0, :, 1] = 1.0  # col 1 in all 5 rows
+        X[0, :2, 3] = 1.0  # col 3 in 2 rows
+        X[0, 0, 0] = 1.0  # col 0 in 1 row
+        cols = entity_top_columns(X, p=2)
+        np.testing.assert_array_equal(cols[0], [1, 3])
+
+    def test_always_include_intercept(self):
+        X = np.ones((1, 4, 5))
+        X[:, :, 4] = 0.0  # intercept col unseen in data values
+        cols = entity_top_columns(X, p=3, always_include=4)
+        assert 4 in cols[0]
+        np.testing.assert_array_equal(cols[0], np.sort(cols[0]))
+
+
+class TestRandomProjector:
+    def test_score_exact_coefficient_back_map(self, rng):
+        """(XP)·w_p must equal X·(P w_p) exactly — the property the model
+        back-map relies on."""
+        proj = RandomProjector.build(20, 6, seed=1)
+        X = jnp.asarray(rng.normal(size=(15, 20)).astype(np.float32))
+        w_p = jnp.asarray(rng.normal(size=6).astype(np.float32))
+        s1 = proj.project_features(X) @ w_p
+        s2 = X @ proj.coefficients_to_original(w_p)
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-4, atol=1e-5)
+
+
+class TestSubspaceTraining:
+    def _problem(self, rng, n=400, E=5, d=12, sparse_cols=3):
+        """Each entity's data only activates ``sparse_cols`` of d columns —
+        the setting index-map projection exploits."""
+        ids = rng.integers(0, E, size=n).astype(np.int32)
+        entity_cols = [rng.choice(d, size=sparse_cols, replace=False) for _ in range(E)]
+        X = np.zeros((n, d), np.float32)
+        W_true = np.zeros((E, d), np.float32)
+        for e in range(E):
+            W_true[e, entity_cols[e]] = rng.normal(size=sparse_cols)
+        for i in range(n):
+            X[i, entity_cols[ids[i]]] = rng.normal(size=sparse_cols)
+        y = (np.sum(W_true[ids] * X, axis=1) + rng.normal(scale=0.05, size=n)).astype(
+            np.float32
+        )
+        return ids, X, y, W_true
+
+    def test_projected_solution_matches_full_width(self, rng):
+        ids, X, y, W_true = self._problem(rng)
+        grouping = group_by_entity(ids)
+        buckets = bucket_entities(grouping)
+        loss = loss_for_task(TaskType.LINEAR_REGRESSION)
+        from photon_ml_tpu.game.data import DenseFeatures
+
+        feats = DenseFeatures(X=jnp.asarray(X))
+        zeros = np.zeros_like(y)
+        ones = np.ones_like(y)
+
+        full = train_random_effects(
+            feats, y, zeros, ones, buckets, grouping.num_entities, loss, OPT,
+            l2_weight=0.1,
+        )
+        prepared = prepare_buckets(
+            feats, y, ones, buckets, features_to_samples_ratio=0.5
+        )
+        # every bucket got projected (d=12 > ratio*C for small buckets)
+        proj = train_prepared(
+            prepared, jnp.asarray(zeros), 12, grouping.num_entities, loss, OPT,
+            l2_weight=0.1,
+        )
+        scores_full = np.sum(np.asarray(full.coefficients)[ids] * X, axis=1)
+        scores_proj = np.sum(np.asarray(proj.coefficients)[ids] * X, axis=1)
+        # the active columns are within each entity's top-k, so the projected
+        # solve sees all the signal the full solve does
+        np.testing.assert_allclose(scores_proj, scores_full, rtol=1e-3, atol=1e-3)
+
+    def test_estimator_with_projection_and_random_projection(self, rng):
+        data = synthetic_game_data(rng, 500, d_fixed=4, effects={"userId": (12, 6)})
+        batch = make_game_batch(
+            data.y,
+            {"global": data.X, "per_user": data.entity_X["userId"]},
+            id_tags={"userId": data.entity_ids["userId"]},
+        )
+        l2 = RegularizationContext(RegularizationType.L2)
+        cfg = GameTrainingConfig(
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            coordinate_update_sequence=("fixed", "per_user"),
+            coordinate_descent_iterations=1,
+            fixed_effect_coordinates={
+                "fixed": FixedEffectCoordinateConfig(
+                    feature_shard_id="global",
+                    optimization=OptimizationConfig(optimizer=OPT),
+                )
+            },
+            random_effect_coordinates={
+                "per_user": RandomEffectCoordinateConfig(
+                    random_effect_type="userId",
+                    feature_shard_id="per_user",
+                    optimization=OptimizationConfig(
+                        optimizer=OPT, regularization=l2, regularization_weight=1.0
+                    ),
+                    features_to_samples_ratio_upper_bound=0.4,
+                )
+            },
+        )
+        est = GameEstimator(cfg, intercept_indices={"global": 4})
+        r = est.fit(batch, batch)[0]
+        assert np.isfinite(r.evaluation.primary)
+        assert r.evaluation.primary > 0.6
+
+        # random projection variant: model stays (E, d_original)
+        cfg2 = cfg.replace(
+            random_effect_coordinates={
+                "per_user": cfg.random_effect_coordinates["per_user"].replace(
+                    features_to_samples_ratio_upper_bound=None,
+                    random_projection_dim=4,
+                )
+            }
+        )
+        est2 = GameEstimator(cfg2, intercept_indices={"global": 4})
+        r2 = est2.fit(batch, batch)[0]
+        assert r2.model["per_user"].coefficients.shape == (12, 6)
+        assert r2.evaluation.primary > 0.6
