@@ -33,18 +33,44 @@ from photon_ml_tpu.types import TaskType
 
 @dataclass(frozen=True)
 class DescentCheckpoint:
-    """A resumable descent state: the model + the NEXT outer iteration."""
+    """A resumable descent state: the model + the NEXT outer iteration.
+
+    ``scores``/``total`` (when present) restore the residual-exchange state
+    bit-exactly: recomputing scores from the model reproduces them only up
+    to float re-association, and the per-entity solvers amplify that
+    epsilon into visible coefficient drift. Storing the accumulated arrays
+    makes an interrupted+resumed run bitwise identical to an uninterrupted
+    one."""
 
     model: GameModel
     next_iteration: int
+    scores: dict[str, np.ndarray] | None = None
+    total: np.ndarray | None = None
 
 
-def save_checkpoint(directory: str, model: GameModel, next_iteration: int) -> None:
+_SCORE_PREFIX = "__score__"
+_TOTAL_KEY = "__total__"
+_META_KEY = "__meta__"
+
+
+def save_checkpoint(
+    directory: str,
+    model: GameModel,
+    next_iteration: int,
+    fingerprint: str | None = None,
+    scores: dict[str, np.ndarray] | None = None,
+    total: np.ndarray | None = None,
+) -> None:
+    """``fingerprint`` identifies the training setup (configuration + data
+    signature); ``load_checkpoint`` refuses checkpoints whose fingerprint
+    differs, so rerunning into the same directory after changing the grid,
+    hyperparameters, or data retrains instead of silently short-circuiting."""
     os.makedirs(directory, exist_ok=True)
     arrays: dict[str, np.ndarray] = {}
     meta: dict = {
         "task_type": model.task_type.value,
         "next_iteration": next_iteration,
+        "fingerprint": fingerprint,
         "coordinates": {},
     }
     for cid, sub in model.models.items():
@@ -70,24 +96,45 @@ def save_checkpoint(directory: str, model: GameModel, next_iteration: int) -> No
         else:  # pragma: no cover
             raise TypeError(f"unknown sub-model {type(sub)}")
 
-    tmp_npz = os.path.join(directory, ".ckpt.npz.tmp")
+    if scores is not None and total is not None:
+        for cid, s in scores.items():
+            arrays[f"{_SCORE_PREFIX}{cid}"] = np.asarray(s)
+        arrays[_TOTAL_KEY] = np.asarray(total)
+        meta["has_scores"] = True
+
+    # The metadata lives INSIDE the npz so the checkpoint is one file and
+    # one atomic rename — a sidecar json renamed separately would leave a
+    # mixed-generation checkpoint if preempted between the two renames.
+    arrays[_META_KEY] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
+
+    # np.savez appends ".npz" to names lacking the suffix, so the tmp name
+    # must already end in it for os.replace to find the written file
+    tmp_npz = os.path.join(directory, ".ckpt.tmp.npz")
     np.savez(tmp_npz, **arrays)
     os.replace(tmp_npz, os.path.join(directory, "ckpt.npz"))
-    tmp_meta = os.path.join(directory, ".ckpt.json.tmp")
-    with open(tmp_meta, "w") as f:
+    # human-readable sidecar, informational only — never read back
+    with open(os.path.join(directory, "ckpt.json"), "w") as f:
         json.dump(meta, f)
-    os.replace(tmp_meta, os.path.join(directory, "ckpt.json"))
 
 
-def load_checkpoint(directory: str) -> DescentCheckpoint | None:
-    """The latest checkpoint in ``directory``, or None if there isn't one."""
-    meta_path = os.path.join(directory, "ckpt.json")
+def load_checkpoint(
+    directory: str, fingerprint: str | None = None
+) -> DescentCheckpoint | None:
+    """The latest checkpoint in ``directory``, or None if there isn't one.
+
+    When ``fingerprint`` is given and the stored checkpoint carries a
+    different one, the checkpoint is ignored (returns None) — it belongs to
+    a different configuration or dataset and resuming from it would return
+    a model trained under the old settings."""
     npz_path = os.path.join(directory, "ckpt.npz")
-    if not (os.path.exists(meta_path) and os.path.exists(npz_path)):
+    if not os.path.exists(npz_path):
         return None
-    with open(meta_path) as f:
-        meta = json.load(f)
     z = np.load(npz_path)
+    if _META_KEY not in z.files:
+        return None  # truncated or foreign npz — not a usable checkpoint
+    meta = json.loads(bytes(z[_META_KEY]).decode())
+    if fingerprint is not None and meta.get("fingerprint") != fingerprint:
+        return None
     task = TaskType(meta["task_type"])
     models: dict = {}
     for cid, info in meta["coordinates"].items():
@@ -108,7 +155,16 @@ def load_checkpoint(directory: str) -> DescentCheckpoint | None:
                 feature_shard_id=info["feature_shard_id"],
                 task_type=task,
             )
+    scores = None
+    total = None
+    if meta.get("has_scores"):
+        scores = {
+            k[len(_SCORE_PREFIX):]: z[k] for k in z.files if k.startswith(_SCORE_PREFIX)
+        }
+        total = z[_TOTAL_KEY]
     return DescentCheckpoint(
         model=GameModel(models=models, task_type=task),
         next_iteration=int(meta["next_iteration"]),
+        scores=scores,
+        total=total,
     )

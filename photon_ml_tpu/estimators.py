@@ -18,6 +18,7 @@ TPU-first notes:
 
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass
 from typing import Callable, Mapping, Sequence
 
@@ -104,6 +105,82 @@ def build_configuration_grid(
         else:
             axes.append([base])
     return [dict(zip(cids, combo)) for combo in itertools.product(*axes)]
+
+
+# GameTrainingConfig fields that do NOT change the optimization trajectory:
+# excluded from the checkpoint fingerprint so benign reruns (extending the
+# iteration count — the canonical resume-and-extend workflow — changing
+# evaluators, output mode, …) still resume instead of retraining from zero.
+_NON_TRAJECTORY_CONFIG_FIELDS = (
+    "coordinate_descent_iterations",
+    "evaluators",
+    "output_mode",
+    "hyperparameter_tuning_iters",
+    "model_input_dir",  # the warm-start model itself is hashed by value
+)
+
+
+def _fingerprint_base(
+    config: GameTrainingConfig,
+    batch: GameBatch,
+    seed: int,
+    initial_model: GameModel | None,
+) -> dict:
+    """The grid-invariant part of the checkpoint-resume fingerprint: the
+    trajectory-affecting ``GameTrainingConfig`` fields, the estimator seed,
+    a value hash of the warm-start model, and a cheap data signature.
+    Computed once per ``fit``; each grid entry folds in only its own
+    per-coordinate optimization configs. A checkpoint written under any
+    different setup must not be silently resumed."""
+    import hashlib
+
+    warm = None
+    if initial_model is not None:
+        warm = {
+            cid: hashlib.sha256(
+                np.ascontiguousarray(np.asarray(sub.coefficient_means)).tobytes()
+            ).hexdigest()
+            for cid, sub in sorted(initial_model.models.items())
+        }
+    # Cheap value digest of the data: head/tail label samples + moments.
+    # A full-array hash would force an O(n) host transfer of a
+    # device-resident batch; this catches regenerated/changed datasets that
+    # happen to keep the same geometry.
+    labels = np.asarray(batch.labels[:256]), np.asarray(batch.labels[-256:])
+    data_digest = hashlib.sha256(
+        labels[0].tobytes()
+        + labels[1].tobytes()
+        + np.float64(jnp.sum(batch.labels)).tobytes()
+        + np.float64(jnp.sum(batch.weights)).tobytes()
+    ).hexdigest()
+    cfg_dict = config.to_dict()
+    for key in _NON_TRAJECTORY_CONFIG_FIELDS:
+        cfg_dict.pop(key, None)
+    return {
+        "training_config": cfg_dict,
+        "seed": seed,
+        "initial_model": warm,
+        "data": {
+            "num_rows": batch.num_rows,
+            "digest": data_digest,
+            "shards": {
+                sid: feats.num_features for sid, feats in sorted(batch.features.items())
+            },
+        },
+    }
+
+
+def _fit_fingerprint(
+    base: dict, configuration: GameOptimizationConfiguration
+) -> str:
+    import hashlib
+
+    payload = dict(
+        base,
+        configuration={cid: oc.to_dict() for cid, oc in configuration.items()},
+    )
+    blob = json.dumps(payload, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()
 
 
 class GameEstimator:
@@ -263,6 +340,11 @@ class GameEstimator:
         norm_contexts = self._normalization_contexts(batch)
         entity_layouts = self._entity_layouts(batch)
         specs = self._evaluator_specs()
+        fingerprint_base = (
+            None
+            if checkpoint_dir is None
+            else _fingerprint_base(cfg, batch, self.seed, initial_model)
+        )
 
         results: list[GameResult] = []
         for i, configuration in enumerate(configurations):
@@ -286,6 +368,11 @@ class GameEstimator:
                     None
                     if checkpoint_dir is None
                     else f"{checkpoint_dir}/config-{i:04d}"
+                ),
+                checkpoint_fingerprint=(
+                    None
+                    if fingerprint_base is None
+                    else _fit_fingerprint(fingerprint_base, configuration)
                 ),
             )
             evaluation = None

@@ -78,22 +78,26 @@ class CoordinateDescent:
         num_iterations: int,
         initial_model: GameModel | None = None,
         checkpoint_dir: str | None = None,
+        checkpoint_fingerprint: str | None = None,
     ) -> CoordinateDescentResult:
         """``checkpoint_dir`` enables resumable descent: the model is
         checkpointed after every outer iteration, and an existing checkpoint
         in the directory restarts from where it left off (exceeds the
         reference, which only supports whole-model warm start —
-        SURVEY.md §5.4)."""
+        SURVEY.md §5.4). ``checkpoint_fingerprint`` identifies the training
+        setup; a stored checkpoint with a different fingerprint is ignored
+        rather than resumed."""
         for cid in update_sequence:
             if cid not in self.coordinates:
                 raise KeyError(f"update sequence names unknown coordinate {cid!r}")
 
         start_iteration = 0
         model = initial_model or GameModel(models={}, task_type=self.task_type)
+        ckpt = None
         if checkpoint_dir is not None:
             from photon_ml_tpu.checkpoint import load_checkpoint
 
-            ckpt = load_checkpoint(checkpoint_dir)
+            ckpt = load_checkpoint(checkpoint_dir, fingerprint=checkpoint_fingerprint)
             if ckpt is not None:
                 model = ckpt.model
                 start_iteration = ckpt.next_iteration
@@ -101,24 +105,32 @@ class CoordinateDescent:
                     f"resuming coordinate descent from checkpoint at outer "
                     f"iteration {start_iteration}"
                 )
-        n = self.batch.num_rows
-        zeros = jnp.zeros((n,), self.batch.labels.dtype)
-        # warm-start scores for every coordinate already in the model
-        # (including locked ones not in the update sequence)
-        scores: dict[str, Array] = {}
-        for cid, sub in model.models.items():
-            coord = self.coordinates.get(cid)
-            scores[cid] = coord.score(sub) if coord is not None else sub.score(self.batch)
 
         trackers: dict[str, list[Any]] = {cid: [] for cid in update_sequence}
         validation_history: list[dict[str, EvaluationResults]] = []
 
-        # running total of base offsets + every coordinate's score, so the
-        # per-coordinate residual is one subtraction (total − own score), not
-        # an O(K²) re-sum over the other coordinates
-        total = self.batch.offsets
-        for s in scores.values():
-            total = total + s
+        scores: dict[str, Array]
+        if ckpt is not None and ckpt.scores is not None and ckpt.total is not None:
+            # bit-exact resume: restore the residual-exchange state rather
+            # than recomputing it (recomputation differs by float
+            # re-association, which the per-entity solvers amplify)
+            scores = {cid: jnp.asarray(s) for cid, s in ckpt.scores.items()}
+            total = jnp.asarray(ckpt.total)
+        else:
+            # warm-start scores for every coordinate already in the model
+            # (including locked ones not in the update sequence)
+            scores = {}
+            for cid, sub in model.models.items():
+                coord = self.coordinates.get(cid)
+                scores[cid] = (
+                    coord.score(sub) if coord is not None else sub.score(self.batch)
+                )
+            # running total of base offsets + every coordinate's score, so the
+            # per-coordinate residual is one subtraction (total − own score),
+            # not an O(K²) re-sum over the other coordinates
+            total = self.batch.offsets
+            for s in scores.values():
+                total = total + s
 
         for it in range(start_iteration, num_iterations):
             iter_validation: dict[str, EvaluationResults] = {}
@@ -149,7 +161,14 @@ class CoordinateDescent:
             if checkpoint_dir is not None:
                 from photon_ml_tpu.checkpoint import save_checkpoint
 
-                save_checkpoint(checkpoint_dir, model, next_iteration=it + 1)
+                save_checkpoint(
+                    checkpoint_dir,
+                    model,
+                    next_iteration=it + 1,
+                    fingerprint=checkpoint_fingerprint,
+                    scores={cid: np.asarray(s) for cid, s in scores.items()},
+                    total=np.asarray(total),
+                )
 
         return CoordinateDescentResult(
             model=model,

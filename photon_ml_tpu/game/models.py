@@ -43,6 +43,12 @@ class FixedEffectModel:
     model: GeneralizedLinearModel
     feature_shard_id: str
 
+    @property
+    def coefficient_means(self) -> Array:
+        """The sub-model's mean-coefficient array (shared accessor so
+        callers don't dispatch on the concrete sub-model type)."""
+        return self.model.coefficients.means
+
     def score(self, batch: GameBatch) -> Array:
         """Raw contribution w·x per sample (no offsets — coordinate scores
         are pure contributions; offsets are summed by the caller)."""
@@ -71,6 +77,12 @@ class RandomEffectModel:
     @property
     def num_entities(self) -> int:
         return self.coefficients.shape[0]
+
+    @property
+    def coefficient_means(self) -> Array:
+        """The (E, d) mean-coefficient matrix (see
+        ``FixedEffectModel.coefficient_means``)."""
+        return self.coefficients
 
     def score(self, batch: GameBatch) -> Array:
         """w_{e(i)}·x_i per sample. Samples whose entity id is out of range

@@ -105,6 +105,29 @@ class TestCheckpointRoundtrip:
     def test_missing_returns_none(self, tmp_path):
         assert load_checkpoint(str(tmp_path / "nope")) is None
 
+    def test_fingerprint_mismatch_ignored(self, tmp_path, rng):
+        model = GameModel(
+            models={
+                "f": FixedEffectModel(
+                    model=GeneralizedLinearModel(
+                        Coefficients(
+                            jnp.asarray(rng.normal(size=3).astype(np.float32)), None
+                        )
+                    ),
+                    feature_shard_id="global",
+                )
+            },
+            task_type=TaskType.LOGISTIC_REGRESSION,
+        )
+        d = str(tmp_path / "ckpt")
+        save_checkpoint(d, model, next_iteration=1, fingerprint="setup-a")
+        assert load_checkpoint(d, fingerprint="setup-a") is not None
+        # a checkpoint written under a different configuration/data must be
+        # ignored, not silently resumed
+        assert load_checkpoint(d, fingerprint="setup-b") is None
+        # callers that don't fingerprint still load it
+        assert load_checkpoint(d) is not None
+
 
 class TestDescentResume:
     def test_resume_matches_uninterrupted(self, tmp_path, rng):
