@@ -16,6 +16,7 @@ valid under preemption mid-write.
 from __future__ import annotations
 
 import json
+import logging
 import os
 from dataclasses import dataclass
 
@@ -52,6 +53,27 @@ _SCORE_PREFIX = "__score__"
 _TOTAL_KEY = "__total__"
 _META_KEY = "__meta__"
 
+_log = logging.getLogger(__name__)
+
+
+def batch_digest(labels, weights) -> str:
+    """Cheap value digest of a batch (head/tail label samples + moments),
+    used to tie a checkpoint's residual-exchange ``scores``/``total`` to the
+    data they were computed on. Avoids an O(n) host transfer of the
+    device-resident arrays."""
+    import hashlib
+
+    import jax.numpy as jnp
+
+    head = np.asarray(labels[:256])
+    tail = np.asarray(labels[-256:])
+    return hashlib.sha256(
+        head.tobytes()
+        + tail.tobytes()
+        + np.float64(jnp.sum(labels)).tobytes()
+        + np.float64(jnp.sum(weights)).tobytes()
+    ).hexdigest()
+
 
 def save_checkpoint(
     directory: str,
@@ -60,6 +82,7 @@ def save_checkpoint(
     fingerprint: str | None = None,
     scores: dict[str, np.ndarray] | None = None,
     total: np.ndarray | None = None,
+    data_digest: str | None = None,
 ) -> None:
     """``fingerprint`` identifies the training setup (configuration + data
     signature); ``load_checkpoint`` refuses checkpoints whose fingerprint
@@ -71,6 +94,7 @@ def save_checkpoint(
         "task_type": model.task_type.value,
         "next_iteration": next_iteration,
         "fingerprint": fingerprint,
+        "data_digest": data_digest,
         "coordinates": {},
     }
     for cid, sub in model.models.items():
@@ -118,22 +142,35 @@ def save_checkpoint(
 
 
 def load_checkpoint(
-    directory: str, fingerprint: str | None = None
+    directory: str,
+    fingerprint: str | None = None,
+    data_digest: str | None = None,
 ) -> DescentCheckpoint | None:
     """The latest checkpoint in ``directory``, or None if there isn't one.
 
     When ``fingerprint`` is given and the stored checkpoint carries a
-    different one, the checkpoint is ignored (returns None) — it belongs to
-    a different configuration or dataset and resuming from it would return
-    a model trained under the old settings."""
+    different one, the checkpoint is ignored (returns None, with a warning)
+    — it belongs to a different configuration or dataset and resuming from
+    it would return a model trained under the old settings. When
+    ``data_digest`` is given and differs from the stored one, only the
+    residual-exchange ``scores``/``total`` are dropped (they embed the old
+    data's per-sample values); the model itself still resumes."""
     npz_path = os.path.join(directory, "ckpt.npz")
     if not os.path.exists(npz_path):
         return None
     z = np.load(npz_path)
     if _META_KEY not in z.files:
-        return None  # truncated or foreign npz — not a usable checkpoint
+        _log.warning(
+            "ignoring %s: no embedded metadata (truncated or foreign npz); "
+            "training restarts from iteration 0", npz_path,
+        )
+        return None
     meta = json.loads(bytes(z[_META_KEY]).decode())
     if fingerprint is not None and meta.get("fingerprint") != fingerprint:
+        _log.warning(
+            "ignoring %s: fingerprint mismatch (written under a different "
+            "configuration/data); training restarts from iteration 0", npz_path,
+        )
         return None
     task = TaskType(meta["task_type"])
     models: dict = {}
@@ -158,10 +195,20 @@ def load_checkpoint(
     scores = None
     total = None
     if meta.get("has_scores"):
-        scores = {
-            k[len(_SCORE_PREFIX):]: z[k] for k in z.files if k.startswith(_SCORE_PREFIX)
-        }
-        total = z[_TOTAL_KEY]
+        stored_digest = meta.get("data_digest")
+        if data_digest is not None and stored_digest != data_digest:
+            _log.warning(
+                "checkpoint %s was written against different data; dropping "
+                "its residual scores (model still resumes, scores recompute)",
+                npz_path,
+            )
+        else:
+            scores = {
+                k[len(_SCORE_PREFIX):]: z[k]
+                for k in z.files
+                if k.startswith(_SCORE_PREFIX)
+            }
+            total = z[_TOTAL_KEY]
     return DescentCheckpoint(
         model=GameModel(models=models, task_type=task),
         next_iteration=int(meta["next_iteration"]),

@@ -142,17 +142,11 @@ def _fingerprint_base(
             ).hexdigest()
             for cid, sub in sorted(initial_model.models.items())
         }
-    # Cheap value digest of the data: head/tail label samples + moments.
-    # A full-array hash would force an O(n) host transfer of a
-    # device-resident batch; this catches regenerated/changed datasets that
-    # happen to keep the same geometry.
-    labels = np.asarray(batch.labels[:256]), np.asarray(batch.labels[-256:])
-    data_digest = hashlib.sha256(
-        labels[0].tobytes()
-        + labels[1].tobytes()
-        + np.float64(jnp.sum(batch.labels)).tobytes()
-        + np.float64(jnp.sum(batch.weights)).tobytes()
-    ).hexdigest()
+    # Cheap value digest of the data: catches regenerated/changed datasets
+    # that happen to keep the same geometry.
+    from photon_ml_tpu.checkpoint import batch_digest
+
+    data_digest = batch_digest(batch.labels, batch.weights)
     cfg_dict = config.to_dict()
     for key in _NON_TRAJECTORY_CONFIG_FIELDS:
         cfg_dict.pop(key, None)

@@ -94,10 +94,18 @@ class CoordinateDescent:
         start_iteration = 0
         model = initial_model or GameModel(models={}, task_type=self.task_type)
         ckpt = None
+        digest = None
         if checkpoint_dir is not None:
-            from photon_ml_tpu.checkpoint import load_checkpoint
+            from photon_ml_tpu.checkpoint import batch_digest, load_checkpoint
 
-            ckpt = load_checkpoint(checkpoint_dir, fingerprint=checkpoint_fingerprint)
+            # ties restored residual scores to THIS batch: a checkpoint from
+            # different data resumes the model but recomputes the scores
+            digest = batch_digest(self.batch.labels, self.batch.weights)
+            ckpt = load_checkpoint(
+                checkpoint_dir,
+                fingerprint=checkpoint_fingerprint,
+                data_digest=digest,
+            )
             if ckpt is not None:
                 model = ckpt.model
                 start_iteration = ckpt.next_iteration
@@ -168,6 +176,7 @@ class CoordinateDescent:
                     fingerprint=checkpoint_fingerprint,
                     scores={cid: np.asarray(s) for cid, s in scores.items()},
                     total=np.asarray(total),
+                    data_digest=digest,
                 )
 
         return CoordinateDescentResult(

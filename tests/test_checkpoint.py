@@ -128,6 +128,35 @@ class TestCheckpointRoundtrip:
         # callers that don't fingerprint still load it
         assert load_checkpoint(d) is not None
 
+    def test_digest_mismatch_drops_scores_keeps_model(self, tmp_path, rng):
+        model = GameModel(
+            models={
+                "f": FixedEffectModel(
+                    model=GeneralizedLinearModel(
+                        Coefficients(
+                            jnp.asarray(rng.normal(size=3).astype(np.float32)), None
+                        )
+                    ),
+                    feature_shard_id="global",
+                )
+            },
+            task_type=TaskType.LOGISTIC_REGRESSION,
+        )
+        d = str(tmp_path / "ckpt")
+        save_checkpoint(
+            d, model, next_iteration=1,
+            scores={"f": np.ones(5, np.float32)},
+            total=np.ones(5, np.float32),
+            data_digest="data-a",
+        )
+        same = load_checkpoint(d, data_digest="data-a")
+        assert same.scores is not None and same.total is not None
+        # different data: the residual scores embed per-sample values from
+        # the old batch and must not be restored — but the model still is
+        other = load_checkpoint(d, data_digest="data-b")
+        assert other is not None and other.next_iteration == 1
+        assert other.scores is None and other.total is None
+
 
 class TestDescentResume:
     def test_resume_matches_uninterrupted(self, tmp_path, rng):
