@@ -1,74 +1,185 @@
-"""Benchmark: GLM logistic training throughput (samples/sec/chip).
+"""Benchmark harness: honest, quality-checked throughput on BASELINE.md configs A-E.
 
-Measures the framework's hot path — the fused GLM value+gradient kernel
-driven by the device-resident L-BFGS loop — on whatever accelerator JAX
-exposes (the real TPU chip under the driver; CPU elsewhere).
+Protocol (BASELINE.md "speed is never reported without a parity check"):
+- Every timed window ends with FULL host materialization of the result
+  (``float()`` on the loss + ``np.asarray`` on the weights). On this
+  platform ``jax.block_until_ready`` alone under-reports by ~1000x (the
+  round-1 artifact); scalar materialization is the reliable fence.
+- Median of ``REPEATS`` timed solves, compile excluded by a warm-up solve.
+- A roofline sanity check rejects physically impossible numbers: the
+  implied HBM traffic of a measurement (lower-bounded by one feature-matrix
+  read per optimizer iteration) must stay below any TPU's HBM bandwidth.
+- Every config reports a model-quality metric (AUC / RMSE / loss ratio
+  against the data's generating model) next to its throughput.
 
-Baseline: the reference (Photon-ML on Spark) publishes no numbers
-(BASELINE.md). ``vs_baseline`` is therefore computed against a Spark-CPU
-*per-core proxy* measured on this host: the same L-BFGS iteration math
-(BLAS-backed margins/gradients via numpy, double precision like Breeze)
-timed on one CPU core. That mirrors what one Spark executor core does per
-iteration in ``DistributedGLMLossFunction`` (SURVEY.md §2.2), making
-``vs_baseline`` ≈ "how many Spark executor cores one TPU chip replaces" for
-config-A-shaped workloads.
+Throughput metric = optimizer-iteration sample throughput: samples x
+optimizer iterations / wall-clock. Line-search passes do extra FLOPs that
+this metric does NOT credit, so it understates device utilization —
+comparable across rounds and to the reference's per-iteration accounting
+(SURVEY.md §6).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline``: the reference (Photon-ML on Spark) publishes no numbers
+(BASELINE.md), so configs A-C compare against a one-core Spark/Breeze-style
+numpy proxy of the same iteration math measured on this host — i.e. "how
+many Spark executor cores one TPU chip replaces". GAME configs (D/E) have
+no meaningful single-core proxy and report ``vs_baseline: null``.
+
+Output contract: stdout carries EXACTLY ONE JSON line — the headline metric
+{"metric", "value", "unit", "vs_baseline", ...} with per-config results
+embedded under "configs". Per-config progress lines go to stderr, and the
+full detail is also written to BENCH_DETAIL.json next to this file.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
-# The CPU proxy must measure ONE core (it models one Spark executor core).
-# BLAS pools size themselves at first numpy import, so pin before importing.
+# The CPU proxies must measure ONE core (they model one Spark executor
+# core). BLAS pools size themselves at first numpy import, so pin first.
 for _v in ("OMP_NUM_THREADS", "OPENBLAS_NUM_THREADS", "MKL_NUM_THREADS"):
     os.environ.setdefault(_v, "1")
 
 import numpy as np
 
+REPEATS = 3
+# No TPU generation exceeds this HBM bandwidth (v5p ~2.8 TB/s); a
+# measurement implying more is a timing artifact, not a fast solve.
+HBM_ROOFLINE_BYTES_PER_S = 4.0e12
 
-def _cpu_proxy_samples_per_sec(X: np.ndarray, y: np.ndarray, iters: int = 5) -> float:
-    """Per-core Spark/Breeze proxy: numpy BLAS logistic value+grad passes."""
-    Xd = X.astype(np.float64)
-    yd = y.astype(np.float64)
-    w = np.zeros(Xd.shape[1])
-    # warm once (BLAS thread spin-up), then time
+
+def _materialize(result) -> float:
+    """Force completion: pull the loss scalar AND the weights to host."""
+    np.asarray(result.w)
+    return float(result.value)
+
+
+def _timed_solves(solve, bytes_lower_bound_per_run: float):
+    """Median wall-clock of REPEATS fully-materialized solves.
+
+    Returns (median seconds, final loss, last result) — callers reuse the
+    result for quality metrics instead of running an extra untimed solve.
+
+    ``bytes_lower_bound_per_run`` must be a TRUE lower bound on the HBM
+    traffic of one solve — use ONE objective pass, not passes x configured
+    iterations, because optimizers may legitimately stop early. Raises
+    RuntimeError if the implied bandwidth breaches the roofline: an
+    impossible number must never be reported as a result.
+    """
+    result = solve()  # compile + warm-up, excluded
+    _materialize(result)
+    times = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        result = solve()
+        value = _materialize(result)
+        times.append(time.perf_counter() - t0)
+    dt = float(np.median(times))
+    implied = bytes_lower_bound_per_run / dt
+    if implied > HBM_ROOFLINE_BYTES_PER_S:
+        raise RuntimeError(
+            f"timing artifact: measured {dt * 1e3:.3f} ms implies "
+            f"{implied / 1e12:.1f} TB/s of HBM traffic (> roofline "
+            f"{HBM_ROOFLINE_BYTES_PER_S / 1e12:.1f} TB/s); refusing to report"
+        )
+    return dt, value, result
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# ----------------------------------------------------------------- proxies
+
+
+def _proxy_logistic_dense(n: int, d: int, iters: int = 5) -> float:
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, d))
+    y = (rng.uniform(size=n) < 0.5).astype(np.float64)
+    w = np.zeros(d)
     for _ in range(1):
-        m = Xd @ w
-        p = 1.0 / (1.0 + np.exp(-m))
-        g = Xd.T @ (p - yd)
+        p = 1.0 / (1.0 + np.exp(-(X @ w)))
+        g = X.T @ (p - y)
     t0 = time.perf_counter()
     for _ in range(iters):
-        m = Xd @ w
+        p = 1.0 / (1.0 + np.exp(-(X @ w)))
+        g = X.T @ (p - y)
+        w = w - 1e-6 * g
+    return n * iters / (time.perf_counter() - t0)
+
+
+def _proxy_logistic_sparse(n: int, d: int, k: int, iters: int = 5) -> float:
+    """One-core gather/scatter logistic pass on padded sparse rows."""
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, d, size=(n, k))
+    val = rng.normal(size=(n, k))
+    y = (rng.uniform(size=n) < 0.5).astype(np.float64)
+    w = np.zeros(d)
+
+    def passes():
+        m = np.sum(val * w[idx], axis=1)
         p = 1.0 / (1.0 + np.exp(-m))
-        g = Xd.T @ (p - yd)
-        w = w - 1e-6 * g  # keep the dependency chain honest
-    dt = time.perf_counter() - t0
-    return Xd.shape[0] * iters / dt
+        g = np.zeros(d)
+        np.add.at(g, idx.ravel(), (val * (p - y)[:, None]).ravel())
+        return g
+
+    passes()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        w = w - 1e-6 * passes()
+    return n * iters / (time.perf_counter() - t0)
 
 
-def main() -> None:
-    import jax
-    import jax.numpy as jnp
+def _proxy_linear_tron(n: int, d: int, iters: int = 5) -> float:
+    """One-core linear value+grad+one-Hv pass per iteration (TRON shape)."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, d))
+    y = rng.normal(size=n)
+    w = np.zeros(d)
+    v = rng.normal(size=d)
+    for _ in range(1):  # warm: first-touch pages + BLAS buffers
+        g = X.T @ (X @ w - y)
+        hv = X.T @ (X @ v)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = X @ w - y
+        g = X.T @ r
+        hv = X.T @ (X @ v)  # one CG step's Hessian-vector product
+        w = w - 1e-6 * (g + 1e-9 * hv)
+    return n * iters / (time.perf_counter() - t0)
 
+
+def _proxy_poisson_dense(n: int, d: int, iters: int = 5) -> float:
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, d))
+    y = rng.poisson(1.0, size=n).astype(np.float64)
+    w = np.zeros(d)
+    for _ in range(1):  # warm: first-touch pages + BLAS buffers
+        g = X.T @ (np.exp(np.clip(X @ w, -30, 30)) - y)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        mu = np.exp(np.clip(X @ w, -30, 30))
+        g = X.T @ (mu - y)
+        w = w - 1e-8 * g
+    return n * iters / (time.perf_counter() - t0)
+
+
+# ----------------------------------------------------------------- configs
+
+
+def bench_dense_logistic(jax, jnp):
+    """Headline: dense logistic L-BFGS (round-over-round comparable)."""
     from photon_ml_tpu.config import OptimizerConfig
-    from photon_ml_tpu.data import synthetic_glm_data
+    from photon_ml_tpu.evaluation.evaluators import auc_roc
+    from photon_ml_tpu.ops.batch import DenseBatch
     from photon_ml_tpu.ops.glm import make_objective
     from photon_ml_tpu.ops.losses import loss_for_task
     from photon_ml_tpu.optim import lbfgs_minimize
     from photon_ml_tpu.types import TaskType
 
-    n, d = 1 << 20, 512  # 1M samples, 512 dense features (a9a-shaped, scaled up)
-    iters = 30
-    task = TaskType.LOGISTIC_REGRESSION
-
-    # Generate the batch ON DEVICE (host→device transfer of GB-scale data
-    # through the TPU tunnel would dominate; real training streams data via
-    # the host pipeline, which is benchmarked separately)
-    from photon_ml_tpu.ops.batch import DenseBatch
+    n, d, iters = 1 << 20, 512, 30
 
     @jax.jit
     def make_data(key):
@@ -78,53 +189,411 @@ def main() -> None:
         w_true = jax.random.normal(k2, (d,), jnp.float32) * 0.5
         p = jax.nn.sigmoid(X @ w_true)
         y = (jax.random.uniform(k3, (n,)) < p).astype(jnp.float32)
-        return X, y
+        return X, y, w_true
 
-    X, y = make_data(jax.random.PRNGKey(0))
+    X, y, w_true = make_data(jax.random.PRNGKey(0))
     batch = DenseBatch(
         X=X, labels=y, offsets=jnp.zeros((n,), jnp.float32),
         weights=jnp.ones((n,), jnp.float32),
     )
-    intercept_index = d - 1
-
     obj = make_objective(
-        batch, loss_for_task(task), l2_weight=1.0, intercept_index=intercept_index
+        batch, loss_for_task(TaskType.LOGISTIC_REGRESSION), l2_weight=1.0,
+        intercept_index=d - 1,
     )
-    cfg = OptimizerConfig(max_iterations=iters, tolerance=0.0)  # fixed-trip: pure throughput
-    w0 = jnp.zeros((batch.num_features,), jnp.float32)
+    cfg = OptimizerConfig(max_iterations=iters, tolerance=0.0)  # fixed trip
+    w0 = jnp.zeros((d,), jnp.float32)
 
-    # compile + warm up
-    res = lbfgs_minimize(obj, w0, cfg)
-    jax.block_until_ready(res.w)
+    dt, value, res = _timed_solves(
+        lambda: lbfgs_minimize(obj, w0, cfg),
+        bytes_lower_bound_per_run=float(n) * d * 4,  # one objective pass
+    )
+    auc_model = float(auc_roc(batch.matvec(res.w), y))
+    auc_true = float(auc_roc(batch.matvec(w_true), y))
+    sps = n * iters / dt
+    proxy = _proxy_logistic_dense(1 << 16, d)
+    return {
+        "samples_per_sec": round(sps, 1),
+        "sec_per_iteration": round(dt / iters, 6),
+        "final_loss": round(value, 6),
+        "auc": round(auc_model, 6),
+        "auc_generating_model": round(auc_true, 6),
+        "quality_ok": bool(auc_model >= 0.98 * auc_true),
+        "vs_one_core_proxy": round(sps / proxy, 2),
+        "shape": {"n": n, "d": d, "iters": iters},
+    }
+
+
+def _make_sparse_problem(jax, jnp, n, d, k, seed):
+    from photon_ml_tpu.ops.batch import SparseBatch
+
+    @jax.jit
+    def make_data(key):
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        idx = jax.random.randint(k1, (n, k), 0, d, jnp.int32)
+        val = jax.random.normal(k2, (n, k), jnp.float32)
+        w_true = jax.random.normal(k3, (d,), jnp.float32) * 0.3
+        m = jnp.sum(val * w_true[idx], axis=-1)
+        y = (jax.random.uniform(k4, (n,)) < jax.nn.sigmoid(m)).astype(jnp.float32)
+        return idx, val, y, w_true
+
+    idx, val, y, w_true = make_data(jax.random.PRNGKey(seed))
+    batch = SparseBatch(
+        indices=idx, values=val, labels=y,
+        offsets=jnp.zeros((n,), jnp.float32),
+        weights=jnp.ones((n,), jnp.float32), num_features=d,
+    )
+    return batch, w_true
+
+
+def _sparse_logistic_bench(jax, jnp, n, d, k, iters, densify_dtype):
+    from photon_ml_tpu.config import OptimizerConfig
+    from photon_ml_tpu.evaluation.evaluators import auc_roc
+    from photon_ml_tpu.ops.batch import maybe_densify
+    from photon_ml_tpu.ops.glm import make_objective
+    from photon_ml_tpu.ops.losses import loss_for_task
+    from photon_ml_tpu.optim import lbfgs_minimize
+    from photon_ml_tpu.types import TaskType
+
+    sparse_batch, w_true = _make_sparse_problem(jax, jnp, n, d, k, seed=1)
+    # The framework's ingest decision: one scatter at ingest buys MXU
+    # matmuls every iteration when the dense matrix fits the HBM budget;
+    # over-budget problems stay on the sparse gather/scatter kernels.
+    batch = (
+        maybe_densify(sparse_batch, dtype=densify_dtype)
+        if densify_dtype is not None
+        else sparse_batch
+    )
+    densified = batch is not sparse_batch
+    obj = make_objective(
+        batch, loss_for_task(TaskType.LOGISTIC_REGRESSION), l2_weight=1.0
+    )
+    cfg = OptimizerConfig(max_iterations=iters, tolerance=0.0)
+    w0 = jnp.zeros((d,), jnp.float32)
+
+    itemsize = 2 if densified and densify_dtype == jnp.bfloat16 else 4
+    bytes_per_pass = n * d * itemsize if densified else n * k * 8
+    dt, value, res = _timed_solves(
+        lambda: lbfgs_minimize(obj, w0, cfg),
+        bytes_lower_bound_per_run=float(bytes_per_pass),  # one objective pass
+    )
+    auc_model = float(auc_roc(sparse_batch.matvec(res.w), sparse_batch.labels))
+    auc_true = float(auc_roc(sparse_batch.matvec(w_true), sparse_batch.labels))
+    sps = n * iters / dt
+    proxy = _proxy_logistic_sparse(1 << 15, d, k)
+    return {
+        "samples_per_sec": round(sps, 1),
+        "sec_per_iteration": round(dt / iters, 6),
+        "final_loss": round(value, 6),
+        "auc": round(auc_model, 6),
+        "auc_generating_model": round(auc_true, 6),
+        "quality_ok": bool(auc_model >= 0.98 * auc_true),
+        "vs_one_core_proxy": round(sps / proxy, 2),
+        "densified": densified,
+        "shape": {"n": n, "d": d, "nnz_per_row": k, "iters": iters},
+    }
+
+
+def bench_a_sparse_logistic(jax, jnp):
+    """Config A: a9a-shaped sparse binary logistic (scaled up ~16x in rows
+    and ~33x in features), ingested sparse, auto-densified to bf16 for the
+    solve (the framework's standard ingest decision at this size)."""
+    return _sparse_logistic_bench(
+        jax, jnp, n=1 << 19, d=4096, k=64, iters=20, densify_dtype=jnp.bfloat16
+    )
+
+
+def bench_a2_sparse_highdim(jax, jnp):
+    """Config A2: high-dimensional sparse logistic that stays on the sparse
+    gather/scatter kernels (dense would need ~270 GB). Known platform
+    limitation: XLA's TPU gather/scatter runs ~1e8 elem/s (latency-bound,
+    no SparseCore), so this path is gather-dominated. n=2^20 kernel-faults
+    this platform's TPU worker (reproduced in isolation); 2^19 is stable."""
+    return _sparse_logistic_bench(
+        jax, jnp, n=1 << 19, d=1 << 17, k=32, iters=10, densify_dtype=None
+    )
+
+
+def bench_b_linear_tron(jax, jnp):
+    """Config B: L2 linear regression under the TRON trust-region solver."""
+    from photon_ml_tpu.config import OptimizerConfig
+    from photon_ml_tpu.ops.batch import DenseBatch
+    from photon_ml_tpu.ops.glm import make_objective
+    from photon_ml_tpu.ops.losses import loss_for_task
+    from photon_ml_tpu.optim.tron import tron_minimize
+    from photon_ml_tpu.types import TaskType
+
+    n, d, iters, noise = 1 << 20, 256, 15, 0.1
+
+    @jax.jit
+    def make_data(key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        X = jax.random.normal(k1, (n, d), jnp.float32)
+        w_true = jax.random.normal(k2, (d,), jnp.float32) * 0.5
+        y = X @ w_true + noise * jax.random.normal(k3, (n,), jnp.float32)
+        return X, y, w_true
+
+    X, y, w_true = make_data(jax.random.PRNGKey(2))
+    batch = DenseBatch(
+        X=X, labels=y, offsets=jnp.zeros((n,), jnp.float32),
+        weights=jnp.ones((n,), jnp.float32),
+    )
+    obj = make_objective(batch, loss_for_task(TaskType.LINEAR_REGRESSION), l2_weight=1.0)
+    cfg = OptimizerConfig(max_iterations=iters, tolerance=0.0)
+    w0 = jnp.zeros((d,), jnp.float32)
+
+    dt, value, res = _timed_solves(
+        lambda: tron_minimize(obj, w0, cfg),
+        bytes_lower_bound_per_run=float(n) * d * 4,  # one objective pass
+    )
+    rmse = float(jnp.sqrt(jnp.mean((batch.matvec(res.w) - y) ** 2)))
+    its = max(int(res.iterations), 1)
+    sps = n * its / dt
+    proxy = _proxy_linear_tron(1 << 16, d)
+    return {
+        "samples_per_sec": round(sps, 1),
+        "sec_per_iteration": round(dt / its, 6),
+        "final_loss": round(value, 6),
+        "rmse": round(rmse, 6),
+        "noise_floor": noise,
+        "quality_ok": bool(rmse <= 2.0 * noise),
+        "vs_one_core_proxy": round(sps / proxy, 2),
+        "shape": {"n": n, "d": d, "iters": its},
+    }
+
+
+def bench_c_poisson(jax, jnp):
+    """Config C: Poisson regression (count data), L-BFGS."""
+    from photon_ml_tpu.config import OptimizerConfig
+    from photon_ml_tpu.ops.batch import DenseBatch
+    from photon_ml_tpu.ops.glm import make_objective
+    from photon_ml_tpu.ops.losses import loss_for_task
+    from photon_ml_tpu.optim import lbfgs_minimize
+    from photon_ml_tpu.types import TaskType
+
+    n, d, iters = 1 << 20, 256, 20
+
+    # Poisson sampling isn't in jax.random's stable API across versions at
+    # fixed shapes; counts are generated on host at this modest size.
+    # small weight scale keeps margins within the sampling clip, so w_true
+    # is (near-)optimal for the unclipped objective and the loss comparison
+    # below is a meaningful parity check
+    rng = np.random.default_rng(3)
+    X_h = rng.normal(size=(n, d)).astype(np.float32)
+    w_true_h = (rng.normal(size=d) * 0.05).astype(np.float32)
+    lam = np.exp(np.clip(X_h @ w_true_h, -10, 3))
+    y_h = rng.poisson(lam).astype(np.float32)
+
+    X, y = jnp.asarray(X_h), jnp.asarray(y_h)
+    w_true = jnp.asarray(w_true_h)
+    batch = DenseBatch(
+        X=X, labels=y, offsets=jnp.zeros((n,), jnp.float32),
+        weights=jnp.ones((n,), jnp.float32),
+    )
+    loss = loss_for_task(TaskType.POISSON_REGRESSION)
+    obj = make_objective(batch, loss, l2_weight=1.0)
+    cfg = OptimizerConfig(max_iterations=iters, tolerance=0.0)
+    w0 = jnp.zeros((d,), jnp.float32)
+
+    dt, value, res = _timed_solves(
+        lambda: lbfgs_minimize(obj, w0, cfg),
+        bytes_lower_bound_per_run=float(n) * d * 4,  # one objective pass
+    )
+    loss_true = float(obj.value(w_true))
+    sps = n * iters / dt
+    proxy = _proxy_poisson_dense(1 << 16, d)
+    return {
+        "samples_per_sec": round(sps, 1),
+        "sec_per_iteration": round(dt / iters, 6),
+        "final_loss": round(value, 6),
+        "loss_of_generating_model": round(loss_true, 6),
+        "quality_ok": bool(value <= loss_true + 0.02 * abs(loss_true)),
+        "vs_one_core_proxy": round(sps / proxy, 2),
+        "shape": {"n": n, "d": d, "iters": iters},
+    }
+
+
+def _game_setup(jax, jnp, n, effects):
+    from photon_ml_tpu.config import (
+        OptimizationConfig,
+        OptimizerConfig,
+        RegularizationContext,
+    )
+    from photon_ml_tpu.data.synthetic import synthetic_game_data
+    from photon_ml_tpu.game import (
+        CoordinateDescent,
+        FixedEffectCoordinate,
+        RandomEffectCoordinate,
+        bucket_entities,
+        group_by_entity,
+        make_game_batch,
+    )
+    from photon_ml_tpu.types import RegularizationType, TaskType
+
+    rng = np.random.default_rng(4)
+    d_fixed = 64
+    data = synthetic_game_data(rng, n, d_fixed=d_fixed, effects=effects)
+    features = {"global": data.X}
+    id_tags = {}
+    for name in effects:
+        features[f"per_{name}"] = data.entity_X[name]
+        id_tags[name] = data.entity_ids[name]
+    batch = make_game_batch(data.y, features, id_tags=id_tags)
+
+    opt = OptimizerConfig(max_iterations=20, tolerance=1e-7)
+    coords = {
+        "fixed": FixedEffectCoordinate(
+            coordinate_id="fixed", batch=batch, feature_shard_id="global",
+            config=OptimizationConfig(optimizer=opt),
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            intercept_index=d_fixed,
+        )
+    }
+    for name in effects:
+        grouping = group_by_entity(np.asarray(batch.id_tags[name]))
+        coords[f"per_{name}"] = RandomEffectCoordinate(
+            coordinate_id=f"per_{name}", batch=batch,
+            feature_shard_id=f"per_{name}", random_effect_type=name,
+            config=OptimizationConfig(
+                optimizer=opt,
+                regularization=RegularizationContext(RegularizationType.L2),
+                regularization_weight=1.0,
+            ),
+            grouping=grouping, buckets=bucket_entities(grouping),
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            num_entities=grouping.num_entities,
+        )
+    cd = CoordinateDescent(coords, batch, TaskType.LOGISTIC_REGRESSION)
+    return cd, batch, data
+
+
+def _game_bench(jax, jnp, n, effects, outer_iters):
+    from photon_ml_tpu.evaluation.evaluators import auc_roc
+
+    cd, batch, data = _game_setup(jax, jnp, n, effects)
+    seq = ("fixed",) + tuple(f"per_{name}" for name in effects)
+
+    cd.run(seq, 2)  # compile warm-up (covers cold and warm-start paths)
     t0 = time.perf_counter()
-    res = lbfgs_minimize(obj, w0, cfg)
-    jax.block_until_ready(res.w)
+    result = cd.run(seq, outer_iters)
+    # fence: materialize every trained coefficient before stopping the clock
+    for sub in result.model.models.values():
+        np.asarray(sub.coefficient_means)
     dt = time.perf_counter() - t0
-    # each L-BFGS iteration = 1 value+grad pass + line-search value passes;
-    # count only optimizer iterations (the reference's metric is per-iteration
-    # sample throughput of the distributed gradient computation)
-    its = int(res.iterations)
-    samples_per_sec = batch.num_rows * max(its, 1) / dt
 
-    # CPU proxy on a small slice, scaled (one core, same math). Generated on
-    # host — pulling device data back through the tunnel is the slow path.
-    n_cpu = 1 << 16
-    rng = np.random.default_rng(0)
-    X_cpu = rng.normal(size=(n_cpu, d)).astype(np.float32)
-    y_cpu = (rng.uniform(size=n_cpu) < 0.5).astype(np.float32)
-    cpu_sps = _cpu_proxy_samples_per_sec(X_cpu, y_cpu)
+    # quality (outside the timed window — AUC compiles its own program)
+    scores = result.model.score(batch)
+    auc_model = float(auc_roc(scores, batch.labels))
+
+    # generating model's AUC on the same rows: the quality ceiling
+    margin = data.X @ data.w_fixed
+    for name in effects:
+        margin = margin + np.sum(
+            data.w_entity[name][data.entity_ids[name]] * data.entity_X[name], axis=1
+        )
+    auc_true = float(auc_roc(jnp.asarray(margin), batch.labels))
+    sec_per_outer = dt / outer_iters
+    return {
+        "sec_per_outer_iteration": round(sec_per_outer, 4),
+        "samples_per_sec": round(n * outer_iters / dt, 1),
+        "auc": round(auc_model, 6),
+        "auc_generating_model": round(auc_true, 6),
+        "quality_ok": bool(auc_model >= 0.95 * auc_true),
+        "vs_one_core_proxy": None,
+        "shape": {"n": n, "effects": {k: list(v) for k, v in effects.items()},
+                   "outer_iters": outer_iters},
+    }
+
+
+def bench_d_game_fixed(jax, jnp):
+    """Config D: GAME fixed-effect-only logistic (single-coordinate CD)."""
+    return _game_bench(jax, jnp, n=1 << 18, effects={}, outer_iters=3)
+
+
+def bench_e_game_glmm(jax, jnp):
+    """Config E: GAME GLMM — fixed + per-user + per-item random effects."""
+    return _game_bench(
+        jax, jnp, n=1 << 18,
+        effects={"userId": (20000, 8), "itemId": (4000, 8)},
+        outer_iters=2,
+    )
+
+
+CONFIGS = {
+    "headline_dense_logistic": bench_dense_logistic,
+    "A_sparse_logistic": bench_a_sparse_logistic,
+    "A2_sparse_highdim": bench_a2_sparse_highdim,
+    "B_linear_tron": bench_b_linear_tron,
+    "C_poisson": bench_c_poisson,
+    "D_game_fixed_only": bench_d_game_fixed,
+    "E_game_glmm": bench_e_game_glmm,
+}
+
+
+def _run_one(name: str) -> None:
+    """Child mode: run one config, print its result JSON on stdout."""
+    import jax
+    import jax.numpy as jnp
+
+    print(json.dumps(CONFIGS[name](jax, jnp)))
+
+
+def main() -> None:
+    import subprocess
+
+    # Each config runs in its OWN subprocess, sequentially (two concurrent
+    # TPU processes deadlock on this platform's relay): device memory is
+    # fully released between configs — closure-captured batches baked into
+    # cached executables otherwise accumulate until the worker OOM-crashes —
+    # and one config crashing cannot poison the rest.
+    results: dict[str, dict] = {}
+    here = os.path.abspath(__file__)
+    for name in CONFIGS:
+        _log(f"[bench] {name} ...")
+        try:
+            proc = subprocess.run(
+                [sys.executable, here, "--config", name],
+                capture_output=True, text=True, timeout=900,
+            )
+            sys.stderr.write(proc.stderr)
+            if proc.returncode == 0:
+                results[name] = json.loads(proc.stdout.strip().splitlines()[-1])
+            else:
+                tail = (proc.stderr or "").strip().splitlines()[-3:]
+                results[name] = {"error": f"rc={proc.returncode}: {' | '.join(tail)}"}
+        except Exception as e:  # an impossible number or a crash: report, don't fake
+            results[name] = {"error": f"{type(e).__name__}: {e}"}
+        _log(f"[bench] {name}: {json.dumps(results[name])[:300]}")
+
+    head = results.get("headline_dense_logistic", {})
+    detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_DETAIL.json")
+    with open(detail_path, "w") as f:
+        json.dump(results, f, indent=2)
 
     print(
         json.dumps(
             {
                 "metric": "glm_logistic_lbfgs_samples_per_sec_per_chip",
-                "value": round(samples_per_sec, 1),
+                "value": head.get("samples_per_sec"),
                 "unit": "samples/s",
-                "vs_baseline": round(samples_per_sec / cpu_sps, 2),
+                "vs_baseline": head.get("vs_one_core_proxy"),
+                "quality": {
+                    "auc": head.get("auc"),
+                    "auc_generating_model": head.get("auc_generating_model"),
+                    "quality_ok": head.get("quality_ok"),
+                },
+                "configs": results,
             }
         )
     )
+    bad = [k for k, v in results.items() if "error" in v or v.get("quality_ok") is False]
+    if bad:
+        _log(f"[bench] configs with errors/quality failures: {bad}")
+        sys.exit(1)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) == 3 and sys.argv[1] == "--config":
+        _run_one(sys.argv[2])
+    else:
+        main()

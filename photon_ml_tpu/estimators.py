@@ -241,13 +241,21 @@ class GameEstimator:
         configuration: GameOptimizationConfiguration,
         norm_contexts: Mapping[str, NormalizationContext],
         entity_layouts: Mapping[str, tuple[EntityGrouping, EntityBuckets, int]],
+        re_coordinate_cache: dict[str, RandomEffectCoordinate] | None = None,
     ) -> dict[str, Coordinate]:
+        """``re_coordinate_cache`` (when given) shares each random-effect
+        coordinate's prepared bucket tensors across grid entries — only the
+        optimization config is swapped per entry, so the staged device
+        buffers are gathered once per ``fit``, not once per grid entry."""
         coordinates: dict[str, Coordinate] = {}
         task = self.config.task_type
         for cid in self.config.coordinate_update_sequence:
             opt = configuration[cid]
             coord_cfg = self.config.coordinate_config(cid)
             if isinstance(coord_cfg, RandomEffectCoordinateConfig):
+                if re_coordinate_cache is not None and cid in re_coordinate_cache:
+                    coordinates[cid] = re_coordinate_cache[cid].with_config(opt)
+                    continue
                 grouping, buckets, num_entities = entity_layouts[cid]
                 projector = None
                 if coord_cfg.random_projection_dim is not None:
@@ -258,7 +266,7 @@ class GameEstimator:
                         coord_cfg.random_projection_dim,
                         seed=self.seed,
                     )
-                coordinates[cid] = RandomEffectCoordinate(
+                coord = RandomEffectCoordinate(
                     coordinate_id=cid,
                     batch=batch,
                     feature_shard_id=coord_cfg.feature_shard_id,
@@ -274,6 +282,9 @@ class GameEstimator:
                     features_to_samples_ratio=coord_cfg.features_to_samples_ratio_upper_bound,
                     projector=projector,
                 )
+                if re_coordinate_cache is not None:
+                    re_coordinate_cache[cid] = coord
+                coordinates[cid] = coord
             else:
                 train_rows = None
                 weight_scale = None
@@ -341,10 +352,12 @@ class GameEstimator:
         )
 
         results: list[GameResult] = []
+        re_coordinate_cache: dict[str, RandomEffectCoordinate] = {}
         for i, configuration in enumerate(configurations):
             self._log(f"grid entry {i + 1}/{len(configurations)}: {configuration}")
             coordinates = self._build_coordinates(
-                batch, configuration, norm_contexts, entity_layouts
+                batch, configuration, norm_contexts, entity_layouts,
+                re_coordinate_cache=re_coordinate_cache,
             )
             descent = CoordinateDescent(
                 coordinates,

@@ -202,6 +202,15 @@ class RandomEffectCoordinate:
         return feats
 
     @property
+    def _train_num_features(self) -> int:
+        """Feature width of the training subspace, WITHOUT materializing the
+        projection (``_features()`` would re-run the full-shard projection
+        matmul every descent iteration just to read a shape)."""
+        if self.projector is not None:
+            return self.projector.projected_dim
+        return self.batch.features[self.feature_shard_id].num_features
+
+    @property
     def _prepared(self):
         """Bucket tensors staged to device ONCE (cached on the instance);
         each descent iteration only gathers fresh offsets on device."""
@@ -219,6 +228,19 @@ class RandomEffectCoordinate:
             )
             object.__setattr__(self, "_prepared_cache", cached)
         return cached
+
+    def with_config(self, config: OptimizationConfig) -> "RandomEffectCoordinate":
+        """A copy bound to a different optimization config that SHARES the
+        prepared bucket tensors (they depend only on data/geometry, not on
+        the optimization config) — so a grid of λ values re-enters the same
+        staged device buffers instead of re-gathering per grid entry."""
+        import dataclasses
+
+        new = dataclasses.replace(self, config=config)
+        cached = self.__dict__.get("_prepared_cache")
+        if cached is not None:
+            object.__setattr__(new, "_prepared_cache", cached)
+        return new
 
     def train(
         self, offsets: Array, initial: GameSubModel | None = None
@@ -242,7 +264,7 @@ class RandomEffectCoordinate:
         result = train_prepared(
             self._prepared,
             jnp.asarray(offsets),
-            self._features().num_features,
+            self._train_num_features,
             self.num_entities,
             loss,
             opt.optimizer,

@@ -90,12 +90,9 @@ def _coefficients_to_record(
 def _record_to_coefficients(
     record: dict, index_map: IndexMap | None, num_features: int | None
 ) -> Coefficients:
-    def key_index(name: str, term: str) -> int:
+    def base_index(name: str, term: str) -> int:
         if index_map is not None:
             return index_map.get(f"{name}{DELIMITER}{term}" if term else name)
-        if name == INTERCEPT_KEY:
-            # synthetic naming puts the intercept at the last index
-            return (num_features or 0) - 1
         m = _SYNTHETIC.match(name)
         if m is None:
             raise ValueError(
@@ -103,22 +100,48 @@ def _record_to_coefficients(
             )
         return int(m.group(1))
 
-    pairs = [(key_index(r["name"], r["term"]), r["value"]) for r in record["means"]]
-    pairs = [(i, v) for i, v in pairs if i >= 0]  # unknown features dropped
-    if num_features is None:
-        num_features = (max(i for i, _ in pairs) + 1) if pairs else 0
+    def resolve(recs: list[dict]) -> tuple[list[tuple[int, float]], list[float]]:
+        """(resolved (index, value) pairs, intercept values needing a slot).
+
+        Without an IndexMap the intercept key has no stored index; it is
+        assigned ``intercept_slot`` (computed below from the mean indices)
+        AFTER the synthetic indices are known — resolving it first would
+        drop it to -1."""
+        pairs: list[tuple[int, float]] = []
+        intercept_values: list[float] = []
+        for r in recs:
+            if index_map is None and r["name"] == INTERCEPT_KEY:
+                intercept_values.append(r["value"])
+            else:
+                pairs.append((base_index(r["name"], r["term"]), r["value"]))
+        pairs = [(i, v) for i, v in pairs if i >= 0]  # unknown features dropped
+        return pairs, intercept_values
+
+    mean_pairs, mean_icept = resolve(record["means"])
+    # one intercept slot for the whole record (means AND variances): at
+    # num_features-1 when the width is known (synthetic naming: intercept
+    # last), else one past the largest synthetic mean index
+    if num_features is not None:
+        intercept_slot = num_features - 1
+    else:
+        intercept_slot = max((i for i, _ in mean_pairs), default=-1) + 1
+    mean_pairs += [(intercept_slot, v) for v in mean_icept]
+    d = num_features
+    if d is None:
+        d = (max(i for i, _ in mean_pairs) + 1) if mean_pairs else 0
         if index_map is not None:
-            num_features = index_map.size
-    means = np.zeros((num_features,), np.float32)
-    for i, v in pairs:
+            d = index_map.size
+    means = np.zeros((d,), np.float32)
+    for i, v in mean_pairs:
         means[i] = v
     variances = None
     if record.get("variances"):
-        variances = np.zeros((num_features,), np.float32)
-        for r in record["variances"]:
-            i = key_index(r["name"], r["term"])
-            if i >= 0:
-                variances[i] = r["value"]
+        var_pairs, var_icept = resolve(record["variances"])
+        var_pairs += [(intercept_slot, v) for v in var_icept]
+        variances = np.zeros((d,), np.float32)
+        for i, v in var_pairs:
+            if i < d:
+                variances[i] = v
     return Coefficients(
         jnp.asarray(means), None if variances is None else jnp.asarray(variances)
     )

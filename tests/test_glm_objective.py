@@ -7,7 +7,13 @@ import numpy as np
 import pytest
 
 from photon_ml_tpu.normalization import build_normalization, no_normalization
-from photon_ml_tpu.ops.batch import DenseBatch, SparseBatch, dense_batch_from_numpy
+from photon_ml_tpu.ops.batch import (
+    DenseBatch,
+    SparseBatch,
+    dense_batch_from_numpy,
+    densify,
+    maybe_densify,
+)
 from photon_ml_tpu.ops.glm import make_objective
 from photon_ml_tpu.ops.losses import LOSSES
 from photon_ml_tpu.types import NormalizationType
@@ -78,6 +84,41 @@ def test_sparse_dense_equivalence(rng):
     np.testing.assert_allclose(gd, gs, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(od.hvp(w, v), os_.hvp(w, v), rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(od.hessian_diag(w), os_.hessian_diag(w), rtol=1e-4, atol=1e-5)
+
+
+def test_densify_matches_sparse(rng):
+    """densify() must reproduce the sparse contractions exactly (f32) and
+    closely (bf16, the HBM-halving ingest choice); maybe_densify respects
+    its budget and accumulates duplicate (row, col) pairs like the sparse
+    kernels do."""
+    X, y, off, wt = _make_data(rng, n=32, d=6)
+    idx, vals = _sparse_from_dense(X)
+    # inject a duplicate column id in one row: contributions must add
+    idx[0, 1] = idx[0, 0]
+    sparse = SparseBatch(
+        indices=jnp.asarray(idx),
+        values=jnp.asarray(vals),
+        labels=jnp.asarray(y, jnp.float32),
+        offsets=jnp.asarray(off, jnp.float32),
+        weights=jnp.asarray(wt, jnp.float32),
+        num_features=6,
+    )
+    w = jnp.asarray(rng.normal(size=6), jnp.float32)
+    dense = densify(sparse)
+    np.testing.assert_allclose(dense.matvec(w), sparse.matvec(w), rtol=1e-5, atol=1e-6)
+    r = jnp.asarray(rng.normal(size=32), jnp.float32)
+    np.testing.assert_allclose(dense.rmatvec(r), sparse.rmatvec(r), rtol=1e-5, atol=1e-5)
+
+    bf16 = densify(sparse, dtype=jnp.bfloat16)
+    assert bf16.X.dtype == jnp.bfloat16
+    assert bf16.matvec(w).dtype == jnp.float32  # f32 accumulation
+    np.testing.assert_allclose(
+        bf16.matvec(w), sparse.matvec(w), rtol=3e-2, atol=3e-2
+    )
+
+    assert isinstance(maybe_densify(sparse, hbm_budget_bytes=10), SparseBatch)
+    assert isinstance(maybe_densify(sparse, hbm_budget_bytes=1e6), DenseBatch)
+    assert maybe_densify(dense, hbm_budget_bytes=10) is dense
 
 
 def test_sparse_padding_is_inert(rng):

@@ -120,6 +120,67 @@ class TestModelIO:
         # zero coefficient: variance record also filtered with it (sparsity)
         assert np.asarray(m2.coefficients.variances)[0] == pytest.approx(0.1)
 
+    def test_load_intercept_without_index_map_or_width(self, tmp_path):
+        """A reference-written model with an '(INTERCEPT)' record must keep
+        its intercept when loaded with neither an IndexMap nor a known
+        width: it lands one past the largest synthetic index."""
+        from photon_ml_tpu.io import write_avro_file
+        from photon_ml_tpu.io.schemas import BAYESIAN_LINEAR_MODEL_SCHEMA
+
+        rec = {
+            "modelId": "global",
+            "modelClass": "GeneralizedLinearModel",
+            "lossFunction": "LOGISTIC_REGRESSION",
+            "means": [
+                {"name": "f0", "term": "", "value": 1.0},
+                {"name": "f2", "term": "", "value": 3.0},
+                {"name": "(INTERCEPT)", "term": "", "value": -0.5},
+            ],
+            "variances": None,
+        }
+        path = str(tmp_path / "m.avro")
+        write_avro_file(path, BAYESIAN_LINEAR_MODEL_SCHEMA, [rec])
+        m = load_glm(path)
+        means = np.asarray(m.coefficients.means)
+        assert means.shape == (4,)  # f0..f2 + intercept appended after them
+        assert means[0] == pytest.approx(1.0)
+        assert means[2] == pytest.approx(3.0)
+        assert means[3] == pytest.approx(-0.5)
+        # with an explicit width the intercept stays at the last slot
+        m2 = load_glm(path, num_features=6)
+        assert np.asarray(m2.coefficients.means)[5] == pytest.approx(-0.5)
+
+    def test_intercept_variance_shares_means_slot(self, tmp_path):
+        """The intercept's variance must land on the SAME slot as its mean
+        even when the variance list has a different sparsity pattern."""
+        from photon_ml_tpu.io import write_avro_file
+        from photon_ml_tpu.io.schemas import BAYESIAN_LINEAR_MODEL_SCHEMA
+
+        rec = {
+            "modelId": "global",
+            "modelClass": "GeneralizedLinearModel",
+            "lossFunction": "LOGISTIC_REGRESSION",
+            "means": [
+                {"name": "f0", "term": "", "value": 1.0},
+                {"name": "f2", "term": "", "value": 3.0},
+                {"name": "(INTERCEPT)", "term": "", "value": -0.5},
+            ],
+            # variances only for f0 + intercept: misaligned with means
+            "variances": [
+                {"name": "f0", "term": "", "value": 0.7},
+                {"name": "(INTERCEPT)", "term": "", "value": 0.9},
+            ],
+        }
+        path = str(tmp_path / "m.avro")
+        write_avro_file(path, BAYESIAN_LINEAR_MODEL_SCHEMA, [rec])
+        m = load_glm(path)
+        means = np.asarray(m.coefficients.means)
+        variances = np.asarray(m.coefficients.variances)
+        assert means[3] == pytest.approx(-0.5)
+        assert variances[3] == pytest.approx(0.9)  # same slot as the mean
+        assert variances[0] == pytest.approx(0.7)
+        assert variances[1] == variances[2] == 0.0
+
     def test_glm_roundtrip_with_index_map(self, tmp_path):
         imap = IndexMap.build(
             [feature_key("age"), feature_key("country", "us")], add_intercept=True
