@@ -74,6 +74,7 @@ def run(
     summarize_features: bool = False,
     variance_computation: VarianceComputationType = VarianceComputationType.NONE,
     validate: DataValidationType = DataValidationType.VALIDATE_DISABLED,
+    streaming_chunk_rows: int | None = None,
     logger: PhotonLogger | None = None,
 ):
     logger = logger or PhotonLogger(output_dir)
@@ -84,6 +85,30 @@ def run(
         with open(stage_file, "w") as f:
             f.write(stage)
         logger.info(f"stage → {stage}")
+
+    if streaming_chunk_rows is not None:
+        # reject — not silently drop — options the streaming branch can't honor
+        unsupported = []
+        if optimizer is not OptimizerType.LBFGS:
+            unsupported.append(f"--optimizer {optimizer.value} (host L-BFGS only)")
+        if normalization is not NormalizationType.NONE:
+            unsupported.append(f"--normalization {normalization.value}")
+        if variance_computation is not VarianceComputationType.NONE:
+            unsupported.append(f"--variance {variance_computation.value}")
+        if validate is not DataValidationType.VALIDATE_DISABLED:
+            unsupported.append(f"--validate {validate.value}")
+        if summarize_features:
+            unsupported.append("--summarize-features")
+        if unsupported:
+            raise ValueError(
+                "--streaming-chunk-rows does not support: "
+                + ", ".join(unsupported)
+            )
+        return _run_streamed(
+            task, train_data, output_dir, data_format, validation_data,
+            regularization, weights, max_iterations, tolerance,
+            streaming_chunk_rows, advance, logger,
+        )
 
     advance("INIT")
     with timed(logger, "read training data"):
@@ -187,6 +212,87 @@ def run(
     return result
 
 
+def _run_streamed(
+    task, train_data, output_dir, data_format, validation_data,
+    regularization, weights, max_iterations, tolerance,
+    chunk_rows, advance, logger,
+):
+    """Out-of-core branch: data is read in uniform chunks that live in host
+    RAM and stream through the device per optimizer iteration (SURVEY.md §7
+    "Streaming 1B rows"). Avro input only — LIBSVM fits in memory whenever
+    its text fits."""
+    if data_format != "avro":
+        raise ValueError("--streaming-chunk-rows requires --format avro")
+    from photon_ml_tpu.supervised.training import train_glm_streamed
+
+    reader = AvroDataReader()
+    sid = next(iter(reader.feature_shards))
+    advance("INIT")
+    with timed(logger, "index maps (streaming pass)"):
+        index_maps, max_nnz = reader.streaming_ingest_stats(train_data)
+    imap = index_maps[sid]
+    with timed(logger, "chunk training data"):
+        chunks = list(
+            reader.iter_batch_chunks(
+                train_data, sid, chunk_rows, index_maps, max_nnz=max_nnz[sid]
+            )
+        )
+    logger.info(f"{len(chunks)} training chunks of {chunk_rows} rows")
+    advance("PROCESSED")
+
+    val_chunks = None
+    if validation_data:
+        with timed(logger, "chunk validation data"):
+            val_chunks = list(
+                reader.iter_batch_chunks(
+                    validation_data, sid, chunk_rows, index_maps
+                )
+            )
+
+    with timed(logger, "train (streamed)"):
+        result = train_glm_streamed(
+            chunks,
+            task,
+            num_features=imap.size,
+            optimizer_config=OptimizerConfig(
+                max_iterations=max_iterations, tolerance=tolerance
+            ),
+            regularization=RegularizationContext(regularization),
+            regularization_weights=list(weights),
+            intercept_index=imap.intercept_index,
+            validation_chunks=val_chunks,
+        )
+    advance("TRAINED")
+
+    with timed(logger, "write models"):
+        for lam, model in result.models.items():
+            save_glm(
+                model,
+                os.path.join(output_dir, "models", f"lambda-{lam:g}", "model.avro"),
+                index_map=imap,
+                model_id=f"lambda-{lam:g}",
+            )
+        save_glm(
+            result.best_model,
+            os.path.join(output_dir, "best", "model.avro"),
+            index_map=imap,
+            model_id="best",
+        )
+    report = {
+        "task": task.value,
+        "streaming_chunk_rows": chunk_rows,
+        "weights": sorted(float(w) for w in weights),
+        "best_weight": result.best_weight,
+        "validation": {
+            str(lam): dict(ev.metrics) for lam, ev in result.validation.items()
+        },
+    }
+    with open(os.path.join(output_dir, "report.json"), "w") as f:
+        json.dump(report, f, indent=2)
+    advance("VALIDATED")
+    return result
+
+
 def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(description="Single-GLM training driver (legacy)")
     p.add_argument("--task", required=True, choices=[t.value for t in TaskType])
@@ -211,6 +317,11 @@ def main(argv: list[str] | None = None) -> None:
         "--validate", default="VALIDATE_DISABLED",
         choices=[v.value for v in DataValidationType],
     )
+    p.add_argument(
+        "--streaming-chunk-rows", type=int, default=None,
+        help="out-of-core mode: stream avro data through the device in "
+             "uniform chunks of this many rows (host-RAM resident)",
+    )
     p.add_argument("--output-dir", required=True)
     args = p.parse_args(argv)
     run(
@@ -228,6 +339,7 @@ def main(argv: list[str] | None = None) -> None:
         summarize_features=args.summarize_features,
         variance_computation=VarianceComputationType(args.variance),
         validate=DataValidationType(args.validate),
+        streaming_chunk_rows=args.streaming_chunk_rows,
     )
 
 

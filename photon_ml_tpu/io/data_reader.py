@@ -134,6 +134,43 @@ class AvroDataReader:
         reference's ``FeatureIndexingDriver`` / ``DefaultIndexMap`` path)."""
         return self._maps_from_parsed(self._parse_rows(list(records)))
 
+    def build_index_maps_streaming(
+        self, path: str | Sequence[str]
+    ) -> dict[str, IndexMap]:
+        """Index maps from a streaming pass: only the distinct-key sets are
+        held in memory, never the records — the out-of-core twin of
+        ``build_index_maps`` for datasets larger than host RAM."""
+        return self.streaming_ingest_stats(path)[0]
+
+    def streaming_ingest_stats(
+        self, path: str | Sequence[str]
+    ) -> tuple[dict[str, IndexMap], dict[str, int]]:
+        """ONE streaming pass producing both the index maps and each
+        shard's max per-record feature count (``max_nnz``, intercept
+        included) — so ``iter_batch_chunks`` doesn't need its own pre-pass
+        and the out-of-core CLI reads the data exactly twice (stats + fill),
+        not three times."""
+        paths = [path] if isinstance(path, str) else list(path)
+        seen: dict[str, dict[str, None]] = {sid: {} for sid in self.feature_shards}
+        max_nnz = {sid: 1 for sid in self.feature_shards}
+        for p in paths:
+            for rec in iter_avro_directory(p):
+                for sid, cfg in self.feature_shards.items():
+                    bucket = seen[sid]
+                    pairs = self._shard_keys(rec, cfg)
+                    for key, _ in pairs:
+                        bucket.setdefault(key, None)
+                    max_nnz[sid] = max(
+                        max_nnz[sid], len(pairs) + int(cfg.has_intercept)
+                    )
+        maps = {
+            sid: IndexMap.build(
+                seen[sid].keys(), add_intercept=self.feature_shards[sid].has_intercept
+            )
+            for sid in self.feature_shards
+        }
+        return maps, max_nnz
+
     def read(
         self,
         path: str | Sequence[str],
@@ -232,6 +269,93 @@ class AvroDataReader:
             uids=uids if any(u is not None for u in uids) else None,
             labels=labels,
         )
+
+
+    # -- out-of-core chunked reading -----------------------------------------
+    def iter_batch_chunks(
+        self,
+        path: str | Sequence[str],
+        shard_id: str,
+        chunk_rows: int,
+        index_maps: Mapping[str, IndexMap],
+        dtype=np.float32,
+        max_nnz: int | None = None,
+    ):
+        """Stream one feature shard as uniform host chunk dicts for
+        ``photon_ml_tpu.ops.streaming`` (out-of-core training — the
+        reference streams through Spark partitions; SURVEY.md §7).
+
+        Requires prebuilt (frozen) ``index_maps`` — the FeatureIndexingDriver
+        output — because a streaming pass cannot grow the feature space.
+        Every chunk has exactly ``chunk_rows`` rows (the last is padded with
+        zero-weight rows) and, on the sparse path, ``max_nnz`` slots per row
+        (derived with a pre-pass over the data when not given) — uniform
+        shapes so the whole stream re-enters ONE compiled kernel.
+        """
+        cfg = self.feature_shards[shard_id]
+        imap = index_maps[shard_id]
+        d = imap.size
+        paths = [path] if isinstance(path, str) else list(path)
+
+        def records():
+            for p in paths:
+                yield from iter_avro_directory(p)
+
+        dense = d <= _DENSE_THRESHOLD
+        if not dense and max_nnz is None:
+            max_nnz = 1
+            for rec in records():
+                nnz = len(self._shard_keys(rec, cfg)) + int(cfg.has_intercept)
+                max_nnz = max(max_nnz, nnz)
+
+        def empty_chunk():
+            chunk = {
+                "labels": np.zeros(chunk_rows, dtype),
+                "offsets": np.zeros(chunk_rows, dtype),
+                "weights": np.zeros(chunk_rows, dtype),  # filled per row
+            }
+            if dense:
+                chunk["X"] = np.zeros((chunk_rows, d), dtype)
+            else:
+                chunk["indices"] = np.zeros((chunk_rows, max_nnz), np.int32)
+                chunk["values"] = np.zeros((chunk_rows, max_nnz), dtype)
+            return chunk
+
+        chunk = empty_chunk()
+        fill = 0
+        for rec in records():
+            i = fill
+            chunk["labels"][i] = float(rec[self.response_field])
+            off = rec.get(self.offset_field)
+            if off is not None:
+                chunk["offsets"][i] = float(off)
+            w = rec.get(self.weight_field)
+            chunk["weights"][i] = 1.0 if w is None else float(w)
+            pairs = [
+                (j, v)
+                for key, v in self._shard_keys(rec, cfg)
+                if (j := imap.get(key)) >= 0
+            ]
+            if cfg.has_intercept:
+                pairs.append((imap.intercept_index, 1.0))
+            if dense:
+                for j, v in pairs:
+                    chunk["X"][i, j] += v
+            else:
+                if len(pairs) > max_nnz:
+                    raise ValueError(
+                        f"record has {len(pairs)} features > max_nnz={max_nnz}"
+                    )
+                for slot, (j, v) in enumerate(pairs):
+                    chunk["indices"][i, slot] = j
+                    chunk["values"][i, slot] = v
+            fill += 1
+            if fill == chunk_rows:
+                yield chunk
+                chunk = empty_chunk()
+                fill = 0
+        if fill:
+            yield chunk  # trailing rows; rest stays zero-weight padding
 
 
 def _build_features(

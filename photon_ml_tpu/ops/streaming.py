@@ -1,0 +1,220 @@
+"""Out-of-core GLM training: chunked host→device objective evaluation.
+
+Reference parity: the reference streams arbitrarily large datasets through
+Spark partitions — each L-BFGS/TRON iteration broadcasts coefficients and
+treeAggregates per-partition (value, gradient) sums back to the driver
+(``photon-api::ml.function.glm.DistributedGLMLossFunction``, SURVEY.md
+§2.2, §7 hard parts: "Streaming 1B rows through host RAM with
+double-buffering").
+
+TPU-native redesign: when a dataset exceeds device HBM, the batch lives in
+host RAM as a list of uniform-shape chunks; each objective evaluation
+streams chunks through the device, accumulating partial (value, gradient)
+sums on device. Transfers are double-buffered — chunk ``i+1``'s
+``device_put`` is issued before chunk ``i``'s compute is consumed, so the
+DMA overlaps the matmuls (JAX dispatch is asynchronous). The per-chunk
+kernel is ONE compiled program re-entered for every chunk of every
+iteration (uniform chunk shapes are a hard requirement for that).
+
+The optimizer driving this is host-side L-BFGS (``host_lbfgs_minimize``):
+the device-resident ``lax.while_loop`` optimizers cannot stream host data
+from inside a compiled loop, so the loop structure intentionally mirrors
+the reference's driver-resident Breeze loop — one streamed pass per
+value+gradient evaluation. For data that fits HBM, the fully
+device-resident optimizers in ``photon_ml_tpu.optim`` remain the fast
+path; ``fits_in_memory`` below is the decision rule.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Iterable, Iterator, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.normalization import NormalizationContext
+from photon_ml_tpu.ops.batch import Batch, DenseBatch, SparseBatch
+from photon_ml_tpu.ops.glm import make_objective
+from photon_ml_tpu.ops.losses import PointwiseLoss
+
+Array = jnp.ndarray
+
+
+def chunk_batch(batch_arrays: dict, chunk_rows: int) -> list[dict]:
+    """Split host arrays (a dict of same-leading-dim numpy arrays) into
+    uniform ``chunk_rows``-row chunks; the last chunk is padded with
+    zero-weight rows so every chunk compiles to the same program."""
+    n = len(batch_arrays["labels"])
+    chunks = []
+    for lo in range(0, n, chunk_rows):
+        hi = min(lo + chunk_rows, n)
+        chunk = {k: v[lo:hi] for k, v in batch_arrays.items()}
+        pad = chunk_rows - (hi - lo)
+        if pad:
+            for k, v in chunk.items():
+                fill = np.zeros((pad,) + v.shape[1:], v.dtype)
+                chunk[k] = np.concatenate([v, fill])
+            # padded rows carry weight 0 → inert in the objective
+            chunk["weights"][hi - lo:] = 0.0
+        chunks.append(chunk)
+    return chunks
+
+
+def dense_chunks(
+    X: np.ndarray,
+    labels: np.ndarray,
+    chunk_rows: int,
+    offsets: np.ndarray | None = None,
+    weights: np.ndarray | None = None,
+) -> list[dict]:
+    n = X.shape[0]
+    return chunk_batch(
+        {
+            "X": X,
+            "labels": labels,
+            "offsets": np.zeros(n, X.dtype) if offsets is None else offsets,
+            "weights": np.ones(n, X.dtype) if weights is None else weights,
+        },
+        chunk_rows,
+    )
+
+
+def sparse_chunks(
+    indices: np.ndarray,
+    values: np.ndarray,
+    labels: np.ndarray,
+    chunk_rows: int,
+    offsets: np.ndarray | None = None,
+    weights: np.ndarray | None = None,
+) -> list[dict]:
+    n = indices.shape[0]
+    return chunk_batch(
+        {
+            "indices": indices,
+            "values": values,
+            "labels": labels,
+            "offsets": np.zeros(n, values.dtype) if offsets is None else offsets,
+            "weights": np.ones(n, values.dtype) if weights is None else weights,
+        },
+        chunk_rows,
+    )
+
+
+def _to_batch(chunk: dict, num_features: int | None) -> Batch:
+    if "X" in chunk:
+        return DenseBatch(
+            X=chunk["X"], labels=chunk["labels"],
+            offsets=chunk["offsets"], weights=chunk["weights"],
+        )
+    return SparseBatch(
+        indices=chunk["indices"], values=chunk["values"], labels=chunk["labels"],
+        offsets=chunk["offsets"], weights=chunk["weights"],
+        num_features=num_features,
+    )
+
+
+def fits_in_memory(num_rows: int, num_features: int, itemsize: int = 4,
+                   hbm_budget_bytes: float = 8e9) -> bool:
+    """Decision rule between the device-resident fast path and streaming."""
+    return num_rows * num_features * itemsize <= hbm_budget_bytes
+
+
+@dataclass
+class StreamingGLMObjective:
+    """GLM objective over host-resident chunks (uniform shapes).
+
+    Exposes the same ``value`` / ``value_and_grad`` contract as
+    ``GLMObjective``, so ``host_lbfgs_minimize`` (or any host-driven
+    optimizer) consumes it directly. Per-chunk math reuses ``GLMObjective``
+    with the L2 term stripped (added once at the end); per-chunk
+    normalization-space gradients sum correctly because
+    ``grad_to_model_space`` is linear in its (g_raw, r_sum) inputs.
+    """
+
+    chunks: Sequence[dict]  # host numpy chunk dicts (uniform shapes)
+    loss: PointwiseLoss
+    num_features: int
+    l2_weight: float = 0.0
+    intercept_index: int | None = None
+    norm: NormalizationContext | None = None
+
+    def __post_init__(self):
+        if not self.chunks:
+            raise ValueError("streaming objective needs at least one chunk")
+        proto = make_objective(
+            _to_batch(self.chunks[0], self.num_features),
+            self.loss,
+            l2_weight=0.0,
+            norm=self.norm,
+            intercept_index=self.intercept_index,
+        )
+        self._reg_mask = proto.reg_mask
+
+        def chunk_value_grad(batch: Batch, w: Array):
+            obj = make_objective(
+                batch, self.loss, l2_weight=0.0, norm=self.norm,
+                intercept_index=self.intercept_index,
+            )
+            return obj.value_and_grad(w)
+
+        def chunk_value(batch: Batch, w: Array):
+            obj = make_objective(
+                batch, self.loss, l2_weight=0.0, norm=self.norm,
+                intercept_index=self.intercept_index,
+            )
+            return obj.value(w)
+
+        # ONE compiled kernel per contract, re-entered for every chunk
+        self._chunk_vg = jax.jit(chunk_value_grad)
+        self._chunk_v = jax.jit(chunk_value)
+
+    def _stream(self, w: Array, kernel: Callable, accumulate: Callable, init):
+        """Double-buffered host→device chunk pipeline: the NEXT chunk's
+        transfer is issued before the CURRENT chunk's compute result is
+        consumed, so DMA overlaps compute (async dispatch)."""
+        w = jnp.asarray(w)
+        acc = init
+        nxt = jax.device_put(self.chunks[0])
+        for i in range(len(self.chunks)):
+            cur = nxt
+            if i + 1 < len(self.chunks):
+                nxt = jax.device_put(self.chunks[i + 1])
+            out = kernel(_to_batch(cur, self.num_features), w)
+            acc = accumulate(acc, out)
+        return acc
+
+    def _l2_term(self, w: Array) -> Array:
+        return 0.5 * self.l2_weight * jnp.sum(self._reg_mask * w * w)
+
+    def value(self, w: Array) -> Array:
+        total = self._stream(
+            w, self._chunk_v, lambda acc, v: acc + v, jnp.float32(0.0)
+        )
+        return total + self._l2_term(jnp.asarray(w))
+
+    def value_and_grad(self, w: Array) -> tuple[Array, Array]:
+        w = jnp.asarray(w)
+        init = (jnp.float32(0.0), jnp.zeros((self.num_features,), jnp.float32))
+        v, g = self._stream(
+            w, self._chunk_vg,
+            lambda acc, out: (acc[0] + out[0], acc[1] + out[1]),
+            init,
+        )
+        g = g + jnp.float32(self.l2_weight) * self._reg_mask * w
+        return v + self._l2_term(w), g
+
+
+def stream_scores(
+    chunks: Sequence[dict],
+    w: np.ndarray,
+    num_rows: int,
+    num_features: int | None = None,
+) -> np.ndarray:
+    """Margins over all chunks (scoring an out-of-core dataset), trimmed to
+    the dataset's true ``num_rows`` (the last chunk is padded)."""
+    score = jax.jit(lambda b, w: b.matvec(w))
+    w = jnp.asarray(w)
+    outs = [np.asarray(score(_to_batch(c, num_features), w)) for c in chunks]
+    return np.concatenate(outs)[:num_rows]

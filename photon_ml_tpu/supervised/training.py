@@ -174,3 +174,113 @@ def train_glm(
     return GLMTrainingResult(
         models=models, trackers=trackers, validation=validation, best_weight=best_weight
     )
+
+
+def train_glm_streamed(
+    chunks: Sequence[dict],
+    task: TaskType,
+    num_features: int,
+    optimizer_config: OptimizerConfig | None = None,
+    regularization: RegularizationContext | None = None,
+    regularization_weights: Sequence[float] = (0.0,),
+    intercept_index: int | None = None,
+    validation_chunks: Sequence[dict] | None = None,
+    evaluators: Sequence[str] = (),
+    initial_model: GeneralizedLinearModel | None = None,
+) -> GLMTrainingResult:
+    """Out-of-core twin of ``train_glm``: the same ascending-λ warm-started
+    sweep, driven by host L-BFGS over a ``StreamingGLMObjective`` (one
+    streamed pass per value+gradient evaluation — the reference's Spark
+    aggregation pattern; SURVEY.md §7 "Streaming 1B rows").
+
+    ``chunks`` are uniform host chunk dicts (``photon_ml_tpu.ops.streaming``
+    builders or ``AvroDataReader.iter_batch_chunks``). Validation scores
+    stream chunk-by-chunk; padded rows carry weight 0, which every
+    evaluator treats as absent. L1 (OWL-QN) and TRON are not offered on
+    this path — the streamed optimizer is L-BFGS.
+    """
+    from photon_ml_tpu.ops.streaming import StreamingGLMObjective, stream_scores
+    from photon_ml_tpu.optim.host_lbfgs import host_lbfgs_minimize
+    from photon_ml_tpu.types import RegularizationType
+
+    optimizer_config = optimizer_config or OptimizerConfig()
+    has_weights = any(w > 0 for w in regularization_weights)
+    if regularization is None:
+        # same default as train_glm: nonzero weights imply plain L2
+        regularization = RegularizationContext(
+            RegularizationType.L2 if has_weights else RegularizationType.NONE
+        )
+    if regularization.l1_weight(1.0) > 0:
+        raise NotImplementedError(
+            "L1/elastic-net is not supported on the streaming path (host "
+            "L-BFGS only); use the in-memory trainer or L2"
+        )
+    if regularization.regularization_type is RegularizationType.NONE and has_weights:
+        raise ValueError(
+            "regularization_weights > 0 with RegularizationType.NONE would be "
+            "silently ignored; pass an L2 context or drop the weights"
+        )
+    loss = loss_for_task(task)
+    w = (
+        np.asarray(initial_model.coefficients.means, np.float32)
+        if initial_model is not None
+        else np.zeros((num_features,), np.float32)
+    )
+
+    specs = list(evaluators)
+    if validation_chunks is not None and not specs:
+        specs = {
+            TaskType.LOGISTIC_REGRESSION: ["AUC"],
+            TaskType.LINEAR_REGRESSION: ["RMSE"],
+            TaskType.POISSON_REGRESSION: ["POISSON_LOSS"],
+            TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: ["AUC"],
+        }[task]
+    primary = make_evaluator(specs[0]) if specs else None
+
+    val_labels = val_weights = val_offsets = None
+    if validation_chunks is not None:
+        val_labels = np.concatenate([c["labels"] for c in validation_chunks])
+        val_weights = np.concatenate([c["weights"] for c in validation_chunks])
+        val_offsets = np.concatenate([c["offsets"] for c in validation_chunks])
+
+    models: dict[float, GeneralizedLinearModel] = {}
+    trackers: dict[float, OptimizationResult] = {}
+    validation: dict[float, EvaluationResults] = {}
+    best_weight: float | None = None
+    best_value = float("nan")
+
+    # ONE objective for the whole sweep: its per-chunk kernels are built
+    # λ-free (λ applied outside the jit), so mutating l2_weight between λs
+    # re-enters the same compiled programs — no recompilation across the grid
+    sobj = StreamingGLMObjective(
+        chunks, loss, num_features=num_features, l2_weight=0.0,
+        intercept_index=intercept_index,
+    )
+    for lam in sorted(regularization_weights):
+        sobj.l2_weight = float(regularization.l2_weight(lam))
+        result = host_lbfgs_minimize(sobj, w, optimizer_config)
+        w = np.asarray(result.w)  # warm start the next λ
+        model = GeneralizedLinearModel(Coefficients(result.w, None), task)
+        models[lam] = model
+        trackers[lam] = result
+
+        if validation_chunks is not None and specs:
+            n_val = len(val_labels)
+            margins = stream_scores(
+                validation_chunks, w, num_rows=n_val, num_features=num_features
+            )
+            res = evaluate_all(
+                specs,
+                jnp.asarray(margins + val_offsets),
+                jnp.asarray(val_labels),
+                jnp.asarray(val_weights),
+            )
+            validation[lam] = res
+            if primary is not None and (
+                best_weight is None or primary.better(res.primary, best_value)
+            ):
+                best_weight, best_value = lam, res.primary
+
+    return GLMTrainingResult(
+        models=models, trackers=trackers, validation=validation, best_weight=best_weight
+    )

@@ -1,0 +1,228 @@
+"""Out-of-core training tests: streamed chunk objectives must match the
+in-memory objective exactly; host-driven L-BFGS on chunks must reach the
+same optimum as the device-resident loop on the whole batch; the chunked
+Avro reader must reproduce ``AvroDataReader.read``."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.config import FeatureShardConfig, OptimizerConfig
+from photon_ml_tpu.io import TRAINING_EXAMPLE_SCHEMA, write_avro_file
+from photon_ml_tpu.io.data_reader import AvroDataReader
+from photon_ml_tpu.ops.batch import dense_batch_from_numpy, SparseBatch
+from photon_ml_tpu.ops.glm import make_objective
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.ops.streaming import (
+    StreamingGLMObjective,
+    dense_chunks,
+    fits_in_memory,
+    sparse_chunks,
+    stream_scores,
+)
+from photon_ml_tpu.optim import lbfgs_minimize
+from photon_ml_tpu.optim.host_lbfgs import host_lbfgs_minimize
+from photon_ml_tpu.types import TaskType
+
+LOSS = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+
+
+def _dense_problem(rng, n=500, d=8):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    X[:, d - 1] = 1.0
+    w_true = (rng.normal(size=d) * 0.5).astype(np.float32)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-X @ w_true))).astype(np.float32)
+    return X, y
+
+
+class TestStreamingObjective:
+    def test_dense_matches_in_memory(self, rng):
+        X, y = _dense_problem(rng)
+        batch = dense_batch_from_numpy(X, y)
+        obj = make_objective(batch, LOSS, l2_weight=0.7, intercept_index=7)
+        chunks = dense_chunks(X, y, chunk_rows=128)  # 500 rows → 4 chunks, last padded
+        assert len(chunks) == 4
+        sobj = StreamingGLMObjective(
+            chunks, LOSS, num_features=8, l2_weight=0.7, intercept_index=7
+        )
+        w = jnp.asarray(rng.normal(size=8), jnp.float32)
+        v1, g1 = obj.value_and_grad(w)
+        v2, g2 = sobj.value_and_grad(w)
+        np.testing.assert_allclose(float(v1), float(v2), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(float(obj.value(w)), float(sobj.value(w)), rtol=1e-5)
+
+    def test_sparse_matches_in_memory(self, rng):
+        n, d, k = 300, 50, 5
+        idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        val = rng.normal(size=(n, k)).astype(np.float32)
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        batch = SparseBatch(
+            indices=jnp.asarray(idx), values=jnp.asarray(val),
+            labels=jnp.asarray(y), offsets=jnp.zeros(n), weights=jnp.ones(n),
+            num_features=d,
+        )
+        obj = make_objective(batch, LOSS, l2_weight=0.3)
+        chunks = sparse_chunks(idx, val, y, chunk_rows=97)
+        sobj = StreamingGLMObjective(chunks, LOSS, num_features=d, l2_weight=0.3)
+        w = jnp.asarray(rng.normal(size=d), jnp.float32)
+        v1, g1 = obj.value_and_grad(w)
+        v2, g2 = sobj.value_and_grad(w)
+        np.testing.assert_allclose(float(v1), float(v2), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4, atol=1e-4)
+
+    def test_stream_scores_match(self, rng):
+        X, y = _dense_problem(rng, n=250)
+        chunks = dense_chunks(X, y, chunk_rows=64)
+        w = rng.normal(size=8).astype(np.float32)
+        np.testing.assert_allclose(
+            stream_scores(chunks, w, num_rows=250),
+            X @ w, rtol=1e-4, atol=1e-4,
+        )
+
+    def test_fits_in_memory_rule(self):
+        assert fits_in_memory(1 << 20, 512)
+        assert not fits_in_memory(1 << 30, 512)
+
+
+class TestHostLBFGS:
+    def test_matches_device_lbfgs(self, rng):
+        X, y = _dense_problem(rng, n=600)
+        batch = dense_batch_from_numpy(X, y)
+        cfg = OptimizerConfig(max_iterations=100, tolerance=1e-8)
+        obj = make_objective(batch, LOSS, l2_weight=1.0, intercept_index=7)
+        dev = lbfgs_minimize(obj, jnp.zeros(8), cfg)
+
+        chunks = dense_chunks(X, y, chunk_rows=200)
+        sobj = StreamingGLMObjective(
+            chunks, LOSS, num_features=8, l2_weight=1.0, intercept_index=7
+        )
+        host = host_lbfgs_minimize(sobj, np.zeros(8), cfg)
+        # same optimum (both converge tightly on a strongly convex problem)
+        np.testing.assert_allclose(
+            np.asarray(host.w), np.asarray(dev.w), rtol=1e-3, atol=1e-3
+        )
+        np.testing.assert_allclose(float(host.value), float(dev.value), rtol=1e-5)
+
+    def test_immediate_convergence_at_optimum(self, rng):
+        X, y = _dense_problem(rng, n=200)
+        cfg = OptimizerConfig(max_iterations=50, tolerance=1e-6)
+        chunks = dense_chunks(X, y, chunk_rows=200)
+        sobj = StreamingGLMObjective(
+            chunks, LOSS, num_features=8, l2_weight=1.0, intercept_index=7
+        )
+        first = host_lbfgs_minimize(sobj, np.zeros(8), cfg)
+        again = host_lbfgs_minimize(sobj, np.asarray(first.w), cfg)
+        assert int(again.iterations) <= 2
+
+
+class TestStreamedGLMDriver:
+    def test_streamed_cli_matches_in_memory(self, tmp_path, rng):
+        """The --streaming-chunk-rows CLI branch must train to the same
+        model as the in-memory branch on the same avro data."""
+        import io as _io
+
+        from photon_ml_tpu.cli import train_glm as cli
+        from photon_ml_tpu.io.model_io import load_glm
+        from photon_ml_tpu.types import RegularizationType
+        from photon_ml_tpu.utils import PhotonLogger
+
+        path = str(tmp_path / "train.avro")
+        TestChunkedAvroReader()._write(path, rng, n=240)
+        quiet = lambda: PhotonLogger(None, stream=_io.StringIO())
+
+        cli.run(
+            TaskType.LOGISTIC_REGRESSION, [path], str(tmp_path / "mem"),
+            data_format="avro", weights=[1.0], max_iterations=80,
+            tolerance=1e-8, logger=quiet(),
+        )
+        cli.run(
+            TaskType.LOGISTIC_REGRESSION, [path], str(tmp_path / "str"),
+            data_format="avro", weights=[1.0], max_iterations=80,
+            tolerance=1e-8, streaming_chunk_rows=64, logger=quiet(),
+        )
+        from photon_ml_tpu.io import read_avro_file
+
+        def coeffs(p):
+            _, recs = read_avro_file(p)
+            return {
+                (r["name"], r["term"]): r["value"] for r in recs[0]["means"]
+            }
+
+        a = coeffs(str(tmp_path / "mem" / "best" / "model.avro"))
+        b = coeffs(str(tmp_path / "str" / "best" / "model.avro"))
+        assert set(a) == set(b)
+        for key in a:
+            np.testing.assert_allclose(a[key], b[key], rtol=1e-2, atol=1e-3)
+        with open(tmp_path / "str" / "_stage") as f:
+            assert f.read() == "VALIDATED"
+
+
+class TestChunkedAvroReader:
+    def _write(self, path, rng, n):
+        recs = []
+        for i in range(n):
+            feats = [
+                {"name": "g", "term": str(j), "value": float(rng.normal())}
+                for j in range(3)
+            ]
+            recs.append(
+                {
+                    "uid": f"s{i}",
+                    "response": float(rng.integers(0, 2)),
+                    "offset": None,
+                    "weight": 2.0 if i % 3 == 0 else None,
+                    "features": feats,
+                    "metadataMap": {},
+                }
+            )
+        schema = json.loads(json.dumps(TRAINING_EXAMPLE_SCHEMA))
+        write_avro_file(path, schema, recs)
+
+    def test_chunks_match_full_read(self, tmp_path, rng):
+        path = str(tmp_path / "data.avro")
+        self._write(path, rng, n=103)
+        reader = AvroDataReader(
+            {"global": FeatureShardConfig(feature_bags=("features",), has_intercept=True)}
+        )
+        ds = reader.read(path)
+        chunks = list(
+            reader.iter_batch_chunks(
+                path, "global", chunk_rows=40, index_maps=ds.index_maps
+            )
+        )
+        assert len(chunks) == 3
+        assert all(c["labels"].shape == (40,) for c in chunks)
+        # padded tail rows have weight 0
+        assert np.all(chunks[-1]["weights"][23:] == 0.0)
+
+        full = ds.batch.batch_for("global")
+        X_full = np.asarray(full.X)
+        X_stream = np.concatenate([c["X"] for c in chunks])[:103]
+        np.testing.assert_allclose(X_stream, X_full, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.concatenate([c["labels"] for c in chunks])[:103],
+            np.asarray(ds.batch.labels), rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.concatenate([c["weights"] for c in chunks])[:103],
+            np.asarray(ds.batch.weights), rtol=1e-6,
+        )
+
+        # streamed training on the chunks matches in-memory training
+        cfg = OptimizerConfig(max_iterations=60, tolerance=1e-8)
+        obj = make_objective(
+            full, LOSS, l2_weight=1.0,
+            intercept_index=ds.index_maps["global"].intercept_index,
+        )
+        dev = lbfgs_minimize(obj, jnp.zeros(full.num_features), cfg)
+        sobj = StreamingGLMObjective(
+            chunks, LOSS, num_features=full.num_features, l2_weight=1.0,
+            intercept_index=ds.index_maps["global"].intercept_index,
+        )
+        host = host_lbfgs_minimize(sobj, np.zeros(full.num_features), cfg)
+        np.testing.assert_allclose(
+            np.asarray(host.w), np.asarray(dev.w), rtol=1e-3, atol=1e-3
+        )
