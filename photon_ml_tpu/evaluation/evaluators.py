@@ -11,9 +11,13 @@ and average the per-group metric: ``MultiAUCEvaluator``,
 
 Design: scalar metrics are device-side jnp (AUC uses a sort-based exact
 rank-sum with average ranks for ties — one sort, two searchsorts, all
-XLA-friendly). Per-entity multi metrics are vectorized host numpy over
-segment boundaries (evaluation runs once per coordinate-descent iteration;
-the reference also runs these as separate Spark jobs off the hot path).
+XLA-friendly). Per-entity multi metrics run on device via the segment-sum
+implementations in ``evaluation.scalable`` (group ids densified on host
+first); the host-numpy versions below (``grouped_auc``,
+``grouped_precision_at_k``) are kept as the reference implementations the
+device path is tested against. ``BUCKETED_AUC`` offers a sort-free O(n)
+histogram AUC for very large score vectors (tolerance documented in
+``evaluation.scalable``).
 """
 
 from __future__ import annotations
@@ -146,7 +150,10 @@ def grouped_precision_at_k(
 @dataclass(frozen=True)
 class Evaluator:
     """Named metric. ``group_by`` set ⇒ a multi-evaluator needing the GAME
-    id tag of that name. ``larger_is_better`` drives model selection."""
+    id tag of that name; its ``_fn`` receives ``(scores, labels,
+    dense_group_ids, num_groups)``. Scalar evaluators' ``_fn`` receives
+    ``(scores, labels, weights)``. ``larger_is_better`` drives model
+    selection."""
 
     name: str
     larger_is_better: bool
@@ -166,10 +173,21 @@ class Evaluator:
                 raise KeyError(
                     f"evaluator {self.name} needs id tag {self.group_by!r}"
                 )
-            gids = np.asarray(group_ids[self.group_by])
-            if self.k is not None:
-                return self._fn(np.asarray(scores), np.asarray(labels), gids, self.k)
-            return self._fn(np.asarray(scores), np.asarray(labels), gids)
+            # Densify ids first: arbitrary (sparse, negative, even string)
+            # ids become contiguous [0, G) — every distinct id is a group,
+            # exactly the host-lexsort semantics, and the device segment
+            # reductions size by G, not by max(id).
+            gids_host = np.asarray(group_ids[self.group_by])
+            uniq, dense = np.unique(gids_host, return_inverse=True)
+            num_groups = max(len(uniq), 1)
+            return float(
+                self._fn(
+                    jnp.asarray(scores),
+                    jnp.asarray(labels),
+                    jnp.asarray(dense.astype(np.int32)),
+                    num_groups,
+                )
+            )
         return float(self._fn(scores, labels, weights))
 
     def better(self, a: float, b: float) -> bool:
@@ -196,25 +214,51 @@ def make_evaluator(spec: str) -> Evaluator:
 
     Forms: "AUC" | "RMSE" | "LOGISTIC_LOSS" | "POISSON_LOSS" |
     "SQUARED_LOSS" | "SMOOTHED_HINGE_LOSS" | "MULTI_AUC(idTag)" |
-    "PRECISION_AT_K(k,idTag)".
+    "PRECISION_AT_K(k,idTag)" | "BUCKETED_AUC" | "BUCKETED_AUC(numBuckets)"
+    (the sort-free O(n) histogram AUC for very large score vectors;
+    tolerance documented in ``evaluation.scalable``).
     """
     spec = spec.strip()
     if spec.upper() in _SCALAR_EVALUATORS:
         fn, lib = _SCALAR_EVALUATORS[spec.upper()]
         return Evaluator(name=spec.upper(), larger_is_better=lib, _fn=fn)
+    m = re.fullmatch(r"BUCKETED_AUC(?:\((\d+)\))?", spec, re.IGNORECASE)
+    if m:
+        from photon_ml_tpu.evaluation.scalable import bucketed_auc
+
+        buckets = int(m.group(1)) if m.group(1) else 1 << 16
+        if buckets < 1:
+            raise ValueError(f"{spec!r}: bucket count must be >= 1")
+        return Evaluator(
+            name=spec.upper(),
+            larger_is_better=True,
+            _fn=lambda s, y, w=None: bucketed_auc(s, y, w, num_buckets=buckets),
+        )
     m = re.fullmatch(r"MULTI_AUC\((\w+)\)", spec, re.IGNORECASE)
     if m:
-        return Evaluator(
-            name=spec, larger_is_better=True, _fn=grouped_auc, group_by=m.group(1)
-        )
-    m = re.fullmatch(r"PRECISION_AT_K\((\d+)\s*,\s*(\w+)\)", spec, re.IGNORECASE)
-    if m:
+        from photon_ml_tpu.evaluation.scalable import grouped_auc_device
+
         return Evaluator(
             name=spec,
             larger_is_better=True,
-            _fn=grouped_precision_at_k,
+            _fn=grouped_auc_device,
+            group_by=m.group(1),
+        )
+    m = re.fullmatch(r"PRECISION_AT_K\((\d+)\s*,\s*(\w+)\)", spec, re.IGNORECASE)
+    if m:
+        from photon_ml_tpu.evaluation.scalable import (
+            grouped_precision_at_k_device,
+        )
+
+        k = int(m.group(1))
+        return Evaluator(
+            name=spec,
+            larger_is_better=True,
+            _fn=lambda s, y, g, num_groups: grouped_precision_at_k_device(
+                s, y, g, k, num_groups
+            ),
             group_by=m.group(2),
-            k=int(m.group(1)),
+            k=k,
         )
     raise ValueError(f"unknown evaluator spec: {spec!r}")
 

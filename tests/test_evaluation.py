@@ -103,3 +103,85 @@ def test_evaluate_all_with_groups(rng):
     assert res.primary == res.metrics["AUC"]
     assert make_evaluator("AUC").better(0.9, 0.5)
     assert make_evaluator("RMSE").better(0.1, 0.5)
+
+
+# --------------------------------------------------------------------------
+# scalable device-side evaluators
+# --------------------------------------------------------------------------
+class TestScalableEvaluators:
+    def test_bucketed_auc_close_to_exact(self, rng):
+        from photon_ml_tpu.evaluation.scalable import bucketed_auc
+
+        scores = rng.normal(size=20000)
+        labels = (rng.uniform(size=20000) < 0.3).astype(float)
+        exact = float(auc_roc(scores, labels))
+        approx = float(bucketed_auc(scores, labels))
+        assert abs(exact - approx) < 1e-3
+
+    def test_bucketed_auc_exact_on_quantized_scores(self, rng):
+        from photon_ml_tpu.evaluation.scalable import bucketed_auc
+
+        # 64 distinct score values, 256 buckets: every bucket holds one
+        # distinct score → the histogram statistic is EXACT incl. ties
+        scores = rng.integers(0, 64, size=5000).astype(float)
+        labels = (rng.uniform(size=5000) < 0.4).astype(float)
+        exact = float(auc_roc(scores, labels))
+        approx = float(bucketed_auc(scores, labels, num_buckets=256))
+        np.testing.assert_allclose(approx, exact, rtol=1e-6)
+
+    def test_bucketed_auc_weight_selection(self, rng):
+        from photon_ml_tpu.evaluation.scalable import bucketed_auc
+
+        scores = rng.normal(size=1000)
+        labels = (rng.uniform(size=1000) < 0.5).astype(float)
+        w = (rng.uniform(size=1000) < 0.7).astype(float)
+        kept = w > 0
+        expect = float(auc_roc(scores[kept], labels[kept]))
+        got = float(bucketed_auc(scores, labels, w))
+        assert abs(expect - got) < 2e-3
+
+    def test_grouped_auc_device_matches_host(self, rng):
+        from photon_ml_tpu.evaluation.scalable import grouped_auc_device
+
+        n, G = 3000, 25
+        scores = rng.normal(size=n)
+        # force ties within and across groups
+        scores = np.round(scores, 1)
+        labels = (rng.uniform(size=n) < 0.4).astype(float)
+        gids = rng.integers(0, G, size=n).astype(np.int32)
+        host = grouped_auc(scores, labels, gids)
+        dev = float(grouped_auc_device(scores, labels, gids, G))
+        np.testing.assert_allclose(dev, host, rtol=1e-9)
+
+    def test_grouped_precision_device_matches_host(self, rng):
+        from photon_ml_tpu.evaluation.scalable import (
+            grouped_precision_at_k_device,
+        )
+
+        n, G, k = 2000, 17, 5
+        scores = rng.normal(size=n)
+        labels = (rng.uniform(size=n) < 0.4).astype(float)
+        gids = rng.integers(0, G, size=n).astype(np.int32)
+        host = grouped_precision_at_k(scores, labels, gids, k)
+        dev = float(grouped_precision_at_k_device(scores, labels, gids, k, G))
+        np.testing.assert_allclose(dev, host, rtol=1e-6)  # device math is f32
+
+    def test_multi_evaluator_uses_device_path_with_unseen_entities(self, rng):
+        """MULTI_AUC through the registry (device path) must match the host
+        implementation, including id -1 (unseen entity) forming a group."""
+        n = 800
+        scores = rng.normal(size=n)
+        labels = (rng.uniform(size=n) < 0.4).astype(float)
+        gids = rng.integers(-1, 6, size=n).astype(np.int32)  # includes -1
+        ev = make_evaluator("MULTI_AUC(userId)")
+        got = ev(scores, labels, group_ids={"userId": gids})
+        expect = grouped_auc(scores, labels, gids)
+        np.testing.assert_allclose(got, expect, rtol=1e-9)
+
+    def test_bucketed_auc_registry_spec(self, rng):
+        scores = rng.normal(size=500)
+        labels = (rng.uniform(size=500) < 0.5).astype(float)
+        ev = make_evaluator("BUCKETED_AUC(4096)")
+        assert ev.larger_is_better
+        got = ev(scores, labels)
+        assert abs(got - float(auc_roc(scores, labels))) < 5e-3
