@@ -134,43 +134,49 @@ def run(
     best = estimator.select_best(results)
     logger.info(f"selected configuration: { {c: o.regularization_weight for c, o in best.configuration.items()} }")
 
-    with timed(logger, "write models"):
-        entity_names = train.entity_names()
-        by_cid = {
-            cid: entity_names[cfg.random_effect_type]
-            for cid, cfg in config.random_effect_coordinates.items()
-        }
-        save_game_model(
-            best.model,
-            os.path.join(output_dir, "best"),
-            index_maps=train.index_maps,
-            entity_names=by_cid,
-        )
-        if config.output_mode is ModelOutputMode.ALL:
-            for i, r in enumerate(results):
-                save_game_model(
-                    r.model,
-                    os.path.join(output_dir, "models", f"{i:04d}"),
-                    index_maps=train.index_maps,
-                    entity_names=by_cid,
-                )
-        _save_maps(output_dir, train)
+    # every process computes; exactly ONE writes the shared outputs —
+    # concurrent writers to the same shared-storage paths corrupt files
+    from photon_ml_tpu.parallel.multihost import is_output_process, sync_processes
 
-    metrics = {
-        "results": [
-            {
-                "configuration": {
-                    cid: opt.to_dict() for cid, opt in r.configuration.items()
-                },
-                "metrics": dict(r.evaluation.metrics) if r.evaluation else None,
+    if is_output_process():
+        with timed(logger, "write models"):
+            entity_names = train.entity_names()
+            by_cid = {
+                cid: entity_names[cfg.random_effect_type]
+                for cid, cfg in config.random_effect_coordinates.items()
             }
-            for r in results
-        ],
-        # identity, not ==: GameResult holds device arrays (ambiguous __eq__)
-        "best_index": next(i for i, r in enumerate(results) if r is best),
-    }
-    with open(os.path.join(output_dir, "metrics.json"), "w") as f:
-        json.dump(metrics, f, indent=2)
+            save_game_model(
+                best.model,
+                os.path.join(output_dir, "best"),
+                index_maps=train.index_maps,
+                entity_names=by_cid,
+            )
+            if config.output_mode is ModelOutputMode.ALL:
+                for i, r in enumerate(results):
+                    save_game_model(
+                        r.model,
+                        os.path.join(output_dir, "models", f"{i:04d}"),
+                        index_maps=train.index_maps,
+                        entity_names=by_cid,
+                    )
+            _save_maps(output_dir, train)
+
+        metrics = {
+            "results": [
+                {
+                    "configuration": {
+                        cid: opt.to_dict() for cid, opt in r.configuration.items()
+                    },
+                    "metrics": dict(r.evaluation.metrics) if r.evaluation else None,
+                }
+                for r in results
+            ],
+            # identity, not ==: GameResult holds device arrays (ambiguous __eq__)
+            "best_index": next(i for i, r in enumerate(results) if r is best),
+        }
+        with open(os.path.join(output_dir, "metrics.json"), "w") as f:
+            json.dump(metrics, f, indent=2)
+    sync_processes("train-outputs-written")
     return best
 
 
@@ -233,11 +239,36 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--train-data", required=True, nargs="+")
     p.add_argument("--validation-data", nargs="*", default=None)
     p.add_argument("--index-maps", default=None, help="FeatureIndexingDriver output dir")
+    p.add_argument(
+        "--multihost", action="store_true",
+        help="join the jax.distributed runtime (coordinator from "
+             "JAX_COORDINATOR_ADDRESS / TPU-pod autodetection; run the SAME "
+             "command on every host) and train over the global device mesh",
+    )
     p.add_argument("--output-dir", required=True)
     args = p.parse_args(argv)
 
     config = load_training_config(args.config)
-    logger = PhotonLogger(args.output_dir)
+    mesh = None
+    if args.multihost:
+        # GAME ingest reads are replicated across hosts (the feature/entity
+        # dictionaries need the global view — the reference gets this from
+        # the Spark shuffle); COMPUTE is sharded over the global mesh. The
+        # per-host-IO path is the streaming GLM driver (train_glm
+        # --multihost, which shards input files across hosts).
+        from photon_ml_tpu.parallel import data_mesh
+        from photon_ml_tpu.parallel.multihost import (
+            initialize_multihost,
+            is_output_process,
+        )
+
+        info = initialize_multihost()
+        # one process owns the shared log file; the rest log to stderr
+        logger = PhotonLogger(args.output_dir if is_output_process() else None)
+        logger.info(f"multihost runtime: {info}")
+        mesh = data_mesh()
+    else:
+        logger = PhotonLogger(args.output_dir)
     run(
         config,
         args.train_data,
@@ -245,6 +276,7 @@ def main(argv: list[str] | None = None) -> None:
         validation_data=args.validation_data,
         index_map_dir=args.index_maps,
         logger=logger,
+        mesh=mesh,
     )
 
 

@@ -75,8 +75,15 @@ def run(
     variance_computation: VarianceComputationType = VarianceComputationType.NONE,
     validate: DataValidationType = DataValidationType.VALIDATE_DISABLED,
     streaming_chunk_rows: int | None = None,
+    multihost: bool = False,
     logger: PhotonLogger | None = None,
 ):
+    if multihost and streaming_chunk_rows is None:
+        raise ValueError(
+            "--multihost requires --streaming-chunk-rows (per-host sharded "
+            "ingest exists on the streaming path; in-memory multihost GLM "
+            "training goes through the GAME driver's --multihost)"
+        )
     logger = logger or PhotonLogger(output_dir)
     stage_file = os.path.join(output_dir, "_stage")
 
@@ -107,7 +114,7 @@ def run(
         return _run_streamed(
             task, train_data, output_dir, data_format, validation_data,
             regularization, weights, max_iterations, tolerance,
-            streaming_chunk_rows, advance, logger,
+            streaming_chunk_rows, advance, logger, multihost=multihost,
         )
 
     advance("INIT")
@@ -212,40 +219,79 @@ def run(
     return result
 
 
+def _expand_avro_paths(paths: list[str]) -> list[str]:
+    """Directories become their sorted ``*.avro`` part files, so per-host
+    path sharding distributes FILES, not whole directories."""
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(
+                os.path.join(p, n)
+                for n in sorted(os.listdir(p))
+                if n.endswith(".avro") and not n.startswith(".")
+            )
+        else:
+            out.append(p)
+    return out
+
+
 def _run_streamed(
     task, train_data, output_dir, data_format, validation_data,
     regularization, weights, max_iterations, tolerance,
-    chunk_rows, advance, logger,
+    chunk_rows, advance, logger, multihost: bool = False,
 ):
     """Out-of-core branch: data is read in uniform chunks that live in host
     RAM and stream through the device per optimizer iteration (SURVEY.md §7
     "Streaming 1B rows"). Avro input only — LIBSVM fits in memory whenever
-    its text fits."""
+    its text fits.
+
+    Multi-host: the stats pass (index maps + max nnz) covers ALL files so
+    every host agrees on the feature space; each host then fills chunks
+    only from ITS slice of the part files, and the streaming objective sums
+    partial (value, gradient) across processes per evaluation. Validation
+    files are read replicated so metrics are global and identical on every
+    host. Only process 0 writes outputs.
+    """
     if data_format != "avro":
         raise ValueError("--streaming-chunk-rows requires --format avro")
     from photon_ml_tpu.supervised.training import train_glm_streamed
+    from photon_ml_tpu.parallel.multihost import is_output_process, sync_processes
 
     reader = AvroDataReader()
     sid = next(iter(reader.feature_shards))
-    advance("INIT")
-    with timed(logger, "index maps (streaming pass)"):
-        index_maps, max_nnz = reader.streaming_ingest_stats(train_data)
+    writer = is_output_process()
+
+    def advance_once(stage):
+        if writer:
+            advance(stage)
+
+    train_paths = _expand_avro_paths(train_data)
+    local_paths = train_paths
+    if multihost:
+        from photon_ml_tpu.parallel.multihost import host_shard_of_paths
+
+        local_paths = host_shard_of_paths(train_paths)
+        logger.info(f"this host reads {len(local_paths)}/{len(train_paths)} files")
+
+    advance_once("INIT")
+    with timed(logger, "index maps (streaming pass, all files)"):
+        index_maps, max_nnz = reader.streaming_ingest_stats(train_paths)
     imap = index_maps[sid]
-    with timed(logger, "chunk training data"):
+    with timed(logger, "chunk training data (this host's files)"):
         chunks = list(
             reader.iter_batch_chunks(
-                train_data, sid, chunk_rows, index_maps, max_nnz=max_nnz[sid]
+                local_paths, sid, chunk_rows, index_maps, max_nnz=max_nnz[sid]
             )
-        )
+        ) if local_paths else []
     logger.info(f"{len(chunks)} training chunks of {chunk_rows} rows")
-    advance("PROCESSED")
+    advance_once("PROCESSED")
 
     val_chunks = None
     if validation_data:
         with timed(logger, "chunk validation data"):
             val_chunks = list(
                 reader.iter_batch_chunks(
-                    validation_data, sid, chunk_rows, index_maps
+                    _expand_avro_paths(validation_data), sid, chunk_rows, index_maps
                 )
             )
 
@@ -261,35 +307,38 @@ def _run_streamed(
             regularization_weights=list(weights),
             intercept_index=imap.intercept_index,
             validation_chunks=val_chunks,
+            cross_process=multihost,
         )
-    advance("TRAINED")
+    advance_once("TRAINED")
 
-    with timed(logger, "write models"):
-        for lam, model in result.models.items():
+    if writer:
+        with timed(logger, "write models"):
+            for lam, model in result.models.items():
+                save_glm(
+                    model,
+                    os.path.join(output_dir, "models", f"lambda-{lam:g}", "model.avro"),
+                    index_map=imap,
+                    model_id=f"lambda-{lam:g}",
+                )
             save_glm(
-                model,
-                os.path.join(output_dir, "models", f"lambda-{lam:g}", "model.avro"),
+                result.best_model,
+                os.path.join(output_dir, "best", "model.avro"),
                 index_map=imap,
-                model_id=f"lambda-{lam:g}",
+                model_id="best",
             )
-        save_glm(
-            result.best_model,
-            os.path.join(output_dir, "best", "model.avro"),
-            index_map=imap,
-            model_id="best",
-        )
-    report = {
-        "task": task.value,
-        "streaming_chunk_rows": chunk_rows,
-        "weights": sorted(float(w) for w in weights),
-        "best_weight": result.best_weight,
-        "validation": {
-            str(lam): dict(ev.metrics) for lam, ev in result.validation.items()
-        },
-    }
-    with open(os.path.join(output_dir, "report.json"), "w") as f:
-        json.dump(report, f, indent=2)
-    advance("VALIDATED")
+        report = {
+            "task": task.value,
+            "streaming_chunk_rows": chunk_rows,
+            "weights": sorted(float(w) for w in weights),
+            "best_weight": result.best_weight,
+            "validation": {
+                str(lam): dict(ev.metrics) for lam, ev in result.validation.items()
+            },
+        }
+        with open(os.path.join(output_dir, "report.json"), "w") as f:
+            json.dump(report, f, indent=2)
+        advance("VALIDATED")
+    sync_processes("train-glm-outputs-written")
     return result
 
 
@@ -322,8 +371,18 @@ def main(argv: list[str] | None = None) -> None:
         help="out-of-core mode: stream avro data through the device in "
              "uniform chunks of this many rows (host-RAM resident)",
     )
+    p.add_argument(
+        "--multihost", action="store_true",
+        help="join the jax.distributed runtime and shard the input part "
+             "files across hosts (streaming mode only; run the SAME "
+             "command on every host)",
+    )
     p.add_argument("--output-dir", required=True)
     args = p.parse_args(argv)
+    if args.multihost:
+        from photon_ml_tpu.parallel.multihost import initialize_multihost
+
+        initialize_multihost()
     run(
         TaskType(args.task),
         args.train_data,
@@ -340,6 +399,7 @@ def main(argv: list[str] | None = None) -> None:
         variance_computation=VarianceComputationType(args.variance),
         validate=DataValidationType(args.validate),
         streaming_chunk_rows=args.streaming_chunk_rows,
+        multihost=args.multihost,
     )
 
 

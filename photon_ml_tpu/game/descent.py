@@ -29,6 +29,14 @@ from photon_ml_tpu.types import TaskType
 Array = jnp.ndarray
 
 
+def _is_output_process() -> bool:
+    """Multi-host: every process loads checkpoints (read-only); exactly one
+    writes them — concurrent writers to shared storage corrupt files."""
+    import jax
+
+    return jax.process_index() == 0
+
+
 @dataclass(frozen=True)
 class CoordinateDescentResult:
     model: GameModel
@@ -166,7 +174,7 @@ class CoordinateDescent:
                 else:
                     self._log(f"iter {it} coordinate {cid}: trained")
             validation_history.append(iter_validation)
-            if checkpoint_dir is not None:
+            if checkpoint_dir is not None and _is_output_process():
                 from photon_ml_tpu.checkpoint import save_checkpoint
 
                 save_checkpoint(

@@ -325,9 +325,9 @@ def train_prepared(
             W = W.at[ids].set(w_b[:k])
             if compute_variance:
                 V = V.at[ids].set(1.0 / jnp.maximum(var_b[:k], 1e-12))
-        loss_values[pb.entity_ids] = np.asarray(f_b[:k], np.float64)
-        iterations[pb.entity_ids] = np.asarray(it_b[:k])
-        converged[pb.entity_ids] = np.asarray(reason_b[:k]) != 0  # != MAX_ITERATIONS
+        loss_values[pb.entity_ids] = _to_host(f_b[:k]).astype(np.float64)
+        iterations[pb.entity_ids] = _to_host(it_b[:k])
+        converged[pb.entity_ids] = _to_host(reason_b[:k]) != 0  # != MAX_ITERATIONS
 
     return RandomEffectTrainingResult(
         coefficients=W,
@@ -336,6 +336,17 @@ def train_prepared(
         iterations=iterations,
         converged=converged,
     )
+
+
+def _to_host(x) -> np.ndarray:
+    """Host copy of a device array that may be sharded across PROCESSES
+    (multi-host): non-fully-addressable arrays are allgathered first —
+    per-entity diagnostics are tiny, so the collective is cheap."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
 
 
 def random_effect_scores(features: Features, entity_ids: Array, W: Array) -> Array:

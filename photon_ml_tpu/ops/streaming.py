@@ -139,18 +139,18 @@ class StreamingGLMObjective:
     l2_weight: float = 0.0
     intercept_index: int | None = None
     norm: NormalizationContext | None = None
+    # multi-host: sum partial (value, grad) across ALL processes per
+    # evaluation (each host streams only its own chunks — the treeAggregate
+    # analog). The L2 term is added once, AFTER the cross-process sum.
+    cross_process: bool = False
 
     def __post_init__(self):
-        if not self.chunks:
+        if not self.chunks and not self.cross_process:
             raise ValueError("streaming objective needs at least one chunk")
-        proto = make_objective(
-            _to_batch(self.chunks[0], self.num_features),
-            self.loss,
-            l2_weight=0.0,
-            norm=self.norm,
-            intercept_index=self.intercept_index,
-        )
-        self._reg_mask = proto.reg_mask
+        mask = jnp.ones((self.num_features,), jnp.float32)
+        if self.intercept_index is not None:
+            mask = mask.at[self.intercept_index].set(0.0)
+        self._reg_mask = mask
 
         def chunk_value_grad(batch: Batch, w: Array):
             obj = make_objective(
@@ -176,13 +176,14 @@ class StreamingGLMObjective:
         consumed, so DMA overlaps compute (async dispatch)."""
         w = jnp.asarray(w)
         acc = init
-        nxt = jax.device_put(self.chunks[0])
-        for i in range(len(self.chunks)):
-            cur = nxt
-            if i + 1 < len(self.chunks):
-                nxt = jax.device_put(self.chunks[i + 1])
-            out = kernel(_to_batch(cur, self.num_features), w)
-            acc = accumulate(acc, out)
+        if self.chunks:
+            nxt = jax.device_put(self.chunks[0])
+            for i in range(len(self.chunks)):
+                cur = nxt
+                if i + 1 < len(self.chunks):
+                    nxt = jax.device_put(self.chunks[i + 1])
+                out = kernel(_to_batch(cur, self.num_features), w)
+                acc = accumulate(acc, out)
         return acc
 
     def _l2_term(self, w: Array) -> Array:
@@ -192,6 +193,10 @@ class StreamingGLMObjective:
         total = self._stream(
             w, self._chunk_v, lambda acc, v: acc + v, jnp.float32(0.0)
         )
+        if self.cross_process:
+            from photon_ml_tpu.parallel.multihost import allreduce_sum_host
+
+            total = jnp.asarray(allreduce_sum_host(np.asarray(total)))
         return total + self._l2_term(jnp.asarray(w))
 
     def value_and_grad(self, w: Array) -> tuple[Array, Array]:
@@ -202,6 +207,11 @@ class StreamingGLMObjective:
             lambda acc, out: (acc[0] + out[0], acc[1] + out[1]),
             init,
         )
+        if self.cross_process:
+            from photon_ml_tpu.parallel.multihost import allreduce_sum_host
+
+            v, g = allreduce_sum_host(np.asarray(v), np.asarray(g))
+            v, g = jnp.asarray(v), jnp.asarray(g)
         g = g + jnp.float32(self.l2_weight) * self._reg_mask * w
         return v + self._l2_term(w), g
 

@@ -12,3 +12,9 @@ from photon_ml_tpu.parallel.distributed import (  # noqa: F401
     shard_batch,
     sharded_minimize,
 )
+from photon_ml_tpu.parallel.multihost import (  # noqa: F401
+    global_batch_from_host_shards,
+    host_shard_of_paths,
+    initialize_multihost,
+    shard_batch_multihost,
+)

@@ -1,0 +1,160 @@
+"""Multi-host runtime scaffolding.
+
+Reference parity: the reference scales out on a Spark cluster — a driver
+plus executors on many hosts, with the cluster manager handling membership
+and the shuffle service moving data (SURVEY.md §2.6 Spark-replacement
+table). The TPU-native replacement is ``jax.distributed``: every host runs
+the SAME program, ``jax.distributed.initialize`` wires the processes into
+one runtime, ``jax.devices()`` becomes the GLOBAL device list, and a mesh
+built over it spans the whole slice — XLA then routes collectives over
+ICI within a host/pod and DCN across pods. No driver, no shuffle: each
+host reads its own slice of the input (``host_shard_of_paths``) and
+assembles its rows into a globally-sharded array
+(``global_batch_from_host_shards``).
+
+Usage (same command on every host, e.g. under GKE/xmanager):
+
+    python -m photon_ml_tpu.cli.train ... --multihost
+
+with the coordinator address/process count/process id taken from the
+standard env vars (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``,
+``JAX_PROCESS_ID``) or auto-detected on TPU pods (GCE metadata).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> dict:
+    """Join this process into the multi-host runtime.
+
+    Arguments default to the standard env vars / TPU-pod auto-detection
+    (``jax.distributed.initialize`` semantics). Returns a summary dict
+    (process index/count, local/global device counts) for logging. Safe to
+    call on a single host only when explicit arguments or env vars are set;
+    plain single-host runs should simply not call this.
+    """
+    # resolve the standard env vars ourselves — jax.distributed auto-detects
+    # only inside known cluster environments (TPU pods, SLURM, …)
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (ValueError, RuntimeError) as e:
+        raise RuntimeError(
+            "multihost initialization failed — on non-auto-detected "
+            "clusters set JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES and "
+            "JAX_PROCESS_ID (or pass them explicitly); on a single host, "
+            f"drop --multihost. Underlying error: {e}"
+        ) from e
+    return runtime_summary()
+
+
+def runtime_summary() -> dict:
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+def host_shard_of_paths(paths: Sequence[str]) -> list[str]:
+    """The input files THIS host reads: a round-robin slice of the sorted
+    path list by process index (the reference's executor partition
+    assignment, without a shuffle service). Every path must be visible to
+    every host (shared filesystem / object store), but each is read once
+    globally."""
+    ordered = sorted(paths)
+    return ordered[jax.process_index() :: jax.process_count()]
+
+
+def global_batch_from_host_shards(local_arrays, mesh: Mesh, axis_name: str = "data"):
+    """Assemble per-host row blocks into ONE globally row-sharded pytree.
+
+    Each process passes its own ``local_arrays`` (a pytree of host numpy
+    arrays with identical structure and per-host row counts that sum to the
+    global batch); ``jax.make_array_from_process_local_data`` builds global
+    arrays whose addressable shards hold this host's rows — no host ever
+    materializes the global batch (SURVEY.md §7: the 1B-row path).
+    """
+    sharding = NamedSharding(mesh, P(axis_name))
+
+    def to_global(a):
+        a = np.asarray(a)
+        return jax.make_array_from_process_local_data(sharding, a)
+
+    return jax.tree.map(to_global, local_arrays)
+
+
+def shard_batch_multihost(local_batch, mesh: Mesh, axis_name: str = "data"):
+    """Multi-host twin of ``parallel.distributed.shard_batch``: every host
+    contributes ITS OWN rows (from its slice of the input files) and the
+    result is one globally row-sharded ``Batch`` — no host ever holds the
+    global data.
+
+    Hosts may have unequal row counts; each pads with zero-weight rows
+    (inert in the objective) to the global per-host maximum, rounded up so
+    the global row count divides the mesh's data axis.
+    """
+    from jax.experimental import multihost_utils
+
+    from photon_ml_tpu.ops.batch import pad_batch
+
+    n_local = local_batch.num_rows
+    counts = multihost_utils.process_allgather(np.asarray([n_local]))
+    per_host = int(np.max(counts))
+    devs_per_host = max(len(jax.local_devices()), 1)
+    per_host = -(-per_host // devs_per_host) * devs_per_host
+    local = pad_batch(local_batch, per_host)
+    return global_batch_from_host_shards(
+        jax.tree.map(np.asarray, local), mesh, axis_name
+    )
+
+
+def is_output_process() -> bool:
+    """True on the single process that writes shared outputs (models,
+    metrics, checkpoints). All hosts COMPUTE; exactly one host WRITES —
+    concurrent writers to shared storage interleave and corrupt files."""
+    return jax.process_index() == 0
+
+
+def sync_processes(tag: str = "photon-ml-barrier") -> None:
+    """Barrier across all processes (e.g. before reading files another
+    process wrote). No-op on a single process."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
+def allreduce_sum_host(*arrays: np.ndarray):
+    """Sum numpy arrays across ALL processes (returns them unchanged on a
+    single process). Used by the streaming objective to combine per-host
+    partial (value, gradient) sums — the treeAggregate analog for the
+    out-of-core path."""
+    if jax.process_count() <= 1:
+        return arrays if len(arrays) > 1 else arrays[0]
+    from jax.experimental import multihost_utils
+
+    stacked = multihost_utils.process_allgather(arrays)  # each: (P, ...)
+    summed = tuple(np.sum(np.asarray(a), axis=0) for a in stacked)
+    return summed if len(summed) > 1 else summed[0]

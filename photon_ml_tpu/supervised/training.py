@@ -187,6 +187,7 @@ def train_glm_streamed(
     validation_chunks: Sequence[dict] | None = None,
     evaluators: Sequence[str] = (),
     initial_model: GeneralizedLinearModel | None = None,
+    cross_process: bool = False,
 ) -> GLMTrainingResult:
     """Out-of-core twin of ``train_glm``: the same ascending-λ warm-started
     sweep, driven by host L-BFGS over a ``StreamingGLMObjective`` (one
@@ -254,7 +255,7 @@ def train_glm_streamed(
     # re-enters the same compiled programs — no recompilation across the grid
     sobj = StreamingGLMObjective(
         chunks, loss, num_features=num_features, l2_weight=0.0,
-        intercept_index=intercept_index,
+        intercept_index=intercept_index, cross_process=cross_process,
     )
     for lam in sorted(regularization_weights):
         sobj.l2_weight = float(regularization.l2_weight(lam))
