@@ -237,7 +237,17 @@ def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(description="GAME training driver")
     p.add_argument("--config", required=True, help="GameTrainingConfig JSON file")
     p.add_argument("--train-data", required=True, nargs="+")
+    p.add_argument(
+        "--train-date-range", nargs=2, metavar=("START", "END"), default=None,
+        help="expand each --train-data base path into its daily "
+             "subdirectories for the inclusive YYYY-MM-DD range "
+             "(base/daily/YYYY/MM/DD or base/YYYY-MM-DD layouts)",
+    )
     p.add_argument("--validation-data", nargs="*", default=None)
+    p.add_argument(
+        "--validation-date-range", nargs=2, metavar=("START", "END"), default=None,
+        help="like --train-date-range, for --validation-data",
+    )
     p.add_argument("--index-maps", default=None, help="FeatureIndexingDriver output dir")
     p.add_argument(
         "--multihost", action="store_true",
@@ -249,6 +259,26 @@ def main(argv: list[str] | None = None) -> None:
     args = p.parse_args(argv)
 
     config = load_training_config(args.config)
+    train_data = args.train_data
+    validation_data = args.validation_data
+    if args.train_date_range:
+        from photon_ml_tpu.io.data_reader import expand_date_range
+
+        train_data = [
+            d for base in train_data for d in expand_date_range(base, *args.train_date_range)
+        ]
+    if args.validation_date_range:
+        from photon_ml_tpu.io.data_reader import expand_date_range
+
+        if not validation_data:
+            raise SystemExit(
+                "--validation-date-range requires --validation-data base paths"
+            )
+        validation_data = [
+            d
+            for base in validation_data
+            for d in expand_date_range(base, *args.validation_date_range)
+        ]
     mesh = None
     if args.multihost:
         # GAME ingest reads are replicated across hosts (the feature/entity
@@ -271,9 +301,9 @@ def main(argv: list[str] | None = None) -> None:
         logger = PhotonLogger(args.output_dir)
     run(
         config,
-        args.train_data,
+        train_data,
         args.output_dir,
-        validation_data=args.validation_data,
+        validation_data=validation_data,
         index_map_dir=args.index_maps,
         logger=logger,
         mesh=mesh,

@@ -202,18 +202,33 @@ class GameEstimator:
 
     def _normalization_contexts(self, batch: GameBatch) -> dict[str, NormalizationContext]:
         """Per-shard normalization from feature summaries (reference:
-        ``BasicStatisticalSummary`` → ``NormalizationContext`` per shard)."""
+        ``BasicStatisticalSummary`` → ``NormalizationContext`` per shard) —
+        for EVERY shard in the update sequence, random-effect shards
+        included (their per-entity solves apply the shard's context inside
+        the objective, like the fixed effect's)."""
         if self.config.normalization is NormalizationType.NONE:
             return {}
         contexts: dict[str, NormalizationContext] = {}
-        fixed_shards = {
+        shard_ids = {
             c.feature_shard_id for c in self.config.fixed_effect_coordinates.values()
+        } | {
+            c.feature_shard_id
+            for c in self.config.random_effect_coordinates.values()
         }
-        for sid in fixed_shards:
+        for sid in shard_ids:
             summary = summarize(batch.batch_for(sid))
-            contexts[sid] = summary.normalization(
-                self.config.normalization, self.intercept_indices.get(sid)
-            )
+            norm_type = self.config.normalization
+            intercept = self.intercept_indices.get(sid)
+            if intercept is None and norm_type is NormalizationType.STANDARDIZATION:
+                # a shard with no intercept cannot absorb the shift term on
+                # the output model; degrade to scale-only for that shard
+                norm_type = NormalizationType.SCALE_WITH_STANDARD_DEVIATION
+                self._log(
+                    f"shard {sid!r} has no intercept: STANDARDIZATION "
+                    f"degraded to SCALE_WITH_STANDARD_DEVIATION (shifts need "
+                    f"an intercept to absorb on the output model)"
+                )
+            contexts[sid] = summary.normalization(norm_type, intercept)
         return contexts
 
     def _entity_layouts(
@@ -277,6 +292,7 @@ class GameEstimator:
                     task_type=task,
                     num_entities=num_entities,
                     intercept_index=self.intercept_indices.get(coord_cfg.feature_shard_id),
+                    normalization=norm_contexts.get(coord_cfg.feature_shard_id),
                     variance_computation=self.config.variance_computation,
                     mesh=self.mesh,
                     features_to_samples_ratio=coord_cfg.features_to_samples_ratio_upper_bound,

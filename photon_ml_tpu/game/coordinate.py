@@ -182,6 +182,7 @@ class RandomEffectCoordinate:
     task_type: TaskType
     num_entities: int
     intercept_index: int | None = None
+    normalization: NormalizationContext | None = None
     variance_computation: VarianceComputationType = VarianceComputationType.NONE
     mesh: Mesh | None = None
     axis_name: str = "data"
@@ -190,6 +191,23 @@ class RandomEffectCoordinate:
     # shared random projection (ProjectionMatrix); trained coefficients are
     # mapped back to the original space, so the model/scores are unchanged
     projector: "RandomProjector | None" = None
+
+    def __post_init__(self):
+        if self.normalization is not None and self.projector is not None:
+            raise NotImplementedError(
+                "normalization is not supported together with random "
+                "projection (the projected columns have no per-feature stats)"
+            )
+        if (
+            self.normalization is not None
+            and self.features_to_samples_ratio is not None
+        ):
+            raise NotImplementedError(
+                "normalization is not supported together with per-entity "
+                "subspace projection (the per-entity column maps would need "
+                "per-entity normalization slices)"
+            )
+        require_intercept_for_shifts(self.normalization)
 
     def _features(self):
         feats = self.batch.features[self.feature_shard_id]
@@ -275,6 +293,7 @@ class RandomEffectCoordinate:
             variance_computation=self.variance_computation,
             mesh=self.mesh,
             axis_name=self.axis_name,
+            norm=self.normalization,
         )
         coefficients = result.coefficients
         variances = result.variances

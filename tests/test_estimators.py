@@ -254,3 +254,198 @@ class TestDataValidation:
         est = GameEstimator(cfg, intercept_indices={"global": 5})
         with pytest.raises(DataValidationError):
             est.fit(bad)
+
+
+class TestRandomEffectNormalization:
+    def test_re_shards_get_normalization_contexts(self, rng):
+        """STANDARDIZATION must build contexts for random-effect shards too
+        and training through them must still fit well (coefficients mapped
+        back to the original space, scores unchanged in distribution)."""
+        from photon_ml_tpu.config import (
+            FeatureShardConfig,
+            FixedEffectCoordinateConfig,
+            GameTrainingConfig,
+            OptimizationConfig,
+            OptimizerConfig,
+            RandomEffectCoordinateConfig,
+            RegularizationContext,
+        )
+        from photon_ml_tpu.data.synthetic import synthetic_game_data
+        from photon_ml_tpu.game import make_game_batch
+        from photon_ml_tpu.types import (
+            NormalizationType,
+            RegularizationType,
+            TaskType,
+        )
+
+        data = synthetic_game_data(
+            rng, 500, d_fixed=4, effects={"userId": (8, 3)}
+        )
+        # scale the RE features so normalization matters
+        entity_X = data.entity_X["userId"] * np.array([10.0, 0.1, 1.0], np.float32)
+        batch = make_game_batch(
+            data.y,
+            {"global": data.X, "per_user": entity_X},
+            id_tags={"userId": data.entity_ids["userId"]},
+        )
+        opt = OptimizerConfig(max_iterations=40, tolerance=1e-8)
+        cfg = GameTrainingConfig(
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            coordinate_update_sequence=("fixed", "per_user"),
+            coordinate_descent_iterations=2,
+            fixed_effect_coordinates={
+                "fixed": FixedEffectCoordinateConfig(
+                    feature_shard_id="global",
+                    optimization=OptimizationConfig(optimizer=opt),
+                )
+            },
+            random_effect_coordinates={
+                "per_user": RandomEffectCoordinateConfig(
+                    random_effect_type="userId",
+                    feature_shard_id="per_user",
+                    optimization=OptimizationConfig(
+                        optimizer=opt,
+                        regularization=RegularizationContext(RegularizationType.L2),
+                        regularization_weight=1.0,
+                    ),
+                )
+            },
+            normalization=NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
+        )
+        est = GameEstimator(cfg, intercept_indices={"global": 4})
+        contexts = est._normalization_contexts(batch)
+        assert "per_user" in contexts  # RE shard covered now
+        results = est.fit(batch)
+        model = results[0].model
+        # model scores in ORIGINAL space must separate classes
+        from photon_ml_tpu.evaluation import auc_roc
+
+        auc = float(auc_roc(model.score(batch), batch.labels))
+        assert auc > 0.7
+
+        # The real invariant: normalized training equals training on
+        # MANUALLY pre-scaled features with the coefficients mapped back
+        # (L2 applies in the normalized space in both cases).
+        import dataclasses  # noqa: F401  (used below)
+
+        std = entity_X.std(axis=0, ddof=0)
+        factors = np.where(std > 0, 1.0 / std, 1.0).astype(np.float32)
+        batch_pre = make_game_batch(
+            data.y,
+            {"global": data.X, "per_user": entity_X * factors},
+            id_tags={"userId": data.entity_ids["userId"]},
+        )
+        cfg2 = dataclasses.replace(cfg, normalization=NormalizationType.NONE)
+        est2 = GameEstimator(cfg2, intercept_indices={"global": 4})
+        model2 = est2.fit(batch_pre)[0].model
+        # X̃·w̃ == X·(f⊙w̃): the pre-scaled model maps back via f⊙w̃
+        np.testing.assert_allclose(
+            np.asarray(model["per_user"].coefficients),
+            np.asarray(model2["per_user"].coefficients) * factors,
+            rtol=2e-2, atol=2e-3,
+        )
+
+
+class TestRandomEffectStandardization:
+    def test_shifted_normalization_with_intercept(self, rng):
+        """STANDARDIZATION (non-zero shifts) on a random-effect shard WITH
+        an intercept: per-entity solves in normalized space must map back to
+        original-space models whose scores equal a manual pre-standardized
+        solve's (the intercept absorbs each entity's shift delta)."""
+        from photon_ml_tpu.config import OptimizerConfig
+        from photon_ml_tpu.game import bucket_entities, group_by_entity
+        from photon_ml_tpu.game.data import DenseFeatures
+        from photon_ml_tpu.game.random_effect import train_random_effects
+        from photon_ml_tpu.normalization import build_normalization
+        from photon_ml_tpu.ops.losses import loss_for_task
+        from photon_ml_tpu.types import NormalizationType, TaskType
+
+        n, E, d = 600, 5, 3
+        ids = rng.integers(0, E, size=n).astype(np.int32)
+        X = (rng.normal(size=(n, d)) * np.array([4.0, 0.5, 1.0]) + 2.0).astype(
+            np.float32
+        )
+        Xi = np.concatenate([X, np.ones((n, 1), np.float32)], axis=1)  # + intercept
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        grouping = group_by_entity(ids)
+        buckets = bucket_entities(grouping)
+        cfg = OptimizerConfig(max_iterations=60, tolerance=1e-9)
+        loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+
+        means = Xi.mean(axis=0)
+        variances = Xi.var(axis=0)
+        norm = build_normalization(
+            NormalizationType.STANDARDIZATION, means, variances,
+            np.abs(Xi).max(axis=0), intercept_index=d,
+        )
+        assert float(np.max(np.abs(np.asarray(norm.shifts)))) > 0  # real shifts
+
+        res = train_random_effects(
+            features=DenseFeatures(X=jnp.asarray(Xi)), labels=y,
+            offsets=np.zeros(n, np.float32), weights=np.ones(n, np.float32),
+            buckets=buckets, num_entities=E, loss=loss, config=cfg,
+            l2_weight=1.0, intercept_index=d, norm=norm,
+        )
+
+        # manual reference: standardize features, train unnormalized, and
+        # compare SCORES (the original-space model must reproduce them)
+        f = np.asarray(norm.factors)
+        s = np.asarray(norm.shifts)
+        Xn = ((Xi - s) * f).astype(np.float32)
+        res_ref = train_random_effects(
+            features=DenseFeatures(X=jnp.asarray(Xn)), labels=y,
+            offsets=np.zeros(n, np.float32), weights=np.ones(n, np.float32),
+            buckets=buckets, num_entities=E, loss=loss, config=cfg,
+            l2_weight=1.0, intercept_index=d,
+        )
+        W = np.asarray(res.coefficients)
+        Wn = np.asarray(res_ref.coefficients)
+        scores = np.sum(W[ids] * Xi, axis=1)
+        scores_ref = np.sum(Wn[ids] * Xn, axis=1)
+        np.testing.assert_allclose(scores, scores_ref, rtol=1e-3, atol=1e-3)
+
+
+class TestFullRandomEffectVariance:
+    def test_full_variance_matches_simple_scale(self, rng):
+        """FULL per-entity variance (diag of the inverse Hessian) must be
+        finite, positive, and close to SIMPLE (1/diag) when the per-entity
+        Hessians are near-diagonal."""
+        from photon_ml_tpu.config import OptimizerConfig
+        from photon_ml_tpu.game import bucket_entities, group_by_entity
+        from photon_ml_tpu.game.data import DenseFeatures
+        from photon_ml_tpu.game.random_effect import train_random_effects
+        from photon_ml_tpu.ops.losses import loss_for_task
+        from photon_ml_tpu.types import TaskType, VarianceComputationType
+
+        n, E, d = 400, 6, 3
+        ids = rng.integers(0, E, size=n).astype(np.int32)
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        grouping = group_by_entity(ids)
+        buckets = bucket_entities(grouping)
+        kwargs = dict(
+            features=DenseFeatures(X=jnp.asarray(X)),
+            labels=y,
+            offsets=np.zeros(n, np.float32),
+            weights=np.ones(n, np.float32),
+            buckets=buckets,
+            num_entities=E,
+            loss=loss_for_task(TaskType.LOGISTIC_REGRESSION),
+            config=OptimizerConfig(max_iterations=50, tolerance=1e-9),
+            l2_weight=1.0,
+        )
+        full = train_random_effects(
+            variance_computation=VarianceComputationType.FULL, **kwargs
+        )
+        simple = train_random_effects(
+            variance_computation=VarianceComputationType.SIMPLE, **kwargs
+        )
+        vf = np.asarray(full.variances)
+        vs = np.asarray(simple.variances)
+        assert np.all(np.isfinite(vf)) and np.all(vf > 0)
+        # FULL >= SIMPLE-ish (off-diagonal mass only increases diag(H^-1))
+        assert np.all(vf >= vs * 0.99)
+        np.testing.assert_allclose(
+            np.asarray(full.coefficients), np.asarray(simple.coefficients),
+            rtol=1e-5, atol=1e-6,
+        )

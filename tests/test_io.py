@@ -345,3 +345,21 @@ class TestAvroDataReader:
         assert recs[0]["predictionScore"] == pytest.approx(0.25)
         assert recs[1]["uid"] == "b"
         assert recs[1]["label"] == pytest.approx(1.0)
+
+
+class TestDateRangeExpansion:
+    def test_both_layouts_and_holes(self, tmp_path):
+        from photon_ml_tpu.io.data_reader import expand_date_range
+
+        base = tmp_path / "input"
+        (base / "daily" / "2026" / "07" / "01").mkdir(parents=True)
+        (base / "2026-07-02").mkdir(parents=True)
+        # 2026-07-03 missing (hole), 2026-07-04 in daily layout
+        (base / "daily" / "2026" / "07" / "04").mkdir(parents=True)
+        got = expand_date_range(str(base), "2026-07-01", "2026-07-04")
+        assert [os.path.basename(p) for p in got] == ["01", "2026-07-02", "04"]
+
+        with pytest.raises(FileNotFoundError):
+            expand_date_range(str(base), "2025-01-01", "2025-01-03")
+        with pytest.raises(ValueError):
+            expand_date_range(str(base), "2026-07-04", "2026-07-01")
