@@ -26,7 +26,7 @@ from photon_ml_tpu.io.model_io import load_game_model
 from photon_ml_tpu.io.results import write_scoring_results
 from photon_ml_tpu.game.models import RandomEffectModel
 from photon_ml_tpu.transformers import GameTransformer
-from photon_ml_tpu.utils import PhotonLogger, timed
+from photon_ml_tpu.utils import PhotonLogger, profile_trace, timed
 
 
 def run(
@@ -36,6 +36,7 @@ def run(
     evaluators: list[str] | None = None,
     feature_shards: dict[str, FeatureShardConfig] | None = None,
     logger: PhotonLogger | None = None,
+    profile_dir: str | None = None,
 ):
     """``model_dir`` is a training output dir (contains ``best/``,
     ``index-maps/``, ``entity-maps.json``) or a bare model dir with the
@@ -86,7 +87,7 @@ def run(
         )
 
     transformer = GameTransformer(model, logger=logger)
-    with timed(logger, "score"):
+    with timed(logger, "score"), profile_trace(profile_dir, "score"):
         if evaluators:
             scores, results = transformer.transform_with_evaluation(ds.batch, evaluators)
             metrics = dict(results.metrics)
@@ -127,6 +128,10 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument(
         "--config", default=None, help="training config JSON (for feature shards)"
     )
+    p.add_argument(
+        "--profile-dir", default=None,
+        help="capture a jax.profiler device trace of the scoring pass",
+    )
     args = p.parse_args(argv)
     shards = None
     if args.config:
@@ -137,6 +142,7 @@ def main(argv: list[str] | None = None) -> None:
         args.output_dir,
         evaluators=args.evaluators,
         feature_shards=shards,
+        profile_dir=args.profile_dir,
     )
 
 

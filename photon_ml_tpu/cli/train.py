@@ -25,7 +25,7 @@ from photon_ml_tpu.estimators import GameEstimator, GameResult
 from photon_ml_tpu.io.data_reader import AvroDataReader, GameDataset
 from photon_ml_tpu.io.model_io import load_game_model, save_game_model
 from photon_ml_tpu.types import ModelOutputMode
-from photon_ml_tpu.utils import PhotonLogger, timed
+from photon_ml_tpu.utils import PhotonLogger, profile_trace, timed
 
 
 def run(
@@ -36,6 +36,7 @@ def run(
     index_map_dir: str | None = None,
     logger: PhotonLogger | None = None,
     mesh=None,
+    profile_dir: str | None = None,
 ) -> GameResult:
     logger = logger or PhotonLogger(output_dir)
     id_tags = tuple(
@@ -109,7 +110,9 @@ def run(
         intercept_indices=train.intercept_indices,
         logger=logger,
     )
-    with timed(logger, "estimator grid fit"):
+    with timed(logger, "estimator grid fit"), profile_trace(
+        profile_dir, "grid-fit"
+    ):
         results = estimator.fit(
             train.batch,
             None if val is None else val.batch,
@@ -255,6 +258,11 @@ def main(argv: list[str] | None = None) -> None:
              "JAX_COORDINATOR_ADDRESS / TPU-pod autodetection; run the SAME "
              "command on every host) and train over the global device mesh",
     )
+    p.add_argument(
+        "--profile-dir", default=None,
+        help="capture jax.profiler device traces of the expensive phases "
+             "into this directory (TensorBoard/Perfetto-loadable)",
+    )
     p.add_argument("--output-dir", required=True)
     args = p.parse_args(argv)
 
@@ -307,6 +315,7 @@ def main(argv: list[str] | None = None) -> None:
         index_map_dir=args.index_maps,
         logger=logger,
         mesh=mesh,
+        profile_dir=args.profile_dir,
     )
 
 

@@ -38,7 +38,7 @@ from photon_ml_tpu.types import (
     TaskType,
     VarianceComputationType,
 )
-from photon_ml_tpu.utils import PhotonLogger, timed
+from photon_ml_tpu.utils import PhotonLogger, profile_trace, timed
 
 STAGES = ("INIT", "PROCESSED", "TRAINED", "VALIDATED")
 
@@ -77,6 +77,7 @@ def run(
     streaming_chunk_rows: int | None = None,
     multihost: bool = False,
     logger: PhotonLogger | None = None,
+    profile_dir: str | None = None,
 ):
     if multihost and streaming_chunk_rows is None:
         raise ValueError(
@@ -115,6 +116,7 @@ def run(
             task, train_data, output_dir, data_format, validation_data,
             regularization, weights, max_iterations, tolerance,
             streaming_chunk_rows, advance, logger, multihost=multihost,
+            profile_dir=profile_dir,
         )
 
     advance("INIT")
@@ -162,7 +164,7 @@ def run(
                 ),
             )
 
-    with timed(logger, "train"):
+    with timed(logger, "train"), profile_trace(profile_dir, "glm-sweep"):
         result = train_glm(
             batch,
             task,
@@ -239,6 +241,7 @@ def _run_streamed(
     task, train_data, output_dir, data_format, validation_data,
     regularization, weights, max_iterations, tolerance,
     chunk_rows, advance, logger, multihost: bool = False,
+    profile_dir: str | None = None,
 ):
     """Out-of-core branch: data is read in uniform chunks that live in host
     RAM and stream through the device per optimizer iteration (SURVEY.md §7
@@ -295,7 +298,9 @@ def _run_streamed(
                 )
             )
 
-    with timed(logger, "train (streamed)"):
+    with timed(logger, "train (streamed)"), profile_trace(
+        profile_dir, "glm-sweep-streamed"
+    ):
         result = train_glm_streamed(
             chunks,
             task,
@@ -377,6 +382,10 @@ def main(argv: list[str] | None = None) -> None:
              "files across hosts (streaming mode only; run the SAME "
              "command on every host)",
     )
+    p.add_argument(
+        "--profile-dir", default=None,
+        help="capture jax.profiler device traces of the training sweep",
+    )
     p.add_argument("--output-dir", required=True)
     args = p.parse_args(argv)
     if args.multihost:
@@ -400,6 +409,7 @@ def main(argv: list[str] | None = None) -> None:
         validate=DataValidationType(args.validate),
         streaming_chunk_rows=args.streaming_chunk_rows,
         multihost=args.multihost,
+        profile_dir=args.profile_dir,
     )
 
 

@@ -1,3 +1,4 @@
-"""Shared utilities: logging, stage timing."""
+"""Shared utilities: logging, stage timing, device profiling."""
 
 from photon_ml_tpu.utils.logging import PhotonLogger, timed  # noqa: F401
+from photon_ml_tpu.utils.profiling import annotate, profile_trace  # noqa: F401

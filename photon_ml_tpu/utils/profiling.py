@@ -1,0 +1,42 @@
+"""Device profiling hooks.
+
+Reference parity: SURVEY.md §5.1 — the reference leans on Spark's UI/event
+timeline for stage-level tracing; the TPU-native equivalent is
+``jax.profiler`` device traces (viewable in TensorBoard / Perfetto). The
+drivers expose ``--profile-dir``; when set, the expensive phases run under
+a trace so perf claims are backed by an inspectable timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def profile_trace(profile_dir: str | None, label: str = "trace") -> Iterator[None]:
+    """Trace the enclosed block into ``profile_dir`` (no-op when None).
+
+    One directory can hold several labeled traces; each ``label`` becomes a
+    subdirectory so e.g. the ingest phase and a descent iteration land in
+    separate, individually-loadable traces.
+    """
+    if profile_dir is None:
+        yield
+        return
+    import os
+
+    import jax
+
+    target = os.path.join(profile_dir, label)
+    os.makedirs(target, exist_ok=True)
+    with jax.profiler.trace(target):
+        yield
+
+
+def annotate(name: str):
+    """Named sub-span inside an active trace (TraceAnnotation passthrough);
+    usable as a context manager around host-side dispatch of a hot op."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,28 @@
+"""Profiling hook tests: the trace context writes loadable artifacts and
+the no-op path stays a no-op."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.utils import annotate, profile_trace
+
+
+def test_profile_trace_writes_artifacts(tmp_path):
+    with profile_trace(str(tmp_path), "unit"):
+        with annotate("matmul"):
+            x = jnp.ones((64, 64)) @ jnp.ones((64, 64))
+            jax.block_until_ready(x)
+    files = [
+        os.path.join(r, f)
+        for r, _, fs in os.walk(tmp_path / "unit")
+        for f in fs
+    ]
+    assert files, "profiler trace produced no artifacts"
+
+
+def test_profile_trace_none_is_noop(tmp_path):
+    with profile_trace(None, "unit"):
+        pass
+    assert list(tmp_path.iterdir()) == []
