@@ -153,6 +153,14 @@ class RandomEffectCoordinateConfig(_JsonMixin):
     # TPU-specific: bucket geometry for the batched per-entity solver.
     # Entities are grouped into buckets of padded sample count; None = auto.
     sample_bucket_sizes: tuple[int, ...] | None = None
+    # Auto-ladder tuning (ignored when sample_bucket_sizes is set): merge
+    # the geometric capacity ladder down toward this many buckets — each
+    # bucket is one device program per descent iteration — as long as total
+    # padded cells stay under bucket_max_padded_ratio x active samples.
+    # Large-d random effects (where padded FLOPs, not program count,
+    # dominate) can lower the ratio or raise the target.
+    bucket_target_count: int = 4
+    bucket_max_padded_ratio: float = 4.0
 
 
 @dataclass(frozen=True)

@@ -246,7 +246,12 @@ class GameEstimator:
                 active_upper_bound=cfg.active_data_upper_bound,
                 seed=self.seed,
             )
-            buckets = bucket_entities(grouping, cfg.sample_bucket_sizes)
+            buckets = bucket_entities(
+                grouping,
+                cfg.sample_bucket_sizes,
+                target_buckets=cfg.bucket_target_count,
+                max_padded_ratio=cfg.bucket_max_padded_ratio,
+            )
             layouts[cid] = (grouping, buckets, num_entities)
         return layouts
 

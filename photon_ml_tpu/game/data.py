@@ -281,13 +281,24 @@ def default_capacities(max_count: int, smallest: int = 8, growth: int = 4) -> tu
 def bucket_entities(
     grouping: EntityGrouping,
     capacities: tuple[int, ...] | None = None,
+    target_buckets: int = 4,
+    max_padded_ratio: float = 4.0,
 ) -> EntityBuckets:
     """Assign each entity (with ≥1 active sample) to the smallest bucket
-    capacity ≥ its active count; build padded row-index matrices."""
+    capacity ≥ its active count; build padded row-index matrices.
+
+    When ``capacities`` is not given, the fine geometric ladder is then
+    GREEDILY MERGED down toward ``target_buckets`` classes: each bucket is
+    one device program per descent iteration, and program count — not the
+    padded compute (inert zero-weight slots) — dominates wall-clock for
+    small-d random effects. Merges stop when the total padded cells would
+    exceed ``max_padded_ratio`` x the active sample count, so pathological
+    ladders (many tiny entities + one huge) can't blow up memory."""
     active = np.flatnonzero(grouping.active_counts > 0)
     if len(active) == 0:
         return EntityBuckets(capacities=(), entity_ids=[], row_indices=[])
     max_count = int(grouping.active_counts[active].max())
+    explicit = capacities is not None
     if capacities is None:
         capacities = default_capacities(max_count)
     caps = np.asarray(sorted(capacities))
@@ -297,6 +308,11 @@ def bucket_entities(
         )
     # smallest capacity >= count, per entity
     slot = np.searchsorted(caps, grouping.active_counts[active])
+    if not explicit:
+        slot, caps = _merge_bucket_classes(
+            slot, caps, grouping.active_counts[active],
+            target_buckets, max_padded_ratio,
+        )
     ent_ids: list[np.ndarray] = []
     row_idx: list[np.ndarray] = []
     used_caps: list[int] = []
@@ -312,6 +328,40 @@ def bucket_entities(
         ent_ids.append(members.astype(np.int64))
         row_idx.append(rows)
     return EntityBuckets(capacities=tuple(used_caps), entity_ids=ent_ids, row_indices=row_idx)
+
+
+def _merge_bucket_classes(
+    slot: np.ndarray,
+    caps: np.ndarray,
+    active_counts: np.ndarray,
+    target_buckets: int,
+    max_padded_ratio: float,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Greedily merge adjacent capacity classes (smallest added padding
+    first) until at most ``target_buckets`` non-empty classes remain or the
+    padding budget is exhausted. Returns the updated (slot, caps)."""
+    total_active = float(active_counts.sum())
+    budget = max_padded_ratio * total_active
+    counts_per_class = np.bincount(slot, minlength=len(caps)).astype(np.int64)
+    padded = float((caps[slot] - active_counts).sum())
+
+    while np.count_nonzero(counts_per_class) > max(target_buckets, 1):
+        used = np.flatnonzero(counts_per_class)
+        if len(used) < 2:
+            break
+        # cost of merging used class i into the NEXT used class above it
+        costs = [
+            (counts_per_class[lo] * (caps[hi] - caps[lo]), lo, hi)
+            for lo, hi in zip(used[:-1], used[1:])
+        ]
+        add, lo, hi = min(costs)
+        if padded + add > budget:
+            break
+        slot = np.where(slot == lo, hi, slot)
+        counts_per_class[hi] += counts_per_class[lo]
+        counts_per_class[lo] = 0
+        padded += add
+    return slot, caps
 
 
 def gather_bucket(

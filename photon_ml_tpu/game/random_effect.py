@@ -309,55 +309,38 @@ def train_prepared(
     l2 = jnp.asarray(l2_weight, jnp.float32)
     sharding = NamedSharding(mesh, P(axis_name)) if mesh is not None else None
 
-    for pb in prepared:
-        k = pb.num_real
-        off_b = offsets[pb.row_idx] * pb.mask  # (k_pad, C), on device
-        bucket_batch = dataclasses.replace(pb.static, offsets=off_b)
-        w0 = W[jnp.asarray(pb.entity_ids)]
-        if pb.static.labels.shape[0] != k:  # entity lane was padded for the mesh
-            w0 = jnp.concatenate(
-                [w0, jnp.zeros((pb.static.labels.shape[0] - k, d), w0.dtype)]
-            )
-        solve_intercept = intercept_index
-        if pb.columns is not None:
-            # subspace projection: solve at width p over each entity's own
-            # columns; the intercept (always the last full-space column by
-            # framework convention) lands at slot p-1
-            w0 = jnp.take_along_axis(w0, pb.columns, axis=1)
-            if intercept_index is not None:
-                solve_intercept = pb.columns.shape[1] - 1
-        if sharding is not None:
-            w0 = jax.device_put(w0, sharding)
+    # per-bucket diagnostics stay ON DEVICE during the loop; reading them
+    # back per bucket would force a host sync between bucket dispatches and
+    # serialize the whole solve (VERDICT weak #6) — one readback at the end
+    diag_refs: list[tuple[np.ndarray, Array, Array, Array]] = []
 
-        w_b, f_b, it_b, reason_b, var_b = _solve_bucket(
-            bucket_batch,
-            w0,
+    for pb in prepared:
+        W, V, f_k, it_k, reason_k = _bucket_step(
+            W,
+            V,
+            offsets,
+            pb.static,
+            pb.row_idx,
+            pb.mask,
+            _ids_device(pb),
+            pb.columns,
             l2,
             norm,
             minimize_fn=minimize_fn,
             loss=loss,
             config=config,
-            intercept_index=solve_intercept,
+            intercept_index=intercept_index,
             variance_computation=variance_computation,
+            k=pb.num_real,
+            sharding=sharding,
             **extra,
         )
-        ids = jnp.asarray(pb.entity_ids)
-        if pb.columns is not None:
-            cols = pb.columns[:k]
-            # coefficients outside an entity's subspace are 0 (reference:
-            # projected training never touches them)
-            W = W.at[ids].set(0.0)
-            W = W.at[ids[:, None], cols].set(w_b[:k])
-            if compute_variance:
-                V = V.at[ids].set(0.0)
-                V = V.at[ids[:, None], cols].set(var_b[:k])
-        else:
-            W = W.at[ids].set(w_b[:k])
-            if compute_variance:
-                V = V.at[ids].set(var_b[:k])
-        loss_values[pb.entity_ids] = _to_host(f_b[:k]).astype(np.float64)
-        iterations[pb.entity_ids] = _to_host(it_b[:k])
-        converged[pb.entity_ids] = _to_host(reason_b[:k]) != 0  # != MAX_ITERATIONS
+        diag_refs.append((pb.entity_ids, f_k, it_k, reason_k))
+
+    for ent_ids, f_b, it_b, reason_b in diag_refs:
+        loss_values[ent_ids] = _to_host(f_b).astype(np.float64)
+        iterations[ent_ids] = _to_host(it_b)
+        converged[ent_ids] = _to_host(reason_b) != 0  # != MAX_ITERATIONS
 
     if norm is not None:
         # back to the ORIGINAL feature space (W was held in normalized space
@@ -374,6 +357,96 @@ def train_prepared(
         iterations=iterations,
         converged=converged,
     )
+
+
+def _ids_device(pb: PreparedBucket) -> Array:
+    """Bucket entity ids staged to device ONCE (cached on the instance) —
+    re-transferring them every descent iteration would add a host→device
+    hop per bucket per iteration."""
+    cached = pb.__dict__.get("_ids_device_cache")
+    if cached is None:
+        cached = jnp.asarray(pb.entity_ids, jnp.int32)
+        object.__setattr__(pb, "_ids_device_cache", cached)
+    return cached
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "minimize_fn", "loss", "config", "intercept_index",
+        "variance_computation", "k", "sharding",
+    ),
+)
+def _bucket_step(
+    W: Array,  # (E, d) current coefficients (normalized space if norm)
+    V: Array | None,  # (E, d) variances or None
+    offsets: Array,  # (n,) residual offsets
+    static_batch: Batch,
+    row_idx: Array,
+    mask: Array,
+    ids: Array,  # (k,) this bucket's entity ids (device)
+    columns: Array | None,
+    l2_weight: Array,
+    norm: Any,
+    *,
+    minimize_fn: Any,
+    loss: PointwiseLoss,
+    config: OptimizerConfig,
+    intercept_index: int | None,
+    variance_computation: VarianceComputationType,
+    k: int,
+    sharding: Any,
+    **minimize_kwargs,
+):
+    """ONE device dispatch per bucket per descent iteration: offset gather,
+    warm-start extraction, the vmapped solve, and the (E, d) scatter update
+    all fuse into a single compiled program. The previous eager sequence
+    cost ~6 host→device dispatches per bucket — pure latency on remote-
+    attached accelerators (SURVEY.md §7 / VERDICT weak #6)."""
+    d = W.shape[1]
+    off_b = offsets[row_idx] * mask
+    bucket_batch = dataclasses.replace(static_batch, offsets=off_b)
+    w0 = W[ids]
+    k_pad = static_batch.labels.shape[0]
+    if k_pad != k:  # entity lane was padded for the mesh
+        w0 = jnp.concatenate([w0, jnp.zeros((k_pad - k, d), w0.dtype)])
+    solve_intercept = intercept_index
+    if columns is not None:
+        # subspace projection: solve at width p over each entity's own
+        # columns; the intercept (always the last full-space column by
+        # framework convention) lands at slot p-1
+        w0 = jnp.take_along_axis(w0, columns, axis=1)
+        if intercept_index is not None:
+            solve_intercept = columns.shape[1] - 1
+    if sharding is not None:
+        w0 = jax.lax.with_sharding_constraint(w0, sharding)
+
+    w_b, f_b, it_b, reason_b, var_b = _solve_bucket(
+        bucket_batch,
+        w0,
+        l2_weight,
+        norm,
+        minimize_fn=minimize_fn,
+        loss=loss,
+        config=config,
+        intercept_index=solve_intercept,
+        variance_computation=variance_computation,
+        **minimize_kwargs,
+    )
+    if columns is not None:
+        cols = columns[:k]
+        # coefficients outside an entity's subspace are 0 (reference:
+        # projected training never touches them)
+        W = W.at[ids].set(0.0)
+        W = W.at[ids[:, None], cols].set(w_b[:k])
+        if V is not None:
+            V = V.at[ids].set(0.0)
+            V = V.at[ids[:, None], cols].set(var_b[:k])
+    else:
+        W = W.at[ids].set(w_b[:k])
+        if V is not None:
+            V = V.at[ids].set(var_b[:k])
+    return W, V, f_b[:k], it_b[:k], reason_b[:k]
 
 
 def _to_host(x) -> np.ndarray:

@@ -370,3 +370,39 @@ class TestCoordinateDescent:
         np.testing.assert_allclose(s[0], X[0] @ W[0], rtol=1e-5)
         np.testing.assert_allclose(s[1], X[1] @ W[2], rtol=1e-5)
         assert s[2] == 0.0 and s[3] == 0.0
+
+
+class TestBucketMerging:
+    def test_merge_respects_target_and_budget(self, rng):
+        ids = rng.integers(0, 200, size=3000).astype(np.int32)
+        g = group_by_entity(ids)
+        fine = bucket_entities(g, target_buckets=100)  # effectively no merge
+        merged = bucket_entities(g)  # default target 4
+        assert len(merged.capacities) <= max(len(fine.capacities), 4)
+        # same entity coverage, counts intact
+        np.testing.assert_array_equal(
+            np.sort(np.concatenate(merged.entity_ids)),
+            np.sort(np.concatenate(fine.entity_ids)),
+        )
+        total_active = int(g.active_counts.sum())
+        padded = sum(
+            rows.shape[0] * rows.shape[1] for rows in merged.row_indices
+        ) - total_active
+        assert padded <= 4.0 * total_active
+
+    def test_degenerate_targets_do_not_crash(self, rng):
+        ids = rng.integers(0, 30, size=500).astype(np.int32)
+        g = group_by_entity(ids)
+        b0 = bucket_entities(g, target_buckets=0)
+        b1 = bucket_entities(g, target_buckets=1)
+        for b in (b0, b1):
+            np.testing.assert_array_equal(
+                np.sort(np.concatenate(b.entity_ids)),
+                np.sort(np.flatnonzero(g.counts > 0)),
+            )
+
+    def test_explicit_capacities_never_merge(self, rng):
+        ids = np.repeat(np.arange(20, dtype=np.int32), 3)
+        g = group_by_entity(ids)
+        b = bucket_entities(g, capacities=(4, 8))
+        assert b.capacities == (4,)  # all entities have 3 samples
