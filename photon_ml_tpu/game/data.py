@@ -343,7 +343,10 @@ def _merge_bucket_classes(
     total_active = float(active_counts.sum())
     budget = max_padded_ratio * total_active
     counts_per_class = np.bincount(slot, minlength=len(caps)).astype(np.int64)
-    padded = float((caps[slot] - active_counts).sum())
+    # budget the padding ADDED BY MERGING — the fine ladder's inherent
+    # padding (up to the ladder's growth factor on skewed data) must not
+    # consume the budget, or the merge never fires exactly where it matters
+    added = 0.0
 
     while np.count_nonzero(counts_per_class) > max(target_buckets, 1):
         used = np.flatnonzero(counts_per_class)
@@ -355,12 +358,12 @@ def _merge_bucket_classes(
             for lo, hi in zip(used[:-1], used[1:])
         ]
         add, lo, hi = min(costs)
-        if padded + add > budget:
+        if added + add > budget:
             break
         slot = np.where(slot == lo, hi, slot)
         counts_per_class[hi] += counts_per_class[lo]
         counts_per_class[lo] = 0
-        padded += add
+        added += add
     return slot, caps
 
 

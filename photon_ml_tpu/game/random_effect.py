@@ -78,6 +78,7 @@ class PreparedBucket:
     it into the full (E, d) matrix."""
 
     entity_ids: np.ndarray  # (k,) original entity ids (host)
+    ids: Array  # (k,) the same ids staged to device (W gather/scatter key)
     static: Batch  # (k_pad, C, …) features/labels/weights; offsets zero
     row_idx: Array  # (k_pad, C) int32 device, clipped to >= 0
     mask: Array  # (k_pad, C) 1.0 where the slot holds a real sample
@@ -163,7 +164,9 @@ def prepare_buckets(
                 columns = jax.device_put(columns, sharding)
         prepared.append(
             PreparedBucket(
-                entity_ids=ent_ids, static=static, row_idx=idx, mask=mask,
+                entity_ids=ent_ids,
+                ids=jnp.asarray(ent_ids, jnp.int32),
+                static=static, row_idx=idx, mask=mask,
                 num_real=k, columns=columns,
             )
         )
@@ -296,7 +299,10 @@ def train_prepared(
     if initial_coefficients is None:
         W = jnp.zeros((num_entities, d), jnp.float32)
     else:
-        W = jnp.asarray(initial_coefficients, jnp.float32)
+        # COPY, never alias: W is donated into the bucket-step programs, and
+        # aliasing the caller's warm-start array (the live model's
+        # coefficients) would invalidate it on donation-supporting backends
+        W = jnp.array(initial_coefficients, jnp.float32, copy=True)
         if norm is not None:
             # warm start arrives in ORIGINAL feature space; the optimizer
             # works in normalized space
@@ -322,7 +328,7 @@ def train_prepared(
             pb.static,
             pb.row_idx,
             pb.mask,
-            _ids_device(pb),
+            pb.ids,
             pb.columns,
             l2,
             norm,
@@ -359,23 +365,16 @@ def train_prepared(
     )
 
 
-def _ids_device(pb: PreparedBucket) -> Array:
-    """Bucket entity ids staged to device ONCE (cached on the instance) —
-    re-transferring them every descent iteration would add a host→device
-    hop per bucket per iteration."""
-    cached = pb.__dict__.get("_ids_device_cache")
-    if cached is None:
-        cached = jnp.asarray(pb.entity_ids, jnp.int32)
-        object.__setattr__(pb, "_ids_device_cache", cached)
-    return cached
-
-
 @partial(
     jax.jit,
     static_argnames=(
         "minimize_fn", "loss", "config", "intercept_index",
         "variance_computation", "k", "sharding",
     ),
+    # W/V are rebound by the caller every bucket; donating them keeps peak
+    # HBM at O(1) coefficient copies even though the deferred-readback loop
+    # enqueues every bucket program without a host sync in between
+    donate_argnums=(0, 1),
 )
 def _bucket_step(
     W: Array,  # (E, d) current coefficients (normalized space if norm)
