@@ -332,6 +332,30 @@ def write_avro_file(
         flush_block(out, enc, count)
 
 
+def read_avro_schema(path: str) -> dict:
+    """The file's writer schema, from the container header only (no record
+    decoding) — used by the native columnar ingest to compile its program."""
+    with open(path, "rb") as f:
+        data = f.read(1 << 20)  # header fits comfortably in 1 MB
+    if data[:4] != MAGIC:
+        raise ValueError(f"{path}: not an Avro container file")
+    dec = _Decoder(data)
+    dec.pos = 4
+    while True:
+        count = dec.read_long()
+        if count == 0:
+            break
+        if count < 0:
+            count = -count
+            dec.read_long()
+        for _ in range(count):
+            k = dec.read(dec.read_long()).decode()
+            v = bytes(dec.read(dec.read_long()))
+            if k == "avro.schema":
+                return json.loads(v)
+    raise ValueError(f"{path}: container has no avro.schema header")
+
+
 def read_avro_file(path: str) -> tuple[dict, list[dict]]:
     """Read an Avro object container file → (schema, records)."""
     with open(path, "rb") as f:
@@ -376,16 +400,22 @@ def read_avro_file(path: str) -> tuple[dict, list[dict]]:
     return schema, records
 
 
-def iter_avro_directory(path: str) -> Iterator[dict]:
-    """Read every ``*.avro`` file under ``path`` (a file or a directory of
-    part files, like the reference's HDFS output dirs), yielding records."""
+def list_avro_files(path: str) -> list[str]:
+    """The data files ``path`` denotes: itself when a file, else its sorted
+    non-hidden ``*.avro`` part files. ONE policy shared by every reader
+    (python and native) so they can never read different file sets."""
     if os.path.isfile(path):
-        yield from read_avro_file(path)[1]
-        return
+        return [path]
     names = sorted(
         n for n in os.listdir(path) if n.endswith(".avro") and not n.startswith(".")
     )
     if not names:
         raise FileNotFoundError(f"no .avro files under {path}")
-    for n in names:
-        yield from read_avro_file(os.path.join(path, n))[1]
+    return [os.path.join(path, n) for n in names]
+
+
+def iter_avro_directory(path: str) -> Iterator[dict]:
+    """Read every ``*.avro`` file under ``path`` (a file or a directory of
+    part files, like the reference's HDFS output dirs), yielding records."""
+    for p in list_avro_files(path):
+        yield from read_avro_file(p)[1]

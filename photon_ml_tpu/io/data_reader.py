@@ -180,6 +180,7 @@ class AvroDataReader:
         entity_maps: Mapping[str, Mapping[str, int]] | None = None,
         extend_entities: bool = False,
         dtype=np.float32,
+        use_native: bool = True,
     ) -> GameDataset:
         """Read records → GameDataset.
 
@@ -189,8 +190,19 @@ class AvroDataReader:
         reference behaves the same way). ``extend_entities`` instead ASSIGNS
         fresh dense ids to unseen entities (incremental retraining: saved
         models keep their rows, new entities append).
+
+        ``use_native`` tries the C++ columnar decoder first (~30x the
+        Python codec); it falls back silently whenever the toolchain or
+        the schema shape is outside the native envelope — the outputs are
+        identical either way.
         """
         paths = [path] if isinstance(path, str) else list(path)
+        if use_native:
+            ds = self._read_native(
+                paths, id_tags, index_maps, entity_maps, extend_entities, dtype
+            )
+            if ds is not None:
+                return ds
         records: list[dict] = []
         for p in paths:
             records.extend(iter_avro_directory(p))
@@ -271,6 +283,238 @@ class AvroDataReader:
             labels=labels,
         )
 
+
+    # -- native columnar fast path -------------------------------------------
+    def _read_native(
+        self,
+        paths: list[str],
+        id_tags: Sequence[str],
+        index_maps: Mapping[str, IndexMap] | None,
+        entity_maps: Mapping[str, Mapping[str, int]] | None,
+        extend_entities: bool,
+        dtype,
+    ) -> GameDataset | None:
+        """The C++ columnar decode path; None when unavailable/unsupported
+        (caller falls back to the Python codec). Produces the same
+        GameDataset as the Python path, including first-seen feature-key
+        and entity-id ordering."""
+        from photon_ml_tpu.io.avro import list_avro_files, read_avro_schema
+        from photon_ml_tpu.io.native_ingest import (
+            compile_program,
+            decode_file,
+            native_ingest_available,
+        )
+
+        if not native_ingest_available():
+            return None
+        all_bags: list[str] = []
+        for cfg in self.feature_shards.values():
+            for b in cfg.feature_bags:
+                if b not in all_bags:
+                    all_bags.append(b)
+        files: list[str] = []
+        for p in paths:
+            try:
+                files.extend(list_avro_files(p))
+            except (OSError, FileNotFoundError):
+                return None  # let the python path raise its usual error
+        if not files:
+            return None
+
+        numeric_fields = {
+            self.response_field: 0.0,
+            self.offset_field: 0.0,
+            self.weight_field: 1.0,
+        }
+        cols = []
+        for fpath in files:
+            try:
+                schema = read_avro_schema(fpath)
+            except Exception:  # malformed/oversized header: python path decides
+                return None
+            prog = compile_program(
+                schema, all_bags, numeric_fields,
+                self.metadata_field if id_tags else None, self.uid_field,
+                non_nullable=frozenset({self.response_field}),
+            )
+            if prog is None:
+                return None
+            col = decode_file(fpath, prog, tags=list(id_tags))
+            if col is None:
+                return None
+            cols.append(col)
+
+        n = sum(c.num_rows for c in cols)
+        if n == 0:
+            return None
+
+        def numeric_col(c, field, default):
+            got = c.numeric.get(field)
+            return got if got is not None else np.full(c.num_rows, default)
+
+        if any(self.response_field not in c.numeric for c in cols):
+            return None  # no response field in a file: let the python path report
+        labels = np.concatenate(
+            [c.numeric[self.response_field] for c in cols]
+        ).astype(dtype)
+        offsets = np.concatenate(
+            [numeric_col(c, self.offset_field, 0.0) for c in cols]
+        ).astype(dtype)
+        weights = np.concatenate(
+            [numeric_col(c, self.weight_field, 1.0) for c in cols]
+        ).astype(dtype)
+        uids: list = []
+        for c in cols:
+            uids.extend(c.uids if c.uids is not None else [None] * c.num_rows)
+
+        # ---- merge each bag's per-file interned streams ----
+        merged_bags: dict[str, dict] = {}
+        for bag in all_bags:
+            key_order: dict[str, int] = {}
+            ids_parts, val_parts, counts_parts = [], [], []
+            # entity-tag-style remap per file: file-uniq id -> merged id
+            for c in cols:
+                b = c.bags[bag]
+                remap = np.asarray(
+                    [key_order.setdefault(k, len(key_order)) for k in b["uniq_keys"]],
+                    np.int64,
+                ) if b["uniq_keys"] else np.zeros(0, np.int64)
+                ids_parts.append(remap[b["ids"]] if len(b["ids"]) else b["ids"])
+                val_parts.append(b["values"])
+                counts_parts.append(np.diff(b["rowptr"]))
+            merged_bags[bag] = {
+                "keys": list(key_order),
+                "ids": np.concatenate(ids_parts) if ids_parts else np.zeros(0, np.int64),
+                "values": np.concatenate(val_parts) if val_parts else np.zeros(0, np.float32),
+                "counts": np.concatenate(counts_parts).astype(np.int64),
+            }
+
+        # ---- index maps (first-seen order matching the python path:
+        # keys appear per record, bags in shard-config order) ----
+        if index_maps is None:
+            built: dict[str, IndexMap] = {}
+            for sid, cfg in self.feature_shards.items():
+                ranked: list[tuple[tuple, str]] = []
+                for bag_idx, bag in enumerate(cfg.feature_bags):
+                    mb = merged_bags[bag]
+                    if not mb["keys"]:
+                        continue
+                    ids_arr = mb["ids"]
+                    first_flat = np.full(len(mb["keys"]), len(ids_arr), np.int64)
+                    # first occurrence of each merged id in the nnz stream
+                    uniq, first_idx = np.unique(ids_arr, return_index=True)
+                    first_flat[uniq] = first_idx
+                    rowptr = np.concatenate([[0], np.cumsum(mb["counts"])])
+                    rows = np.searchsorted(rowptr, first_flat, side="right") - 1
+                    pos = first_flat - rowptr[rows]
+                    for kid, key in enumerate(mb["keys"]):
+                        ranked.append(((rows[kid], bag_idx, pos[kid]), key))
+                ranked.sort(key=lambda t: t[0])
+                built[sid] = IndexMap.build(
+                    (k for _, k in ranked), add_intercept=cfg.has_intercept
+                )
+            index_maps = built
+        else:
+            index_maps = dict(index_maps)
+
+        # ---- entity maps ----
+        frozen_entities = entity_maps is not None and not extend_entities
+        ent_maps: dict[str, dict[str, int]] = (
+            {t: dict(m) for t, m in entity_maps.items()}
+            if entity_maps
+            else {t: {} for t in id_tags}
+        )
+        for t in id_tags:
+            ent_maps.setdefault(t, {})
+        ids_out = {t: np.full(n, -1, np.int32) for t in id_tags}
+        row0 = 0
+        missing: tuple[int, str] | None = None
+        for c in cols:
+            for t in id_tags:
+                tag = c.tags[t]
+                m = ent_maps[t]
+                remap = np.empty(len(tag["uniq_values"]), np.int64)
+                for uid_, v in enumerate(tag["uniq_values"]):
+                    if v in m:
+                        remap[uid_] = m[v]
+                    elif not frozen_entities:
+                        m[v] = len(m)
+                        remap[uid_] = m[v]
+                    else:
+                        remap[uid_] = -1
+                tids = tag["ids"]
+                if len(tids) and (tids < 0).any() and missing is None:
+                    missing = (row0 + int(np.flatnonzero(tids < 0)[0]), t)
+                present = tids >= 0
+                out = ids_out[t][row0:row0 + c.num_rows]
+                out[present] = remap[tids[present]]
+            row0 += c.num_rows
+        if missing is not None:
+            raise ValueError(f"record {missing[0]} missing id tag {missing[1]!r}")
+
+        # ---- per-shard features ----
+        features: dict[str, Features] = {}
+        for sid, cfg in self.feature_shards.items():
+            imap = index_maps[sid]
+            # concatenate this shard's bags in (row, bag order, position)
+            # order — the python path's per-record iteration order
+            rows_parts, cols_parts, vals_parts, pos_parts, bagix_parts = [], [], [], [], []
+            for bag_idx, bag in enumerate(cfg.feature_bags):
+                mb = merged_bags[bag]
+                if not len(mb["ids"]):
+                    continue
+                uniq_to_col = imap.lookup_all(np.asarray(mb["keys"], np.str_))
+                rowptr = np.concatenate([[0], np.cumsum(mb["counts"])])
+                rows = np.repeat(np.arange(n, dtype=np.int64), mb["counts"])
+                pos = np.arange(len(mb["ids"]), dtype=np.int64) - rowptr[rows]
+                colv = uniq_to_col[mb["ids"]]
+                keep = colv >= 0  # unknown features dropped
+                rows_parts.append(rows[keep])
+                cols_parts.append(colv[keep])
+                vals_parts.append(mb["values"][keep])
+                pos_parts.append(pos[keep])
+                bagix_parts.append(np.full(keep.sum(), bag_idx, np.int64))
+            if rows_parts:
+                rows = np.concatenate(rows_parts)
+                colv = np.concatenate(cols_parts)
+                vals = np.concatenate(vals_parts)
+                order = np.lexsort(
+                    (np.concatenate(pos_parts), np.concatenate(bagix_parts), rows)
+                )
+                rows, colv, vals = rows[order], colv[order], vals[order]
+            else:
+                rows = np.zeros(0, np.int64)
+                colv = np.zeros(0, np.int64)
+                vals = np.zeros(0, np.float32)
+            if cfg.has_intercept:
+                rows = np.concatenate([rows, np.arange(n, dtype=np.int64)])
+                colv = np.concatenate(
+                    [colv, np.full(n, imap.intercept_index, np.int64)]
+                )
+                vals = np.concatenate([vals, np.ones(n, np.float32)])
+                # keep per-row order: features first, intercept last
+                order = np.lexsort(
+                    (np.concatenate([np.zeros(len(rows) - n), np.ones(n)]), rows)
+                )
+                rows, colv, vals = rows[order], colv[order], vals[order]
+            features[sid] = _build_features_arrays(
+                rows, colv, vals, n, index_maps[sid].size, dtype
+            )
+
+        batch = make_game_batch(
+            labels,
+            features,
+            id_tags={t: ids_out[t] for t in id_tags},
+            offsets=offsets,
+            weights=weights,
+        )
+        return GameDataset(
+            batch=batch,
+            index_maps=index_maps,
+            entity_maps=ent_maps,
+            uids=uids if any(u is not None for u in uids) else None,
+            labels=labels,
+        )
 
     # -- out-of-core chunked reading -----------------------------------------
     def iter_batch_chunks(
@@ -402,6 +646,35 @@ def expand_date_range(
             f"YYYY-MM-DD layouts)"
         )
     return out
+
+
+def _build_features_arrays(
+    rows: np.ndarray,  # (nnz,) int64, sorted by row (per-row order preserved)
+    cols: np.ndarray,  # (nnz,) int64 columns
+    vals: np.ndarray,  # (nnz,) float32
+    n: int,
+    d: int,
+    dtype,
+) -> Features:
+    """Vectorized twin of ``_build_features`` for the native COO stream
+    (same densify threshold, same duplicate/padding semantics)."""
+    import jax.numpy as jnp
+
+    if d <= _DENSE_THRESHOLD:
+        X = np.zeros((n, d), dtype)
+        np.add.at(X, (rows, cols), vals.astype(dtype))
+        return DenseFeatures(X=jnp.asarray(X))
+    counts = np.bincount(rows, minlength=n)
+    k = max(int(counts.max()) if n else 1, 1)
+    rowptr = np.concatenate([[0], np.cumsum(counts)])
+    slots = np.arange(len(rows), dtype=np.int64) - rowptr[rows]
+    indices = np.zeros((n, k), np.int32)
+    values = np.zeros((n, k), dtype)
+    indices[rows, slots] = cols
+    values[rows, slots] = vals
+    return SparseFeatures(
+        indices=jnp.asarray(indices), values=jnp.asarray(values), num_features=d
+    )
 
 
 def _build_features(

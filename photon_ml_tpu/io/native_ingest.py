@@ -1,0 +1,339 @@
+"""Native columnar Avro ingest: schema-program compiler + ctypes wrapper.
+
+The pure-Python codec (``io/avro.py``) decodes ~1e4 records/s — the host
+becomes the bottleneck long before the TPU does (SURVEY.md §7 "Streaming
+1B rows"). The native decoder (``native/avro_ingest.cc``) executes a small
+opcode program compiled HERE from the file's writer schema and returns
+columnar output: numeric columns, CSR feature bags with a first-seen-order
+interned key table (each distinct feature string crosses the C boundary
+once, not once per occurrence), per-row entity-tag ids, and raw uids.
+
+``compile_program`` returns None for schema shapes outside the supported
+envelope (unions other than [null, X] / uid's [null, string, long], array
+items that aren't (name, term, value) records, non-string maps …) — the
+caller then falls back to the Python decoder, so the native path is a pure
+accelerator, never a compatibility constraint.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from photon_ml_tpu.native.build import load_library
+
+# opcode codes (must match avro_ingest.cc)
+_END, _SKIP, _CAPNUM, _BAG, _TAGMAP, _UID, _SKIPOPT = 0, 1, 2, 3, 4, 5, 6
+_KIND_LONG, _KIND_DOUBLE, _KIND_FLOAT, _KIND_STRING, _KIND_BOOL = 0, 1, 2, 3, 4
+_KIND_NULL, _KIND_MAP_STR, _KIND_NTV_ARRAY = 5, 6, 7
+
+_PERMS = {
+    (0, 1, 2): 0, (0, 2, 1): 1, (1, 0, 2): 2,
+    (2, 0, 1): 3, (1, 2, 0): 4, (2, 1, 0): 5,
+}
+
+_PRIMITIVE_KIND = {
+    "long": _KIND_LONG, "int": _KIND_LONG, "double": _KIND_DOUBLE,
+    "float": _KIND_FLOAT, "string": _KIND_STRING, "bytes": _KIND_STRING,
+    "boolean": _KIND_BOOL, "null": _KIND_NULL,
+}
+
+
+@dataclass
+class Program:
+    ops: np.ndarray  # (n_ops, 4) uint32
+    defaults: np.ndarray  # (n_slots,) float64
+    slots: dict  # field name -> numeric slot
+    bags: list  # bag field names in bag-id order
+    capture_uid: bool
+
+
+@dataclass
+class ColumnarFile:
+    """One file's decoded columns (host numpy; zero-copy views are copied
+    out of the native handle before it is freed)."""
+
+    num_rows: int
+    numeric: dict  # field -> (n,) float64
+    bags: dict = field(default_factory=dict)
+    # bag -> dict(rowptr (n+1,) int64, ids (nnz,) int32, values (nnz,) f32,
+    #             uniq_keys list[str] in first-seen order)
+    tags: dict = field(default_factory=dict)
+    # tag -> dict(ids (n,) int32 into uniq_values, uniq_values list[str])
+    uids: list | None = None
+
+
+def _resolve_named(schema, registry):
+    if isinstance(schema, str):
+        return registry.get(schema, schema)
+    if isinstance(schema, dict) and schema.get("type") == "record":
+        registry[schema["name"]] = schema
+        ns = schema.get("namespace")
+        if ns:
+            registry[f"{ns}.{schema['name']}"] = schema
+    return schema
+
+
+def _is_ntv_record(schema, registry) -> tuple | None:
+    """(perm index, value_is_float) when schema is a (name, term, value)
+    record in any field order; else None."""
+    schema = _resolve_named(schema, registry)
+    if not isinstance(schema, dict) or schema.get("type") != "record":
+        return None
+    fields = schema.get("fields", [])
+    if len(fields) != 3:
+        return None
+    pos = {}
+    value_is_float = False
+    for i, f in enumerate(fields):
+        t = f["type"]
+        if f["name"] == "name" and t == "string":
+            pos["name"] = i
+        elif f["name"] == "term" and t == "string":
+            pos["term"] = i
+        elif f["name"] == "value" and t in ("double", "float"):
+            pos["value"] = i
+            value_is_float = t == "float"
+        else:
+            return None
+    perm = _PERMS.get((pos["name"], pos["term"], pos["value"]))
+    return None if perm is None else (perm, value_is_float)
+
+
+def _unwrap_nullable(t):
+    """(inner type, union flags) for plain types and [null, X] unions (flag
+    bit0 = nullable, bit1 = null is the SECOND branch); None for others."""
+    if not isinstance(t, list):
+        return t, 0
+    if len(t) != 2 or "null" not in t:
+        return None, 0
+    inner = t[0] if t[1] == "null" else t[1]
+    flags = 1 | (2 if t[1] == "null" else 0)
+    return inner, flags
+
+
+def compile_program(
+    schema: dict,
+    bag_fields: list[str],
+    numeric_fields: dict,  # field name -> default value
+    tag_field: str | None,
+    uid_field: str | None,
+    non_nullable: frozenset[str] = frozenset(),
+) -> Program | None:
+    """``non_nullable`` numeric fields must not be nullable in the schema —
+    the native decoder substitutes defaults for nulls, which would silently
+    differ from the Python path's hard error (e.g. a null label)."""
+    if not isinstance(schema, dict) or schema.get("type") != "record":
+        return None
+    registry: dict = {}
+    _resolve_named(schema, registry)
+    ops: list[tuple[int, int, int, int]] = []
+    defaults: list[float] = []
+    slots: dict = {}
+    bags_found: dict = {}
+    uid_found = False
+
+    for f in schema.get("fields", []):
+        fname, ftype = f["name"], f["type"]
+        if fname == uid_field:
+            uid_found = True
+            if ftype == "string":
+                ops.append((_UID, 0, 0, 0))
+            elif isinstance(ftype, list) and ftype[:2] == ["null", "string"]:
+                extra = ftype[2:]
+                if extra == ["long"]:
+                    ops.append((_UID, 0, 0, 1 | 4))
+                elif not extra:
+                    ops.append((_UID, 0, 0, 1))
+                else:
+                    return None
+            else:
+                return None
+            continue
+        if fname in numeric_fields:
+            inner, flags = _unwrap_nullable(ftype)
+            kind = {"long": 0, "int": 0, "double": 1, "float": 2}.get(inner)
+            if kind is None:
+                return None
+            if flags and fname in non_nullable:
+                return None  # python path errors on null; don't mask it
+            slot = len(defaults)
+            slots[fname] = slot
+            defaults.append(float(numeric_fields[fname]))
+            ops.append((_CAPNUM, slot, kind, flags))
+            continue
+        if fname == tag_field:
+            inner, flags = _unwrap_nullable(ftype)
+            if not (isinstance(inner, dict) and inner.get("type") == "map"
+                    and inner.get("values") == "string"):
+                return None
+            ops.append((_TAGMAP, 0, 0, flags))
+            continue
+
+        inner, flags = _unwrap_nullable(ftype)
+        if inner is None:
+            return None
+        is_bag_field = fname in bag_fields
+        if isinstance(inner, dict) and inner.get("type") == "array":
+            ntv = _is_ntv_record(inner.get("items"), registry)
+            if ntv is None:
+                return None
+            perm, value_is_float = ntv
+            if is_bag_field:
+                bag_id = bags_found.setdefault(fname, len(bags_found))
+                c = (1 if value_is_float else 0) | (2 if flags & 1 else 0) | (
+                    4 if flags & 2 else 0
+                )
+                ops.append((_BAG, bag_id, perm, c))
+            elif value_is_float:
+                return None  # generic NTV skip assumes 8-byte value
+            elif flags:
+                return None
+            else:
+                ops.append((_SKIP, _KIND_NTV_ARRAY, 0, 0))
+            continue
+        if is_bag_field:
+            return None  # requested bag isn't an NTV array
+        if isinstance(inner, dict) and inner.get("type") == "map":
+            if inner.get("values") != "string":
+                return None
+            kind = _KIND_MAP_STR
+        else:
+            kind = _PRIMITIVE_KIND.get(inner) if isinstance(inner, str) else None
+            if kind is None:
+                return None
+        ops.append((_SKIPOPT, kind, 0, flags) if flags else (_SKIP, kind, 0, 0))
+
+    missing = [b for b in bag_fields if b not in bags_found]
+    if missing:
+        return None
+    ops.append((_END, 0, 0, 0))
+    return Program(
+        ops=np.asarray(ops, np.uint32),
+        defaults=np.asarray(defaults, np.float64),
+        slots=slots,
+        bags=sorted(bags_found, key=bags_found.get),
+        # only when the schema actually HAS the field: the C++ side fills
+        # uid arrays strictly via the _UID op
+        capture_uid=uid_field is not None and uid_found,
+    )
+
+
+def _strings_from_blob(blob: bytes, offsets: np.ndarray) -> list[str]:
+    return [
+        blob[offsets[i]:offsets[i + 1]].decode("utf-8", "replace")
+        for i in range(len(offsets) - 1)
+    ]
+
+
+def decode_file(path: str, program: Program, tags: list[str]) -> ColumnarFile | None:
+    """Run the native decoder on one file. None on failure (caller falls
+    back to the Python codec)."""
+    lib = load_library()
+    if lib is None:
+        return None
+    ops = np.ascontiguousarray(program.ops, np.uint32)
+    defaults = np.ascontiguousarray(program.defaults, np.float64)
+    tag_bytes = [t.encode() for t in tags]
+    tags_blob = b"".join(tag_bytes)
+    tag_lens = np.asarray([len(t) for t in tag_bytes], np.uint32)
+    errbuf = ctypes.create_string_buffer(256)
+    handle = lib.pavro_ingest(
+        path.encode(),
+        ops.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        len(program.ops),
+        defaults.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        len(defaults),
+        tags_blob,
+        tag_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        len(tags),
+        len(program.bags),
+        1 if program.capture_uid else 0,
+        errbuf,
+        len(errbuf),
+    )
+    if not handle:
+        return None
+    try:
+        n = int(lib.pavro_num_rows(handle))
+        if n == 0:
+            # empty std::vector::data() may be NULL — never wrap pointers
+            out = ColumnarFile(
+                num_rows=0,
+                numeric={f: np.zeros(0) for f in program.slots},
+            )
+            for bag in program.bags:
+                out.bags[bag] = {
+                    "rowptr": np.zeros(1, np.int64),
+                    "ids": np.zeros(0, np.int64),
+                    "values": np.zeros(0, np.float32),
+                    "uniq_keys": [],
+                }
+            for tag in tags:
+                out.tags[tag] = {"ids": np.zeros(0, np.int32), "uniq_values": []}
+            if program.capture_uid:
+                out.uids = []
+            return out
+        numeric = {
+            fname: np.ctypeslib.as_array(
+                lib.pavro_numeric(handle, slot), shape=(n,)
+            ).copy()
+            for fname, slot in program.slots.items()
+        }
+        out = ColumnarFile(num_rows=n, numeric=numeric)
+        for bag_id, bag in enumerate(program.bags):
+            nnz = int(lib.pavro_bag_nnz(handle, bag_id))
+            n_uniq = int(lib.pavro_bag_num_uniq(handle, bag_id))
+            offs = np.ctypeslib.as_array(
+                lib.pavro_bag_uniq_offsets(handle, bag_id), shape=(n_uniq + 1,)
+            )
+            blob = ctypes.string_at(
+                lib.pavro_bag_uniq_blob(handle, bag_id), int(offs[-1])
+            ) if n_uniq else b""
+            out.bags[bag] = {
+                "rowptr": np.ctypeslib.as_array(
+                    lib.pavro_bag_rowptr(handle, bag_id), shape=(n + 1,)
+                ).copy(),
+                "ids": np.ctypeslib.as_array(
+                    lib.pavro_bag_ids(handle, bag_id), shape=(nnz,)
+                ).astype(np.int64) if nnz else np.zeros(0, np.int64),
+                "values": np.ctypeslib.as_array(
+                    lib.pavro_bag_values(handle, bag_id), shape=(nnz,)
+                ).copy() if nnz else np.zeros(0, np.float32),
+                "uniq_keys": _strings_from_blob(blob, offs),
+            }
+        for tag_id, tag in enumerate(tags):
+            n_uniq = int(lib.pavro_tag_num_uniq(handle, tag_id))
+            offs = np.ctypeslib.as_array(
+                lib.pavro_tag_uniq_offsets(handle, tag_id), shape=(n_uniq + 1,)
+            )
+            blob = ctypes.string_at(
+                lib.pavro_tag_uniq_blob(handle, tag_id), int(offs[-1])
+            ) if n_uniq else b""
+            out.tags[tag] = {
+                "ids": np.ctypeslib.as_array(
+                    lib.pavro_tag_ids(handle, tag_id), shape=(n,)
+                ).copy() if n else np.zeros(0, np.int32),
+                "uniq_values": _strings_from_blob(blob, offs),
+            }
+        if program.capture_uid and n:
+            offs = np.ctypeslib.as_array(lib.pavro_uid_offsets(handle), shape=(n + 1,))
+            blob = ctypes.string_at(lib.pavro_uid_blob(handle), int(offs[-1]))
+            kinds = np.ctypeslib.as_array(lib.pavro_uid_kinds(handle), shape=(n,))
+            uids: list = []
+            for i in range(n):
+                if kinds[i] == 0:
+                    uids.append(None)
+                else:
+                    s = blob[offs[i]:offs[i + 1]].decode("utf-8", "replace")
+                    uids.append(int(s) if kinds[i] == 2 else s)
+            out.uids = uids
+        return out
+    finally:
+        lib.pavro_free(handle)
+
+
+def native_ingest_available() -> bool:
+    return load_library() is not None

@@ -13,7 +13,10 @@ import os
 import subprocess
 import tempfile
 
-_SOURCE = os.path.join(os.path.dirname(__file__), "index_store.cc")
+_SOURCES = [
+    os.path.join(os.path.dirname(__file__), "index_store.cc"),
+    os.path.join(os.path.dirname(__file__), "avro_ingest.cc"),
+]
 _LIB = None
 _TRIED = False
 
@@ -27,14 +30,17 @@ def _cache_dir() -> str:
 
 
 def _build() -> str:
-    with open(_SOURCE, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    out = os.path.join(_cache_dir(), f"libphotonidx-{digest}.so")
+    hasher = hashlib.sha256()
+    for src in _SOURCES:
+        with open(src, "rb") as f:
+            hasher.update(f.read())
+    digest = hasher.hexdigest()[:16]
+    out = os.path.join(_cache_dir(), f"libphoton-{digest}.so")
     if os.path.exists(out):
         return out
     tmp = out + f".build-{os.getpid()}"
     subprocess.run(
-        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SOURCE, "-o", tmp],
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", *_SOURCES, "-o", tmp, "-lz"],
         check=True,
         capture_output=True,
     )
@@ -75,6 +81,45 @@ def load_library():
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64, i64p,
     ]
     lib.pidx_entry.restype = ctypes.c_int64
+
+    # ---- columnar avro ingest (avro_ingest.cc) ----
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    lib.pavro_ingest.argtypes = [
+        ctypes.c_char_p, u32p, ctypes.c_uint32, f64p, ctypes.c_uint32,
+        ctypes.c_char_p, u32p, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32,
+    ]
+    lib.pavro_ingest.restype = ctypes.c_void_p
+    lib.pavro_free.argtypes = [ctypes.c_void_p]
+    lib.pavro_num_rows.argtypes = [ctypes.c_void_p]
+    lib.pavro_num_rows.restype = ctypes.c_uint64
+    lib.pavro_numeric.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.pavro_numeric.restype = f64p
+    for name, restype in [
+        ("pavro_bag_nnz", ctypes.c_uint64),
+        ("pavro_bag_rowptr", i64p),
+        ("pavro_bag_ids", u32p),
+        ("pavro_bag_values", ctypes.POINTER(ctypes.c_float)),
+        ("pavro_bag_num_uniq", ctypes.c_uint64),
+        ("pavro_bag_uniq_blob", ctypes.POINTER(ctypes.c_char)),
+        ("pavro_bag_uniq_offsets", u64p),
+        ("pavro_tag_ids", ctypes.POINTER(ctypes.c_int32)),
+        ("pavro_tag_num_uniq", ctypes.c_uint64),
+        ("pavro_tag_uniq_blob", ctypes.POINTER(ctypes.c_char)),
+        ("pavro_tag_uniq_offsets", u64p),
+    ]:
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        fn.restype = restype
+    for name, restype in [
+        ("pavro_uid_blob", ctypes.POINTER(ctypes.c_char)),
+        ("pavro_uid_offsets", u64p),
+        ("pavro_uid_kinds", ctypes.POINTER(ctypes.c_uint8)),
+    ]:
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.c_void_p]
+        fn.restype = restype
     _LIB = lib
     return lib
 

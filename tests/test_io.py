@@ -363,3 +363,181 @@ class TestDateRangeExpansion:
             expand_date_range(str(base), "2025-01-01", "2025-01-03")
         with pytest.raises(ValueError):
             expand_date_range(str(base), "2026-07-04", "2026-07-01")
+
+
+# ---------------------------------------------------------------------------
+# native columnar ingest
+# ---------------------------------------------------------------------------
+class TestNativeIngest:
+    def _write(self, path, rng, n=150, codec="null", with_user_bag=True):
+        import json as _json
+
+        from photon_ml_tpu.io import write_avro_file
+
+        schema = _json.loads(_json.dumps(TRAINING_EXAMPLE_SCHEMA))
+        if with_user_bag:
+            schema["fields"].insert(
+                5,
+                {"name": "userFeatures",
+                 "type": {"type": "array", "items": "NameTermValueAvro"},
+                 "default": []},
+            )
+        recs = []
+        for i in range(n):
+            feats = [
+                {"name": "g", "term": str(j), "value": float(rng.normal())}
+                for j in range(rng.integers(1, 5))
+            ]
+            rec = {
+                # exercise all three uid branches
+                "uid": (None if i % 7 == 0 else (i * 11 if i % 3 == 0 else f"s{i}")),
+                "response": float(rng.integers(0, 2)),
+                "offset": None if i % 2 else float(rng.normal()),
+                "weight": None if i % 3 else 2.0,
+                "features": feats,
+                "metadataMap": {"userId": f"user_{rng.integers(0, 9)}"},
+            }
+            if with_user_bag:
+                rec["userFeatures"] = [
+                    {"name": "u", "term": str(j), "value": float(rng.normal())}
+                    for j in range(2)
+                ]
+            recs.append(rec)
+        write_avro_file(path, schema, recs, codec=codec)
+
+    def _assert_same(self, a, b):
+        np.testing.assert_allclose(np.asarray(a.batch.labels), np.asarray(b.batch.labels))
+        np.testing.assert_allclose(np.asarray(a.batch.offsets), np.asarray(b.batch.offsets))
+        np.testing.assert_allclose(np.asarray(a.batch.weights), np.asarray(b.batch.weights))
+        assert a.uids == b.uids
+        assert a.entity_maps == b.entity_maps
+        for t in a.batch.id_tags:
+            np.testing.assert_array_equal(
+                np.asarray(a.batch.id_tags[t]), np.asarray(b.batch.id_tags[t])
+            )
+        for sid in a.index_maps:
+            assert dict(a.index_maps[sid].items()) == dict(b.index_maps[sid].items())
+            fa, fb = a.batch.features[sid], b.batch.features[sid]
+            assert type(fa) is type(fb)
+            if hasattr(fa, "X"):
+                np.testing.assert_allclose(
+                    np.asarray(fa.X), np.asarray(fb.X), rtol=1e-6, atol=1e-6
+                )
+            else:
+                # padded slot layouts must score identically
+                w = np.random.default_rng(0).normal(size=fa.num_features).astype(np.float32)
+                np.testing.assert_allclose(
+                    np.asarray(fa.score(jnp.asarray(w))),
+                    np.asarray(fb.score(jnp.asarray(w))),
+                    rtol=1e-4, atol=1e-4,
+                )
+
+    @pytest.mark.parametrize("codec", ["null", "deflate"])
+    def test_native_matches_python_read(self, tmp_path, rng, codec):
+        from photon_ml_tpu.io.native_ingest import native_ingest_available
+
+        if not native_ingest_available():
+            pytest.skip("native toolchain unavailable")
+        # two part files in a directory, two shards over two bags
+        d = tmp_path / "data"
+        d.mkdir()
+        self._write(str(d / "part-0.avro"), rng, codec=codec)
+        self._write(str(d / "part-1.avro"), rng, n=80, codec=codec)
+        reader = AvroDataReader(
+            {
+                "global": FeatureShardConfig(feature_bags=("features",), has_intercept=True),
+                "per_user": FeatureShardConfig(feature_bags=("userFeatures",), has_intercept=False),
+                "both": FeatureShardConfig(
+                    feature_bags=("features", "userFeatures"), has_intercept=True
+                ),
+            }
+        )
+        nat = reader.read(str(d), id_tags=["userId"], use_native=True)
+        py = reader.read(str(d), id_tags=["userId"], use_native=False)
+        self._assert_same(nat, py)
+
+        # frozen maps (validation read): columns/entities line up, unknowns drop
+        rng2 = np.random.default_rng(123)
+        self._write(str(tmp_path / "val.avro"), rng2, n=60, codec=codec)
+        nat_v = reader.read(
+            str(tmp_path / "val.avro"), id_tags=["userId"],
+            index_maps=py.index_maps, entity_maps=py.entity_maps,
+            use_native=True,
+        )
+        py_v = reader.read(
+            str(tmp_path / "val.avro"), id_tags=["userId"],
+            index_maps=py.index_maps, entity_maps=py.entity_maps,
+            use_native=False,
+        )
+        self._assert_same(nat_v, py_v)
+
+    def test_unsupported_schema_falls_back(self, tmp_path, rng):
+        """A schema outside the native envelope must silently use the
+        Python path (not fail)."""
+        from photon_ml_tpu.io import write_avro_file
+
+        schema = {
+            "type": "record", "name": "Weird",
+            "fields": [
+                {"name": "response", "type": "double"},
+                {"name": "features", "type": {"type": "array", "items": {
+                    "type": "record", "name": "NTV4", "fields": [
+                        {"name": "name", "type": "string"},
+                        {"name": "term", "type": "string"},
+                        {"name": "value", "type": "double"},
+                        {"name": "extra", "type": "long"},  # 4th field: unsupported
+                    ]}}},
+            ],
+        }
+        recs = [
+            {"response": 1.0,
+             "features": [{"name": "a", "term": "", "value": 2.0, "extra": 1}]}
+        ]
+        path = str(tmp_path / "w.avro")
+        write_avro_file(path, schema, recs)
+        ds = AvroDataReader(
+            {"global": FeatureShardConfig(feature_bags=("features",), has_intercept=False)}
+        ).read(path, use_native=True)
+        assert ds.batch.num_rows == 1
+        assert ds.index_maps["global"].get("a") >= 0
+
+    def test_empty_part_file_and_nullable_response(self, tmp_path, rng):
+        """Zero-record part files must not crash the native path, and a
+        nullable response field must fall back to the Python path (which
+        errors on null labels instead of silently training zeros)."""
+        import json as _json
+
+        from photon_ml_tpu.io import write_avro_file
+
+        d = tmp_path / "data"
+        d.mkdir()
+        self._write(str(d / "part-0.avro"), rng, n=40, with_user_bag=False)
+        schema = _json.loads(_json.dumps(TRAINING_EXAMPLE_SCHEMA))
+        write_avro_file(str(d / "part-1.avro"), schema, [])  # empty part
+        reader = AvroDataReader(
+            {"global": FeatureShardConfig(feature_bags=("features",), has_intercept=True)}
+        )
+        nat = reader.read(str(d), id_tags=["userId"], use_native=True)
+        py = reader.read(str(d), id_tags=["userId"], use_native=False)
+        self._assert_same(nat, py)
+
+        # nullable response: native must decline (no silent 0.0 labels)
+        schema2 = _json.loads(_json.dumps(TRAINING_EXAMPLE_SCHEMA))
+        schema2["fields"][1]["type"] = ["null", "double"]
+        schema2["fields"][1]["default"] = None
+        recs = [
+            {"uid": None, "response": 1.0, "offset": None, "weight": None,
+             "features": [{"name": "a", "term": "", "value": 1.0}],
+             "metadataMap": None}
+        ]
+        p2 = str(tmp_path / "nullable.avro")
+        write_avro_file(p2, schema2, recs)
+        from photon_ml_tpu.io.avro import read_avro_schema
+        from photon_ml_tpu.io.native_ingest import compile_program
+
+        prog = compile_program(
+            read_avro_schema(p2), ["features"],
+            {"response": 0.0, "offset": 0.0, "weight": 1.0},
+            None, "uid", non_nullable=frozenset({"response"}),
+        )
+        assert prog is None
