@@ -144,14 +144,18 @@ class AvroDataReader:
         return self.streaming_ingest_stats(path)[0]
 
     def streaming_ingest_stats(
-        self, path: str | Sequence[str]
+        self, path: str | Sequence[str], use_native: bool = True
     ) -> tuple[dict[str, IndexMap], dict[str, int]]:
         """ONE streaming pass producing both the index maps and each
         shard's max per-record feature count (``max_nnz``, intercept
         included) — so ``iter_batch_chunks`` doesn't need its own pre-pass
         and the out-of-core CLI reads the data exactly twice (stats + fill),
-        not three times."""
+        not three times. Uses the native columnar decoder when possible."""
         paths = [path] if isinstance(path, str) else list(path)
+        if use_native:
+            out = self._streaming_stats_native(paths)
+            if out is not None:
+                return out
         seen: dict[str, dict[str, None]] = {sid: {} for sid in self.feature_shards}
         max_nnz = {sid: 1 for sid in self.feature_shards}
         for p in paths:
@@ -298,52 +302,10 @@ class AvroDataReader:
         (caller falls back to the Python codec). Produces the same
         GameDataset as the Python path, including first-seen feature-key
         and entity-id ordering."""
-        from photon_ml_tpu.io.avro import list_avro_files, read_avro_schema
-        from photon_ml_tpu.io.native_ingest import (
-            compile_program,
-            decode_file,
-            native_ingest_available,
-        )
-
-        if not native_ingest_available():
+        decoded = self._decode_files_native(paths, id_tags)
+        if decoded is None:
             return None
-        all_bags: list[str] = []
-        for cfg in self.feature_shards.values():
-            for b in cfg.feature_bags:
-                if b not in all_bags:
-                    all_bags.append(b)
-        files: list[str] = []
-        for p in paths:
-            try:
-                files.extend(list_avro_files(p))
-            except (OSError, FileNotFoundError):
-                return None  # let the python path raise its usual error
-        if not files:
-            return None
-
-        numeric_fields = {
-            self.response_field: 0.0,
-            self.offset_field: 0.0,
-            self.weight_field: 1.0,
-        }
-        cols = []
-        for fpath in files:
-            try:
-                schema = read_avro_schema(fpath)
-            except Exception:  # malformed/oversized header: python path decides
-                return None
-            prog = compile_program(
-                schema, all_bags, numeric_fields,
-                self.metadata_field if id_tags else None, self.uid_field,
-                non_nullable=frozenset({self.response_field}),
-            )
-            if prog is None:
-                return None
-            col = decode_file(fpath, prog, tags=list(id_tags))
-            if col is None:
-                return None
-            cols.append(col)
-
+        cols, all_bags = decoded
         n = sum(c.num_rows for c in cols)
         if n == 0:
             return None
@@ -368,50 +330,16 @@ class AvroDataReader:
             uids.extend(c.uids if c.uids is not None else [None] * c.num_rows)
 
         # ---- merge each bag's per-file interned streams ----
-        merged_bags: dict[str, dict] = {}
-        for bag in all_bags:
-            key_order: dict[str, int] = {}
-            ids_parts, val_parts, counts_parts = [], [], []
-            # entity-tag-style remap per file: file-uniq id -> merged id
-            for c in cols:
-                b = c.bags[bag]
-                remap = np.asarray(
-                    [key_order.setdefault(k, len(key_order)) for k in b["uniq_keys"]],
-                    np.int64,
-                ) if b["uniq_keys"] else np.zeros(0, np.int64)
-                ids_parts.append(remap[b["ids"]] if len(b["ids"]) else b["ids"])
-                val_parts.append(b["values"])
-                counts_parts.append(np.diff(b["rowptr"]))
-            merged_bags[bag] = {
-                "keys": list(key_order),
-                "ids": np.concatenate(ids_parts) if ids_parts else np.zeros(0, np.int64),
-                "values": np.concatenate(val_parts) if val_parts else np.zeros(0, np.float32),
-                "counts": np.concatenate(counts_parts).astype(np.int64),
-            }
+        merged_bags = {bag: _merge_bag_columns(cols, bag) for bag in all_bags}
 
         # ---- index maps (first-seen order matching the python path:
         # keys appear per record, bags in shard-config order) ----
         if index_maps is None:
             built: dict[str, IndexMap] = {}
             for sid, cfg in self.feature_shards.items():
-                ranked: list[tuple[tuple, str]] = []
-                for bag_idx, bag in enumerate(cfg.feature_bags):
-                    mb = merged_bags[bag]
-                    if not mb["keys"]:
-                        continue
-                    ids_arr = mb["ids"]
-                    first_flat = np.full(len(mb["keys"]), len(ids_arr), np.int64)
-                    # first occurrence of each merged id in the nnz stream
-                    uniq, first_idx = np.unique(ids_arr, return_index=True)
-                    first_flat[uniq] = first_idx
-                    rowptr = np.concatenate([[0], np.cumsum(mb["counts"])])
-                    rows = np.searchsorted(rowptr, first_flat, side="right") - 1
-                    pos = first_flat - rowptr[rows]
-                    for kid, key in enumerate(mb["keys"]):
-                        ranked.append(((rows[kid], bag_idx, pos[kid]), key))
-                ranked.sort(key=lambda t: t[0])
                 built[sid] = IndexMap.build(
-                    (k for _, k in ranked), add_intercept=cfg.has_intercept
+                    _first_seen_ranked_keys(merged_bags, cfg),
+                    add_intercept=cfg.has_intercept,
                 )
             index_maps = built
         else:
@@ -516,6 +444,240 @@ class AvroDataReader:
             labels=labels,
         )
 
+    def _plan_native(self, paths: list[str], id_tags: Sequence[str]):
+        """Validate EVERY file's schema against the native envelope up
+        front; returns (list of (path, program), all_bags) or None. The
+        up-front check means lazy per-file decoding can never fail over to
+        the python path mid-stream (after chunks were already yielded)."""
+        from photon_ml_tpu.io.avro import list_avro_files, read_avro_schema
+        from photon_ml_tpu.io.native_ingest import (
+            compile_program,
+            native_ingest_available,
+        )
+
+        if not native_ingest_available():
+            return None
+        all_bags: list[str] = []
+        for cfg in self.feature_shards.values():
+            for b in cfg.feature_bags:
+                if b not in all_bags:
+                    all_bags.append(b)
+        files: list[str] = []
+        for p in paths:
+            try:
+                files.extend(list_avro_files(p))
+            except (OSError, FileNotFoundError):
+                return None  # let the python path raise its usual error
+        if not files:
+            return None
+        numeric_fields = {
+            self.response_field: 0.0,
+            self.offset_field: 0.0,
+            self.weight_field: 1.0,
+        }
+        plan = []
+        for fpath in files:
+            try:
+                schema = read_avro_schema(fpath)
+            except Exception:  # malformed/oversized header: python path decides
+                return None
+            prog = compile_program(
+                schema, all_bags, numeric_fields,
+                self.metadata_field if id_tags else None, self.uid_field,
+                non_nullable=frozenset({self.response_field}),
+            )
+            if prog is None or self.response_field not in prog.slots:
+                return None
+            plan.append((fpath, prog))
+        return plan, all_bags
+
+    def _iter_decoded_native(self, plan, id_tags: Sequence[str]):
+        """Decode the planned files ONE AT A TIME (out-of-core callers
+        process and free each file's columns before the next is decoded).
+        Raises on decode failure — the plan already validated the schemas,
+        so a failure here means a corrupt file, which the python path would
+        also report."""
+        from photon_ml_tpu.io.native_ingest import decode_file
+
+        for fpath, prog in plan:
+            col = decode_file(fpath, prog, tags=list(id_tags))
+            if col is None:
+                raise ValueError(f"native decode failed for {fpath} (corrupt file?)")
+            yield col
+
+    def _decode_files_native(self, paths: list[str], id_tags: Sequence[str]):
+        """Eager decode of every part file (for the whole-dataset ``read``
+        path); None when the native path can't take them."""
+        planned = self._plan_native(paths, id_tags)
+        if planned is None:
+            return None
+        plan, all_bags = planned
+        return list(self._iter_decoded_native(plan, id_tags)), all_bags
+
+    def _streaming_stats_native(self, paths: list[str]):
+        """Index maps + per-shard max nnz in ONE pass holding one file's
+        columns at a time (out-of-core: the dataset never sits in RAM)."""
+        planned = self._plan_native(paths, id_tags=())
+        if planned is None:
+            return None
+        plan, all_bags = planned
+        # global first-seen rank per key, folded incrementally per file
+        key_rank: dict[str, dict[str, tuple]] = {b: {} for b in all_bags}
+        per_shard_max = {sid: 1 for sid in self.feature_shards}
+        bag_pos = {
+            sid: {b: i for i, b in enumerate(cfg.feature_bags)}
+            for sid, cfg in self.feature_shards.items()
+        }
+        row0 = 0
+        for c in self._iter_decoded_native(plan, ()):
+            n_f = c.num_rows
+            for bag in all_bags:
+                b = c.bags[bag]
+                ranks = key_rank[bag]
+                ids_arr = b["ids"]
+                if len(b["uniq_keys"]):
+                    first_flat = np.full(len(b["uniq_keys"]), len(ids_arr), np.int64)
+                    uniq, first_idx = np.unique(ids_arr, return_index=True)
+                    first_flat[uniq] = first_idx
+                    rows = (
+                        np.searchsorted(b["rowptr"], first_flat, side="right") - 1
+                    )
+                    pos = first_flat - b["rowptr"][rows]
+                    for kid, key in enumerate(b["uniq_keys"]):
+                        if key not in ranks:
+                            ranks[key] = (row0 + rows[kid], pos[kid])
+            for sid, cfg in self.feature_shards.items():
+                per_row = np.zeros(n_f, np.int64)
+                for bag in cfg.feature_bags:
+                    per_row += np.diff(c.bags[bag]["rowptr"])
+                if n_f:
+                    per_shard_max[sid] = max(
+                        per_shard_max[sid],
+                        int(per_row.max()) + int(cfg.has_intercept),
+                    )
+            row0 += n_f
+        maps: dict[str, IndexMap] = {}
+        for sid, cfg in self.feature_shards.items():
+            ranked: list[tuple[tuple, str]] = []
+            for bag in cfg.feature_bags:
+                bi = bag_pos[sid][bag]
+                for key, (row, pos) in key_rank[bag].items():
+                    ranked.append(((row, bi, pos), key))
+            ranked.sort(key=lambda t: t[0])
+            maps[sid] = IndexMap.build(
+                (k for _, k in ranked), add_intercept=cfg.has_intercept
+            )
+        return maps, per_shard_max
+
+    def _chunks_from_columnar(
+        self, col_iter, cfg, imap: IndexMap, chunk_rows: int, dtype,
+        max_nnz: int | None, dense: bool,
+    ):
+        """Assemble uniform chunk dicts from native per-file columnar
+        decodes, consuming ONE file at a time (out-of-core: each file's
+        columns are freed once its rows are emitted; rows may span file
+        boundaries; the trailing chunk is padded with zero-weight rows like
+        the python path's)."""
+        d = imap.size
+
+        def file_coo(c):
+            rows_parts, cols_parts, vals_parts, pos_parts, bag_parts = [], [], [], [], []
+            n_f = c.num_rows
+            for bag_idx, bag in enumerate(cfg.feature_bags):
+                b = c.bags[bag]
+                if not len(b["ids"]):
+                    continue
+                uniq_to_col = imap.lookup_all(np.asarray(b["uniq_keys"], np.str_))
+                counts = np.diff(b["rowptr"])
+                rows = np.repeat(np.arange(n_f, dtype=np.int64), counts)
+                pos = np.arange(len(b["ids"]), dtype=np.int64) - b["rowptr"][rows]
+                colv = uniq_to_col[b["ids"]]
+                keep = colv >= 0
+                rows_parts.append(rows[keep])
+                cols_parts.append(colv[keep])
+                vals_parts.append(b["values"][keep])
+                pos_parts.append(pos[keep])
+                bag_parts.append(np.full(int(keep.sum()), bag_idx, np.int64))
+            if rows_parts:
+                rows = np.concatenate(rows_parts)
+                order = np.lexsort(
+                    (np.concatenate(pos_parts), np.concatenate(bag_parts), rows)
+                )
+                rows = rows[order]
+                colv = np.concatenate(cols_parts)[order]
+                vals = np.concatenate(vals_parts)[order]
+            else:
+                rows = np.zeros(0, np.int64)
+                colv = np.zeros(0, np.int64)
+                vals = np.zeros(0, np.float32)
+            counts_f = np.bincount(rows, minlength=n_f).astype(np.int64)
+            rowptr_f = np.concatenate([[0], np.cumsum(counts_f)])
+            if not dense and len(counts_f):
+                worst = int(counts_f.max()) + int(cfg.has_intercept)
+                if worst > max_nnz:
+                    raise ValueError(
+                        f"record has {worst} features > max_nnz={max_nnz}"
+                    )
+            return rows, colv, vals, counts_f, rowptr_f
+
+        def empty_chunk():
+            chunk = {
+                "labels": np.zeros(chunk_rows, dtype),
+                "offsets": np.zeros(chunk_rows, dtype),
+                "weights": np.zeros(chunk_rows, dtype),
+            }
+            if dense:
+                chunk["X"] = np.zeros((chunk_rows, d), dtype)
+            else:
+                chunk["indices"] = np.zeros((chunk_rows, max_nnz), np.int32)
+                chunk["values"] = np.zeros((chunk_rows, max_nnz), dtype)
+            return chunk
+
+        buf = empty_chunk()
+        fill = 0
+        icept = imap.intercept_index if cfg.has_intercept else None
+        for c in col_iter:
+            rows, colv, vals, counts_f, rowptr_f = file_coo(c)
+            labels_f = c.numeric[self.response_field]  # guaranteed by the plan
+            offsets_f = c.numeric.get(self.offset_field)
+            weights_f = c.numeric.get(self.weight_field)
+            n_f = c.num_rows
+            r0 = 0
+            while r0 < n_f:
+                take = min(chunk_rows - fill, n_f - r0)
+                dst = slice(fill, fill + take)
+                src = slice(r0, r0 + take)
+                buf["labels"][dst] = labels_f[src]
+                if offsets_f is not None:
+                    buf["offsets"][dst] = offsets_f[src]
+                buf["weights"][dst] = (
+                    weights_f[src] if weights_f is not None else 1.0
+                )
+                lo, hi = rowptr_f[r0], rowptr_f[r0 + take]
+                rr = rows[lo:hi] - r0 + fill
+                if dense:
+                    np.add.at(buf["X"], (rr, colv[lo:hi]), vals[lo:hi].astype(dtype))
+                    if icept is not None:
+                        buf["X"][dst, icept] += 1.0
+                else:
+                    slots = np.arange(lo, hi, dtype=np.int64) - rowptr_f[rows[lo:hi]]
+                    buf["indices"][rr, slots] = colv[lo:hi]
+                    buf["values"][rr, slots] = vals[lo:hi]
+                    if icept is not None:
+                        # intercept occupies the slot right after the row's
+                        # real features — the python path's per-row order
+                        islot = counts_f[src]
+                        buf["indices"][np.arange(fill, fill + take), islot] = icept
+                        buf["values"][np.arange(fill, fill + take), islot] = 1.0
+                fill += take
+                r0 += take
+                if fill == chunk_rows:
+                    yield buf
+                    buf = empty_chunk()
+                    fill = 0
+        if fill:
+            yield buf
+
     # -- out-of-core chunked reading -----------------------------------------
     def iter_batch_chunks(
         self,
@@ -525,6 +687,7 @@ class AvroDataReader:
         index_maps: Mapping[str, IndexMap],
         dtype=np.float32,
         max_nnz: int | None = None,
+        use_native: bool = True,
     ):
         """Stream one feature shard as uniform host chunk dicts for
         ``photon_ml_tpu.ops.streaming`` (out-of-core training — the
@@ -547,6 +710,19 @@ class AvroDataReader:
                 yield from iter_avro_directory(p)
 
         dense = d <= _DENSE_THRESHOLD
+        if use_native:
+            planned = self._plan_native(paths, id_tags=())
+            if planned is not None:
+                plan, _ = planned
+                if not dense and max_nnz is None:
+                    stats = self._streaming_stats_native(paths)
+                    max_nnz = stats[1][shard_id] if stats else None
+                if dense or max_nnz is not None:
+                    yield from self._chunks_from_columnar(
+                        self._iter_decoded_native(plan, ()),
+                        cfg, imap, chunk_rows, dtype, max_nnz, dense,
+                    )
+                    return
         if not dense and max_nnz is None:
             max_nnz = 1
             for rec in records():
@@ -646,6 +822,51 @@ def expand_date_range(
             f"YYYY-MM-DD layouts)"
         )
     return out
+
+
+def _merge_bag_columns(cols: list, bag: str) -> dict:
+    """Merge one bag's per-file interned streams (native ingest output)
+    into one stream with a global first-seen key table."""
+    key_order: dict[str, int] = {}
+    ids_parts, val_parts, counts_parts = [], [], []
+    for c in cols:
+        b = c.bags[bag]
+        remap = np.asarray(
+            [key_order.setdefault(k, len(key_order)) for k in b["uniq_keys"]],
+            np.int64,
+        ) if b["uniq_keys"] else np.zeros(0, np.int64)
+        ids_parts.append(remap[b["ids"]] if len(b["ids"]) else b["ids"])
+        val_parts.append(b["values"])
+        counts_parts.append(np.diff(b["rowptr"]))
+    return {
+        "keys": list(key_order),
+        "ids": np.concatenate(ids_parts) if ids_parts else np.zeros(0, np.int64),
+        "values": np.concatenate(val_parts) if val_parts else np.zeros(0, np.float32),
+        "counts": np.concatenate(counts_parts).astype(np.int64)
+        if counts_parts else np.zeros(0, np.int64),
+    }
+
+
+def _first_seen_ranked_keys(merged_bags: Mapping[str, dict], cfg) -> list[str]:
+    """One shard's feature keys in the PYTHON reader's first-seen order:
+    by (row, bag position in the shard config, position within the bag)."""
+    ranked: list[tuple[tuple, str]] = []
+    for bag_idx, bag in enumerate(cfg.feature_bags):
+        mb = merged_bags[bag]
+        if not mb["keys"]:
+            continue
+        ids_arr = mb["ids"]
+        first_flat = np.full(len(mb["keys"]), len(ids_arr), np.int64)
+        # first occurrence of each merged id in the nnz stream
+        uniq, first_idx = np.unique(ids_arr, return_index=True)
+        first_flat[uniq] = first_idx
+        rowptr = np.concatenate([[0], np.cumsum(mb["counts"])])
+        rows = np.searchsorted(rowptr, first_flat, side="right") - 1
+        pos = first_flat - rowptr[rows]
+        for kid, key in enumerate(mb["keys"]):
+            ranked.append(((rows[kid], bag_idx, pos[kid]), key))
+    ranked.sort(key=lambda t: t[0])
+    return [k for _, k in ranked]
 
 
 def _build_features_arrays(

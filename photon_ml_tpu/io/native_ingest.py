@@ -154,7 +154,11 @@ def compile_program(
             continue
         if fname in numeric_fields:
             inner, flags = _unwrap_nullable(ftype)
-            kind = {"long": 0, "int": 0, "double": 1, "float": 2}.get(inner)
+            kind = (
+                {"long": 0, "int": 0, "double": 1, "float": 2}.get(inner)
+                if isinstance(inner, str)
+                else None
+            )
             if kind is None:
                 return None
             if flags and fname in non_nullable:
@@ -187,8 +191,9 @@ def compile_program(
                     4 if flags & 2 else 0
                 )
                 ops.append((_BAG, bag_id, perm, c))
-            elif value_is_float:
-                return None  # generic NTV skip assumes 8-byte value
+            elif value_is_float or perm not in (0, 2):
+                # generic NTV skip assumes an 8-byte value LAST in the record
+                return None
             elif flags:
                 return None
             else:

@@ -226,3 +226,37 @@ class TestChunkedAvroReader:
         np.testing.assert_allclose(
             np.asarray(host.w), np.asarray(dev.w), rtol=1e-3, atol=1e-3
         )
+
+
+class TestNativeChunkedReader:
+    def test_native_chunks_match_python_chunks(self, tmp_path, rng):
+        from photon_ml_tpu.io.native_ingest import native_ingest_available
+
+        if not native_ingest_available():
+            import pytest as _pytest
+
+            _pytest.skip("native toolchain unavailable")
+        d = tmp_path / "data"
+        d.mkdir()
+        TestChunkedAvroReader()._write(str(d / "part-0.avro"), rng, n=77)
+        TestChunkedAvroReader()._write(str(d / "part-1.avro"), rng, n=50)
+        reader = AvroDataReader(
+            {"global": FeatureShardConfig(feature_bags=("features",), has_intercept=True)}
+        )
+        maps_nat, nnz_nat = reader.streaming_ingest_stats(str(d), use_native=True)
+        maps_py, nnz_py = reader.streaming_ingest_stats(str(d), use_native=False)
+        assert nnz_nat == nnz_py
+        assert dict(maps_nat["global"].items()) == dict(maps_py["global"].items())
+
+        nat = list(reader.iter_batch_chunks(
+            str(d), "global", 40, maps_py, max_nnz=nnz_py["global"], use_native=True
+        ))
+        py = list(reader.iter_batch_chunks(
+            str(d), "global", 40, maps_py, max_nnz=nnz_py["global"], use_native=False
+        ))
+        assert len(nat) == len(py)
+        for a, b in zip(nat, py):
+            assert set(a) == set(b)
+            for k in a:
+                np.testing.assert_allclose(a[k], b[k], rtol=1e-6, atol=1e-6,
+                                           err_msg=f"chunk field {k}")
