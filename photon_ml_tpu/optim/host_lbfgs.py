@@ -36,12 +36,18 @@ def host_lbfgs_minimize(
     w0: np.ndarray,
     config: OptimizerConfig,
     history: int = 10,
+    iteration_callback: Any = None,
 ) -> OptimizationResult:
     """Minimize ``objective`` (anything exposing ``value_and_grad(w)`` —
     e.g. ``StreamingGLMObjective``) with L-BFGS driven from the host. Each
     iteration costs one streamed value+gradient pass per line-search trial
     (usually exactly one: the unit step is accepted and its gradient is the
-    next iterate's)."""
+    next iterate's).
+
+    ``iteration_callback(it, w, value)`` fires after every accepted
+    iteration (host numpy ``w``) — the streamed sweep's checkpoint hook.
+    Resuming means restarting from the checkpointed ``w`` with a fresh
+    curvature history; L-BFGS rebuilds it within a few iterations."""
     w = np.asarray(w0, np.float64)
     d = w.shape[0]
     max_iter = config.max_iterations
@@ -126,6 +132,8 @@ def host_lbfgs_minimize(
         it += 1
         gn = float(np.linalg.norm(g))
         loss_hist[it], gnorm_hist[it] = f, gn
+        if iteration_callback is not None:
+            iteration_callback(it, w, f)
         if converged_grad(gn):
             reason = ConvergenceReason.GRADIENT_CONVERGED
             break

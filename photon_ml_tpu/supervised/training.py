@@ -176,6 +176,127 @@ def train_glm(
     )
 
 
+class _StreamedSweepCheckpoint:
+    """Resumable state for the streamed λ sweep: an atomic npz with the
+    completed λs' coefficient vectors (rewritten only when a λ finishes)
+    plus a separate small per-iteration file holding the in-progress λ's
+    latest iterate. Both carry a fingerprint of the sweep setup (task,
+    geometry, optimizer config, regularization, data digest), so a changed
+    setup retrains instead of silently resuming; corrupt/foreign files are
+    ignored, never fatal — a resume feature must not be able to brick runs.
+
+    Single-process only: the caller gates this out in multi-host mode
+    (per-host file shards give each process a different data digest, and a
+    process-0-only load would desynchronize the cross-process collectives).
+    """
+
+    def __init__(self, directory, task, chunks, num_features, opt_config, reg):
+        import hashlib
+        import os
+
+        self.directory = directory
+        self.done_path = os.path.join(directory, "sweep-done.npz")
+        self.partial_path = os.path.join(directory, "sweep-partial.npz")
+        first_labels = np.ascontiguousarray(chunks[0]["labels"]) if chunks else np.zeros(0)
+        total_rows = sum(len(c["labels"]) for c in chunks)
+        # NOTE: the λ list is deliberately NOT fingerprinted — completed
+        # models are keyed by λ, so extending the sweep (the canonical
+        # resume-and-extend workflow) reuses what finished and trains the
+        # rest. The optimizer config IS fingerprinted: a λ "completed"
+        # under a smaller iteration budget is not the model a bigger
+        # budget's rerun asks for.
+        self.fingerprint = hashlib.sha256(
+            repr(
+                (
+                    task.value,
+                    num_features,
+                    total_rows,
+                    len(chunks),
+                    opt_config.max_iterations,
+                    opt_config.tolerance,
+                    reg.regularization_type.value if reg is not None else None,
+                )
+            ).encode()
+            + first_labels.tobytes()
+        ).hexdigest()
+        self._completed: dict[str, np.ndarray] = {}
+        self._partial: tuple[float, np.ndarray] | None = None
+        done = self._load(self.done_path)
+        if done is not None:
+            z, _ = done
+            self._completed = {
+                k[len("done__"):]: z[k] for k in z.files if k.startswith("done__")
+            }
+        partial = self._load(self.partial_path)
+        if partial is not None:
+            z, meta = partial
+            if "w" in z.files and meta.get("lam") is not None:
+                self._partial = (float(meta["lam"]), z["w"])
+
+    def _load(self, path):
+        """(npz, meta) when ``path`` is a valid checkpoint matching this
+        sweep's fingerprint; None otherwise (corrupt files included)."""
+        import json as _json
+        import os
+
+        if not os.path.exists(path):
+            return None
+        try:
+            z = np.load(path, allow_pickle=False)
+            meta = _json.loads(bytes(z["__meta__"]).decode())
+        except Exception:
+            return None  # truncated/foreign file: retrain, don't crash
+        if meta.get("fingerprint") != self.fingerprint:
+            return None
+        return z, meta
+
+    def completed_model(self, lam: float) -> np.ndarray | None:
+        got = self._completed.get(repr(float(lam)))
+        return None if got is None else np.asarray(got, np.float64)
+
+    def partial_iterate(self, lam: float) -> np.ndarray | None:
+        if self._partial is not None and self._partial[0] == float(lam):
+            return np.asarray(self._partial[1], np.float64)
+        return None
+
+    def save_partial(self, lam: float, w: np.ndarray) -> None:
+        # small file, rewritten per accepted iteration — the completed
+        # models are immutable and must not be re-serialized that often
+        self._partial = (float(lam), np.asarray(w))
+        self._write(
+            self.partial_path, {"w": self._partial[1]}, {"lam": self._partial[0]}
+        )
+
+    def save_completed(self, lam: float, w: np.ndarray) -> None:
+        import os
+
+        self._completed[repr(float(lam))] = np.asarray(w)
+        self._partial = None
+        self._write(
+            self.done_path,
+            {f"done__{k}": v for k, v in self._completed.items()},
+            {},
+        )
+        try:
+            os.remove(self.partial_path)
+        except OSError:
+            pass
+
+    def _write(self, path: str, arrays: dict, extra_meta: dict) -> None:
+        import json as _json
+        import os
+
+        os.makedirs(self.directory, exist_ok=True)
+        meta = {"fingerprint": self.fingerprint, **extra_meta}
+        arrays = dict(arrays)
+        arrays["__meta__"] = np.frombuffer(
+            _json.dumps(meta).encode(), dtype=np.uint8
+        )
+        tmp = path + f".tmp-{os.getpid()}.npz"
+        np.savez(tmp, **arrays)
+        os.replace(tmp, path)
+
+
 def train_glm_streamed(
     chunks: Sequence[dict],
     task: TaskType,
@@ -188,6 +309,7 @@ def train_glm_streamed(
     evaluators: Sequence[str] = (),
     initial_model: GeneralizedLinearModel | None = None,
     cross_process: bool = False,
+    checkpoint_dir: str | None = None,
 ) -> GLMTrainingResult:
     """Out-of-core twin of ``train_glm``: the same ascending-λ warm-started
     sweep, driven by host L-BFGS over a ``StreamingGLMObjective`` (one
@@ -199,6 +321,14 @@ def train_glm_streamed(
     stream chunk-by-chunk; padded rows carry weight 0, which every
     evaluator treats as absent. L1 (OWL-QN) and TRON are not offered on
     this path — the streamed optimizer is L-BFGS.
+
+    ``checkpoint_dir`` makes the sweep resumable: completed λs' models and
+    the in-progress λ's latest iterate are checkpointed (atomic npz with an
+    embedded fingerprint of the sweep setup + a data digest); a rerun loads
+    completed models and restarts the interrupted λ from its saved iterate
+    with a fresh L-BFGS history. Single-process only — it is rejected with
+    ``cross_process=True`` (per-host data shards make checkpoint decisions
+    diverge across processes and deadlock the gradient collectives).
     """
     from photon_ml_tpu.ops.streaming import StreamingGLMObjective, stream_scores
     from photon_ml_tpu.optim.host_lbfgs import host_lbfgs_minimize
@@ -250,6 +380,21 @@ def train_glm_streamed(
     best_weight: float | None = None
     best_value = float("nan")
 
+    if checkpoint_dir is not None and cross_process:
+        raise ValueError(
+            "checkpoint_dir is not supported with cross_process=True: "
+            "per-host data shards make checkpoint decisions diverge across "
+            "processes and deadlock the gradient collectives"
+        )
+    ckpt = (
+        _StreamedSweepCheckpoint(
+            checkpoint_dir, task, chunks, num_features, optimizer_config,
+            regularization,
+        )
+        if checkpoint_dir is not None
+        else None
+    )
+
     # ONE objective for the whole sweep: its per-chunk kernels are built
     # λ-free (λ applied outside the jit), so mutating l2_weight between λs
     # re-enters the same compiled programs — no recompilation across the grid
@@ -258,12 +403,30 @@ def train_glm_streamed(
         intercept_index=intercept_index, cross_process=cross_process,
     )
     for lam in sorted(regularization_weights):
-        sobj.l2_weight = float(regularization.l2_weight(lam))
-        result = host_lbfgs_minimize(sobj, w, optimizer_config)
-        w = np.asarray(result.w)  # warm start the next λ
-        model = GeneralizedLinearModel(Coefficients(result.w, None), task)
+        done_w = ckpt.completed_model(lam) if ckpt is not None else None
+        if done_w is not None:
+            w = done_w
+            result = None
+        else:
+            sobj.l2_weight = float(regularization.l2_weight(lam))
+            resume_w = ckpt.partial_iterate(lam) if ckpt is not None else None
+            result = host_lbfgs_minimize(
+                sobj,
+                resume_w if resume_w is not None else w,
+                optimizer_config,
+                iteration_callback=(
+                    None if ckpt is None else lambda it, wi, f: ckpt.save_partial(lam, wi)
+                ),
+            )
+            w = np.asarray(result.w)  # warm start the next λ
+            if ckpt is not None:
+                ckpt.save_completed(lam, w)
+        model = GeneralizedLinearModel(
+            Coefficients(jnp.asarray(w, jnp.float32), None), task
+        )
         models[lam] = model
-        trackers[lam] = result
+        if result is not None:
+            trackers[lam] = result
 
         if validation_chunks is not None and specs:
             n_val = len(val_labels)

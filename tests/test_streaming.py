@@ -260,3 +260,80 @@ class TestNativeChunkedReader:
             for k in a:
                 np.testing.assert_allclose(a[k], b[k], rtol=1e-6, atol=1e-6,
                                            err_msg=f"chunk field {k}")
+
+
+class TestStreamedSweepCheckpoint:
+    def _sweep(self, chunks, weights, tmpdir, max_iterations=80, w0=None):
+        from photon_ml_tpu.supervised.training import train_glm_streamed
+
+        return train_glm_streamed(
+            chunks, TaskType.LOGISTIC_REGRESSION, num_features=8,
+            optimizer_config=OptimizerConfig(
+                max_iterations=max_iterations, tolerance=1e-8
+            ),
+            regularization_weights=weights,
+            intercept_index=7,
+            checkpoint_dir=tmpdir,
+        )
+
+    def test_completed_lambdas_short_circuit(self, tmp_path, rng):
+        X, y = _dense_problem(rng, n=400)
+        chunks = dense_chunks(X, y, chunk_rows=128)
+        d = str(tmp_path / "ck")
+        first = self._sweep(chunks, [0.5], d)
+        # extending the sweep reuses λ=0.5's checkpointed model
+        # (no tracker entry = loaded, not retrained) and trains only λ=2.0
+        second = self._sweep(chunks, [0.5, 2.0], d)
+        assert 0.5 not in second.trackers and 2.0 in second.trackers
+        np.testing.assert_allclose(
+            np.asarray(second.models[0.5].coefficients.means),
+            np.asarray(first.models[0.5].coefficients.means),
+            rtol=1e-6,
+        )
+
+    def test_mid_lambda_resume_reaches_same_optimum(self, tmp_path, rng, monkeypatch):
+        import photon_ml_tpu.optim.host_lbfgs as hl
+
+        X, y = _dense_problem(rng, n=400)
+        chunks = dense_chunks(X, y, chunk_rows=128)
+        d = str(tmp_path / "ck")
+
+        # genuinely CRASH mid-λ after 3 accepted iterations (the partial
+        # iterate has been checkpointed by then)
+        orig = hl.host_lbfgs_minimize
+
+        def crashing(obj, w0, config, history=10, iteration_callback=None):
+            def cb(it, w, f):
+                if iteration_callback is not None:
+                    iteration_callback(it, w, f)
+                if it >= 3:
+                    raise KeyboardInterrupt
+
+            return orig(obj, w0, config, history, cb)
+
+        monkeypatch.setattr(hl, "host_lbfgs_minimize", crashing)
+        with pytest.raises(KeyboardInterrupt):
+            self._sweep(chunks, [1.0], d)
+        monkeypatch.setattr(hl, "host_lbfgs_minimize", orig)
+
+        resumed = self._sweep(chunks, [1.0], d)
+        assert 1.0 in resumed.trackers  # partial: retrained, not loaded
+        # the resumed solve starts from the saved iterate, not from zero
+        assert int(resumed.trackers[1.0].iterations) < 80
+        full = self._sweep(chunks, [1.0], str(tmp_path / "fresh"))
+        np.testing.assert_allclose(
+            np.asarray(resumed.models[1.0].coefficients.means),
+            np.asarray(full.models[1.0].coefficients.means),
+            rtol=1e-3, atol=1e-4,
+        )
+
+    def test_fingerprint_guards_changed_data(self, tmp_path, rng):
+        X, y = _dense_problem(rng, n=400)
+        chunks = dense_chunks(X, y, chunk_rows=128)
+        d = str(tmp_path / "ck")
+        self._sweep(chunks, [1.0], d)
+        # different data, same geometry: checkpoint must be ignored
+        X2, y2 = _dense_problem(np.random.default_rng(999), n=400)
+        chunks2 = dense_chunks(X2, y2, chunk_rows=128)
+        redone = self._sweep(chunks2, [1.0], d)
+        assert 1.0 in redone.trackers  # retrained from scratch
