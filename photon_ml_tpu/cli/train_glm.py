@@ -97,8 +97,10 @@ def run(
     if streaming_chunk_rows is not None:
         # reject — not silently drop — options the streaming branch can't honor
         unsupported = []
-        if optimizer is not OptimizerType.LBFGS:
-            unsupported.append(f"--optimizer {optimizer.value} (host L-BFGS only)")
+        if optimizer not in (OptimizerType.LBFGS, OptimizerType.TRON):
+            unsupported.append(
+                f"--optimizer {optimizer.value} (streaming offers LBFGS/TRON)"
+            )
         if normalization is not NormalizationType.NONE:
             unsupported.append(f"--normalization {normalization.value}")
         if variance_computation is not VarianceComputationType.NONE:
@@ -116,7 +118,7 @@ def run(
             task, train_data, output_dir, data_format, validation_data,
             regularization, weights, max_iterations, tolerance,
             streaming_chunk_rows, advance, logger, multihost=multihost,
-            profile_dir=profile_dir,
+            profile_dir=profile_dir, optimizer=optimizer,
         )
 
     advance("INIT")
@@ -242,6 +244,7 @@ def _run_streamed(
     regularization, weights, max_iterations, tolerance,
     chunk_rows, advance, logger, multihost: bool = False,
     profile_dir: str | None = None,
+    optimizer: OptimizerType = OptimizerType.LBFGS,
 ):
     """Out-of-core branch: data is read in uniform chunks that live in host
     RAM and stream through the device per optimizer iteration (SURVEY.md §7
@@ -306,7 +309,9 @@ def _run_streamed(
             task,
             num_features=imap.size,
             optimizer_config=OptimizerConfig(
-                max_iterations=max_iterations, tolerance=tolerance
+                optimizer_type=optimizer,
+                max_iterations=max_iterations,
+                tolerance=tolerance,
             ),
             regularization=RegularizationContext(regularization),
             regularization_weights=list(weights),

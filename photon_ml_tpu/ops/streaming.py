@@ -16,11 +16,12 @@ DMA overlaps the matmuls (JAX dispatch is asynchronous). The per-chunk
 kernel is ONE compiled program re-entered for every chunk of every
 iteration (uniform chunk shapes are a hard requirement for that).
 
-The optimizer driving this is host-side L-BFGS (``host_lbfgs_minimize``):
-the device-resident ``lax.while_loop`` optimizers cannot stream host data
-from inside a compiled loop, so the loop structure intentionally mirrors
-the reference's driver-resident Breeze loop — one streamed pass per
-value+gradient evaluation. For data that fits HBM, the fully
+The optimizers driving this are host-side L-BFGS and TRON
+(``optim.host_lbfgs`` / ``optim.host_tron``): the device-resident
+``lax.while_loop`` optimizers cannot stream host data from inside a
+compiled loop, so the loop structure intentionally mirrors the reference's
+driver-resident Breeze loop — one streamed pass per value+gradient
+evaluation (plus one per CG step for TRON). For data that fits HBM, the fully
 device-resident optimizers in ``photon_ml_tpu.optim`` remain the fast
 path; ``fits_in_memory`` below is the decision rule.
 """
@@ -166,15 +167,23 @@ class StreamingGLMObjective:
             )
             return obj.value(w)
 
+        def chunk_hvp(batch: Batch, wv: tuple[Array, Array]):
+            obj = make_objective(
+                batch, self.loss, l2_weight=0.0, norm=self.norm,
+                intercept_index=self.intercept_index,
+            )
+            return obj.hvp(wv[0], wv[1])
+
         # ONE compiled kernel per contract, re-entered for every chunk
         self._chunk_vg = jax.jit(chunk_value_grad)
         self._chunk_v = jax.jit(chunk_value)
+        self._chunk_hvp = jax.jit(chunk_hvp)
 
-    def _stream(self, w: Array, kernel: Callable, accumulate: Callable, init):
+    def _stream(self, params, kernel: Callable, accumulate: Callable, init):
         """Double-buffered host→device chunk pipeline: the NEXT chunk's
         transfer is issued before the CURRENT chunk's compute result is
-        consumed, so DMA overlaps compute (async dispatch)."""
-        w = jnp.asarray(w)
+        consumed, so DMA overlaps compute (async dispatch). ``params`` is
+        passed to ``kernel`` verbatim (an array or a tuple of arrays)."""
         acc = init
         if self.chunks:
             nxt = jax.device_put(self.chunks[0])
@@ -182,7 +191,7 @@ class StreamingGLMObjective:
                 cur = nxt
                 if i + 1 < len(self.chunks):
                     nxt = jax.device_put(self.chunks[i + 1])
-                out = kernel(_to_batch(cur, self.num_features), w)
+                out = kernel(_to_batch(cur, self.num_features), params)
                 acc = accumulate(acc, out)
         return acc
 
@@ -191,13 +200,32 @@ class StreamingGLMObjective:
 
     def value(self, w: Array) -> Array:
         total = self._stream(
-            w, self._chunk_v, lambda acc, v: acc + v, jnp.float32(0.0)
+            jnp.asarray(w), self._chunk_v, lambda acc, v: acc + v, jnp.float32(0.0)
         )
         if self.cross_process:
             from photon_ml_tpu.parallel.multihost import allreduce_sum_host
 
             total = jnp.asarray(allreduce_sum_host(np.asarray(total)))
         return total + self._l2_term(jnp.asarray(w))
+
+    def hvp(self, w: Array, v: Array) -> Array:
+        """Gauss-Newton Hessian-vector product, streamed — TRON's CG inner
+        loop costs one full-data pass per step, exactly the reference's
+        treeAggregate accounting (SURVEY §2.1 TRON row)."""
+        w = jnp.asarray(w)
+        v = jnp.asarray(v)
+        init = jnp.zeros((self.num_features,), jnp.float32)
+        hv = self._stream(
+            (w, v),
+            lambda batch, wv: self._chunk_hvp(batch, wv),
+            lambda acc, out: acc + out,
+            init,
+        )
+        if self.cross_process:
+            from photon_ml_tpu.parallel.multihost import allreduce_sum_host
+
+            hv = jnp.asarray(allreduce_sum_host(np.asarray(hv)))
+        return hv + jnp.float32(self.l2_weight) * self._reg_mask * v
 
     def value_and_grad(self, w: Array) -> tuple[Array, Array]:
         w = jnp.asarray(w)

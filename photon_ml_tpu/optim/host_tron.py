@@ -1,0 +1,153 @@
+"""Host-driven TRON for streaming (out-of-core) objectives.
+
+Mirrors ``optim/tron.py`` — the same LIBLINEAR trust-region truncated-
+Newton algorithm, the same η/σ constants, the same convergence and
+stagnation tests — but as a host loop over an objective whose every
+``value_and_grad``/``hvp`` evaluation streams the dataset through the
+device (``StreamingGLMObjective``). This is exactly the reference's cost
+model: one cluster pass per outer evaluation plus one per CG step
+(``HessianVectorAggregator`` over treeAggregate — SURVEY.md §2.1 TRON
+row). For HBM-resident data, the fully compiled ``tron_minimize`` remains
+the fast path.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.config import OptimizerConfig
+from photon_ml_tpu.optim.common import ConvergenceReason, OptimizationResult
+
+# LIBLINEAR tron.cpp constants (identical to optim/tron.py)
+_ETA0, _ETA1, _ETA2 = 1e-4, 0.25, 0.75
+_SIGMA1, _SIGMA2, _SIGMA3 = 0.25, 0.5, 4.0
+_CG_XI = 0.1
+
+
+def _trcg_host(hvp, g: np.ndarray, delta: float, max_cg: int):
+    """Truncated CG for H·s = -g within ‖s‖ ≤ delta (host twin of
+    ``tron._trcg``; each ``hvp`` call is one streamed data pass)."""
+    s = np.zeros_like(g)
+    r = -g
+    d = r.copy()
+    rtr = float(r @ r)
+    cg_tol = _CG_XI * float(np.linalg.norm(g))
+    for _ in range(max_cg):
+        if np.sqrt(rtr) <= cg_tol:
+            break
+        hd = np.asarray(hvp(d), np.float64)
+        dhd = float(d @ hd)
+        alpha = rtr / max(dhd, 1e-30)
+        s1 = s + alpha * d
+        if np.linalg.norm(s1) > delta:
+            # boundary intersection: τ ≥ 0 with ‖s + τ·d‖ = delta
+            std = float(s @ d)
+            dd = float(d @ d)
+            ss = float(s @ s)
+            rad = np.sqrt(max(std * std + dd * (delta * delta - ss), 0.0))
+            if std >= 0.0:
+                tau = (delta * delta - ss) / max(std + rad, 1e-30)
+            else:
+                tau = (rad - std) / max(dd, 1e-30)
+            s = s + tau * d
+            r = r - tau * hd
+            break
+        s = s1
+        r = r - alpha * hd
+        rtr_new = float(r @ r)
+        beta = rtr_new / max(rtr, 1e-30)
+        d = r + beta * d
+        rtr = rtr_new
+    return s, r
+
+
+def host_tron_minimize(
+    objective: Any,
+    w0: np.ndarray,
+    config: OptimizerConfig,
+    iteration_callback: Any = None,
+) -> OptimizationResult:
+    """Minimize with TRON driven from the host. ``objective`` must expose
+    ``value_and_grad(w)`` and ``hvp(w, v)`` (e.g.
+    ``StreamingGLMObjective``). ``iteration_callback(it, w, value)`` fires
+    after every outer iteration — the streamed sweep's checkpoint hook."""
+    T = config.max_iterations
+    tol = config.tolerance
+
+    def vg(w_):
+        v, g = objective.value_and_grad(jnp.asarray(w_, jnp.float32))
+        return float(v), np.asarray(g, np.float64)
+
+    w = np.asarray(w0, np.float64)
+    f, g = vg(w)
+    g0_norm = float(np.linalg.norm(g))
+    loss_hist = np.full(T + 1, np.nan)
+    gnorm_hist = np.full(T + 1, np.nan)
+    loss_hist[0], gnorm_hist[0] = f, g0_norm
+
+    def converged_grad(gn):
+        return gn <= tol * max(1.0, g0_norm)
+
+    delta = g0_norm
+    reason = ConvergenceReason.MAX_ITERATIONS
+    it = 0
+    if converged_grad(g0_norm):
+        reason = ConvergenceReason.GRADIENT_CONVERGED
+        T = 0
+
+    while it < T:
+        s, r = _trcg_host(
+            lambda v: objective.hvp(jnp.asarray(w, jnp.float32), jnp.asarray(v, jnp.float32)),
+            g, delta, config.max_cg_iterations,
+        )
+        gs = float(g @ s)
+        prered = -0.5 * (gs - float(s @ r))
+        f_new, g_new = vg(w + s)
+        actred = f - f_new
+        snorm = float(np.linalg.norm(s))
+
+        if it == 0:
+            delta = min(delta, snorm)
+        denom = f_new - f - gs
+        alpha = _SIGMA3 if denom <= 0.0 else max(_SIGMA1, -0.5 * gs / denom)
+        if actred < _ETA0 * prered:
+            delta = min(max(alpha, _SIGMA1) * snorm, _SIGMA2 * delta)
+        elif actred < _ETA1 * prered:
+            delta = max(_SIGMA1 * delta, min(alpha * snorm, _SIGMA2 * delta))
+        elif actred < _ETA2 * prered:
+            delta = max(_SIGMA1 * delta, min(alpha * snorm, _SIGMA3 * delta))
+        else:
+            delta = max(delta, min(alpha * snorm, _SIGMA3 * delta))
+
+        accept = actred > _ETA0 * prered
+        if accept:
+            w, f, g = w + s, f_new, g_new
+        gn = float(np.linalg.norm(g))
+        it += 1
+        loss_hist[it], gnorm_hist[it] = f, gn
+        if iteration_callback is not None:
+            iteration_callback(it, w, f)
+
+        if accept and converged_grad(gn):
+            reason = ConvergenceReason.GRADIENT_CONVERGED
+            break
+        tiny = 1e-12 * abs(f)
+        stalled = (abs(actred) <= 0.0 and prered <= 0.0) or (
+            abs(actred) <= tiny and abs(prered) <= tiny
+        )
+        if stalled or f < -1e32:
+            reason = ConvergenceReason.OBJECTIVE_CONVERGED
+            break
+
+    return OptimizationResult(
+        w=jnp.asarray(w, jnp.float32),
+        value=jnp.asarray(f, jnp.float32),
+        grad_norm=jnp.asarray(np.linalg.norm(g), jnp.float32),
+        iterations=jnp.asarray(it, jnp.int32),
+        reason=jnp.asarray(int(reason), jnp.int32),
+        loss_history=jnp.asarray(loss_hist, jnp.float32),
+        grad_norm_history=jnp.asarray(gnorm_hist, jnp.float32),
+    )

@@ -212,7 +212,9 @@ class _StreamedSweepCheckpoint:
                     num_features,
                     total_rows,
                     len(chunks),
+                    opt_config.optimizer_type.value,
                     opt_config.max_iterations,
+                    opt_config.max_cg_iterations,
                     opt_config.tolerance,
                     reg.regularization_type.value if reg is not None else None,
                 )
@@ -319,8 +321,9 @@ def train_glm_streamed(
     ``chunks`` are uniform host chunk dicts (``photon_ml_tpu.ops.streaming``
     builders or ``AvroDataReader.iter_batch_chunks``). Validation scores
     stream chunk-by-chunk; padded rows carry weight 0, which every
-    evaluator treats as absent. L1 (OWL-QN) and TRON are not offered on
-    this path — the streamed optimizer is L-BFGS.
+    evaluator treats as absent. The streamed optimizers are host-driven
+    L-BFGS and TRON (selected by ``optimizer_config.optimizer_type``);
+    L1 (OWL-QN) is not offered on this path.
 
     ``checkpoint_dir`` makes the sweep resumable: completed λs' models and
     the in-progress λ's latest iterate are checkpointed (atomic npz with an
@@ -332,6 +335,7 @@ def train_glm_streamed(
     """
     from photon_ml_tpu.ops.streaming import StreamingGLMObjective, stream_scores
     from photon_ml_tpu.optim.host_lbfgs import host_lbfgs_minimize
+    from photon_ml_tpu.optim.host_tron import host_tron_minimize
     from photon_ml_tpu.types import RegularizationType
 
     optimizer_config = optimizer_config or OptimizerConfig()
@@ -344,7 +348,16 @@ def train_glm_streamed(
     if regularization.l1_weight(1.0) > 0:
         raise NotImplementedError(
             "L1/elastic-net is not supported on the streaming path (host "
-            "L-BFGS only); use the in-memory trainer or L2"
+            "L-BFGS/TRON only); use the in-memory trainer or L2"
+        )
+    host_minimize = {
+        OptimizerType.LBFGS: host_lbfgs_minimize,
+        OptimizerType.TRON: host_tron_minimize,
+    }.get(optimizer_config.optimizer_type)
+    if host_minimize is None:
+        raise NotImplementedError(
+            f"optimizer {optimizer_config.optimizer_type} has no streaming "
+            f"(host-driven) twin; use LBFGS or TRON"
         )
     if regularization.regularization_type is RegularizationType.NONE and has_weights:
         raise ValueError(
@@ -410,7 +423,7 @@ def train_glm_streamed(
         else:
             sobj.l2_weight = float(regularization.l2_weight(lam))
             resume_w = ckpt.partial_iterate(lam) if ckpt is not None else None
-            result = host_lbfgs_minimize(
+            result = host_minimize(
                 sobj,
                 resume_w if resume_w is not None else w,
                 optimizer_config,

@@ -337,3 +337,58 @@ class TestStreamedSweepCheckpoint:
         chunks2 = dense_chunks(X2, y2, chunk_rows=128)
         redone = self._sweep(chunks2, [1.0], d)
         assert 1.0 in redone.trackers  # retrained from scratch
+
+
+class TestHostTRON:
+    def test_streamed_tron_matches_device_tron(self, rng):
+        from photon_ml_tpu.optim.host_tron import host_tron_minimize
+        from photon_ml_tpu.optim.tron import tron_minimize
+
+        X, y = _dense_problem(rng, n=600)
+        batch = dense_batch_from_numpy(X, y)
+        cfg = OptimizerConfig(max_iterations=40, tolerance=1e-8)
+        obj = make_objective(batch, LOSS, l2_weight=1.0, intercept_index=7)
+        dev = tron_minimize(obj, jnp.zeros(8), cfg)
+
+        chunks = dense_chunks(X, y, chunk_rows=160)
+        sobj = StreamingGLMObjective(
+            chunks, LOSS, num_features=8, l2_weight=1.0, intercept_index=7
+        )
+        host = host_tron_minimize(sobj, np.zeros(8), cfg)
+        np.testing.assert_allclose(
+            np.asarray(host.w), np.asarray(dev.w), rtol=1e-3, atol=1e-3
+        )
+        np.testing.assert_allclose(float(host.value), float(dev.value), rtol=1e-5)
+
+    def test_streamed_hvp_matches_in_memory(self, rng):
+        X, y = _dense_problem(rng, n=300)
+        batch = dense_batch_from_numpy(X, y)
+        obj = make_objective(batch, LOSS, l2_weight=0.4, intercept_index=7)
+        chunks = dense_chunks(X, y, chunk_rows=77)
+        sobj = StreamingGLMObjective(
+            chunks, LOSS, num_features=8, l2_weight=0.4, intercept_index=7
+        )
+        w = jnp.asarray(rng.normal(size=8), jnp.float32)
+        v = jnp.asarray(rng.normal(size=8), jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(sobj.hvp(w, v)), np.asarray(obj.hvp(w, v)),
+            rtol=1e-4, atol=1e-4,
+        )
+
+    def test_streamed_sweep_with_tron(self, tmp_path, rng):
+        from photon_ml_tpu.supervised.training import train_glm_streamed
+        from photon_ml_tpu.types import OptimizerType
+
+        X, y = _dense_problem(rng, n=400)
+        chunks = dense_chunks(X, y, chunk_rows=128)
+        result = train_glm_streamed(
+            chunks, TaskType.LOGISTIC_REGRESSION, num_features=8,
+            optimizer_config=OptimizerConfig(
+                optimizer_type=OptimizerType.TRON,
+                max_iterations=40, tolerance=1e-8,
+            ),
+            regularization_weights=[1.0],
+            intercept_index=7,
+            checkpoint_dir=str(tmp_path / "ck"),
+        )
+        assert bool(result.trackers[1.0].converged)
