@@ -101,6 +101,13 @@ def run(
             unsupported.append(
                 f"--optimizer {optimizer.value} (streaming offers LBFGS/TRON)"
             )
+        if optimizer is OptimizerType.TRON and regularization in (
+            RegularizationType.L1, RegularizationType.ELASTIC_NET
+        ):
+            unsupported.append(
+                f"--optimizer TRON with --regularization {regularization.value} "
+                f"(L1 routes through OWL-QN; use LBFGS)"
+            )
         if normalization is not NormalizationType.NONE:
             unsupported.append(f"--normalization {normalization.value}")
         if variance_computation is not VarianceComputationType.NONE:

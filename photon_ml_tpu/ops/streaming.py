@@ -151,7 +151,9 @@ class StreamingGLMObjective:
         mask = jnp.ones((self.num_features,), jnp.float32)
         if self.intercept_index is not None:
             mask = mask.at[self.intercept_index].set(0.0)
-        self._reg_mask = mask
+        # public: the host OWL-QN twin applies scalar L1 over this mask,
+        # exactly like the device objective's reg_mask contract
+        self.reg_mask = mask
 
         def chunk_value_grad(batch: Batch, w: Array):
             obj = make_objective(
@@ -196,7 +198,7 @@ class StreamingGLMObjective:
         return acc
 
     def _l2_term(self, w: Array) -> Array:
-        return 0.5 * self.l2_weight * jnp.sum(self._reg_mask * w * w)
+        return 0.5 * self.l2_weight * jnp.sum(self.reg_mask * w * w)
 
     def value(self, w: Array) -> Array:
         total = self._stream(
@@ -225,7 +227,7 @@ class StreamingGLMObjective:
             from photon_ml_tpu.parallel.multihost import allreduce_sum_host
 
             hv = jnp.asarray(allreduce_sum_host(np.asarray(hv)))
-        return hv + jnp.float32(self.l2_weight) * self._reg_mask * v
+        return hv + jnp.float32(self.l2_weight) * self.reg_mask * v
 
     def value_and_grad(self, w: Array) -> tuple[Array, Array]:
         w = jnp.asarray(w)
@@ -240,7 +242,7 @@ class StreamingGLMObjective:
 
             v, g = allreduce_sum_host(np.asarray(v), np.asarray(g))
             v, g = jnp.asarray(v), jnp.asarray(g)
-        g = g + jnp.float32(self.l2_weight) * self._reg_mask * w
+        g = g + jnp.float32(self.l2_weight) * self.reg_mask * w
         return v + self._l2_term(w), g
 
 

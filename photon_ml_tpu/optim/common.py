@@ -82,21 +82,39 @@ def grad_converged(g_norm: Array, g0_norm: Array, tolerance: float) -> Array:
     return g_norm <= tolerance * jnp.maximum(1.0, g0_norm)
 
 
-def select_minimize_fn(config: OptimizerConfig, l1_weight: float = 0.0) -> tuple[Callable, dict]:
+def select_minimize_fn(
+    config: OptimizerConfig, l1_weight: float = 0.0, host: bool = False
+) -> tuple[Callable, dict]:
     """THE optimizer-selection rule (single source of truth, used by every
     trainer): TRON if configured (rejecting L1, reference parity), else
     OWL-QN when L1 is active, else L-BFGS. Returns (fn, extra_kwargs) where
-    ``fn(objective, w0, config, **extra_kwargs)`` runs the solve."""
-    from photon_ml_tpu.optim.lbfgs import lbfgs_minimize, owlqn_minimize
-    from photon_ml_tpu.optim.tron import tron_minimize
+    ``fn(objective, w0, config, **extra_kwargs)`` runs the solve.
+
+    ``host=True`` selects the host-driven twins (streaming/out-of-core
+    objectives) — same rule, same rejection, same call shape."""
+    if host:
+        from photon_ml_tpu.optim.host_lbfgs import (
+            host_lbfgs_minimize,
+            host_owlqn_minimize,
+        )
+        from photon_ml_tpu.optim.host_tron import host_tron_minimize
+
+        lbfgs_fn, owlqn_fn, tron_fn = (
+            host_lbfgs_minimize, host_owlqn_minimize, host_tron_minimize,
+        )
+    else:
+        from photon_ml_tpu.optim.lbfgs import lbfgs_minimize, owlqn_minimize
+        from photon_ml_tpu.optim.tron import tron_minimize
+
+        lbfgs_fn, owlqn_fn, tron_fn = lbfgs_minimize, owlqn_minimize, tron_minimize
 
     if config.optimizer_type is OptimizerType.TRON:
         if l1_weight > 0.0:
             raise ValueError("TRON does not support L1 regularization (reference parity)")
-        return tron_minimize, {}
+        return tron_fn, {}
     if l1_weight > 0.0:
-        return owlqn_minimize, {"l1_weight": l1_weight}
-    return lbfgs_minimize, {}
+        return owlqn_fn, {"l1_weight": l1_weight}
+    return lbfgs_fn, {}
 
 
 def make_optimizer(config: OptimizerConfig, l1_weight: float = 0.0) -> Callable:

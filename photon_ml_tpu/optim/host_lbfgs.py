@@ -1,24 +1,26 @@
-"""Host-driven L-BFGS for streaming (out-of-core) objectives.
+"""Host-driven L-BFGS / OWL-QN for streaming (out-of-core) objectives.
 
 Reference parity: the reference's optimizer loop IS host-driven — Breeze
-L-BFGS on the Spark driver, with each value+gradient evaluation fanned out
-over executors (``photon-lib::ml.optimization.LBFGS`` wrapping
-``breeze.optimize.LBFGS``, SURVEY.md §2.1). The TPU build keeps the fully
-device-resident ``lax.while_loop`` L-BFGS (``photon_ml_tpu.optim.lbfgs``)
-as the fast path for HBM-resident data; THIS loop exists for datasets that
-must stream through the device per evaluation — a compiled loop cannot
-pull host chunks from inside ``lax.while_loop``.
+L-BFGS/OWL-QN on the Spark driver, with each value+gradient evaluation
+fanned out over executors (``photon-lib::ml.optimization.{LBFGS, OWLQN}``,
+SURVEY.md §2.1). The TPU build keeps the fully device-resident
+``lax.while_loop`` implementations (``photon_ml_tpu.optim.lbfgs``) as the
+fast path for HBM-resident data; THIS loop exists for datasets that must
+stream through the device per evaluation — a compiled loop cannot pull
+host chunks from inside ``lax.while_loop``.
 
 Math mirrors ``lbfgs.py``: ring-buffer two-loop recursion, Armijo
-backtracking, the same convergence tests (relative gradient norm, relative
-objective decrease), the same ``OptimizationResult`` contract — so
-trainers can swap the two paths without behavioral drift. The small-vector
-recursion math runs in float64 on host (d ≤ a few million: megabytes).
+backtracking on the (possibly orthant-projected) actual step, OWL-QN's
+pseudo-gradient / orthant-constrained direction / sign-projected trial
+points, the same convergence tests, the same ``OptimizationResult``
+contract — so trainers can swap the two paths without behavioral drift.
+The small-vector recursion math runs in float64 on host (d ≤ a few
+million: megabytes).
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any
 
 import jax.numpy as jnp
 import numpy as np
@@ -28,21 +30,31 @@ from photon_ml_tpu.optim.common import ConvergenceReason, OptimizationResult
 
 _ARMIJO_C1 = 1e-4
 _BACKTRACK = 0.5
-_MAX_LINE_SEARCH = 20
+_CURVATURE_EPS = 1e-10
+
+
+def _pseudo_gradient(w: np.ndarray, g: np.ndarray, l1w: np.ndarray) -> np.ndarray:
+    """OWL-QN pseudo-gradient (minimal-norm subgradient of f + Σ l1ⱼ|wⱼ|)."""
+    gp = g + l1w
+    gm = g - l1w
+    at_zero = np.where(gp < 0.0, gp, np.where(gm > 0.0, gm, 0.0))
+    return np.where(w > 0.0, gp, np.where(w < 0.0, gm, at_zero))
 
 
 def host_lbfgs_minimize(
     objective: Any,
     w0: np.ndarray,
     config: OptimizerConfig,
-    history: int = 10,
+    history: int | None = None,
     iteration_callback: Any = None,
+    l1_weight: np.ndarray | None = None,
 ) -> OptimizationResult:
     """Minimize ``objective`` (anything exposing ``value_and_grad(w)`` —
-    e.g. ``StreamingGLMObjective``) with L-BFGS driven from the host. Each
+    e.g. ``StreamingGLMObjective``) with L-BFGS driven from the host; with
+    ``l1_weight`` (a per-coordinate L1 vector) the loop runs OWL-QN. Each
     iteration costs one streamed value+gradient pass per line-search trial
-    (usually exactly one: the unit step is accepted and its gradient is the
-    next iterate's).
+    (usually exactly one: the accepted trial's gradient is the next
+    iterate's).
 
     ``iteration_callback(it, w, value)`` fires after every accepted
     iteration (host numpy ``w``) — the streamed sweep's checkpoint hook.
@@ -52,13 +64,25 @@ def host_lbfgs_minimize(
     d = w.shape[0]
     max_iter = config.max_iterations
     tol = config.tolerance
+    # same knobs as the device loop (behavioral-parity requirement)
+    history = config.history_length if history is None else history
+    max_ls = config.max_line_search_steps
+    use_l1 = l1_weight is not None
+    l1w = np.asarray(l1_weight, np.float64) if use_l1 else None
 
     def vg(w_):
         v, g = objective.value_and_grad(jnp.asarray(w_, jnp.float32))
-        return float(v), np.asarray(g, np.float64)
+        f = float(v)
+        g = np.asarray(g, np.float64)
+        if use_l1:
+            f += float(np.sum(l1w * np.abs(w_)))
+            pg = _pseudo_gradient(np.asarray(w_, np.float64), g, l1w)
+        else:
+            pg = g
+        return f, g, pg
 
-    f, g = vg(w)
-    g0_norm = float(np.linalg.norm(g))
+    f, g, pg = vg(w)
+    g0_norm = float(np.linalg.norm(pg))
     loss_hist = np.full(max_iter + 1, np.nan)
     gnorm_hist = np.full(max_iter + 1, np.nan)
     loss_hist[0], gnorm_hist[0] = f, g0_norm
@@ -78,8 +102,8 @@ def host_lbfgs_minimize(
         max_iter = 0
 
     while it < max_iter:
-        # two-loop recursion over the ring buffer
-        q = g.copy()
+        # two-loop recursion over the ring buffer (on the pseudo-gradient)
+        q = pg.copy()
         m = min(count, history)
         alphas = np.zeros(history)
         for j in range(m):
@@ -94,24 +118,40 @@ def host_lbfgs_minimize(
             i = (count - 1 - j) % history
             beta = rho[i] * np.dot(Y[i], q)
             q += (alphas[i] - beta) * S[i]
-        p = -q  # descent direction
+        p = -q
 
-        gTp = np.dot(g, p)
-        if gTp >= 0:  # not a descent direction: restart with steepest descent
-            p = -g
-            gTp = -np.dot(g, g)
+        if use_l1:
+            # constrain the search direction to the descent orthant
+            p = np.where(p * (-pg) > 0.0, p, 0.0)
+        if np.dot(p, pg) >= 0:  # not a descent direction: steepest descent
+            p = -pg
 
-        # Armijo backtracking. Every trial uses value_and_grad (on the
-        # streaming path the host→device transfer per chunk is identical
-        # for value-only and value+grad passes, and the accepted trial's
-        # gradient is needed anyway — so the common first-trial accept
-        # costs exactly ONE streamed sweep per iteration).
-        step = 1.0
+        if use_l1:
+            xi = np.where(w != 0.0, np.sign(w), np.sign(-pg))
+
+            def trial_point(t):
+                x = w + t * p
+                return np.where(np.sign(x) == xi, x, 0.0)
+        else:
+
+            def trial_point(t):
+                return w + t * p
+
+        # first iteration: identity Hessian guess → unit-length initial step
+        step = 1.0 if count > 0 else 1.0 / max(1.0, float(np.linalg.norm(p)))
+
+        # Armijo backtracking on the ACTUAL (possibly projected) step.
+        # Every trial uses value_and_grad: on the streaming path the
+        # host→device transfer per chunk is identical for value-only and
+        # value+grad passes, and the accepted trial's gradient is needed
+        # anyway — so the common first-trial accept costs ONE streamed
+        # sweep per iteration.
         accepted = False
-        for _ in range(_MAX_LINE_SEARCH):
-            w_try = w + step * p
-            f_try, g_try = vg(w_try)
-            if f_try <= f + _ARMIJO_C1 * step * gTp:
+        for _ in range(max_ls):
+            w_try = trial_point(step)
+            f_try, g_try, pg_try = vg(w_try)
+            rhs = f + _ARMIJO_C1 * float(np.dot(pg, w_try - w))
+            if f_try <= rhs and not np.isnan(f_try):
                 accepted = True
                 break
             step *= _BACKTRACK
@@ -119,18 +159,16 @@ def host_lbfgs_minimize(
             reason = ConvergenceReason.LINE_SEARCH_FAILED
             break
 
-        w_new = w_try
-        f_prev = f
-        f, g_new = f_try, g_try
-        s, y = w_new - w, g_new - g
+        s, y = w_try - w, g_try - g
         sy = np.dot(s, y)
-        if sy > 1e-10:
+        if sy > _CURVATURE_EPS:
             i = count % history
             S[i], Y[i], rho[i] = s, y, 1.0 / sy
             count += 1
-        w, g = w_new, g_new
+        f_prev = f
+        w, f, g, pg = w_try, f_try, g_try, pg_try
         it += 1
-        gn = float(np.linalg.norm(g))
+        gn = float(np.linalg.norm(pg))
         loss_hist[it], gnorm_hist[it] = f, gn
         if iteration_callback is not None:
             iteration_callback(it, w, f)
@@ -144,9 +182,27 @@ def host_lbfgs_minimize(
     return OptimizationResult(
         w=jnp.asarray(w, jnp.float32),
         value=jnp.asarray(f, jnp.float32),
-        grad_norm=jnp.asarray(np.linalg.norm(g), jnp.float32),
+        grad_norm=jnp.asarray(np.linalg.norm(pg), jnp.float32),
         iterations=jnp.asarray(it, jnp.int32),
         reason=jnp.asarray(int(reason), jnp.int32),
         loss_history=jnp.asarray(loss_hist, jnp.float32),
         grad_norm_history=jnp.asarray(gnorm_hist, jnp.float32),
+    )
+
+
+def host_owlqn_minimize(
+    objective: Any,
+    w0: np.ndarray,
+    config: OptimizerConfig,
+    l1_weight: float,
+    history: int | None = None,
+    iteration_callback: Any = None,
+) -> OptimizationResult:
+    """OWL-QN driven from the host — the device ``owlqn_minimize``'s call
+    shape: scalar ``l1_weight`` applied over ``objective.reg_mask`` (the
+    intercept and other unregularized coordinates stay L1-free)."""
+    l1_vec = float(l1_weight) * np.asarray(objective.reg_mask, np.float64)
+    return host_lbfgs_minimize(
+        objective, w0, config, history=history,
+        iteration_callback=iteration_callback, l1_weight=l1_vec,
     )

@@ -323,7 +323,8 @@ def train_glm_streamed(
     stream chunk-by-chunk; padded rows carry weight 0, which every
     evaluator treats as absent. The streamed optimizers are host-driven
     L-BFGS and TRON (selected by ``optimizer_config.optimizer_type``);
-    L1 (OWL-QN) is not offered on this path.
+    a positive L1 weight routes through host OWL-QN, exactly like the
+    in-memory path (L1 with TRON is rejected, as in the reference).
 
     ``checkpoint_dir`` makes the sweep resumable: completed λs' models and
     the in-progress λ's latest iterate are checkpointed (atomic npz with an
@@ -334,8 +335,7 @@ def train_glm_streamed(
     diverge across processes and deadlock the gradient collectives).
     """
     from photon_ml_tpu.ops.streaming import StreamingGLMObjective, stream_scores
-    from photon_ml_tpu.optim.host_lbfgs import host_lbfgs_minimize
-    from photon_ml_tpu.optim.host_tron import host_tron_minimize
+    from photon_ml_tpu.optim.common import select_minimize_fn
     from photon_ml_tpu.types import RegularizationType
 
     optimizer_config = optimizer_config or OptimizerConfig()
@@ -345,20 +345,11 @@ def train_glm_streamed(
         regularization = RegularizationContext(
             RegularizationType.L2 if has_weights else RegularizationType.NONE
         )
-    if regularization.l1_weight(1.0) > 0:
-        raise NotImplementedError(
-            "L1/elastic-net is not supported on the streaming path (host "
-            "L-BFGS/TRON only); use the in-memory trainer or L2"
-        )
-    host_minimize = {
-        OptimizerType.LBFGS: host_lbfgs_minimize,
-        OptimizerType.TRON: host_tron_minimize,
-    }.get(optimizer_config.optimizer_type)
-    if host_minimize is None:
-        raise NotImplementedError(
-            f"optimizer {optimizer_config.optimizer_type} has no streaming "
-            f"(host-driven) twin; use LBFGS or TRON"
-        )
+    # fail fast on unsupported combinations BEFORE any data work: the
+    # selection rule (and its rejections) is shared with the in-memory path
+    select_minimize_fn(
+        optimizer_config, regularization.l1_weight(1.0), host=True
+    )
     if regularization.regularization_type is RegularizationType.NONE and has_weights:
         raise ValueError(
             "regularization_weights > 0 with RegularizationType.NONE would be "
@@ -423,13 +414,17 @@ def train_glm_streamed(
         else:
             sobj.l2_weight = float(regularization.l2_weight(lam))
             resume_w = ckpt.partial_iterate(lam) if ckpt is not None else None
-            result = host_minimize(
+            minimize, extra = select_minimize_fn(
+                optimizer_config, regularization.l1_weight(lam), host=True
+            )
+            result = minimize(
                 sobj,
                 resume_w if resume_w is not None else w,
                 optimizer_config,
                 iteration_callback=(
                     None if ckpt is None else lambda it, wi, f: ckpt.save_partial(lam, wi)
                 ),
+                **extra,
             )
             w = np.asarray(result.w)  # warm start the next λ
             if ckpt is not None:

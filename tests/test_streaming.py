@@ -392,3 +392,48 @@ class TestHostTRON:
             checkpoint_dir=str(tmp_path / "ck"),
         )
         assert bool(result.trackers[1.0].converged)
+
+
+class TestHostOWLQN:
+    def test_streamed_owlqn_matches_device(self, rng):
+        from photon_ml_tpu.optim import owlqn_minimize
+        from photon_ml_tpu.optim.host_lbfgs import host_owlqn_minimize
+
+        X, y = _dense_problem(rng, n=600)
+        batch = dense_batch_from_numpy(X, y)
+        cfg = OptimizerConfig(max_iterations=150, tolerance=1e-9)
+        obj = make_objective(batch, LOSS, l2_weight=0.0, intercept_index=7)
+        l1 = 30.0
+        dev = owlqn_minimize(obj, jnp.zeros(8), cfg, l1_weight=l1)
+
+        chunks = dense_chunks(X, y, chunk_rows=160)
+        sobj = StreamingGLMObjective(
+            chunks, LOSS, num_features=8, l2_weight=0.0, intercept_index=7
+        )
+        host = host_owlqn_minimize(sobj, np.zeros(8), cfg, l1)  # scalar, like the device fn
+        np.testing.assert_allclose(
+            np.asarray(host.w), np.asarray(dev.w), rtol=1e-2, atol=1e-3
+        )
+        # L1 must produce exact zeros on the same support
+        hz = np.asarray(host.w) == 0.0
+        dz = np.asarray(dev.w) == 0.0
+        np.testing.assert_array_equal(hz, dz)
+        assert hz[:7].any()  # some non-intercept coordinate was zeroed
+        assert not hz[7]  # the intercept is never L1-penalized
+
+    def test_streamed_sweep_with_l1(self, rng):
+        from photon_ml_tpu.config import RegularizationContext
+        from photon_ml_tpu.supervised.training import train_glm_streamed
+        from photon_ml_tpu.types import RegularizationType
+
+        X, y = _dense_problem(rng, n=400)
+        chunks = dense_chunks(X, y, chunk_rows=128)
+        result = train_glm_streamed(
+            chunks, TaskType.LOGISTIC_REGRESSION, num_features=8,
+            optimizer_config=OptimizerConfig(max_iterations=120, tolerance=1e-9),
+            regularization=RegularizationContext(RegularizationType.L1),
+            regularization_weights=[40.0],
+            intercept_index=7,
+        )
+        w = np.asarray(result.models[40.0].coefficients.means)
+        assert (w[:7] == 0.0).any()  # sparsity actually induced
