@@ -325,11 +325,7 @@ def _run_streamed(
             intercept_index=imap.intercept_index,
             validation_chunks=val_chunks,
             cross_process=multihost,
-            # single-host only: per-host data shards would desynchronize
-            # checkpoint decisions across processes (see train_glm_streamed)
-            checkpoint_dir=(
-                None if multihost else os.path.join(output_dir, "checkpoints")
-            ),
+            checkpoint_dir=os.path.join(output_dir, "checkpoints"),
         )
     advance_once("TRAINED")
 

@@ -147,7 +147,8 @@ def host_lbfgs_minimize(
         # anyway — so the common first-trial accept costs ONE streamed
         # sweep per iteration.
         accepted = False
-        for _ in range(max_ls):
+        # device parity: the initial trial PLUS max_ls halvings
+        for _ in range(max_ls + 1):
             w_try = trial_point(step)
             f_try, g_try, pg_try = vg(w_try)
             rhs = f + _ARMIJO_C1 * float(np.dot(pg, w_try - w))

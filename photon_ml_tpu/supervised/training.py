@@ -185,9 +185,10 @@ class _StreamedSweepCheckpoint:
     setup retrains instead of silently resuming; corrupt/foreign files are
     ignored, never fatal — a resume feature must not be able to brick runs.
 
-    Single-process only: the caller gates this out in multi-host mode
-    (per-host file shards give each process a different data digest, and a
-    process-0-only load would desynchronize the cross-process collectives).
+    Multi-host: process 0 alone reads/writes the files (per-host data
+    shards give other processes different digests, and shared storage must
+    have exactly one writer); ``sync_across_processes`` broadcasts its
+    state so every process branches identically.
     """
 
     def __init__(self, directory, task, chunks, num_features, opt_config, reg):
@@ -215,25 +216,96 @@ class _StreamedSweepCheckpoint:
                     opt_config.optimizer_type.value,
                     opt_config.max_iterations,
                     opt_config.max_cg_iterations,
+                    opt_config.history_length,
+                    opt_config.max_line_search_steps,
                     opt_config.tolerance,
                     reg.regularization_type.value if reg is not None else None,
+                    reg.alpha if reg is not None else None,
                 )
             ).encode()
             + first_labels.tobytes()
         ).hexdigest()
         self._completed: dict[str, np.ndarray] = {}
         self._partial: tuple[float, np.ndarray] | None = None
-        done = self._load(self.done_path)
-        if done is not None:
-            z, _ = done
-            self._completed = {
-                k[len("done__"):]: z[k] for k in z.files if k.startswith("done__")
-            }
-        partial = self._load(self.partial_path)
-        if partial is not None:
-            z, meta = partial
-            if "w" in z.files and meta.get("lam") is not None:
-                self._partial = (float(meta["lam"]), z["w"])
+        import jax
+
+        if jax.process_index() == 0:
+            # only process 0 touches the files; in multi-host runs the
+            # caller broadcasts this state via sync_across_processes()
+            done = self._load(self.done_path)
+            if done is not None:
+                z, _ = done
+                self._completed = {
+                    k[len("done__"):]: z[k] for k in z.files if k.startswith("done__")
+                }
+            partial = self._load(self.partial_path)
+            if partial is not None:
+                z, meta = partial
+                if "w" in z.files and meta.get("lam") is not None:
+                    self._partial = (float(meta["lam"]), z["w"])
+
+    def sync_across_processes(self) -> None:
+        """Multi-host: replace every process's view of the checkpoint with
+        PROCESS 0's (only process 0 reads/writes the files; per-host data
+        shards would otherwise give each process a different fingerprint
+        and desynchronize the λ-loop branches, deadlocking the gradient
+        collectives). Two broadcast phases: sizes first, then arrays."""
+        import jax
+
+        if jax.process_count() <= 1:
+            return
+        from jax.experimental import multihost_utils as mhu
+
+        d = None
+        for v in self._completed.values():
+            d = len(v)
+            break
+        if d is None and self._partial is not None:
+            d = len(self._partial[1])
+        counts = mhu.broadcast_one_to_all(
+            np.asarray(
+                [len(self._completed), 1 if self._partial is not None else 0,
+                 d if d is not None else 0],
+                np.int64,
+            )
+        )
+        k, has_partial, d = int(counts[0]), int(counts[1]), int(counts[2])
+        if k == 0 and not has_partial:
+            self._completed, self._partial = {}, None
+            return
+        # every array broadcast in ONE canonical dtype — the stored
+        # coefficient dtype varies (f32 from the solver, f64 from resume)
+        # and a dtype mismatch between source and placeholder aborts gloo
+        if jax.process_index() == 0:
+            lams = np.asarray([float(key) for key in self._completed], np.float64)
+            W = (
+                np.stack(
+                    [self._completed[key] for key in self._completed]
+                ).astype(np.float64)
+                if k
+                else np.zeros((0, d))
+            )
+            plam = np.asarray(
+                [self._partial[0] if self._partial is not None else 0.0],
+                np.float64,
+            )
+            pw = (
+                np.asarray(self._partial[1], np.float64)
+                if self._partial is not None
+                else np.zeros(d)
+            )
+        else:
+            lams = np.zeros(k, np.float64)
+            W = np.zeros((k, d))
+            plam = np.zeros(1)
+            pw = np.zeros(d)
+        lams, W, plam, pw = mhu.broadcast_one_to_all((lams, W, plam, pw))
+        self._completed = {
+            repr(float(lams[i])): np.asarray(W[i]) for i in range(k)
+        }
+        self._partial = (
+            (float(plam[0]), np.asarray(pw)) if has_partial else None
+        )
 
     def _load(self, path):
         """(npz, meta) when ``path`` is a valid checkpoint matching this
@@ -288,6 +360,10 @@ class _StreamedSweepCheckpoint:
         import json as _json
         import os
 
+        from photon_ml_tpu.parallel.multihost import is_output_process
+
+        if not is_output_process():
+            return  # multi-host: exactly one writer
         os.makedirs(self.directory, exist_ok=True)
         meta = {"fingerprint": self.fingerprint, **extra_meta}
         arrays = dict(arrays)
@@ -330,9 +406,10 @@ def train_glm_streamed(
     the in-progress λ's latest iterate are checkpointed (atomic npz with an
     embedded fingerprint of the sweep setup + a data digest); a rerun loads
     completed models and restarts the interrupted λ from its saved iterate
-    with a fresh L-BFGS history. Single-process only — it is rejected with
-    ``cross_process=True`` (per-host data shards make checkpoint decisions
-    diverge across processes and deadlock the gradient collectives).
+    with a fresh L-BFGS history. Multi-host safe: process 0 owns the files
+    and its checkpoint view is broadcast to every process, so all λ-loop
+    branches are taken identically and the gradient collectives stay
+    matched.
     """
     from photon_ml_tpu.ops.streaming import StreamingGLMObjective, stream_scores
     from photon_ml_tpu.optim.common import select_minimize_fn
@@ -384,12 +461,6 @@ def train_glm_streamed(
     best_weight: float | None = None
     best_value = float("nan")
 
-    if checkpoint_dir is not None and cross_process:
-        raise ValueError(
-            "checkpoint_dir is not supported with cross_process=True: "
-            "per-host data shards make checkpoint decisions diverge across "
-            "processes and deadlock the gradient collectives"
-        )
     ckpt = (
         _StreamedSweepCheckpoint(
             checkpoint_dir, task, chunks, num_features, optimizer_config,
@@ -398,6 +469,11 @@ def train_glm_streamed(
         if checkpoint_dir is not None
         else None
     )
+    if ckpt is not None and cross_process:
+        # multi-host: all processes adopt process 0's checkpoint view, so
+        # every λ-loop branch (load vs train vs resume-from-iterate) is
+        # taken identically and the gradient collectives stay matched
+        ckpt.sync_across_processes()
 
     # ONE objective for the whole sweep: its per-chunk kernels are built
     # λ-free (λ applied outside the jit), so mutating l2_weight between λs

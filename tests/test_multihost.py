@@ -257,5 +257,29 @@ def test_two_process_streamed_glm_matches_single(tmp_path, rng):
     assert set(multi) == set(ref)
     for key in ref:
         np.testing.assert_allclose(multi[key], ref[key], rtol=1e-2, atol=1e-3)
-    # only process 0 wrote outputs
+    # only process 0 wrote outputs (models AND sweep checkpoints)
     assert not (tmp_path / "out1" / "best").exists()
+    assert (tmp_path / "out0" / "checkpoints" / "sweep-done.npz").exists()
+    assert not (tmp_path / "out1" / "checkpoints").exists()
+
+    # RERUN into the same output dir: process 0 loads the completed λ from
+    # its checkpoint and broadcasts the decision — both processes must
+    # short-circuit identically (no collective mismatch) and reproduce the
+    # same best model
+    coordinator2 = f"127.0.0.1:{_free_port()}"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _GLM_WORKER, coordinator2, str(pid),
+             str(data_dir), str(tmp_path / f"out{pid}")],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for pid in range(2)
+    ]
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, f"resume worker failed:\n{out}\n{err}"
+    rerun = coeffs(str(tmp_path / "out0" / "best" / "model.avro"))
+    assert set(rerun) == set(multi)
+    for key in multi:
+        np.testing.assert_allclose(rerun[key], multi[key], rtol=1e-6)
