@@ -37,10 +37,54 @@ def run(
     feature_shards: dict[str, FeatureShardConfig] | None = None,
     logger: PhotonLogger | None = None,
     profile_dir: str | None = None,
+    multihost: bool = False,
 ):
     """``model_dir`` is a training output dir (contains ``best/``,
     ``index-maps/``, ``entity-maps.json``) or a bare model dir with the
-    maps alongside."""
+    maps alongside.
+
+    ``multihost``: scoring is per-row independent, so each host loads the
+    (replicated, on-disk) model, scores ITS round-robin slice of the input
+    part files, and writes its own output partition
+    (``part-{process_index:05d}.avro``) — no collectives on the scoring
+    path itself. Requested metrics are computed GLOBALLY by allgathering
+    (score, label, weight) with zero-weight padding (inert to every
+    evaluator), identically on every host; grouped (Multi*) evaluators are
+    rejected in this mode. Process 0 writes ``metrics.json``.
+    """
+    import jax
+
+    part_index = 0
+    if multihost:
+        from photon_ml_tpu.io.avro import list_avro_files
+        from photon_ml_tpu.parallel.multihost import (
+            host_shard_of_paths,
+            is_output_process,
+        )
+
+        # one process owns the shared log file; the rest log to stderr
+        logger = logger or PhotonLogger(
+            output_dir if is_output_process() else None
+        )
+
+        if evaluators:
+            from photon_ml_tpu.evaluation import make_evaluator
+
+            grouped = [s for s in evaluators if make_evaluator(s).group_by]
+            if grouped:
+                raise ValueError(
+                    f"--multihost scoring does not support grouped "
+                    f"evaluators {grouped} (the global allgather carries "
+                    f"no entity ids); run them single-host"
+                )
+        files: list[str] = []
+        for p_ in data:
+            files.extend(list_avro_files(p_))
+        data = host_shard_of_paths(files)
+        part_index = jax.process_index()
+        logger.info(
+            f"multihost scoring: this host scores {len(data)}/{len(files)} files"
+        )
     logger = logger or PhotonLogger(output_dir)
 
     best_dir = os.path.join(model_dir, "best")
@@ -78,34 +122,83 @@ def run(
         if isinstance(sub, RandomEffectModel)
     )
     reader = AvroDataReader(feature_shards)
-    with timed(logger, "read scoring data"):
-        ds = reader.read(
-            data,
-            id_tags=id_tags,
-            index_maps=index_maps or None,
-            entity_maps={t: entity_maps[t] for t in id_tags} if entity_maps else None,
-        )
+    ds = None
+    # single-host empty input keeps its loud error; only a multihost member
+    # may legitimately hold fewer part files than its peers
+    if data or not multihost:
+        with timed(logger, "read scoring data"):
+            ds = reader.read(
+                data,
+                id_tags=id_tags,
+                index_maps=index_maps or None,
+                entity_maps={t: entity_maps[t] for t in id_tags} if entity_maps else None,
+            )
 
     transformer = GameTransformer(model, logger=logger)
+    metrics = None
     with timed(logger, "score"), profile_trace(profile_dir, "score"):
-        if evaluators:
-            scores, results = transformer.transform_with_evaluation(ds.batch, evaluators)
+        if evaluators and not multihost:
+            scores, results = transformer.transform_with_evaluation(
+                ds.batch, evaluators
+            )
             metrics = dict(results.metrics)
-        else:
+        elif ds is not None:
             scores = transformer.transform(ds.batch)
-            metrics = None
+        else:
+            scores = np.zeros(0)
+        if evaluators and multihost:
+            metrics = _global_metrics_multihost(
+                list(evaluators),
+                np.asarray(scores),
+                np.asarray(ds.batch.labels) if ds is not None else np.zeros(0),
+                np.asarray(ds.batch.weights) if ds is not None else np.zeros(0),
+            )
+            logger.info(f"scoring evaluation (global): {metrics}")
 
     with timed(logger, "write scores"):
-        write_scoring_results(
-            os.path.join(output_dir, "scores", "part-00000.avro"),
-            np.asarray(scores),
-            uids=ds.uids,
-            labels=ds.labels,
-        )
+        if ds is not None:
+            write_scoring_results(
+                os.path.join(output_dir, "scores", f"part-{part_index:05d}.avro"),
+                np.asarray(scores),
+                uids=ds.uids,
+                labels=ds.labels,
+            )
         if metrics is not None:
-            with open(os.path.join(output_dir, "metrics.json"), "w") as f:
-                json.dump(metrics, f, indent=2)
+            from photon_ml_tpu.parallel.multihost import is_output_process
+
+            if is_output_process():
+                with open(os.path.join(output_dir, "metrics.json"), "w") as f:
+                    json.dump(metrics, f, indent=2)
+    if multihost:
+        from photon_ml_tpu.parallel.multihost import sync_processes
+
+        sync_processes("score-outputs-written")
     return scores, metrics
+
+
+def _global_metrics_multihost(
+    specs: list[str], scores: np.ndarray, labels: np.ndarray, weights: np.ndarray
+) -> dict:
+    """Global metrics over every host's rows: allgather (score, label,
+    weight) padded to the max per-host row count with weight-0 rows, which
+    every evaluator treats as absent. Identical on all processes."""
+    from jax.experimental import multihost_utils as mhu
+
+    from photon_ml_tpu.evaluation import evaluate_all
+
+    counts = mhu.process_allgather(np.asarray([len(scores)], np.int64))
+    max_n = int(np.max(counts))
+
+    def pad(a):
+        out = np.zeros(max_n, np.float64)
+        out[: len(a)] = np.asarray(a, np.float64)
+        return out
+
+    s, y, w = mhu.process_allgather(
+        (pad(scores), pad(labels), pad(weights))
+    )
+    results = evaluate_all(specs, s.ravel(), y.ravel(), w.ravel())
+    return dict(results.metrics)
 
 
 def _random_effects(game_dir: str) -> dict:
@@ -132,7 +225,17 @@ def main(argv: list[str] | None = None) -> None:
         "--profile-dir", default=None,
         help="capture a jax.profiler device trace of the scoring pass",
     )
+    p.add_argument(
+        "--multihost", action="store_true",
+        help="join the jax.distributed runtime; each host scores its slice "
+             "of the input part files and writes its own output partition "
+             "(run the SAME command on every host)",
+    )
     args = p.parse_args(argv)
+    if args.multihost:
+        from photon_ml_tpu.parallel.multihost import initialize_multihost
+
+        initialize_multihost()
     shards = None
     if args.config:
         shards = dict(load_training_config(args.config).feature_shards)
@@ -143,6 +246,7 @@ def main(argv: list[str] | None = None) -> None:
         evaluators=args.evaluators,
         feature_shards=shards,
         profile_dir=args.profile_dir,
+        multihost=args.multihost,
     )
 
 

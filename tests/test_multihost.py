@@ -283,3 +283,142 @@ def test_two_process_streamed_glm_matches_single(tmp_path, rng):
     assert set(rerun) == set(multi)
     for key in multi:
         np.testing.assert_allclose(rerun[key], multi[key], rtol=1e-6)
+
+
+_SCORE_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+
+    coordinator, pid, model_dir, data_dir, out_dir, cfg = sys.argv[1:7]
+    os.environ["JAX_COORDINATOR_ADDRESS"] = coordinator
+    os.environ["JAX_NUM_PROCESSES"] = "2"
+    os.environ["JAX_PROCESS_ID"] = pid
+
+    from photon_ml_tpu.cli import score
+    score.main([
+        "--model-dir", model_dir, "--data", data_dir,
+        "--output-dir", out_dir, "--evaluators", "AUC",
+        "--config", cfg, "--multihost",
+    ])
+    print("SCORE WORKER DONE", pid)
+    """
+)
+
+
+@pytest.mark.slow
+def test_two_process_scoring_matches_single(tmp_path, rng):
+    """--multihost scoring: hosts score disjoint file slices and write their
+    own partitions; the union of scores and the global metrics must match a
+    single-host scoring run."""
+    import io as _io
+
+    from photon_ml_tpu.cli import score as score_cli
+    from photon_ml_tpu.cli import train as train_cli
+    from photon_ml_tpu.config import (
+        FeatureShardConfig,
+        FixedEffectCoordinateConfig,
+        GameTrainingConfig,
+        OptimizationConfig,
+        OptimizerConfig,
+    )
+    from photon_ml_tpu.data.synthetic import synthetic_game_data
+    from photon_ml_tpu.io import TRAINING_EXAMPLE_SCHEMA, read_avro_file, write_avro_file
+    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.utils import PhotonLogger
+
+    def write_file(path, data, lo, hi, seed_offset=0):
+        recs = []
+        for i in range(lo, hi):
+            recs.append({
+                "uid": f"s{seed_offset + i}",
+                "response": float(data.y[i]), "offset": None, "weight": None,
+                "features": [
+                    {"name": "g", "term": str(j), "value": float(data.X[i, j])}
+                    for j in range(3)
+                ],
+                "metadataMap": {},
+            })
+        write_avro_file(path, json.loads(json.dumps(TRAINING_EXAMPLE_SCHEMA)), recs)
+
+    data = synthetic_game_data(rng, 300, d_fixed=3, effects={})
+    train_path = tmp_path / "train.avro"
+    write_file(str(train_path), data, 0, 200)
+    test_dir = tmp_path / "test"
+    test_dir.mkdir()
+    write_file(str(test_dir / "part-0.avro"), data, 200, 250)
+    write_file(str(test_dir / "part-1.avro"), data, 250, 300)
+
+    cfg = GameTrainingConfig(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinate_update_sequence=("fixed",),
+        coordinate_descent_iterations=1,
+        fixed_effect_coordinates={
+            "fixed": FixedEffectCoordinateConfig(
+                feature_shard_id="global",
+                optimization=OptimizationConfig(
+                    optimizer=OptimizerConfig(max_iterations=40, tolerance=1e-8)
+                ),
+            )
+        },
+        feature_shards={
+            "global": FeatureShardConfig(feature_bags=("features",), has_intercept=True)
+        },
+    )
+    model_dir = tmp_path / "model"
+    train_cli.run(
+        cfg, [str(train_path)], str(model_dir),
+        logger=PhotonLogger(None, stream=_io.StringIO()),
+    )
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps(cfg.to_dict()))
+
+    # single-host reference scoring
+    ref_out = tmp_path / "ref-scores"
+    _, ref_metrics = score_cli.run(
+        str(model_dir), [str(test_dir)], str(ref_out), evaluators=["AUC"],
+        feature_shards=dict(cfg.feature_shards),
+        logger=PhotonLogger(None, stream=_io.StringIO()),
+    )
+
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items() if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    mh_out = tmp_path / "mh-scores"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _SCORE_WORKER, coordinator, str(pid),
+             str(model_dir), str(test_dir), str(mh_out), str(cfg_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for pid in range(2)
+    ]
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, f"score worker failed:\n{out}\n{err}"
+
+    def read_scores(root):
+        out = {}
+        d = os.path.join(root, "scores")
+        for fn in sorted(os.listdir(d)):
+            _, recs = read_avro_file(os.path.join(d, fn))
+            for r in recs:
+                out[r["uid"]] = r["predictionScore"]
+        return out
+
+    ref = read_scores(str(ref_out))
+    mh = read_scores(str(mh_out))
+    assert set(ref) == set(mh) and len(ref) == 100
+    for uid in ref:
+        np.testing.assert_allclose(mh[uid], ref[uid], rtol=1e-5, atol=1e-6)
+    # two partitions, one per host
+    assert sorted(os.listdir(mh_out / "scores")) == ["part-00000.avro", "part-00001.avro"]
+    with open(mh_out / "metrics.json") as f:
+        mh_metrics = json.load(f)
+    np.testing.assert_allclose(mh_metrics["AUC"], ref_metrics["AUC"], rtol=1e-6)
