@@ -91,8 +91,11 @@ class OptimizerConfig(_JsonMixin):
     tolerance: float = 1e-7
     # L-BFGS history size (Breeze default m=10 per SURVEY.md §2.1)
     history_length: int = 10
-    # Backtracking line-search bound (fixed trip count under jit)
-    max_line_search_steps: int = 25
+    # Interpolating-backtracking line-search bound. With safeguarded
+    # quadratic interpolation (optim/lbfgs.py) a workable step is found in
+    # 1-3 refinements; 10 bounds the terminal no-representable-progress
+    # iteration without burning 25 full objective passes on it.
+    max_line_search_steps: int = 10
     # TRON inner conjugate-gradient iteration bound
     max_cg_iterations: int = 20
 

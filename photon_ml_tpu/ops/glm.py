@@ -44,7 +44,7 @@ Array = jnp.ndarray
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=["batch", "norm", "l2_weight", "reg_mask"],
-    meta_fields=["loss", "axis_name"],
+    meta_fields=["loss", "axis_name", "fused", "offsets_zero", "weights_one"],
 )
 @dataclass(frozen=True)
 class GLMObjective:
@@ -57,6 +57,13 @@ class GLMObjective:
       reg_mask  — (d,) 0/1 mask of regularized coordinates (intercept → 0).
       loss      — pointwise loss namespace (static).
       axis_name — mesh axis to psum over, or None for single-node (static).
+      fused     — use the one-pass Pallas kernels (``ops/fused.py``) for
+                  value_and_grad/hvp on dense batches (static; ``X`` streams
+                  from HBM once per evaluation instead of 2-3 times).
+      offsets_zero / weights_one — static data hints (detected once at
+                  construction): constant-0 offsets / constant-1 weights
+                  let the fused kernels skip those VMEM-padded aux streams
+                  and run larger X tiles.
     """
 
     batch: Batch
@@ -65,6 +72,9 @@ class GLMObjective:
     reg_mask: Array
     loss: PointwiseLoss
     axis_name: str | None = None
+    fused: bool = False
+    offsets_zero: bool = False
+    weights_one: bool = False
 
     # -- collective hook (identity when single-node) --------------------------
     def _reduce(self, x):
@@ -94,14 +104,26 @@ class GLMObjective:
         return self._reduce(local) + self._l2_term(w)
 
     def value_and_grad(self, w: Array) -> tuple[Array, Array]:
-        m = self.margins(w)
-        lv = self.loss.value(m, self.batch.labels)
-        r = self._weighted(self.loss.d1(m, self.batch.labels))
-        local = (
-            jnp.sum(self._weighted(lv)),
-            self.batch.rmatvec(r),
-            jnp.sum(r),
-        )
+        if self.fused and isinstance(self.batch, DenseBatch):
+            from photon_ml_tpu.ops.fused import fused_value_grad
+
+            u, c = self.norm.to_effective(w)
+            local = fused_value_grad(
+                self.batch.X, self.batch.labels,
+                None if self.offsets_zero else self.batch.offsets,
+                None if self.weights_one else self.batch.weights,
+                u, c, loss=self.loss,
+                interpret=jax.default_backend() != "tpu",
+            )
+        else:
+            m = self.margins(w)
+            lv = self.loss.value(m, self.batch.labels)
+            r = self._weighted(self.loss.d1(m, self.batch.labels))
+            local = (
+                jnp.sum(self._weighted(lv)),
+                self.batch.rmatvec(r),
+                jnp.sum(r),
+            )
         val, g_raw, r_sum = self._reduce(local)
         g = self.norm.grad_to_model_space(g_raw, r_sum) + self.l2_weight * self.reg_mask * w
         return val + self._l2_term(w), g
@@ -113,12 +135,25 @@ class GLMObjective:
         """Gauss-Newton/Hessian-vector product H·v = AᵀDA·v + λ₂·v (A = the
         normalized design matrix, D = diag(weight·d2)). One forward matmul +
         one reverse matmul; for TRON's CG loop this is the hot kernel."""
-        m = self.margins(w)
-        d2 = self._weighted(self.loss.d2(m, self.batch.labels))
         v_eff = self.norm.factors * v
-        mv = self.batch.matvec(v_eff) - jnp.dot(self.norm.shifts, v_eff)
-        q = d2 * mv
-        local = (self.batch.rmatvec(q), jnp.sum(q))
+        if self.fused and isinstance(self.batch, DenseBatch):
+            from photon_ml_tpu.ops.fused import fused_hvp
+
+            u, c = self.norm.to_effective(w)
+            local = fused_hvp(
+                self.batch.X, self.batch.labels,
+                None if self.offsets_zero else self.batch.offsets,
+                None if self.weights_one else self.batch.weights,
+                u, v_eff, c,
+                jnp.dot(self.norm.shifts, v_eff), loss=self.loss,
+                interpret=jax.default_backend() != "tpu",
+            )
+        else:
+            m = self.margins(w)
+            d2 = self._weighted(self.loss.d2(m, self.batch.labels))
+            mv = self.batch.matvec(v_eff) - jnp.dot(self.norm.shifts, v_eff)
+            q = d2 * mv
+            local = (self.batch.rmatvec(q), jnp.sum(q))
         hv_raw, q_sum = self._reduce(local)
         hv = self.norm.grad_to_model_space(hv_raw, q_sum)
         return hv + self.l2_weight * self.reg_mask * v
@@ -179,15 +214,40 @@ def make_objective(
     norm: NormalizationContext | None = None,
     intercept_index: int | None = None,
     axis_name: str | None = None,
+    fused: bool | None = None,
 ) -> GLMObjective:
     """Convenience constructor. ``intercept_index`` is excluded from L2
-    regularization (and from normalization if ``norm`` is built with it)."""
+    regularization (and from normalization if ``norm`` is built with it).
+
+    ``fused=None`` auto-enables the one-pass Pallas kernels on TPU for
+    dense batches with supported shapes (``ops/fused.py``); pass
+    ``False``/``True`` to force (``True`` off-TPU runs the kernels in
+    interpreter mode — correct but slow, for tests). Set the environment
+    variable ``PHOTON_DISABLE_FUSED=1`` to veto auto-enabling."""
+    import os
+
     d = batch.num_features
     if norm is None:
         norm = no_normalization(d, intercept_index)
     mask = jnp.ones((d,), jnp.float32)
     if intercept_index is not None:
         mask = mask.at[intercept_index].set(0.0)
+    if fused is None:
+        from photon_ml_tpu.ops.fused import supports_fused
+
+        fused = (
+            isinstance(batch, DenseBatch)
+            # concrete arrays only: under a transform (e.g. the vmap-batched
+            # per-entity solves) X is a tracer and pallas_call would lower
+            # through untested vmap batching rules — keep the XLA path there
+            and not isinstance(batch.X, jax.core.Tracer)
+            and jax.default_backend() == "tpu"
+            and not os.environ.get("PHOTON_DISABLE_FUSED")
+            and supports_fused(batch.num_rows, d, batch.X.dtype)
+        )
+    offsets_zero = weights_one = False
+    if fused:
+        offsets_zero, weights_one = _constant_hints(batch)
     return GLMObjective(
         batch=batch,
         norm=norm,
@@ -195,4 +255,24 @@ def make_objective(
         reg_mask=mask,
         loss=loss,
         axis_name=axis_name,
+        fused=bool(fused),
+        offsets_zero=offsets_zero,
+        weights_one=weights_one,
     )
+
+
+def _constant_hints(batch: Batch) -> tuple[bool, bool]:
+    """(offsets all 0, weights all 1) — static data hints for the fused
+    kernels, computed only when the arrays are concrete (outside jit).
+    One small device reduction each, once per objective construction."""
+    import numpy as np
+
+    def _is_const(x, value) -> bool:
+        if isinstance(x, jax.core.Tracer):
+            return False
+        try:
+            return bool(np.asarray(jnp.all(x == value)))
+        except Exception:
+            return False
+
+    return _is_const(batch.offsets, 0.0), _is_const(batch.weights, 1.0)

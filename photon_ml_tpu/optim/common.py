@@ -41,6 +41,7 @@ class ConvergenceReason(enum.IntEnum):
         "reason",
         "loss_history",
         "grad_norm_history",
+        "objective_passes",
     ],
     meta_fields=[],
 )
@@ -58,6 +59,10 @@ class OptimizationResult:
     reason: Array  # int32, a ConvergenceReason value
     loss_history: Array  # (max_iterations + 1,)
     grad_norm_history: Array  # (max_iterations + 1,)
+    # total objective evaluations (value or value+grad passes over the
+    # data), incl. line-search trials — the honest work unit for
+    # throughput accounting; None when a solver does not track it
+    objective_passes: Array | None = None
 
     @property
     def converged(self) -> Array:

@@ -147,7 +147,12 @@ def host_lbfgs_minimize(
         # anyway — so the common first-trial accept costs ONE streamed
         # sweep per iteration.
         accepted = False
-        # device parity: the initial trial PLUS max_ls halvings
+        # device parity: the initial trial PLUS max_ls refinements, each
+        # chosen by the same safeguarded quadratic interpolation as
+        # optim/lbfgs.py (minimizer of the parabola through f(0), f'(0),
+        # f(t), clamped to [t/10, t/2]) — a failed step recovers in 1-3
+        # trials instead of plain 0.5^k halvings
+        slope0 = float(np.dot(pg, p))
         for _ in range(max_ls + 1):
             w_try = trial_point(step)
             f_try, g_try, pg_try = vg(w_try)
@@ -155,7 +160,11 @@ def host_lbfgs_minimize(
             if f_try <= rhs and not np.isnan(f_try):
                 accepted = True
                 break
-            step *= _BACKTRACK
+            denom = 2.0 * (f_try - f - slope0 * step)
+            t_q = -slope0 * step * step / denom if denom > 0 else _BACKTRACK * step
+            if not np.isfinite(t_q):
+                t_q = _BACKTRACK * step
+            step = min(max(t_q, 0.1 * step), _BACKTRACK * step)
         if not accepted:
             reason = ConvergenceReason.LINE_SEARCH_FAILED
             break

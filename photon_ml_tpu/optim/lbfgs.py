@@ -50,6 +50,7 @@ class _LbfgsState(NamedTuple):
     rho: Array  # (m,) 1/(sᵀy) ring
     count: Array  # int32: number of pairs ever stored (ring head = count-1 mod m)
     it: Array  # int32 iteration counter
+    evals: Array  # int32: objective passes so far (incl. line-search trials)
     reason: Array  # int32 ConvergenceReason; loop runs while MAX_ITERATIONS
     done: Array  # bool
     g0_norm: Array
@@ -107,6 +108,7 @@ def _lbfgs_impl(
     m = config.history_length
     T = config.max_iterations
     use_l1 = l1w is not None
+    fused_eval = bool(getattr(objective, "fused", False))
     d = w0.shape[0]
     dtype = w0.dtype
 
@@ -143,6 +145,7 @@ def _lbfgs_impl(
         rho=jnp.zeros((m,), dtype),
         count=jnp.int32(0),
         it=jnp.int32(0),
+        evals=jnp.int32(1),  # the initial value_and_grads
         reason=jnp.int32(ConvergenceReason.MAX_ITERATIONS),
         done=grad_converged(g0_norm, g0_norm, config.tolerance),
         g0_norm=g0_norm,
@@ -179,27 +182,96 @@ def _lbfgs_impl(
         p_norm = jnp.linalg.norm(p)
         t0 = jnp.where(st.count == 0, 1.0 / jnp.maximum(1.0, p_norm), 1.0)
 
-        def ls_cond(carry):
-            t, f_new, w_new, k = carry
+        def armijo_rhs(w_new):
             # Armijo on the (possibly projected) actual step
-            rhs = st.f + _ARMIJO_C1 * jnp.dot(st.pg, w_new - st.w)
-            insufficient = jnp.logical_or(f_new > rhs, jnp.isnan(f_new))
-            return jnp.logical_and(insufficient, k < config.max_line_search_steps)
+            return st.f + _ARMIJO_C1 * jnp.dot(st.pg, w_new - st.w)
 
-        def ls_body(carry):
-            t, _, _, k = carry
-            t_new = t * 0.5
-            w_new = trial_point(t_new)
-            return t_new, full_value(w_new), w_new, k + 1
+        def hopeless(w_new):
+            # Achievable decrease (~|pgᵀΔw|, the first-order model of the
+            # step — NOT the c1-scaled Armijo threshold) below the f32
+            # resolution of f: further halvings only shrink it, so no
+            # representable improvement is possible; stop backtracking
+            # instead of spinning max_line_search_steps objective passes
+            # on the terminal iteration.
+            return jnp.abs(jnp.dot(st.pg, w_new - st.w)) < 1e-7 * jnp.abs(st.f)
+
+        def ls_should_continue(f_new, w_new, k):
+            insufficient = jnp.logical_or(f_new > armijo_rhs(w_new), jnp.isnan(f_new))
+            keep_going = jnp.logical_and(
+                insufficient, jnp.logical_not(hopeless(w_new))
+            )
+            return jnp.logical_and(keep_going, k < config.max_line_search_steps)
+
+        slope0 = jnp.dot(st.pg, p)  # directional derivative at t = 0
+
+        def next_t(t, f_t):
+            # Safeguarded quadratic interpolation through f(0), f'(0), f(t):
+            # the minimizer of the fitted parabola, clamped to [t/10, t/2].
+            # An overshot step lands near the right t in one refit instead
+            # of O(log) plain halvings (Breeze's line search interpolates
+            # the same way) — this keeps the terminal iteration cheap.
+            denom = 2.0 * (f_t - st.f - slope0 * t)
+            t_q = -slope0 * t * t / jnp.where(denom != 0.0, denom, 1.0)
+            t_q = jnp.where(
+                jnp.logical_and(jnp.isfinite(t_q), denom > 0.0), t_q, 0.5 * t
+            )
+            return jnp.clip(t_q, 0.1 * t, 0.5 * t)
 
         w_try = trial_point(t0)
-        t, f_new, w_new, _ = lax.while_loop(
-            ls_cond, ls_body, (t0, full_value(w_try), w_try, jnp.int32(0))
-        )
-        rhs = st.f + _ARMIJO_C1 * jnp.dot(st.pg, w_new - st.w)
-        ls_ok = jnp.logical_and(f_new <= rhs, jnp.logical_not(jnp.isnan(f_new)))
+        if fused_eval:
+            # One-pass objective (ops/fused.py): value_and_grad costs the
+            # same single X read as value alone, so each trial evaluates
+            # both and an accepted step needs NO extra gradient pass —
+            # the typical iteration touches X exactly once.
+            def ls_cond(carry):
+                t, f_new, _, _, w_new, k = carry
+                return ls_should_continue(f_new, w_new, k)
 
-        f2, g2, pg2 = value_and_grads(w_new)
+            def ls_body(carry):
+                t, f_prev, _, _, _, k = carry
+                t_new = next_t(t, f_prev)
+                w_new = trial_point(t_new)
+                f, g, pg = value_and_grads(w_new)
+                return t_new, f, g, pg, w_new, k + 1
+
+            f1, g1, pg1 = value_and_grads(w_try)
+            t, f2, g2, pg2, w_new, ls_k = lax.while_loop(
+                ls_cond, ls_body, (t0, f1, g1, pg1, w_try, jnp.int32(0))
+            )
+            new_evals = st.evals + 1 + ls_k
+        else:
+
+            def ls_cond(carry):
+                t, f_new, w_new, k = carry
+                return ls_should_continue(f_new, w_new, k)
+
+            def ls_body(carry):
+                t, f_prev, _, k = carry
+                t_new = next_t(t, f_prev)
+                w_new = trial_point(t_new)
+                return t_new, full_value(w_new), w_new, k + 1
+
+            t, f_new, w_new, ls_k = lax.while_loop(
+                ls_cond, ls_body, (t0, full_value(w_try), w_try, jnp.int32(0))
+            )
+            f2, g2, pg2 = value_and_grads(w_new)
+            new_evals = st.evals + 2 + ls_k
+        rhs = armijo_rhs(w_new)
+        # Armijo acceptance, EXCEPT the degenerate terminal case: a
+        # fully-backtracked below-f32-resolution step (hopeless) that does
+        # not decrease f satisfies "f_new <= rhs" with f_new == f, and
+        # accepting it spins the solver at max_line_search_steps evals per
+        # iteration with zero progress — that state means converged within
+        # arithmetic precision: stop (reported as LINE_SEARCH_FAILED, the
+        # same terminal state Breeze's FirstOrderMinimizer reaches).
+        # Substantive steps with f_new == f are still accepted: near the
+        # optimum of a large-n sum objective, f sits on an f32 plateau
+        # while real steps keep improving w and the gradient norm.
+        degenerate = jnp.logical_and(hopeless(w_new), f2 >= st.f)
+        ls_ok = jnp.logical_and(
+            jnp.logical_and(f2 <= rhs, jnp.logical_not(degenerate)),
+            jnp.logical_not(jnp.isnan(f2)),
+        )
         s = w_new - st.w
         y = g2 - st.g
         sy = jnp.dot(s, y)
@@ -243,6 +315,7 @@ def _lbfgs_impl(
             rho=rho,
             count=count,
             it=it,
+            evals=new_evals,
             reason=reason,
             done=done,
             g0_norm=st.g0_norm,
@@ -265,6 +338,7 @@ def _lbfgs_impl(
         reason=reason,
         loss_history=final.loss_hist,
         grad_norm_history=final.gnorm_hist,
+        objective_passes=final.evals,
     )
 
 

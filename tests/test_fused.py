@@ -1,0 +1,188 @@
+"""Fused one-pass Pallas kernels vs the XLA objective path.
+
+The kernels (``ops/fused.py``) run here in interpreter mode on the CPU
+backend — the identical program the TPU executes compiled — and must
+reproduce the XLA objective's value/gradient/Hv numerics exactly (f32)
+or to bf16-accumulation tolerance (bf16 storage)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.config import OptimizerConfig
+from photon_ml_tpu.normalization import NormalizationType, build_normalization
+from photon_ml_tpu.ops.batch import DenseBatch
+from photon_ml_tpu.ops.fused import supports_fused
+from photon_ml_tpu.ops.glm import make_objective
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.optim import lbfgs_minimize, owlqn_minimize
+from photon_ml_tpu.types import TaskType
+
+TASKS = list(TaskType)
+
+
+def _problem(rng, n, d, task, dtype=jnp.float32, zero_weights=True):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = (rng.normal(size=d) * 0.4).astype(np.float32)
+    margin = X @ w_true
+    if task in (TaskType.LOGISTIC_REGRESSION, TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM):
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(np.float32)
+    elif task is TaskType.POISSON_REGRESSION:
+        y = rng.poisson(np.exp(np.clip(margin, -8, 3))).astype(np.float32)
+    else:
+        y = (margin + 0.1 * rng.normal(size=n)).astype(np.float32)
+    offsets = (0.1 * rng.normal(size=n)).astype(np.float32)
+    weights = rng.uniform(0.5, 1.5, size=n).astype(np.float32)
+    if zero_weights:
+        weights[:: max(n // 7, 1)] = 0.0  # padding rows
+    return DenseBatch(
+        X=jnp.asarray(X, dtype),
+        labels=jnp.asarray(y),
+        offsets=jnp.asarray(offsets),
+        weights=jnp.asarray(weights),
+    )
+
+
+def _pair(batch, task, norm=None):
+    loss = loss_for_task(task)
+    kw = dict(l2_weight=0.7, norm=norm, intercept_index=None)
+    return (
+        make_objective(batch, loss, fused=False, **kw),
+        make_objective(batch, loss, fused=True, **kw),
+    )
+
+
+@pytest.mark.parametrize("task", TASKS)
+@pytest.mark.parametrize("n", [37, 512])
+def test_fused_value_grad_matches_xla(rng, task, n):
+    d = 128
+    batch = _problem(rng, n, d, task)
+    ref, fused = _pair(batch, task)
+    w = jnp.asarray(rng.normal(size=d).astype(np.float32) * 0.3)
+    f0, g0 = ref.value_and_grad(w)
+    f1, g1 = fused.value_and_grad(w)
+    np.testing.assert_allclose(float(f1), float(f0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("task", [TaskType.LOGISTIC_REGRESSION, TaskType.LINEAR_REGRESSION])
+def test_fused_hvp_matches_xla(rng, task):
+    n, d = 300, 128  # 300 % 256 != 0: exercises the masked tail tile
+    batch = _problem(rng, n, d, task)
+    ref, fused = _pair(batch, task)
+    w = jnp.asarray(rng.normal(size=d).astype(np.float32) * 0.3)
+    v = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(fused.hvp(w, v)), np.asarray(ref.hvp(w, v)),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_fused_with_normalization(rng):
+    n, d = 200, 128
+    batch = _problem(rng, n, d, TaskType.LOGISTIC_REGRESSION)
+    X = np.asarray(batch.X).copy()
+    X[:, d - 1] = 1.0  # intercept column absorbs the standardization shift
+    batch = DenseBatch(
+        X=jnp.asarray(X), labels=batch.labels,
+        offsets=batch.offsets, weights=batch.weights,
+    )
+    norm = build_normalization(
+        NormalizationType.STANDARDIZATION,
+        means=X.mean(axis=0),
+        variances=X.var(axis=0),
+        max_magnitudes=np.abs(X).max(axis=0),
+        intercept_index=d - 1,
+    )
+    loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+    kw = dict(l2_weight=0.7, norm=norm, intercept_index=d - 1)
+    ref = make_objective(batch, loss, fused=False, **kw)
+    fused = make_objective(batch, loss, fused=True, **kw)
+    w = jnp.asarray(rng.normal(size=d).astype(np.float32) * 0.2)
+    f0, g0 = ref.value_and_grad(w)
+    f1, g1 = fused.value_and_grad(w)
+    np.testing.assert_allclose(float(f1), float(f0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), rtol=1e-4, atol=1e-4)
+    v = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(fused.hvp(w, v)), np.asarray(ref.hvp(w, v)),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_fused_bf16_matches_xla_bf16(rng):
+    n, d = 512, 128
+    batch = _problem(rng, n, d, TaskType.LOGISTIC_REGRESSION, dtype=jnp.bfloat16)
+    ref, fused = _pair(batch, TaskType.LOGISTIC_REGRESSION)
+    w = jnp.asarray(rng.normal(size=d).astype(np.float32) * 0.3)
+    f0, g0 = ref.value_and_grad(w)
+    f1, g1 = fused.value_and_grad(w)
+    # both paths feed bf16 MXU operands with f32 accumulation; only the
+    # accumulation order differs
+    np.testing.assert_allclose(float(f1), float(f0), rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), rtol=2e-2, atol=2e-2)
+
+
+def test_lbfgs_fused_converges_to_same_optimum(rng):
+    n, d = 400, 128
+    batch = _problem(rng, n, d, TaskType.LOGISTIC_REGRESSION)
+    ref, fused = _pair(batch, TaskType.LOGISTIC_REGRESSION)
+    cfg = OptimizerConfig(max_iterations=60, tolerance=1e-9)
+    w0 = jnp.zeros((d,), jnp.float32)
+    r0 = lbfgs_minimize(ref, w0, cfg)
+    r1 = lbfgs_minimize(fused, w0, cfg)
+    np.testing.assert_allclose(float(r1.value), float(r0.value), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(r1.w), np.asarray(r0.w), rtol=1e-2, atol=1e-3)
+
+
+def test_owlqn_fused_converges_to_same_optimum(rng):
+    n, d = 300, 128
+    batch = _problem(rng, n, d, TaskType.LOGISTIC_REGRESSION)
+    ref, fused = _pair(batch, TaskType.LOGISTIC_REGRESSION)
+    cfg = OptimizerConfig(max_iterations=80, tolerance=1e-9)
+    w0 = jnp.zeros((d,), jnp.float32)
+    r0 = owlqn_minimize(ref, w0, cfg, l1_weight=0.5)
+    r1 = owlqn_minimize(fused, w0, cfg, l1_weight=0.5)
+    np.testing.assert_allclose(float(r1.value), float(r0.value), rtol=1e-4)
+    # same sparsity pattern (the OWL-QN contract)
+    np.testing.assert_array_equal(
+        np.asarray(r1.w) == 0.0, np.asarray(r0.w) == 0.0
+    )
+
+
+@pytest.mark.parametrize("n", [37, 512])
+def test_fused_constant_aux_hints(rng, n):
+    """Zero offsets + unit weights are detected statically and the kernels
+    drop those aux streams; numerics must be unchanged."""
+    d = 128
+    task = TaskType.LOGISTIC_REGRESSION
+    batch = _problem(rng, n, d, task, zero_weights=False)
+    batch = DenseBatch(
+        X=batch.X, labels=batch.labels,
+        offsets=jnp.zeros((n,), jnp.float32),
+        weights=jnp.ones((n,), jnp.float32),
+    )
+    loss = loss_for_task(task)
+    ref = make_objective(batch, loss, l2_weight=0.7, fused=False)
+    fused = make_objective(batch, loss, l2_weight=0.7, fused=True)
+    assert fused.offsets_zero and fused.weights_one
+    w = jnp.asarray(rng.normal(size=d).astype(np.float32) * 0.3)
+    f0, g0 = ref.value_and_grad(w)
+    f1, g1 = fused.value_and_grad(w)
+    np.testing.assert_allclose(float(f1), float(f0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), rtol=1e-4, atol=1e-4)
+    v = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(fused.hvp(w, v)), np.asarray(ref.hvp(w, v)),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_supports_fused_gates():
+    assert supports_fused(1024, 512, jnp.float32)
+    assert supports_fused(1024, 512, jnp.bfloat16)
+    assert not supports_fused(1024, 500, jnp.float32)  # lane-unaligned d
+    assert not supports_fused(1024, 512, jnp.int8)
+    assert not supports_fused(1024, 1 << 17, jnp.float32)  # tile over budget
