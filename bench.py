@@ -204,7 +204,7 @@ def bench_dense_logistic(jax, jnp, dtype=None):
     )
     obj = make_objective(
         batch, loss_for_task(TaskType.LOGISTIC_REGRESSION), l2_weight=1.0,
-        intercept_index=d - 1,
+        intercept_index=d - 1, data_hints=(True, True),
     )
     cfg = OptimizerConfig(max_iterations=iters, tolerance=0.0)  # fixed trip
     w0 = jnp.zeros((d,), jnp.float32)
@@ -282,7 +282,8 @@ def _sparse_logistic_bench(jax, jnp, n, d, k, iters, densify_dtype):
     )
     densified = batch is not sparse_batch
     obj = make_objective(
-        batch, loss_for_task(TaskType.LOGISTIC_REGRESSION), l2_weight=1.0
+        batch, loss_for_task(TaskType.LOGISTIC_REGRESSION), l2_weight=1.0,
+        data_hints=(True, True),
     )
     cfg = OptimizerConfig(max_iterations=iters, tolerance=0.0)
     w0 = jnp.zeros((d,), jnp.float32)
@@ -355,7 +356,8 @@ def bench_b_linear_tron(jax, jnp):
         X=X, labels=y, offsets=jnp.zeros((n,), jnp.float32),
         weights=jnp.ones((n,), jnp.float32),
     )
-    obj = make_objective(batch, loss_for_task(TaskType.LINEAR_REGRESSION), l2_weight=1.0)
+    obj = make_objective(batch, loss_for_task(TaskType.LINEAR_REGRESSION), l2_weight=1.0,
+                         data_hints=(True, True))
     cfg = OptimizerConfig(max_iterations=iters, tolerance=0.0)
     w0 = jnp.zeros((d,), jnp.float32)
 
@@ -408,7 +410,7 @@ def bench_c_poisson(jax, jnp):
         weights=jnp.ones((n,), jnp.float32),
     )
     loss = loss_for_task(TaskType.POISSON_REGRESSION)
-    obj = make_objective(batch, loss, l2_weight=1.0)
+    obj = make_objective(batch, loss, l2_weight=1.0, data_hints=(True, True))
     cfg = OptimizerConfig(max_iterations=iters, tolerance=0.0)
     w0 = jnp.zeros((d,), jnp.float32)
 

@@ -78,6 +78,7 @@ def run(
     multihost: bool = False,
     logger: PhotonLogger | None = None,
     profile_dir: str | None = None,
+    prior_model_path: str | None = None,
 ):
     if multihost and streaming_chunk_rows is None:
         raise ValueError(
@@ -116,6 +117,8 @@ def run(
             unsupported.append(f"--validate {validate.value}")
         if summarize_features:
             unsupported.append("--summarize-features")
+        if prior_model_path:
+            unsupported.append("--prior-model (incremental mode is in-memory)")
         if unsupported:
             raise ValueError(
                 "--streaming-chunk-rows does not support: "
@@ -173,6 +176,21 @@ def run(
                 ),
             )
 
+    prior_model = None
+    if prior_model_path:
+        with timed(logger, "load prior model"):
+            from photon_ml_tpu.io.model_io import load_glm
+
+            prior_model = load_glm(
+                prior_model_path,
+                index_map=(
+                    None if train_ds is None
+                    else next(iter(train_ds.index_maps.values()))
+                ),
+                num_features=batch.num_features,
+                task=task,
+            )
+
     with timed(logger, "train"), profile_trace(profile_dir, "glm-sweep"):
         result = train_glm(
             batch,
@@ -188,6 +206,8 @@ def run(
             intercept_index=intercept_index,
             validation_batch=val_batch,
             variance_computation=variance_computation,
+            initial_model=prior_model,
+            incremental=prior_model is not None,
         )
     advance("TRAINED")
 
@@ -399,6 +419,12 @@ def main(argv: list[str] | None = None) -> None:
         "--profile-dir", default=None,
         help="capture jax.profiler device traces of the training sweep",
     )
+    p.add_argument(
+        "--prior-model", default=None,
+        help="incremental training: path to a previously saved model Avro "
+             "whose means/variances become an informative Gaussian prior "
+             "(MAP update) and the warm-start point",
+    )
     p.add_argument("--output-dir", required=True)
     args = p.parse_args(argv)
     if args.multihost:
@@ -420,6 +446,7 @@ def main(argv: list[str] | None = None) -> None:
         summarize_features=args.summarize_features,
         variance_computation=VarianceComputationType(args.variance),
         validate=DataValidationType(args.validate),
+        prior_model_path=args.prior_model,
         streaming_chunk_rows=args.streaming_chunk_rows,
         multihost=args.multihost,
         profile_dir=args.profile_dir,

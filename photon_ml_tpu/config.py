@@ -197,6 +197,9 @@ class GameTrainingConfig(_JsonMixin):
     variance_computation: VarianceComputationType = VarianceComputationType.NONE
     data_validation: DataValidationType = DataValidationType.VALIDATE_DISABLED
     model_input_dir: str | None = None  # warm start
+    # incremental training: the warm-start model additionally acts as a
+    # Gaussian MAP prior (per-coordinate means + 1/variance precisions)
+    incremental: bool = False
     hyperparameter_tuning_iters: int = 0
     # Per-coordinate regularization-weight lists; the training grid is their
     # cross-product (reference: per-coordinate regularizationWeights in the

@@ -262,6 +262,7 @@ class GameEstimator:
         norm_contexts: Mapping[str, NormalizationContext],
         entity_layouts: Mapping[str, tuple[EntityGrouping, EntityBuckets, int]],
         re_coordinate_cache: dict[str, RandomEffectCoordinate] | None = None,
+        prior_model: "GameModel | None" = None,
     ) -> dict[str, Coordinate]:
         """``re_coordinate_cache`` (when given) shares each random-effect
         coordinate's prepared bucket tensors across grid entries — only the
@@ -302,6 +303,9 @@ class GameEstimator:
                     mesh=self.mesh,
                     features_to_samples_ratio=coord_cfg.features_to_samples_ratio_upper_bound,
                     projector=projector,
+                    prior_model=(
+                        None if prior_model is None else prior_model.models.get(cid)
+                    ),
                 )
                 if re_coordinate_cache is not None:
                     re_coordinate_cache[cid] = coord
@@ -330,6 +334,9 @@ class GameEstimator:
                     mesh=self.mesh,
                     train_rows=train_rows,
                     train_weight_scale=weight_scale,
+                    prior_model=(
+                        None if prior_model is None else prior_model.models.get(cid)
+                    ),
                 )
         return coordinates
 
@@ -379,6 +386,7 @@ class GameEstimator:
             coordinates = self._build_coordinates(
                 batch, configuration, norm_contexts, entity_layouts,
                 re_coordinate_cache=re_coordinate_cache,
+                prior_model=initial_model if cfg.incremental else None,
             )
             descent = CoordinateDescent(
                 coordinates,

@@ -58,6 +58,18 @@ class Coordinate(Protocol):
     def score(self, model: GameSubModel) -> Array: ...
 
 
+def _require_prior_l2(config) -> None:
+    """The MAP prior's strength is λ₂·(1/variance): with a zero effective
+    L2 weight the prior silently does nothing — refuse the configuration
+    instead of quietly training unanchored."""
+    if config.regularization.l2_weight(config.regularization_weight) <= 0.0:
+        raise ValueError(
+            "incremental training (prior_model) requires a positive L2 "
+            "regularization weight: the prior's pull is "
+            "l2_weight * (1/prior_variance)"
+        )
+
+
 @dataclass(frozen=True)
 class FixedEffectCoordinate:
     """Distributed single-GLM solve over all samples of one feature shard.
@@ -80,6 +92,12 @@ class FixedEffectCoordinate:
     axis_name: str = "data"
     train_rows: Array | None = None  # int32 row subset (down-sampling)
     train_weight_scale: Array | None = None  # per-subset-row weight correction
+    # incremental training: the LOADED warm-start sub-model, held fixed as
+    # a Gaussian MAP prior across ALL descent iterations (the per-iteration
+    # ``initial`` argument evolves — anchoring the prior to it would make
+    # the objective drift every pass). Parity with Photon-ML's incremental
+    # learning (SURVEY.md §2.3 Model IO + warm start).
+    prior_model: "FixedEffectModel | None" = None
 
     def _training_batch(self, offsets: Array):
         shard = self.batch.features[self.feature_shard_id]
@@ -101,6 +119,16 @@ class FixedEffectCoordinate:
     ) -> tuple[FixedEffectModel, OptimizationResult]:
         train_batch = self._training_batch(offsets)
         d = train_batch.num_features
+        prior = None
+        if self.prior_model is not None:
+            from photon_ml_tpu.ops.glm import GaussianPrior
+
+            _require_prior_l2(self.config)
+            prior = GaussianPrior.from_coefficients(
+                self.prior_model.model.coefficients.means,
+                self.prior_model.model.coefficients.variances,
+                self.normalization,
+            )
         if initial is not None:
             w0 = jnp.asarray(initial.model.coefficients.means, jnp.float32)
             if self.normalization is not None:
@@ -126,6 +154,7 @@ class FixedEffectCoordinate:
                 norm=self.normalization,
                 intercept_index=self.intercept_index,
                 axis_name=self.axis_name,
+                prior=prior,
                 **extra,
             )
         else:
@@ -135,6 +164,7 @@ class FixedEffectCoordinate:
                 l2_weight=l2,
                 norm=self.normalization,
                 intercept_index=self.intercept_index,
+                prior=prior,
             )
             result = minimize_fn(obj, w0, opt.optimizer, **extra)
 
@@ -147,6 +177,7 @@ class FixedEffectCoordinate:
                 l2_weight=l2,
                 norm=self.normalization,
                 intercept_index=self.intercept_index,
+                prior=prior,
             )
             variances = compute_variances(obj, w, self.variance_computation)
         if self.normalization is not None:
@@ -191,6 +222,10 @@ class RandomEffectCoordinate:
     # shared random projection (ProjectionMatrix); trained coefficients are
     # mapped back to the original space, so the model/scores are unchanged
     projector: "RandomProjector | None" = None
+    # incremental training: the LOADED warm-start sub-model, held fixed as
+    # per-entity Gaussian MAP priors across all descent iterations (see
+    # FixedEffectCoordinate.prior_model)
+    prior_model: "RandomEffectModel | None" = None
 
     def __post_init__(self):
         if self.normalization is not None and self.projector is not None:
@@ -268,6 +303,7 @@ class RandomEffectCoordinate:
         l1 = opt.regularization.l1_weight(opt.regularization_weight)
         l2 = opt.regularization.l2_weight(opt.regularization_weight)
         W0 = None
+        prior_W = prior_V = None
         if initial is not None:
             W0 = initial.coefficients
             if W0.shape[0] != self.num_entities:
@@ -279,6 +315,19 @@ class RandomEffectCoordinate:
                 # (JL), so projecting the original-space warm start is the
                 # standard choice
                 W0 = W0 @ self.projector.matrix
+        if self.prior_model is not None:
+            _require_prior_l2(self.config)
+            prior_W = self.prior_model.coefficients
+            prior_V = self.prior_model.variances
+            if prior_W.shape[0] != self.num_entities:
+                raise ValueError(
+                    f"prior entity count {prior_W.shape[0]} != {self.num_entities}"
+                )
+            if self.projector is not None:
+                prior_W = prior_W @ self.projector.matrix
+                # diagonal variances do not survive a dense projection;
+                # fall back to unit precision in the projected space
+                prior_V = None
         result = train_prepared(
             self._prepared,
             jnp.asarray(offsets),
@@ -294,6 +343,8 @@ class RandomEffectCoordinate:
             mesh=self.mesh,
             axis_name=self.axis_name,
             norm=self.normalization,
+            prior_coefficients=prior_W,
+            prior_variances=prior_V,
         )
         coefficients = result.coefficients
         variances = result.variances

@@ -184,6 +184,8 @@ def _solve_bucket(
     w0: Array,  # (k, d)
     l2_weight: Array,
     norm: Any,  # NormalizationContext | None (pytree)
+    prior_mu: Array | None,  # (k, d) per-entity Gaussian-prior means
+    prior_var: Array | None,  # (k, d) per-entity prior variances
     minimize_fn: Any,
     loss: PointwiseLoss,
     config: OptimizerConfig,
@@ -201,10 +203,15 @@ def _solve_bucket(
     variances (zeros when NONE)."""
     from photon_ml_tpu.ops.glm import compute_variances
 
-    def solve_one(batch: Batch, w0_e: Array):
+    from photon_ml_tpu.ops.glm import GaussianPrior
+
+    def solve_one(batch: Batch, w0_e: Array, mu_e, var_e):
+        prior = None
+        if mu_e is not None:
+            prior = GaussianPrior(means=mu_e, variances=var_e)
         obj = make_objective(
             batch, loss, l2_weight=l2_weight, norm=norm,
-            intercept_index=intercept_index,
+            intercept_index=intercept_index, prior=prior,
         )
         res = minimize_fn(obj, w0_e, config, **minimize_kwargs)
         var = compute_variances(obj, res.w, variance_computation)
@@ -212,7 +219,13 @@ def _solve_bucket(
             var = jnp.zeros_like(res.w)
         return res.w, res.value, res.iterations, res.reason, var
 
-    return jax.vmap(solve_one)(bucket_batch, w0)
+    # vmap maps the entity lane of every non-None prior array; None stays
+    # None (static absence) across all lanes
+    in_axes = (0, 0, None if prior_mu is None else 0,
+               None if prior_var is None else 0)
+    return jax.vmap(solve_one, in_axes=in_axes)(
+        bucket_batch, w0, prior_mu, prior_var
+    )
 
 
 def train_random_effects(
@@ -232,6 +245,8 @@ def train_random_effects(
     mesh: Mesh | None = None,
     axis_name: str = "data",
     norm: Any = None,
+    prior_coefficients: Array | None = None,
+    prior_variances: Array | None = None,
 ) -> RandomEffectTrainingResult:
     """Train all entities' GLMs; returns the (E, d) coefficient matrix.
 
@@ -256,6 +271,8 @@ def train_random_effects(
         mesh=mesh,
         axis_name=axis_name,
         norm=norm,
+        prior_coefficients=prior_coefficients,
+        prior_variances=prior_variances,
     )
 
 
@@ -274,6 +291,8 @@ def train_prepared(
     mesh: Mesh | None = None,
     axis_name: str = "data",
     norm: Any = None,  # NormalizationContext | None (shared by all entities)
+    prior_coefficients: Array | None = None,  # (E, d) per-entity MAP prior means
+    prior_variances: Array | None = None,  # (E, d) per-entity prior variances
 ) -> RandomEffectTrainingResult:
     """Solve every prepared bucket against the current offsets. Only the
     offsets are gathered per call (on device); everything else was staged by
@@ -307,6 +326,15 @@ def train_prepared(
             # warm start arrives in ORIGINAL feature space; the optimizer
             # works in normalized space
             W = jax.vmap(norm.model_from_original_space)(W)
+    prior_mu = prior_var = None
+    if prior_coefficients is not None:
+        # per-entity Gaussian MAP prior (incremental training): arrives in
+        # ORIGINAL feature space like the warm start; map into the solver's
+        # (normalized) space through the shared transform
+        from photon_ml_tpu.ops.glm import GaussianPrior
+
+        p = GaussianPrior.from_coefficients(prior_coefficients, prior_variances, norm)
+        prior_mu, prior_var = p.means, p.variances
     V = jnp.zeros((num_entities, d), jnp.float32) if compute_variance else None
     loss_values = np.full((num_entities,), np.nan, np.float64)
     iterations = np.zeros((num_entities,), np.int64)
@@ -332,6 +360,8 @@ def train_prepared(
             pb.columns,
             l2,
             norm,
+            prior_mu,
+            prior_var,
             minimize_fn=minimize_fn,
             loss=loss,
             config=config,
@@ -387,6 +417,8 @@ def _bucket_step(
     columns: Array | None,
     l2_weight: Array,
     norm: Any,
+    prior_mu: Array | None,  # (E, d) per-entity prior means, or None
+    prior_var: Array | None,  # (E, d) per-entity prior variances, or None
     *,
     minimize_fn: Any,
     loss: PointwiseLoss,
@@ -407,6 +439,23 @@ def _bucket_step(
     bucket_batch = dataclasses.replace(static_batch, offsets=off_b)
     w0 = W[ids]
     k_pad = static_batch.labels.shape[0]
+
+    def lane(M, pad_value=0.0):
+        """Extract, pad, project, and shard this bucket's rows of an (E, d)
+        matrix the same way as the warm-start lane."""
+        if M is None:
+            return None
+        rows = M[ids]
+        if k_pad != k:
+            rows = jnp.concatenate(
+                [rows, jnp.full((k_pad - k, d), pad_value, rows.dtype)]
+            )
+        if columns is not None:
+            rows = jnp.take_along_axis(rows, columns, axis=1)
+        if sharding is not None:
+            rows = jax.lax.with_sharding_constraint(rows, sharding)
+        return rows
+
     if k_pad != k:  # entity lane was padded for the mesh
         w0 = jnp.concatenate([w0, jnp.zeros((k_pad - k, d), w0.dtype)])
     solve_intercept = intercept_index
@@ -425,6 +474,8 @@ def _bucket_step(
         w0,
         l2_weight,
         norm,
+        lane(prior_mu),
+        lane(prior_var, pad_value=1.0),  # padded lanes: harmless unit variance
         minimize_fn=minimize_fn,
         loss=loss,
         config=config,

@@ -43,7 +43,8 @@ Array = jnp.ndarray
 
 @partial(
     jax.tree_util.register_dataclass,
-    data_fields=["batch", "norm", "l2_weight", "reg_mask"],
+    data_fields=["batch", "norm", "l2_weight", "reg_mask", "prior_mean",
+                 "prior_precision"],
     meta_fields=["loss", "axis_name", "fused", "offsets_zero", "weights_one"],
 )
 @dataclass(frozen=True)
@@ -64,6 +65,12 @@ class GLMObjective:
                   construction): constant-0 offsets / constant-1 weights
                   let the fused kernels skip those VMEM-padded aux streams
                   and run larger X tiles.
+      prior_mean / prior_precision — optional (d,) Gaussian prior for
+                  incremental training: the regularizer becomes
+                  0.5·λ₂·Σ maskⱼ·precⱼ·(wⱼ−μⱼ)², i.e. a MAP update toward
+                  the previous model (reference: Photon-ML's incremental
+                  learning uses the prior model's means/variances the same
+                  way; plain L2 is the μ=0, prec=1 special case).
     """
 
     batch: Batch
@@ -75,6 +82,8 @@ class GLMObjective:
     fused: bool = False
     offsets_zero: bool = False
     weights_one: bool = False
+    prior_mean: Array | None = None
+    prior_precision: Array | None = None
 
     # -- collective hook (identity when single-node) --------------------------
     def _reduce(self, x):
@@ -94,9 +103,31 @@ class GLMObjective:
         u, c = self.norm.to_effective(w)
         return self.batch.matvec(u) - c + self.batch.offsets
 
+    # -- regularizer (plain L2 or Gaussian prior) ------------------------------
+    def _reg_delta(self, w: Array) -> Array:
+        """prec·(w − μ) — the vector the regularizer's value/grad/Hessian
+        are built from (w itself for plain L2)."""
+        if self.prior_mean is None:
+            return w
+        prec = (
+            jnp.ones_like(w) if self.prior_precision is None
+            else self.prior_precision
+        )
+        return prec * (w - self.prior_mean)
+
+    def _reg_curvature(self, like: Array) -> Array:
+        """The regularizer's diagonal curvature scale (prec, or ones)."""
+        if self.prior_mean is None or self.prior_precision is None:
+            return jnp.ones_like(like)
+        return self.prior_precision
+
     # -- objective contracts ---------------------------------------------------
     def _l2_term(self, w: Array) -> Array:
-        return 0.5 * self.l2_weight * jnp.sum(self.reg_mask * w * w)
+        if self.prior_mean is None:
+            return 0.5 * self.l2_weight * jnp.sum(self.reg_mask * w * w)
+        prec = self._reg_curvature(w)
+        delta = w - self.prior_mean
+        return 0.5 * self.l2_weight * jnp.sum(self.reg_mask * prec * delta * delta)
 
     def value(self, w: Array) -> Array:
         m = self.margins(w)
@@ -125,7 +156,8 @@ class GLMObjective:
                 jnp.sum(r),
             )
         val, g_raw, r_sum = self._reduce(local)
-        g = self.norm.grad_to_model_space(g_raw, r_sum) + self.l2_weight * self.reg_mask * w
+        g = (self.norm.grad_to_model_space(g_raw, r_sum)
+             + self.l2_weight * self.reg_mask * self._reg_delta(w))
         return val + self._l2_term(w), g
 
     def grad(self, w: Array) -> Array:
@@ -156,7 +188,7 @@ class GLMObjective:
             local = (self.batch.rmatvec(q), jnp.sum(q))
         hv_raw, q_sum = self._reduce(local)
         hv = self.norm.grad_to_model_space(hv_raw, q_sum)
-        return hv + self.l2_weight * self.reg_mask * v
+        return hv + self.l2_weight * self.reg_mask * self._reg_curvature(v) * v
 
     def hessian_diag(self, w: Array) -> Array:
         """diag(H) — for VarianceComputationType.SIMPLE.
@@ -169,7 +201,7 @@ class GLMObjective:
         sq, lin, tot = self._reduce(local)
         f, s = self.norm.factors, self.norm.shifts
         diag = f * f * (sq - 2.0 * s * lin + s * s * tot)
-        return diag + self.l2_weight * self.reg_mask
+        return diag + self.l2_weight * self.reg_mask * self._reg_curvature(diag)
 
     def hessian(self, w: Array) -> Array:
         """Full (d, d) Hessian — for VarianceComputationType.FULL. Dense
@@ -184,7 +216,60 @@ class GLMObjective:
         Z = (self.batch.X - self.norm.shifts) * self.norm.factors
         local = Z.T @ (d2[:, None] * Z)
         h = self._reduce(local)
-        return h + jnp.diag(self.l2_weight * self.reg_mask)
+        return h + jnp.diag(self.l2_weight * self.reg_mask * self._reg_curvature(self.reg_mask))
+
+
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["means", "variances"],
+    meta_fields=["min_variance"],
+)
+@dataclass(frozen=True)
+class GaussianPrior:
+    """Informative Gaussian prior for incremental training (MAP update).
+
+    Built from a previously-trained model's coefficient means and
+    variances: the new fit is pulled toward ``means`` with per-coordinate
+    strength 1/variance (relative to the L2 weight λ₂). Reference:
+    Photon-ML's incremental learning consumes the prior model's
+    ``BayesianLinearModelAvro`` means/variances the same way (SURVEY.md §2.3
+    Model IO; warm start + prior = incremental retraining).
+
+    Registered as a pytree so it can cross ``jit``/``shard_map`` boundaries
+    (the sharded fixed-effect solve passes it as a replicated argument).
+    """
+
+    means: Array
+    variances: Array | None = None
+    min_variance: float = 1e-6
+
+    @property
+    def precisions(self) -> Array | None:
+        if self.variances is None:
+            return None
+        v = jnp.asarray(self.variances, jnp.float32)
+        return 1.0 / jnp.maximum(v, self.min_variance)
+
+    @classmethod
+    def from_coefficients(cls, means, variances, norm=None) -> "GaussianPrior":
+        """Build the prior IN THE SOLVER'S SPACE from original-feature-space
+        model coefficients: means map through the normalization, variances
+        through the inverse of the output map var_out = f²·var_norm. The
+        single home for this transform (GLM sweep, GAME fixed effect, and
+        the per-entity lanes all route through it); handles (d,) vectors
+        and (E, d) per-entity matrices alike."""
+        mu = jnp.asarray(means, jnp.float32)
+        if norm is not None:
+            f = norm.model_from_original_space
+            mu = jax.vmap(f)(mu) if mu.ndim == 2 else f(mu)
+        var = None
+        if variances is not None:
+            var = jnp.asarray(variances, jnp.float32)
+            if norm is not None:
+                var = var / (norm.factors**2)
+        return cls(means=mu, variances=var)
 
 
 def compute_variances(
@@ -215,6 +300,8 @@ def make_objective(
     intercept_index: int | None = None,
     axis_name: str | None = None,
     fused: bool | None = None,
+    data_hints: tuple[bool, bool] | None = None,
+    prior: "GaussianPrior | None" = None,
 ) -> GLMObjective:
     """Convenience constructor. ``intercept_index`` is excluded from L2
     regularization (and from normalization if ``norm`` is built with it).
@@ -223,9 +310,14 @@ def make_objective(
     dense batches with supported shapes (``ops/fused.py``); pass
     ``False``/``True`` to force (``True`` off-TPU runs the kernels in
     interpreter mode — correct but slow, for tests). Set the environment
-    variable ``PHOTON_DISABLE_FUSED=1`` to veto auto-enabling."""
-    import os
+    variable ``PHOTON_DISABLE_FUSED=1`` to veto auto-enabling.
 
+    ``data_hints`` = (offsets all zero, weights all one), for callers that
+    know their device-resident data (host numpy arrays are auto-detected
+    for free). The hints let the fused kernels drop those aux streams.
+
+    ``prior`` switches the regularizer from plain L2 to a Gaussian MAP
+    prior (incremental training): 0.5·λ₂·Σ maskⱼ·precⱼ·(wⱼ−μⱼ)²."""
     d = batch.num_features
     if norm is None:
         norm = no_normalization(d, intercept_index)
@@ -233,21 +325,12 @@ def make_objective(
     if intercept_index is not None:
         mask = mask.at[intercept_index].set(0.0)
     if fused is None:
-        from photon_ml_tpu.ops.fused import supports_fused
-
-        fused = (
-            isinstance(batch, DenseBatch)
-            # concrete arrays only: under a transform (e.g. the vmap-batched
-            # per-entity solves) X is a tracer and pallas_call would lower
-            # through untested vmap batching rules — keep the XLA path there
-            and not isinstance(batch.X, jax.core.Tracer)
-            and jax.default_backend() == "tpu"
-            and not os.environ.get("PHOTON_DISABLE_FUSED")
-            and supports_fused(batch.num_rows, d, batch.X.dtype)
-        )
+        fused = auto_fused(batch)
     offsets_zero = weights_one = False
     if fused:
-        offsets_zero, weights_one = _constant_hints(batch)
+        offsets_zero, weights_one = (
+            data_hints if data_hints is not None else _constant_hints(batch)
+        )
     return GLMObjective(
         batch=batch,
         norm=norm,
@@ -258,21 +341,42 @@ def make_objective(
         fused=bool(fused),
         offsets_zero=offsets_zero,
         weights_one=weights_one,
+        prior_mean=None if prior is None else jnp.asarray(prior.means, jnp.float32),
+        prior_precision=None if prior is None else prior.precisions,
+    )
+
+
+def auto_fused(batch: Batch) -> bool:
+    """Should this (concrete) batch use the one-pass Pallas kernels?
+    True on TPU for dense, lane-aligned, VMEM-feasible shapes. Callers that
+    construct objectives inside a transform (``shard_map``, ``vmap``) must
+    decide BEFORE entering it — under a transform X is a tracer and this
+    returns False (pallas under vmap batching rules is untested; under
+    ``shard_map`` pass the pre-computed answer through a static arg, as
+    ``parallel/distributed.py`` does with per-device row counts)."""
+    import os
+
+    from photon_ml_tpu.ops.fused import supports_fused
+
+    return (
+        isinstance(batch, DenseBatch)
+        and not isinstance(batch.X, jax.core.Tracer)
+        and jax.default_backend() == "tpu"
+        and not os.environ.get("PHOTON_DISABLE_FUSED")
+        and supports_fused(batch.num_rows, batch.num_features, batch.X.dtype)
     )
 
 
 def _constant_hints(batch: Batch) -> tuple[bool, bool]:
     """(offsets all 0, weights all 1) — static data hints for the fused
-    kernels, computed only when the arrays are concrete (outside jit).
-    One small device reduction each, once per objective construction."""
+    kernels. Only HOST numpy arrays are inspected (a free scan): checking a
+    device array would force a blocking device→host sync per objective
+    construction, which call sites like the coordinate-descent loop pay
+    every iteration. Callers holding device arrays that know their data
+    pass ``data_hints`` to ``make_objective`` instead."""
     import numpy as np
 
     def _is_const(x, value) -> bool:
-        if isinstance(x, jax.core.Tracer):
-            return False
-        try:
-            return bool(np.asarray(jnp.all(x == value)))
-        except Exception:
-            return False
+        return isinstance(x, np.ndarray) and bool(np.all(x == value))
 
     return _is_const(batch.offsets, 0.0), _is_const(batch.weights, 1.0)

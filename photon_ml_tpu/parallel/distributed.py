@@ -62,6 +62,8 @@ def shard_batch(batch: Batch, mesh: Mesh, axis_name: str = "data") -> Batch:
         "axis_name",
         "mesh",
         "use_l1",
+        "fused",
+        "data_hints",
     ),
 )
 def _sharded_solve(
@@ -70,6 +72,7 @@ def _sharded_solve(
     l2_weight: Array,
     l1_weight: Array,
     norm: NormalizationContext | None,
+    prior,  # GaussianPrior | None (replicated pytree)
     *,
     minimize_fn: Callable,
     loss: PointwiseLoss,
@@ -78,8 +81,14 @@ def _sharded_solve(
     axis_name: str,
     mesh: Mesh,
     use_l1: bool,
+    fused: bool = False,
+    data_hints: tuple[bool, bool] = (False, False),
 ) -> OptimizationResult:
-    def solve(local_batch, w0, l2w, l1w, norm_):
+    def solve(local_batch, w0, l2w, l1w, norm_, prior_):
+        # ``fused``/``data_hints`` are decided OUTSIDE the shard_map (the
+        # local batch here is a tracer, so in-place auto-detection would
+        # always say no); inside, the Pallas kernels see the per-device
+        # row shard with concrete shapes.
         obj = make_objective(
             local_batch,
             loss,
@@ -87,6 +96,9 @@ def _sharded_solve(
             norm=norm_,
             intercept_index=intercept_index,
             axis_name=axis_name,
+            fused=fused,
+            data_hints=data_hints,
+            prior=prior_,
         )
         kwargs = {"l1_weight": l1w} if use_l1 else {}
         return minimize_fn(obj, w0, config, **kwargs)
@@ -94,10 +106,10 @@ def _sharded_solve(
     return jax.shard_map(
         solve,
         mesh=mesh,
-        in_specs=(P(axis_name), P(), P(), P(), P()),
+        in_specs=(P(axis_name), P(), P(), P(), P(), P()),
         out_specs=P(),
         check_vma=False,
-    )(batch, w0, l2_weight, l1_weight, norm)
+    )(batch, w0, l2_weight, l1_weight, norm, prior)
 
 
 def sharded_minimize(
@@ -112,6 +124,8 @@ def sharded_minimize(
     intercept_index: int | None = None,
     axis_name: str = "data",
     l1_weight: float | Array | None = None,
+    fused: bool | None = None,
+    prior=None,
     **minimize_kwargs,
 ) -> OptimizationResult:
     """Run a device-resident optimizer over a row-sharded batch.
@@ -121,12 +135,26 @@ def sharded_minimize(
     objective they see simply carries ``axis_name`` so its partial sums
     psum over the mesh (the twin structure of SURVEY.md §4, collapsed to
     one code path).
+
+    ``fused=None`` auto-enables the one-pass Pallas kernels (TPU, dense
+    batch, supported shapes) — decided here on the concrete global batch
+    because inside ``shard_map`` only tracers are visible.
     """
+    from photon_ml_tpu.ops.glm import _constant_hints, auto_fused
+
     if "l1_weight" in minimize_kwargs:
         l1_weight = minimize_kwargs.pop("l1_weight")
     if minimize_kwargs:
         raise TypeError(f"unsupported kwargs: {sorted(minimize_kwargs)}")
+    if fused is None:
+        fused = auto_fused(batch)
+    data_hints = _constant_hints(batch) if fused else (False, False)
+    n_before = batch.num_rows
     batch = shard_batch(batch, mesh, axis_name)
+    if batch.num_rows != n_before:
+        # sharding padded zero-WEIGHT rows in: the all-ones hint no longer
+        # holds (the padding must stay inert through the weight mask)
+        data_hints = (data_hints[0], False)
     use_l1 = l1_weight is not None
     return _sharded_solve(
         batch,
@@ -134,6 +162,7 @@ def sharded_minimize(
         jnp.asarray(l2_weight, jnp.float32),
         jnp.asarray(0.0 if l1_weight is None else l1_weight, jnp.float32),
         norm,
+        prior,
         minimize_fn=minimize_fn,
         loss=loss,
         config=config,
@@ -141,6 +170,8 @@ def sharded_minimize(
         axis_name=axis_name,
         mesh=mesh,
         use_l1=use_l1,
+        fused=bool(fused),
+        data_hints=tuple(data_hints),
     )
 
 

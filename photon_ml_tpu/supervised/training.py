@@ -68,9 +68,16 @@ def train_glm(
     variance_computation: VarianceComputationType = VarianceComputationType.NONE,
     initial_model: GeneralizedLinearModel | None = None,
     axis_name: str | None = None,
+    incremental: bool = False,
 ) -> GLMTrainingResult:
     """Train one GLM per regularization weight (ascending, warm-started),
     validate each, and select the best by the first evaluator.
+
+    ``incremental=True`` turns ``initial_model`` from a plain warm start
+    into an informative Gaussian prior (MAP update): the regularizer pulls
+    toward the prior model's means with strength 1/variance per coordinate
+    (unit precision when the prior model carries no variances — train it
+    with ``variance_computation`` to get per-coordinate strengths).
 
     When ``axis_name`` is set the caller is responsible for invoking this
     inside ``shard_map`` (the distributed layer wraps it); the code is
@@ -101,11 +108,29 @@ def train_glm(
 
     # The optimizer works in NORMALIZED coefficient space; models are kept in
     # ORIGINAL space (the reference un-applies factors on the final model).
+    prior = None
     if initial_model is not None:
         w = jnp.asarray(initial_model.coefficients.means, dtype)
         if normalization is not None:
             w = normalization.model_from_original_space(w)
+        if incremental:
+            from photon_ml_tpu.ops.glm import GaussianPrior
+
+            if not any(regularization.l2_weight(lam) > 0
+                       for lam in regularization_weights):
+                raise ValueError(
+                    "incremental=True needs at least one sweep weight with a "
+                    "positive L2 component: the prior's pull is "
+                    "l2_weight * (1/prior_variance)"
+                )
+            prior = GaussianPrior.from_coefficients(
+                initial_model.coefficients.means,
+                initial_model.coefficients.variances,
+                normalization,
+            )
     else:
+        if incremental:
+            raise ValueError("incremental=True requires initial_model (the prior)")
         w = jnp.zeros((d,), dtype)
 
     specs = list(evaluators)
@@ -135,6 +160,7 @@ def train_glm(
             norm=normalization,
             intercept_index=intercept_index,
             axis_name=axis_name,
+            prior=prior,
         )
         minimize_fn, extra = select_minimize_fn(optimizer_config, l1)
         result = minimize_fn(obj, w, optimizer_config, **extra)

@@ -1,0 +1,367 @@
+"""Gaussian-prior (incremental / MAP) regularization.
+
+Reference parity: Photon-ML's incremental learning trains against the
+prior model's coefficient means/variances; plain L2 is the zero-mean,
+unit-precision special case (SURVEY.md §2.3 Model IO + warm start)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.config import OptimizerConfig
+from photon_ml_tpu.ops.batch import DenseBatch
+from photon_ml_tpu.ops.glm import GaussianPrior, compute_variances, make_objective
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.optim import lbfgs_minimize
+from photon_ml_tpu.optim.tron import tron_minimize
+from photon_ml_tpu.types import TaskType, VarianceComputationType
+
+
+def _batch(rng, n, d):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = (rng.normal(size=d) * 0.4).astype(np.float32)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(X @ w_true)))).astype(np.float32)
+    return DenseBatch(
+        X=jnp.asarray(X), labels=jnp.asarray(y),
+        offsets=jnp.zeros((n,), jnp.float32),
+        weights=jnp.ones((n,), jnp.float32),
+    ), w_true
+
+
+def test_zero_mean_unit_variance_prior_equals_plain_l2(rng):
+    batch, _ = _batch(rng, 120, 16)
+    loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+    d = 16
+    plain = make_objective(batch, loss, l2_weight=2.0)
+    prior = make_objective(
+        batch, loss, l2_weight=2.0,
+        prior=GaussianPrior(means=np.zeros(d, np.float32),
+                            variances=np.ones(d, np.float32)),
+    )
+    w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    f0, g0 = plain.value_and_grad(w)
+    f1, g1 = prior.value_and_grad(w)
+    np.testing.assert_allclose(float(f1), float(f0), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), rtol=1e-5, atol=1e-6)
+    v = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(prior.hvp(w, v)), np.asarray(plain.hvp(w, v)),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_prior_gradient_matches_finite_differences(rng):
+    batch, _ = _batch(rng, 80, 8)
+    loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+    mu = rng.normal(size=8).astype(np.float32)
+    var = rng.uniform(0.1, 2.0, size=8).astype(np.float32)
+    obj = make_objective(
+        batch, loss, l2_weight=1.5, prior=GaussianPrior(means=mu, variances=var)
+    )
+    w = jnp.asarray(rng.normal(size=8).astype(np.float32) * 0.3)
+    _, g = obj.value_and_grad(w)
+    eps = 1e-3
+    for j in range(8):
+        e = np.zeros(8, np.float32)
+        e[j] = eps
+        fd = (float(obj.value(w + e)) - float(obj.value(w - e))) / (2 * eps)
+        np.testing.assert_allclose(float(g[j]), fd, rtol=2e-2, atol=2e-3)
+
+
+def test_strong_prior_dominates_small_data(rng):
+    """With huge λ₂ the MAP solution collapses onto the prior means."""
+    batch, _ = _batch(rng, 40, 8)
+    loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+    mu = (rng.normal(size=8) * 0.5).astype(np.float32)
+    obj = make_objective(
+        batch, loss, l2_weight=1e6,
+        prior=GaussianPrior(means=mu, variances=np.full(8, 0.01, np.float32)),
+    )
+    res = lbfgs_minimize(obj, jnp.zeros(8, jnp.float32),
+                         OptimizerConfig(max_iterations=200, tolerance=1e-10))
+    np.testing.assert_allclose(np.asarray(res.w), mu, atol=1e-3)
+
+
+def test_incremental_beats_cold_start_on_shifted_data(rng):
+    """Classic incremental scenario: a model trained on a big old batch
+    becomes the prior for a SMALL new batch; the MAP fit should stay close
+    to the truth while a plain-L2 fit on the small batch alone overfits."""
+    d = 12
+    w_true = (rng.normal(size=d) * 0.6).astype(np.float32)
+
+    def make(n, seed_shift):
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(X @ w_true)))).astype(np.float32)
+        return DenseBatch(
+            X=jnp.asarray(X), labels=jnp.asarray(y),
+            offsets=jnp.zeros((n,), jnp.float32),
+            weights=jnp.ones((n,), jnp.float32),
+        )
+
+    loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+    cfg = OptimizerConfig(max_iterations=300, tolerance=1e-10)
+
+    big = make(4000, 0)
+    obj_big = make_objective(big, loss, l2_weight=1.0)
+    res_big = lbfgs_minimize(obj_big, jnp.zeros(d, jnp.float32), cfg)
+    variances = compute_variances(obj_big, res_big.w, VarianceComputationType.SIMPLE)
+
+    small = make(30, 1)
+    cold = lbfgs_minimize(
+        make_objective(small, loss, l2_weight=1.0), jnp.zeros(d, jnp.float32), cfg
+    )
+    warm = lbfgs_minimize(
+        make_objective(
+            small, loss, l2_weight=1.0,
+            prior=GaussianPrior(means=res_big.w, variances=variances),
+        ),
+        res_big.w, cfg,
+    )
+    err_cold = float(np.linalg.norm(np.asarray(cold.w) - w_true))
+    err_warm = float(np.linalg.norm(np.asarray(warm.w) - w_true))
+    assert err_warm < err_cold, (err_warm, err_cold)
+    assert err_warm < 0.5 * err_cold  # the prior carries most of the signal
+
+
+def test_tron_with_prior_matches_lbfgs(rng):
+    batch, _ = _batch(rng, 300, 10)
+    loss = loss_for_task(TaskType.LINEAR_REGRESSION)
+    mu = (rng.normal(size=10) * 0.3).astype(np.float32)
+    var = rng.uniform(0.5, 1.5, size=10).astype(np.float32)
+    obj = make_objective(
+        batch, loss, l2_weight=2.0, prior=GaussianPrior(means=mu, variances=var)
+    )
+    cfg = OptimizerConfig(max_iterations=200, tolerance=1e-10)
+    r1 = lbfgs_minimize(obj, jnp.zeros(10, jnp.float32), cfg)
+    r2 = tron_minimize(obj, jnp.zeros(10, jnp.float32), cfg)
+    np.testing.assert_allclose(np.asarray(r2.w), np.asarray(r1.w), rtol=1e-3, atol=1e-4)
+
+
+def test_incremental_glm_driver_roundtrip(tmp_path, rng):
+    """Train a model with variances, then retrain a small batch with
+    --prior-model: the driver must load the prior and produce a model
+    closer to the prior than a cold fit."""
+    import os
+
+    from photon_ml_tpu.cli import train_glm
+    from photon_ml_tpu.io.model_io import load_glm
+
+    w = np.array([1.0, -2.0, 0.5])
+
+    def write_libsvm(path, n, seed):
+        r = np.random.default_rng(seed)
+        lines = []
+        for _ in range(n):
+            x = r.normal(size=3)
+            y = 1 if r.uniform() < 1 / (1 + np.exp(-x @ w)) else -1
+            feats = " ".join(f"{j + 1}:{x[j]:.5f}" for j in range(3))
+            lines.append(f"{y} {feats}")
+        with open(path, "w") as f:
+            f.write("\n".join(lines))
+
+    big = str(tmp_path / "big.libsvm")
+    small = str(tmp_path / "small.libsvm")
+    write_libsvm(big, 2000, 0)
+    write_libsvm(small, 25, 1)
+
+    out1 = str(tmp_path / "out1")
+    train_glm.run(
+        TaskType.LOGISTIC_REGRESSION, [big], out1, weights=[1.0],
+        variance_computation=VarianceComputationType.SIMPLE,
+    )
+    prior_path = os.path.join(out1, "best", "model.avro")
+    prior = load_glm(prior_path)
+    assert prior.coefficients.variances is not None
+
+    out_cold = str(tmp_path / "cold")
+    train_glm.run(TaskType.LOGISTIC_REGRESSION, [small], out_cold, weights=[1.0])
+    out_warm = str(tmp_path / "warm")
+    train_glm.run(
+        TaskType.LOGISTIC_REGRESSION, [small], out_warm, weights=[1.0],
+        prior_model_path=prior_path,
+    )
+    w_prior = np.asarray(prior.coefficients.means)
+    w_cold = np.asarray(load_glm(os.path.join(out_cold, "best", "model.avro")).coefficients.means)
+    w_warm = np.asarray(load_glm(os.path.join(out_warm, "best", "model.avro")).coefficients.means)
+    assert np.linalg.norm(w_warm - w_prior) < np.linalg.norm(w_cold - w_prior)
+
+
+def test_random_effect_per_entity_prior(rng):
+    """Per-entity MAP priors: entities with tiny data stay near their prior
+    rows; a cold solve drifts further."""
+    from photon_ml_tpu.game import bucket_entities, group_by_entity
+    from photon_ml_tpu.game.data import DenseFeatures
+    from photon_ml_tpu.game.random_effect import train_random_effects
+
+    E, d = 12, 4
+    W_prior = (rng.normal(size=(E, d)) * 0.5).astype(np.float32)
+    V_prior = np.full((E, d), 0.05, np.float32)
+    # 3 rows per entity — far too little to pin down 4 coefficients
+    ids = np.repeat(np.arange(E, dtype=np.int32), 3)
+    n = ids.shape[0]
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    margins = np.sum(W_prior[ids] * X, axis=1)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margins))).astype(np.float32)
+    grouping = group_by_entity(ids, num_entities=E)
+    common = dict(
+        features=DenseFeatures(X=jnp.asarray(X)),
+        labels=y,
+        offsets=np.zeros(n, np.float32),
+        weights=np.ones(n, np.float32),
+        buckets=bucket_entities(grouping),
+        num_entities=E,
+        loss=loss_for_task(TaskType.LOGISTIC_REGRESSION),
+        config=OptimizerConfig(max_iterations=100, tolerance=1e-9),
+        l2_weight=1.0,
+    )
+    cold = train_random_effects(**common)
+    warm = train_random_effects(
+        **common,
+        initial_coefficients=jnp.asarray(W_prior),
+        prior_coefficients=jnp.asarray(W_prior),
+        prior_variances=jnp.asarray(V_prior),
+    )
+    drift_cold = float(np.linalg.norm(np.asarray(cold.coefficients) - W_prior))
+    drift_warm = float(np.linalg.norm(np.asarray(warm.coefficients) - W_prior))
+    assert drift_warm < 0.5 * drift_cold, (drift_warm, drift_cold)
+
+
+def test_game_estimator_incremental_fit(rng):
+    """End-to-end: a GAME fit with config.incremental=True consumes the
+    warm-start model as a prior for BOTH coordinate kinds and trains
+    without error; the result stays closer to the prior model."""
+    from photon_ml_tpu.config import (
+        FeatureShardConfig,
+        FixedEffectCoordinateConfig,
+        GameTrainingConfig,
+        OptimizationConfig,
+        RandomEffectCoordinateConfig,
+        RegularizationContext,
+    )
+    from photon_ml_tpu.types import RegularizationType
+    from photon_ml_tpu.estimators import GameEstimator
+    from photon_ml_tpu.game import make_game_batch
+
+    n, d_fixed, E, d_re = 300, 6, 10, 3
+    w_fixed = (rng.normal(size=d_fixed) * 0.5).astype(np.float32)
+    W_re = (rng.normal(size=(E, d_re)) * 0.5).astype(np.float32)
+    X = rng.normal(size=(n, d_fixed)).astype(np.float32)
+    Xr = rng.normal(size=(n, d_re)).astype(np.float32)
+    ids = rng.integers(0, E, size=n).astype(np.int32)
+    margin = X @ w_fixed + np.sum(W_re[ids] * Xr, axis=1)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(np.float32)
+    batch = make_game_batch(
+        y, {"global": X, "per_user": Xr}, id_tags={"userId": ids}
+    )
+
+    def config(incremental):
+        return GameTrainingConfig(
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            coordinate_update_sequence=("fixed", "user"),
+            coordinate_descent_iterations=2,
+            fixed_effect_coordinates={
+                "fixed": FixedEffectCoordinateConfig(
+                    feature_shard_id="global",
+                    optimization=OptimizationConfig(
+                        optimizer=OptimizerConfig(max_iterations=50),
+                        regularization=RegularizationContext(RegularizationType.L2),
+                        regularization_weight=1.0,
+                    ),
+                )
+            },
+            random_effect_coordinates={
+                "user": RandomEffectCoordinateConfig(
+                    feature_shard_id="per_user",
+                    random_effect_type="userId",
+                    optimization=OptimizationConfig(
+                        optimizer=OptimizerConfig(max_iterations=50),
+                        regularization=RegularizationContext(RegularizationType.L2),
+                        regularization_weight=1.0,
+                    ),
+                )
+            },
+            variance_computation=VarianceComputationType.SIMPLE,
+            incremental=incremental,
+        )
+
+    first = GameEstimator(config(False)).fit(batch)[0].model
+    refit = GameEstimator(config(True)).fit(batch, initial_model=first)
+    assert refit, "incremental fit returned no results"
+    model = refit[0].model
+    # the prior anchors the refit: coefficients stay close to the first fit
+    w1 = np.asarray(first.models["fixed"].model.coefficients.means)
+    w2 = np.asarray(model.models["fixed"].model.coefficients.means)
+    assert np.linalg.norm(w2 - w1) < 0.5 * np.linalg.norm(w1)
+
+
+def test_prior_through_sharded_solve(rng):
+    """GaussianPrior must cross the jit/shard_map boundary (it is a
+    registered pytree) and give the same MAP optimum as single-device."""
+    from photon_ml_tpu.parallel import data_mesh
+    from photon_ml_tpu.parallel.distributed import sharded_minimize
+
+    batch, _ = _batch(rng, 8 * 40, 16)
+    loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+    mu = (rng.normal(size=16) * 0.4).astype(np.float32)
+    var = rng.uniform(0.05, 0.5, size=16).astype(np.float32)
+    prior = GaussianPrior(means=mu, variances=var)
+    cfg = OptimizerConfig(max_iterations=150, tolerance=1e-10)
+    w0 = jnp.zeros(16, jnp.float32)
+    local = lbfgs_minimize(
+        make_objective(batch, loss, l2_weight=3.0, prior=prior), w0, cfg
+    )
+    sharded = sharded_minimize(
+        lbfgs_minimize, batch, w0, cfg, data_mesh(8), loss,
+        l2_weight=3.0, prior=prior,
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded.w), np.asarray(local.w), rtol=1e-3, atol=1e-4
+    )
+
+
+def test_game_incremental_multi_iteration_prior_is_anchored(rng):
+    """The MAP prior must stay pinned to the LOADED model across descent
+    iterations (not drift to each iteration's own output): with a
+    near-infinite-precision prior, even a multi-iteration refit on
+    contradicting data must return (approximately) the prior itself."""
+    from photon_ml_tpu.game import (
+        CoordinateDescent,
+        FixedEffectCoordinate,
+        bucket_entities,
+        group_by_entity,
+        make_game_batch,
+    )
+    from photon_ml_tpu.config import OptimizationConfig
+    from photon_ml_tpu.game.models import FixedEffectModel
+    from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+
+    n, d = 200, 5
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    batch = make_game_batch(y, {"global": X})
+    mu = (rng.normal(size=d) * 0.7).astype(np.float32)
+    prior_sub = FixedEffectModel(
+        model=GeneralizedLinearModel(
+            Coefficients(jnp.asarray(mu), jnp.full((d,), 1e-4, jnp.float32)),
+            TaskType.LOGISTIC_REGRESSION,
+        ),
+        feature_shard_id="global",
+    )
+    from photon_ml_tpu.config import RegularizationContext
+    from photon_ml_tpu.types import RegularizationType
+
+    coord = FixedEffectCoordinate(
+        coordinate_id="fixed", batch=batch, feature_shard_id="global",
+        config=OptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=100, tolerance=1e-10),
+            regularization=RegularizationContext(RegularizationType.L2),
+            regularization_weight=100.0,
+        ),
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        prior_model=prior_sub,
+    )
+    cd = CoordinateDescent({"fixed": coord}, batch, TaskType.LOGISTIC_REGRESSION)
+    result = cd.run(("fixed",), 3, initial_model=None)
+    w = np.asarray(result.model.models["fixed"].model.coefficients.means)
+    np.testing.assert_allclose(w, mu, atol=5e-2)
