@@ -37,6 +37,7 @@ def run(
     logger: PhotonLogger | None = None,
     mesh=None,
     profile_dir: str | None = None,
+    diagnostics: bool = False,
 ) -> GameResult:
     logger = logger or PhotonLogger(output_dir)
     id_tags = tuple(
@@ -179,6 +180,16 @@ def run(
         }
         with open(os.path.join(output_dir, "metrics.json"), "w") as f:
             json.dump(metrics, f, indent=2)
+        if diagnostics:
+            from photon_ml_tpu.diagnostics import game_diagnostics, write_report
+
+            with timed(logger, "write diagnostics"):
+                write_report(
+                    game_diagnostics(
+                        results, config=config, index_maps=train.index_maps
+                    ),
+                    output_dir,
+                )
     sync_processes("train-outputs-written")
     return best
 
@@ -263,6 +274,11 @@ def main(argv: list[str] | None = None) -> None:
         help="capture jax.profiler device traces of the expensive phases "
              "into this directory (TensorBoard/Perfetto-loadable)",
     )
+    p.add_argument(
+        "--diagnostics", action="store_true",
+        help="write diagnostics.json + a self-contained diagnostics.html "
+             "(per-coordinate optimizer traces, metrics, top features)",
+    )
     p.add_argument("--output-dir", required=True)
     args = p.parse_args(argv)
 
@@ -316,6 +332,7 @@ def main(argv: list[str] | None = None) -> None:
         logger=logger,
         mesh=mesh,
         profile_dir=args.profile_dir,
+        diagnostics=args.diagnostics,
     )
 
 

@@ -79,6 +79,7 @@ def run(
     logger: PhotonLogger | None = None,
     profile_dir: str | None = None,
     prior_model_path: str | None = None,
+    diagnostics: bool = False,
 ):
     if multihost and streaming_chunk_rows is None:
         raise ValueError(
@@ -119,6 +120,8 @@ def run(
             unsupported.append("--summarize-features")
         if prior_model_path:
             unsupported.append("--prior-model (incremental mode is in-memory)")
+        if diagnostics:
+            unsupported.append("--diagnostics (in-memory mode only)")
         if unsupported:
             raise ValueError(
                 "--streaming-chunk-rows does not support: "
@@ -246,6 +249,14 @@ def run(
     }
     with open(os.path.join(output_dir, "report.json"), "w") as f:
         json.dump(report, f, indent=2)
+    if diagnostics:
+        from photon_ml_tpu.diagnostics import glm_sweep_diagnostics, write_report
+
+        with timed(logger, "write diagnostics"):
+            write_report(
+                glm_sweep_diagnostics(result, index_map=imap, task=task),
+                output_dir,
+            )
     advance("VALIDATED")
     return result
 
@@ -420,6 +431,11 @@ def main(argv: list[str] | None = None) -> None:
         help="capture jax.profiler device traces of the training sweep",
     )
     p.add_argument(
+        "--diagnostics", action="store_true",
+        help="write diagnostics.json + a self-contained diagnostics.html "
+             "(optimizer traces, validation metrics, top features)",
+    )
+    p.add_argument(
         "--prior-model", default=None,
         help="incremental training: path to a previously saved model Avro "
              "whose means/variances become an informative Gaussian prior "
@@ -447,6 +463,7 @@ def main(argv: list[str] | None = None) -> None:
         variance_computation=VarianceComputationType(args.variance),
         validate=DataValidationType(args.validate),
         prior_model_path=args.prior_model,
+        diagnostics=args.diagnostics,
         streaming_chunk_rows=args.streaming_chunk_rows,
         multihost=args.multihost,
         profile_dir=args.profile_dir,

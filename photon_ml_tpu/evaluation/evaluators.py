@@ -29,6 +29,8 @@ from typing import Callable, Mapping
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu.types import TaskType
+
 from photon_ml_tpu.ops import losses as losses_mod
 
 Array = jnp.ndarray
@@ -206,6 +208,16 @@ _SCALAR_EVALUATORS = {
     "POISSON_LOSS": (poisson_loss_metric, False),
     "SQUARED_LOSS": (squared_loss_metric, False),
     "SMOOTHED_HINGE_LOSS": (smoothed_hinge_loss_metric, False),
+}
+
+
+# The per-task default model-selection metric (single source of truth for
+# the sweep trainer and cross-validation).
+DEFAULT_EVALUATOR_BY_TASK = {
+    TaskType.LOGISTIC_REGRESSION: "AUC",
+    TaskType.LINEAR_REGRESSION: "RMSE",
+    TaskType.POISSON_REGRESSION: "POISSON_LOSS",
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: "AUC",
 }
 
 

@@ -437,7 +437,6 @@ def _bucket_step(
     d = W.shape[1]
     off_b = offsets[row_idx] * mask
     bucket_batch = dataclasses.replace(static_batch, offsets=off_b)
-    w0 = W[ids]
     k_pad = static_batch.labels.shape[0]
 
     def lane(M, pad_value=0.0):
@@ -456,18 +455,14 @@ def _bucket_step(
             rows = jax.lax.with_sharding_constraint(rows, sharding)
         return rows
 
-    if k_pad != k:  # entity lane was padded for the mesh
-        w0 = jnp.concatenate([w0, jnp.zeros((k_pad - k, d), w0.dtype)])
+    w0 = lane(W)
     solve_intercept = intercept_index
     if columns is not None:
-        # subspace projection: solve at width p over each entity's own
+        # subspace projection solves at width p over each entity's own
         # columns; the intercept (always the last full-space column by
         # framework convention) lands at slot p-1
-        w0 = jnp.take_along_axis(w0, columns, axis=1)
         if intercept_index is not None:
             solve_intercept = columns.shape[1] - 1
-    if sharding is not None:
-        w0 = jax.lax.with_sharding_constraint(w0, sharding)
 
     w_b, f_b, it_b, reason_b, var_b = _solve_bucket(
         bucket_batch,

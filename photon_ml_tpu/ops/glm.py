@@ -247,10 +247,17 @@ class GaussianPrior:
 
     @property
     def precisions(self) -> Array | None:
+        """1/variance, with NON-POSITIVE variances treated as UNINFORMATIVE
+        (precision 1, i.e. plain-L2 strength). Model loaders zero-fill
+        variances for features absent from the saved record and for padded
+        new entities — clamping those zeros to min_variance would give them
+        near-infinite precision and freeze them at the prior mean forever;
+        the reference gives missing prior features a default variance of 1
+        for exactly this reason."""
         if self.variances is None:
             return None
         v = jnp.asarray(self.variances, jnp.float32)
-        return 1.0 / jnp.maximum(v, self.min_variance)
+        return jnp.where(v > 0.0, 1.0 / jnp.maximum(v, self.min_variance), 1.0)
 
     @classmethod
     def from_coefficients(cls, means, variances, norm=None) -> "GaussianPrior":

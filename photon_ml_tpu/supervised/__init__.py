@@ -1,3 +1,7 @@
 """Single-model supervised training (legacy GLM driver parity)."""
 
+from photon_ml_tpu.supervised.cross_validation import (  # noqa: F401
+    CrossValidationResult,
+    cross_validate_glm,
+)
 from photon_ml_tpu.supervised.training import GLMTrainingResult, train_glm  # noqa: F401

@@ -135,12 +135,9 @@ def train_glm(
 
     specs = list(evaluators)
     if validation_batch is not None and not specs:
-        specs = {
-            TaskType.LOGISTIC_REGRESSION: ["AUC"],
-            TaskType.LINEAR_REGRESSION: ["RMSE"],
-            TaskType.POISSON_REGRESSION: ["POISSON_LOSS"],
-            TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: ["AUC"],
-        }[task]
+        from photon_ml_tpu.evaluation.evaluators import DEFAULT_EVALUATOR_BY_TASK
+
+        specs = [DEFAULT_EVALUATOR_BY_TASK[task]]
     primary = make_evaluator(specs[0]) if specs else None
 
     models: dict[float, GeneralizedLinearModel] = {}

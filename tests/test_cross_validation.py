@@ -1,0 +1,74 @@
+"""K-fold cross-validation for the GLM sweep (SURVEY.md checklist item 7)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.config import OptimizerConfig
+from photon_ml_tpu.ops.batch import DenseBatch
+from photon_ml_tpu.supervised.cross_validation import cross_validate_glm
+from photon_ml_tpu.types import TaskType
+
+
+def _logistic_batch(rng, n, d, w_true):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(X @ w_true)))).astype(np.float32)
+    return DenseBatch(
+        X=jnp.asarray(X), labels=jnp.asarray(y),
+        offsets=jnp.zeros((n,), jnp.float32),
+        weights=jnp.ones((n,), jnp.float32),
+    )
+
+
+def test_cv_selects_moderate_lambda_and_refits(rng):
+    d = 8
+    w_true = (rng.normal(size=d) * 0.8).astype(np.float32)
+    batch = _logistic_batch(rng, 400, d, w_true)
+    res = cross_validate_glm(
+        batch,
+        TaskType.LOGISTIC_REGRESSION,
+        k=4,
+        regularization_weights=[0.1, 1.0, 1e4],
+        optimizer_config=OptimizerConfig(max_iterations=100, tolerance=1e-8),
+        seed=3,
+    )
+    assert res.metric_name == "AUC"
+    # every λ gets one metric per fold
+    assert all(len(v) == 4 for v in res.metric_values.values())
+    # the absurd λ=1e4 (near-zero model) must not win
+    assert res.best_weight != 1e4
+    assert res.mean(res.best_weight) >= res.mean(1e4)
+    # the refit trains exactly the winning weight on all rows
+    assert list(res.final.models.keys()) == [res.best_weight]
+    s = res.summary()
+    assert s["best_weight"] == res.best_weight
+    assert set(s["per_weight"]) == {"0.1", "1.0", "10000.0"}
+
+
+def test_cv_linear_uses_rmse_lower_is_better(rng):
+    d = 5
+    w_true = (rng.normal(size=d)).astype(np.float32)
+    X = rng.normal(size=(300, d)).astype(np.float32)
+    y = X @ w_true + 0.05 * rng.normal(size=300).astype(np.float32)
+    batch = DenseBatch(
+        X=jnp.asarray(X), labels=jnp.asarray(y),
+        offsets=jnp.zeros((300,), jnp.float32),
+        weights=jnp.ones((300,), jnp.float32),
+    )
+    res = cross_validate_glm(
+        batch, TaskType.LINEAR_REGRESSION, k=3,
+        regularization_weights=[0.01, 1e5], seed=0,
+    )
+    assert res.metric_name == "RMSE"
+    assert res.best_weight == 0.01  # the over-regularized model has huge RMSE
+    assert res.mean(0.01) < res.mean(1e5)
+
+
+def test_cv_rejects_bad_k(rng):
+    batch = _logistic_batch(rng, 10, 3, np.ones(3, np.float32))
+    with pytest.raises(ValueError):
+        cross_validate_glm(batch, TaskType.LOGISTIC_REGRESSION, k=1)
+    with pytest.raises(ValueError):
+        cross_validate_glm(batch, TaskType.LOGISTIC_REGRESSION, k=11)

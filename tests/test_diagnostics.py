@@ -1,0 +1,130 @@
+"""Model diagnostics reports (JSON + self-contained HTML).
+
+Reference parity: the reference's historical model-diagnostics subsystem
+(HTML reports off training artifacts) — SURVEY.md checklist item 7."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.types import TaskType, VarianceComputationType
+
+
+def _write_libsvm(path, rng, n, w):
+    lines = []
+    for _ in range(n):
+        x = rng.normal(size=w.shape[0])
+        y = 1 if rng.uniform() < 1 / (1 + np.exp(-x @ w)) else -1
+        feats = " ".join(f"{j + 1}:{x[j]:.5f}" for j in range(w.shape[0]))
+        lines.append(f"{y} {feats}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+
+
+def test_coefficient_summary_resolves_names():
+    from photon_ml_tpu.data.index_map import IndexMap
+    from photon_ml_tpu.diagnostics import coefficient_summary
+
+    imap = IndexMap.build(["age\x01", "income\x01log", "clicks\x01"])
+    means = np.array([0.5, -2.0, 0.0])
+    c = coefficient_summary(means, variances=np.array([0.1, 0.2, 0.3]), index_map=imap)
+    assert c["num_features"] == 3
+    assert c["num_nonzero"] == 2
+    # top feature is the largest |weight| and carries its resolved name
+    top = c["top_features"][0]
+    assert abs(top["weight"]) == 2.0 and isinstance(top["feature"], str)
+    assert len(c["top_features"]) == 2  # zeros excluded
+    assert c["has_variances"]
+
+
+def test_glm_driver_writes_diagnostics(tmp_path, rng):
+    from photon_ml_tpu.cli import train_glm
+
+    path = str(tmp_path / "train.libsvm")
+    _write_libsvm(path, rng, 300, np.array([1.0, -2.0, 0.5]))
+    out = str(tmp_path / "out")
+    train_glm.run(
+        TaskType.LOGISTIC_REGRESSION,
+        [path],
+        out,
+        validation_data=[path],
+        weights=[0.1, 1.0],
+        variance_computation=VarianceComputationType.SIMPLE,
+        diagnostics=True,
+    )
+    with open(os.path.join(out, "diagnostics.json")) as f:
+        report = json.load(f)
+    assert report["kind"] == "glm_sweep"
+    assert report["best_regularization_weight"] in (0.1, 1.0)
+    assert len(report["entries"]) == 2
+    e = report["entries"][0]
+    assert e["optimizer"]["iterations"] >= 1
+    assert e["optimizer"]["loss_history"][0] >= e["optimizer"]["loss_history"][-1]
+    assert e["validation"]["AUC"] > 0.6
+    assert e["coefficients"]["top_features"], "expected resolved top features"
+    html_text = open(os.path.join(out, "diagnostics.html")).read()
+    assert "<svg" in html_text and "top features" in html_text
+    assert str(report["best_regularization_weight"]) in html_text
+
+
+def test_game_diagnostics_report(rng):
+    from photon_ml_tpu.config import (
+        FixedEffectCoordinateConfig,
+        GameTrainingConfig,
+        OptimizationConfig,
+        RandomEffectCoordinateConfig,
+    )
+    from photon_ml_tpu.config import OptimizerConfig
+    from photon_ml_tpu.diagnostics import game_diagnostics, write_html
+    from photon_ml_tpu.estimators import GameEstimator
+    from photon_ml_tpu.game import make_game_batch
+
+    n, d, E, dr = 200, 4, 6, 2
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    Xr = rng.normal(size=(n, dr)).astype(np.float32)
+    ids = rng.integers(0, E, size=n).astype(np.int32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    batch = make_game_batch(y, {"g": X, "r": Xr}, id_tags={"uid": ids})
+    cfg = GameTrainingConfig(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinate_update_sequence=("fixed", "user"),
+        coordinate_descent_iterations=1,
+        fixed_effect_coordinates={
+            "fixed": FixedEffectCoordinateConfig(
+                feature_shard_id="g",
+                optimization=OptimizationConfig(
+                    optimizer=OptimizerConfig(max_iterations=20)
+                ),
+            )
+        },
+        random_effect_coordinates={
+            "user": RandomEffectCoordinateConfig(
+                feature_shard_id="r",
+                random_effect_type="uid",
+                optimization=OptimizationConfig(
+                    optimizer=OptimizerConfig(max_iterations=20)
+                ),
+            )
+        },
+    )
+    results = GameEstimator(cfg).fit(batch)
+    report = game_diagnostics(results, config=cfg)
+    assert report["kind"] == "game" and len(report["grid"]) == 1
+    coords = report["grid"][0]["coordinates"]
+    assert coords["fixed"]["type"] == "fixed_effect"
+    assert coords["user"]["type"] == "random_effect"
+    assert coords["user"]["num_entities"] == E
+    assert coords["fixed"]["per_iteration"], "fixed coordinate tracker missing"
+    json.dumps(report)  # must be JSON-serializable
+
+    out = os.path.join(os.path.dirname(__file__), "..", ".tmp_diag.html")
+    try:
+        write_html(report, out)
+        assert "coordinate" in open(out).read()
+    finally:
+        if os.path.exists(out):
+            os.remove(out)

@@ -365,3 +365,30 @@ def test_game_incremental_multi_iteration_prior_is_anchored(rng):
     result = cd.run(("fixed",), 3, initial_model=None)
     w = np.asarray(result.model.models["fixed"].model.coefficients.means)
     np.testing.assert_allclose(w, mu, atol=5e-2)
+
+
+def test_zero_variance_prior_entries_are_uninformative(rng):
+    """Model loaders zero-fill variances for absent features / padded new
+    entities; those coordinates must get plain-L2 strength (precision 1),
+    NOT be frozen at the prior mean by a clamped near-infinite precision."""
+    batch, _ = _batch(rng, 500, 6)
+    loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+    mu = np.zeros(6, np.float32)
+    var = np.array([0.0, 0.0, 0.0, 1.0, 1.0, 1.0], np.float32)  # half "absent"
+    cfg = OptimizerConfig(max_iterations=200, tolerance=1e-10)
+    with_prior = lbfgs_minimize(
+        make_objective(batch, loss, l2_weight=1.0,
+                       prior=GaussianPrior(means=mu, variances=var)),
+        jnp.zeros(6, jnp.float32), cfg,
+    )
+    plain = lbfgs_minimize(
+        make_objective(batch, loss, l2_weight=1.0),
+        jnp.zeros(6, jnp.float32), cfg,
+    )
+    # zero-variance coordinates behave exactly like plain L2 (both priors
+    # here have mean 0 and unit effective precision)
+    np.testing.assert_allclose(
+        np.asarray(with_prior.w), np.asarray(plain.w), rtol=1e-4, atol=1e-5
+    )
+    # and they are NOT frozen at the mean
+    assert np.all(np.abs(np.asarray(with_prior.w)[:3]) > 1e-3)
