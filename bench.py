@@ -220,11 +220,34 @@ def bench_dense_logistic(jax, jnp, dtype=None):
     # within arithmetic precision) — count the iterations it actually ran
     iters = max(int(res.iterations), 1)
     passes = max(int(res.objective_passes), iters)
+    # marginal ms/iteration: difference a short solve out of the long one —
+    # cancels the fixed per-solve dispatch+readback latency of this relay
+    # platform (~0.1-0.25 s/solve), which locally-attached chips don't pay
+    marginal = None
+    short_T = 9
+    if iters > short_T:
+        cfg_s = OptimizerConfig(max_iterations=short_T, tolerance=0.0)
+        dt_s, _, res_s = _timed_solves(
+            lambda: lbfgs_minimize(obj, w0, cfg_s),
+            bytes_lower_bound_per_run=float(n) * d * itemsize,
+        )
+        its_s = max(int(res_s.iterations), 1)
+        # relay latency jitter can swamp the differenced work on a noisy
+        # run — report marginal only when the difference is positive
+        if iters > its_s and dt > dt_s:
+            marginal = (dt - dt_s) / (iters - its_s)
     sps = n * iters / dt
     proxy = _proxy_logistic_dense(1 << 16, d)
     return {
         "samples_per_sec": round(sps, 1),
+        "sec_per_solve": round(dt, 6),
         "sec_per_iteration": round(dt / iters, 6),
+        "sec_per_iteration_marginal": (
+            None if marginal is None else round(marginal, 6)
+        ),
+        "samples_per_sec_marginal": (
+            None if marginal is None else round(n / marginal, 1)
+        ),
         # full-data objective passes incl. line-search trials — the honest
         # work unit; sec/pass is the fused-kernel wall-clock per X read
         "objective_passes": passes,
@@ -301,6 +324,7 @@ def _sparse_logistic_bench(jax, jnp, n, d, k, iters, densify_dtype):
     proxy = _proxy_logistic_sparse(1 << 15, d, k)
     return {
         "samples_per_sec": round(sps, 1),
+        "sec_per_solve": round(dt, 6),
         "sec_per_iteration": round(dt / iters, 6),
         "final_loss": round(value, 6),
         "auc": round(auc_model, 6),
@@ -371,6 +395,7 @@ def bench_b_linear_tron(jax, jnp):
     proxy = _proxy_linear_tron(1 << 16, d)
     return {
         "samples_per_sec": round(sps, 1),
+        "sec_per_solve": round(dt, 6),
         "sec_per_iteration": round(dt / its, 6),
         "final_loss": round(value, 6),
         "rmse": round(rmse, 6),
@@ -424,6 +449,7 @@ def bench_c_poisson(jax, jnp):
     proxy = _proxy_poisson_dense(1 << 16, d)
     return {
         "samples_per_sec": round(sps, 1),
+        "sec_per_solve": round(dt, 6),
         "sec_per_iteration": round(dt / iters, 6),
         "final_loss": round(value, 6),
         "loss_of_generating_model": round(loss_true, 6),

@@ -129,6 +129,34 @@ class TestScalableEvaluators:
         approx = float(bucketed_auc(scores, labels, num_buckets=256))
         np.testing.assert_allclose(approx, exact, rtol=1e-6)
 
+    def test_bucketed_auc_sharded_matches_local(self, rng):
+        """The distributed-AUC path (SURVEY §7): per-shard histograms +
+        one psum must reproduce the single-device histogram AUC exactly."""
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.evaluation.scalable import (
+            bucketed_auc,
+            bucketed_auc_sharded,
+        )
+        from photon_ml_tpu.parallel import data_mesh
+
+        n = 8 * 2500
+        scores = rng.normal(size=n)
+        labels = (rng.uniform(size=n) < 0.3).astype(float)
+        weights = rng.uniform(size=n)
+        weights[:: 9] = 0.0  # excluded rows on every shard
+        local = float(bucketed_auc(jnp.asarray(scores), jnp.asarray(labels),
+                                   jnp.asarray(weights)))
+        sharded = float(
+            bucketed_auc_sharded(
+                jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(weights),
+                mesh=data_mesh(8),
+            )
+        )
+        np.testing.assert_allclose(sharded, local, atol=1e-9)
+        exact = float(auc_roc(scores, labels, weights))
+        assert abs(sharded - exact) < 1e-3
+
     def test_bucketed_auc_weight_selection(self, rng):
         from photon_ml_tpu.evaluation.scalable import bucketed_auc
 
