@@ -107,12 +107,12 @@ def bucketed_auc_sharded(
     """
     from jax.sharding import PartitionSpec as P
 
-    n = scores.shape[0]
-    if weights is None:
-        weights = jnp.ones((n,), scores.dtype)
+    has_weights = weights is not None
 
-    def local(s, y, w):
-        inc = w > 0
+    def local(s, y, *w):
+        # branch on the STATIC absence of weights rather than materializing
+        # an O(n) all-ones vector on the billion-row path
+        inc = (w[0] > 0) if has_weights else jnp.ones(s.shape, bool)
         lo = jax.lax.pmin(
             jnp.min(jnp.where(inc, s, jnp.inf)), axis_name
         )
@@ -124,13 +124,14 @@ def bucketed_auc_sharded(
         neg_hist = jax.lax.psum(neg_hist, axis_name)
         return _auc_from_histograms(pos_hist, neg_hist)
 
+    args = (scores, labels) + ((weights,) if has_weights else ())
     return jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(axis_name), P(axis_name), P(axis_name)),
+        in_specs=(P(axis_name),) * len(args),
         out_specs=P(),
         check_vma=False,
-    )(scores, labels, weights)
+    )(*args)
 
 
 def _group_score_order(scores: Array, group_ids: Array) -> Array:

@@ -43,3 +43,7 @@ from photon_ml_tpu.game.coordinate import (  # noqa: F401
     RandomEffectCoordinate,
 )
 from photon_ml_tpu.game.descent import CoordinateDescent, CoordinateDescentResult  # noqa: F401
+from photon_ml_tpu.game.streaming import (  # noqa: F401
+    StreamedGameData,
+    StreamedGameTrainer,
+)

@@ -1,0 +1,330 @@
+"""Out-of-core GAME training: coordinate descent over host-resident data.
+
+Reference parity: the reference trains GAME on datasets far larger than
+any single executor's memory — Spark partitions stream through the fixed
+effect's ``treeAggregate`` and the random effects' per-entity solves
+(SURVEY.md §3.1; §7 hard parts "Streaming 1B rows"). The in-memory
+``CoordinateDescent`` (``game/descent.py``) is the fast path when the
+whole ``GameBatch`` fits HBM; this module is its out-of-HBM twin:
+
+- The dataset lives in HOST RAM as numpy columns (memory-mappable).
+- Device HBM holds, at any moment, ONE fixed-effect chunk or ONE
+  random-effect bucket, plus the models — never the dataset.
+- Residual bookkeeping (``base_offsets + total − own_score``) is host
+  numpy, O(n) per coordinate visit, exactly the descent recipe.
+
+Per coordinate:
+- Fixed effect: the streamed GLM objective (``ops/streaming.py``) +
+  host-driven L-BFGS/OWL-QN/TRON — one double-buffered chunk sweep per
+  objective evaluation.
+- Random effects: entity grouping/bucketing happens once (host argsort —
+  the reference's shuffle); each bucket is gathered FROM HOST
+  (``gather_bucket``), solved with the vmap-batched device optimizer
+  (``random_effect._solve_bucket`` — the same kernel the in-memory path
+  uses), and its coefficient rows written back to the host (E, d) matrix.
+
+Scope (documented limits, not silent ones): dense feature shards,
+L1/L2/elastic-net, no normalization contexts, no projection, no
+down-sampling, single process. Everything else raises.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.config import GameTrainingConfig, OptimizationConfig
+from photon_ml_tpu.game.data import (
+    EntityBuckets,
+    EntityGrouping,
+    DenseFeatures,
+    bucket_entities,
+    gather_bucket,
+    group_by_entity,
+)
+from photon_ml_tpu.game.models import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.game.random_effect import _solve_bucket
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.ops.streaming import (
+    StreamingGLMObjective,
+    dense_chunks,
+    stream_scores,
+)
+from photon_ml_tpu.optim.common import select_minimize_fn
+from photon_ml_tpu.types import VarianceComputationType
+
+Array = jnp.ndarray
+
+
+@dataclass
+class StreamedGameData:
+    """Host-resident GAME dataset columns (plain or memory-mapped numpy).
+
+    ``features[shard_id]`` is a dense (n, d_shard) matrix;
+    ``id_tags[tag]`` the per-sample entity ids of one random-effect type.
+    """
+
+    labels: np.ndarray
+    features: Mapping[str, np.ndarray]
+    id_tags: Mapping[str, np.ndarray] = field(default_factory=dict)
+    offsets: np.ndarray | None = None
+    weights: np.ndarray | None = None
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.labels)
+
+
+@dataclass
+class StreamedCoordinateInfo:
+    """Last-visit solve diagnostics for one coordinate."""
+
+    final_loss: float
+    iterations: int
+    converged: bool
+
+
+def _chunk_ranges(n: int, chunk_rows: int) -> list[tuple[int, int]]:
+    return [(lo, min(lo + chunk_rows, n)) for lo in range(0, n, chunk_rows)]
+
+
+@jax.jit
+def _re_chunk_scores(W_rows: Array, X: Array) -> Array:
+    return jnp.sum(W_rows * X, axis=1)
+
+
+class StreamedGameTrainer:
+    """Block coordinate descent over a ``StreamedGameData`` dataset.
+
+    The coordinate/update-sequence configuration is the SAME
+    ``GameTrainingConfig`` the in-memory estimator consumes; only the data
+    residency differs. Unsupported config features raise at construction.
+    """
+
+    def __init__(
+        self,
+        config: GameTrainingConfig,
+        chunk_rows: int = 1 << 20,
+        intercept_indices: Mapping[str, int | None] | None = None,
+        logger=None,
+    ):
+        self.config = config
+        self.chunk_rows = int(chunk_rows)
+        self.intercept_indices = dict(intercept_indices or {})
+        self._log = logger or (lambda msg: None)
+        # per-coordinate streamed objectives, reused across descent visits:
+        # the jitted chunk kernels take the chunk as an argument, so only
+        # the FIRST visit compiles; later visits just swap the chunk list
+        self._fixed_objectives: dict[str, StreamingGLMObjective] = {}
+        if config.normalization.value != "NONE":
+            raise NotImplementedError(
+                "streamed GAME does not support normalization contexts"
+            )
+        if config.variance_computation is not VarianceComputationType.NONE:
+            raise NotImplementedError(
+                "streamed GAME does not support variance computation"
+            )
+        for cid, c in config.random_effect_coordinates.items():
+            if c.random_projection_dim is not None:
+                raise NotImplementedError(
+                    f"coordinate {cid}: random projection is in-memory only"
+                )
+            if c.features_to_samples_ratio_upper_bound is not None:
+                raise NotImplementedError(
+                    f"coordinate {cid}: per-entity subspace projection is "
+                    "in-memory only"
+                )
+        for cid, c in config.fixed_effect_coordinates.items():
+            if c.optimization.down_sampling_rate < 1.0:
+                raise NotImplementedError(
+                    f"coordinate {cid}: down-sampling is in-memory only"
+                )
+
+    # -- coordinate training ------------------------------------------------
+
+    def _train_fixed(
+        self,
+        cid: str,
+        X: np.ndarray,
+        data: StreamedGameData,
+        offs: np.ndarray,
+        opt: OptimizationConfig,
+        w0: np.ndarray,
+        intercept_index: int | None,
+    ):
+        n, d = X.shape
+        weights = (
+            np.ones(n, np.float32) if data.weights is None else data.weights
+        )
+        chunks = dense_chunks(
+            X, np.asarray(data.labels, np.float32), self.chunk_rows,
+            offsets=offs, weights=weights,
+        )
+        loss = loss_for_task(self.config.task_type)
+        l1 = opt.regularization.l1_weight(opt.regularization_weight)
+        l2 = opt.regularization.l2_weight(opt.regularization_weight)
+        sobj = self._fixed_objectives.get(cid)
+        if sobj is None:
+            sobj = StreamingGLMObjective(
+                chunks, loss, num_features=d, l2_weight=l2,
+                intercept_index=intercept_index,
+            )
+            self._fixed_objectives[cid] = sobj
+        else:
+            sobj.chunks = chunks  # fresh residual offsets; kernels reused
+        minimize_fn, extra = select_minimize_fn(opt.optimizer, l1, host=True)
+        res = minimize_fn(sobj, w0, opt.optimizer, **extra)
+        w = np.asarray(res.w, np.float32)
+        scores = stream_scores(chunks, w, num_rows=n)
+        return w, scores, res
+
+    def _train_random(
+        self,
+        cid: str,
+        X: np.ndarray,
+        data: StreamedGameData,
+        offs: np.ndarray,
+        opt: OptimizationConfig,
+        buckets: EntityBuckets,
+        W: np.ndarray,
+        intercept_index: int | None,
+    ):
+        n, d = X.shape
+        loss = loss_for_task(self.config.task_type)
+        l1 = opt.regularization.l1_weight(opt.regularization_weight)
+        l2 = jnp.asarray(opt.regularization.l2_weight(opt.regularization_weight), jnp.float32)
+        minimize_fn, extra = select_minimize_fn(opt.optimizer, l1)
+        weights = (
+            np.ones(n, np.float32) if data.weights is None else data.weights
+        )
+        feats = DenseFeatures(X=X)
+        last_losses: list[float] = []
+        for ent_ids, rows in zip(buckets.entity_ids, buckets.row_indices):
+            # ONE bucket in HBM at a time: gather from host, solve, write back
+            bucket = gather_bucket(
+                feats, data.labels, offs, weights, rows
+            )
+            w0 = jnp.asarray(W[ent_ids], jnp.float32)
+            w_b, f_b, it_b, reason_b, var_b = _solve_bucket(
+                bucket,
+                w0,
+                l2,
+                None,  # norm
+                None,  # prior_mu
+                None,  # prior_var
+                minimize_fn=minimize_fn,
+                loss=loss,
+                config=opt.optimizer,
+                intercept_index=intercept_index,
+                variance_computation=VarianceComputationType.NONE,
+                **extra,
+            )
+            W[ent_ids] = np.asarray(w_b, np.float32)
+            last_losses.append(float(jnp.sum(f_b)))
+            del bucket, w_b  # free device buffers before the next bucket
+
+        # streamed per-chunk scoring: host-gather this coordinate's rows
+        tag = self.config.random_effect_coordinates[cid].random_effect_type
+        ids = np.asarray(data.id_tags[tag])
+        scores = np.empty(n, np.float32)
+        for lo, hi in _chunk_ranges(n, self.chunk_rows):
+            W_rows = jnp.asarray(W[ids[lo:hi]])
+            scores[lo:hi] = np.asarray(
+                _re_chunk_scores(W_rows, jnp.asarray(X[lo:hi]))
+            )
+        return scores, float(np.sum(last_losses))
+
+    # -- descent ------------------------------------------------------------
+
+    def fit(
+        self, data: StreamedGameData
+    ) -> tuple[GameModel, dict[str, StreamedCoordinateInfo]]:
+        cfg = self.config
+        n = data.num_rows
+        base = (
+            np.zeros(n, np.float32)
+            if data.offsets is None
+            else np.asarray(data.offsets, np.float32)
+        )
+
+        # entity layouts once (the "shuffle")
+        layouts: dict[str, tuple[EntityGrouping, EntityBuckets, int]] = {}
+        for cid, c in cfg.random_effect_coordinates.items():
+            ids = np.asarray(data.id_tags[c.random_effect_type])
+            grouping = group_by_entity(
+                ids, active_upper_bound=c.active_data_upper_bound
+            )
+            buckets = bucket_entities(grouping)
+            layouts[cid] = (grouping, buckets, grouping.num_entities)
+
+        # model state on HOST
+        fixed_w: dict[str, np.ndarray] = {}
+        re_W: dict[str, np.ndarray] = {}
+        for cid, c in cfg.fixed_effect_coordinates.items():
+            fixed_w[cid] = np.zeros(data.features[c.feature_shard_id].shape[1], np.float32)
+        for cid, c in cfg.random_effect_coordinates.items():
+            d = data.features[c.feature_shard_id].shape[1]
+            re_W[cid] = np.zeros((layouts[cid][2], d), np.float32)
+
+        scores: dict[str, np.ndarray] = {
+            cid: np.zeros(n, np.float32) for cid in cfg.coordinate_update_sequence
+        }
+        info: dict[str, StreamedCoordinateInfo] = {}
+
+        total = base.copy()
+        for it in range(cfg.coordinate_descent_iterations):
+            for cid in cfg.coordinate_update_sequence:
+                offs = total - scores[cid]
+                if cid in cfg.fixed_effect_coordinates:
+                    c = cfg.fixed_effect_coordinates[cid]
+                    X = np.asarray(data.features[c.feature_shard_id])
+                    w, new_scores, res = self._train_fixed(
+                        cid, X, data, offs, c.optimization, fixed_w[cid],
+                        self.intercept_indices.get(c.feature_shard_id),
+                    )
+                    fixed_w[cid] = w
+                    info[cid] = StreamedCoordinateInfo(
+                        final_loss=float(res.value),
+                        iterations=int(res.iterations),
+                        converged=bool(res.converged),
+                    )
+                else:
+                    c = cfg.random_effect_coordinates[cid]
+                    X = np.asarray(data.features[c.feature_shard_id])
+                    _, buckets, _ = layouts[cid]
+                    new_scores, loss_sum = self._train_random(
+                        cid, X, data, offs, c.optimization,
+                        buckets, re_W[cid],
+                        self.intercept_indices.get(c.feature_shard_id),
+                    )
+                    info[cid] = StreamedCoordinateInfo(
+                        final_loss=loss_sum, iterations=1, converged=True
+                    )
+                total = offs + new_scores
+                scores[cid] = new_scores
+                self._log(
+                    f"iter {it} coordinate {cid}: loss={info[cid].final_loss:.6g}"
+                )
+
+        models: dict[str, Any] = {}
+        for cid, c in cfg.fixed_effect_coordinates.items():
+            models[cid] = FixedEffectModel(
+                model=GeneralizedLinearModel(
+                    Coefficients(jnp.asarray(fixed_w[cid]), None), cfg.task_type
+                ),
+                feature_shard_id=c.feature_shard_id,
+            )
+        for cid, c in cfg.random_effect_coordinates.items():
+            models[cid] = RandomEffectModel(
+                coefficients=jnp.asarray(re_W[cid]),
+                variances=None,
+                random_effect_type=c.random_effect_type,
+                feature_shard_id=c.feature_shard_id,
+                task_type=cfg.task_type,
+            )
+        return GameModel(models=models, task_type=cfg.task_type), info
