@@ -96,6 +96,20 @@ class IndexMap:
         for k, i in zip(self._keys, self._indices):
             yield str(k), int(i)
 
+    def keys_for(self, indices) -> list[str]:
+        """Reverse lookup (index → feature key) for a FEW indices: one
+        vectorized O(d) integer membership test selects just the matching
+        entries — no d-sized string allocation, no Python-dict inversion —
+        so reporting paths resolve a handful of top features out of 10⁷+
+        cheaply. Unknown indices resolve to their decimal string."""
+        indices = np.asarray(indices, dtype=np.int64)
+        mask = np.isin(self._indices, indices)
+        found = {
+            int(i): str(k)
+            for i, k in zip(self._indices[mask], self._keys[mask])
+        }
+        return [found.get(int(j), str(int(j))) for j in indices]
+
     # -- persistence (PalDB-store equivalent: one mmap-able npz per shard) ----
     def save(self, path: str) -> None:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

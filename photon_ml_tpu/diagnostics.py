@@ -78,19 +78,22 @@ def coefficient_summary(
     nz = int(np.count_nonzero(w))
     finite = np.isfinite(w)
     order = np.argsort(-np.abs(np.where(finite, w, 0.0)))[: min(top_k, d)]
-    names: dict[int, str] = {}
-    if index_map is not None:
-        names = {int(idx): key for key, idx in index_map.items()}
+    # resolve names ONLY for the selected indices (vectorized reverse
+    # lookup; a full dict inversion is O(d) dict inserts at 10⁷+ features)
+    names = (
+        index_map.keys_for(order) if index_map is not None
+        else [str(int(j)) for j in order]
+    )
     top = []
     var = None if variances is None else np.asarray(variances, np.float64).ravel()
-    for j in order:
+    for rank, j in enumerate(order):
         if not finite[j]:
             continue  # diverged solves can leave NaN/Inf weights
         if w[j] == 0.0:
             break
         entry = {
             "index": int(j),
-            "feature": names.get(int(j), str(int(j))),
+            "feature": names[rank],
             "weight": _clean(w[j]),
         }
         if var is not None:

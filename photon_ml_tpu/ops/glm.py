@@ -41,6 +41,12 @@ from photon_ml_tpu.types import VarianceComputationType
 Array = jnp.ndarray
 
 
+def _interpret_fused() -> bool:
+    """Pallas kernels run compiled on TPU, interpreter-mode elsewhere (the
+    CPU test suite exercises the identical program)."""
+    return jax.default_backend() != "tpu"
+
+
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=["batch", "norm", "l2_weight", "reg_mask", "prior_mean",
@@ -144,7 +150,7 @@ class GLMObjective:
                 None if self.offsets_zero else self.batch.offsets,
                 None if self.weights_one else self.batch.weights,
                 u, c, loss=self.loss,
-                interpret=jax.default_backend() != "tpu",
+                interpret=_interpret_fused(),
             )
         else:
             m = self.margins(w)
@@ -178,7 +184,7 @@ class GLMObjective:
                 None if self.weights_one else self.batch.weights,
                 u, v_eff, c,
                 jnp.dot(self.norm.shifts, v_eff), loss=self.loss,
-                interpret=jax.default_backend() != "tpu",
+                interpret=_interpret_fused(),
             )
         else:
             m = self.margins(w)
