@@ -41,12 +41,19 @@ class DescentCheckpoint:
     to float re-association, and the per-entity solvers amplify that
     epsilon into visible coefficient drift. Storing the accumulated arrays
     makes an interrupted+resumed run bitwise identical to an uninterrupted
-    one."""
+    one.
+
+    ``next_coordinate`` refines the resume point to mid-outer-iteration
+    granularity (the streamed GAME trainer checkpoints after every
+    coordinate VISIT, not just every outer iteration — a visit can be hours
+    at the 1B-row scale): resume restarts at coordinate index
+    ``next_coordinate`` of outer iteration ``next_iteration``."""
 
     model: GameModel
     next_iteration: int
     scores: dict[str, np.ndarray] | None = None
     total: np.ndarray | None = None
+    next_coordinate: int = 0
 
 
 _SCORE_PREFIX = "__score__"
@@ -83,6 +90,7 @@ def save_checkpoint(
     scores: dict[str, np.ndarray] | None = None,
     total: np.ndarray | None = None,
     data_digest: str | None = None,
+    next_coordinate: int = 0,
 ) -> None:
     """``fingerprint`` identifies the training setup (configuration + data
     signature); ``load_checkpoint`` refuses checkpoints whose fingerprint
@@ -93,6 +101,7 @@ def save_checkpoint(
     meta: dict = {
         "task_type": model.task_type.value,
         "next_iteration": next_iteration,
+        "next_coordinate": next_coordinate,
         "fingerprint": fingerprint,
         "data_digest": data_digest,
         "coordinates": {},
@@ -214,4 +223,5 @@ def load_checkpoint(
         next_iteration=int(meta["next_iteration"]),
         scores=scores,
         total=total,
+        next_coordinate=int(meta.get("next_coordinate", 0)),
     )

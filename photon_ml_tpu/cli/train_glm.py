@@ -262,19 +262,12 @@ def run(
 
 
 def _expand_avro_paths(paths: list[str]) -> list[str]:
-    """Directories become their sorted ``*.avro`` part files, so per-host
-    path sharding distributes FILES, not whole directories."""
-    out: list[str] = []
-    for p in paths:
-        if os.path.isdir(p):
-            out.extend(
-                os.path.join(p, n)
-                for n in sorted(os.listdir(p))
-                if n.endswith(".avro") and not n.startswith(".")
-            )
-        else:
-            out.append(p)
-    return out
+    """Directories become their sorted ``*.avro`` part files (the shared
+    ``list_avro_files`` policy), so per-host path sharding distributes
+    FILES, not whole directories."""
+    from photon_ml_tpu.io.avro import list_avro_files
+
+    return [f for p in paths for f in list_avro_files(p)]
 
 
 def _run_streamed(

@@ -158,6 +158,15 @@ class CoordinateDescent:
                 total = offsets + new_score
                 scores[cid] = new_score
                 model = model.updated(cid, sub_model)
+                # bound HBM retention of lazy per-entity diagnostics: the
+                # previous visit's device buffers are materialized (its
+                # programs finished at least one visit ago) and released
+                if trackers[cid]:
+                    release = getattr(
+                        trackers[cid][-1], "release_device_diagnostics", None
+                    )
+                    if release is not None:
+                        release()
                 trackers[cid].append(tracker)
 
                 if self.validation_batch is not None and self.evaluators:

@@ -116,9 +116,31 @@ def _to_batch(chunk: dict, num_features: int | None) -> Batch:
     )
 
 
+def device_hbm_budget_bytes(
+    default: float = 8e9, fraction: float = 0.75, device=None
+) -> float:
+    """The HBM budget for dataset residency, QUERIED from the device
+    (``memory_stats()['bytes_limit']`` scaled by ``fraction`` to leave room
+    for coefficients, optimizer state and XLA scratch). Falls back to
+    ``default`` on backends that expose no memory stats (e.g. CPU)."""
+    try:
+        if device is None:
+            device = jax.local_devices()[0]
+        stats = device.memory_stats() or {}
+        limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        if limit:
+            return fraction * float(limit)
+    except Exception:
+        pass
+    return default
+
+
 def fits_in_memory(num_rows: int, num_features: int, itemsize: int = 4,
-                   hbm_budget_bytes: float = 8e9) -> bool:
-    """Decision rule between the device-resident fast path and streaming."""
+                   hbm_budget_bytes: float | None = None) -> bool:
+    """Decision rule between the device-resident fast path and streaming.
+    ``hbm_budget_bytes=None`` queries the device (``device_hbm_budget_bytes``)."""
+    if hbm_budget_bytes is None:
+        hbm_budget_bytes = device_hbm_budget_bytes()
     return num_rows * num_features * itemsize <= hbm_budget_bytes
 
 
@@ -254,6 +276,8 @@ def stream_scores(
 ) -> np.ndarray:
     """Margins over all chunks (scoring an out-of-core dataset), trimmed to
     the dataset's true ``num_rows`` (the last chunk is padded)."""
+    if not chunks:
+        return np.zeros(num_rows, np.float32)  # 0-row host shard
     score = jax.jit(lambda b, w: b.matvec(w))
     w = jnp.asarray(w)
     outs = [np.asarray(score(_to_batch(c, num_features), w)) for c in chunks]

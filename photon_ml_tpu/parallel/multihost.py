@@ -146,6 +146,60 @@ def sync_processes(tag: str = "photon-ml-barrier") -> None:
         multihost_utils.sync_global_devices(tag)
 
 
+def broadcast_from_host0(pytree):
+    """Every process receives process 0's value of ``pytree`` (host numpy
+    leaves; identity on a single process). The pytree STRUCTURE must be
+    identical on every process — only leaf values may differ. Used to make
+    checkpoint-resume decisions (and restored state) consistent when hosts
+    do not share an output filesystem."""
+    if jax.process_count() <= 1:
+        return pytree
+    from jax.experimental import multihost_utils
+
+    out = multihost_utils.broadcast_one_to_all(pytree)
+    return jax.tree.map(np.asarray, out)
+
+
+def allgather_row_chunks(arrays, chunk_rows: int, pad_values=None):
+    """Chunk-wise all-to-all of per-host row blocks (the TPU-native stand-in
+    for the reference's Spark shuffle, done on HOSTS over DCN).
+
+    ``arrays`` is a dict of same-leading-dim host numpy arrays (this host's
+    rows). Yields one round at a time: a dict of ``(P, chunk_rows, ...)``
+    stacked arrays holding EVERY process's chunk — the receiver filters the
+    rows it owns and frees the round before the next, so peak memory is
+    O(P · chunk_rows), never O(global rows). Hosts with fewer rows pad
+    trailing rounds (``pad_values[k]``, default 0 — pick a sentinel the
+    receiver can filter, e.g. -1 entity ids). Every process yields the SAME
+    number of rounds (a collective requirement).
+    """
+    from jax.experimental import multihost_utils
+
+    pad_values = dict(pad_values or {})
+    keys = list(arrays)
+    n_loc = len(arrays[keys[0]]) if keys else 0
+    counts = np.asarray(
+        multihost_utils.process_allgather(np.asarray([n_loc]))
+    ).reshape(-1)
+    rounds = int(-(-int(counts.max()) // chunk_rows)) if counts.max() else 0
+    for r in range(rounds):
+        lo = r * chunk_rows
+        hi = min(lo + chunk_rows, n_loc)
+        chunk = {}
+        for k in keys:
+            a = np.asarray(arrays[k])
+            part = a[lo:hi] if lo < n_loc else a[:0]
+            pad = chunk_rows - len(part)
+            if pad:
+                fill = np.full(
+                    (pad,) + a.shape[1:], pad_values.get(k, 0), a.dtype
+                )
+                part = np.concatenate([part, fill])
+            chunk[k] = part
+        gathered = multihost_utils.process_allgather(chunk)
+        yield {k: np.asarray(v) for k, v in gathered.items()}
+
+
 def allreduce_sum_host(*arrays: np.ndarray):
     """Sum numpy arrays across ALL processes (returns them unchanged on a
     single process). Used by the streaming objective to combine per-host

