@@ -212,30 +212,63 @@ def run(
     return best
 
 
-def _should_auto_stream(train_data: list[str], logger) -> bool:
+def _streamed_unsupported(config: GameTrainingConfig) -> list[str]:
+    """Config features the out-of-core branch rejects (used both to fail
+    fast on an EXPLICIT --streaming-chunk-rows and to veto AUTO-selection
+    — auto-streaming must never turn a runnable in-memory job into a
+    ValueError)."""
+    out = []
+    if config.hyperparameter_tuning_iters > 0:
+        out.append("hyperparameter tuning")
+    if config.regularization_weight_grid:
+        out.append("regularization weight grids")
+    if config.model_input_dir:
+        out.append("warm start (model_input_dir)")
+    return out
+
+
+def _should_auto_stream(
+    train_data: list[str], config: GameTrainingConfig, logger
+) -> bool:
     """Auto-select the out-of-core path when the raw input bytes already
-    exceed the device's QUERIED HBM budget (``device_hbm_budget_bytes`` —
-    memory_stats when the backend exposes them, 8 GB fallback). Avro is
-    more compact than the decoded f32 columns, so raw bytes > budget means
-    the in-memory read is guaranteed to blow HBM; smaller inputs keep the
+    exceed the CLUSTER's queried HBM budget (per-device
+    ``device_hbm_budget_bytes`` — memory_stats when the backend exposes
+    them, 8 GB fallback — times the global device count: the in-memory
+    multihost path shards compute over every chip). Avro is more compact
+    than the decoded f32 columns, so raw bytes > budget means the
+    in-memory read is guaranteed to blow HBM; smaller inputs keep the
     in-memory fast path. Sizes EXACTLY the file set the readers will read
     (``list_avro_files`` policy), so the gate and the ingest can never
-    disagree on what the dataset is."""
+    disagree on what the dataset is. Configs the streamed branch rejects
+    are never auto-streamed — a warning is logged instead."""
+    import jax
+
     from photon_ml_tpu.ops.streaming import device_hbm_budget_bytes
 
     try:
         total = sum(os.path.getsize(f) for f in _expand_part_files(train_data))
     except (FileNotFoundError, OSError):
         return False  # let the reader raise its usual error
-    budget = device_hbm_budget_bytes()
-    if total > budget:
+    budget = device_hbm_budget_bytes() * max(len(jax.devices()), 1)
+    if total <= budget:
+        return False
+    unsupported = _streamed_unsupported(config)
+    if unsupported:
         logger.info(
-            f"input bytes {total:.3g} exceed the device HBM budget "
-            f"{budget:.3g}: auto-selecting the out-of-core streamed path "
-            f"(pass --streaming-chunk-rows to control the chunk size)"
+            f"input bytes {total:.3g} exceed the cluster HBM budget "
+            f"{budget:.3g} but the configuration uses "
+            f"{', '.join(unsupported)}, which the streamed path does not "
+            f"support — keeping the in-memory path (expect device OOM if "
+            f"the estimate is right)"
         )
-        return True
-    return False
+        return False
+    logger.info(
+        f"input bytes {total:.3g} exceed the cluster HBM budget "
+        f"{budget:.3g}: auto-selecting the out-of-core streamed path "
+        f"(pass --streaming-chunk-rows to control the chunk size, or "
+        f"--no-auto-streaming to force in-memory)"
+    )
+    return True
 
 
 def _run_streamed_game(
@@ -260,13 +293,7 @@ def _run_streamed_game(
         sync_processes,
     )
 
-    unsupported = []
-    if config.hyperparameter_tuning_iters > 0:
-        unsupported.append("hyperparameter tuning")
-    if config.regularization_weight_grid:
-        unsupported.append("regularization weight grids")
-    if config.model_input_dir:
-        unsupported.append("warm start (model_input_dir)")
+    unsupported = _streamed_unsupported(config)
     if unsupported:
         raise ValueError(
             "--streaming-chunk-rows does not support: " + ", ".join(unsupported)
@@ -350,18 +377,36 @@ def _run_streamed_game(
             with open(os.path.join(output_dir, "entity-maps.json"), "w") as f:
                 json.dump(entity_maps, f)
         metrics_path = os.path.join(output_dir, "metrics.json")
-        if info or not os.path.exists(metrics_path):
-            metrics = {
-                "streaming_chunk_rows": chunk_rows,
-                "coordinates": {
+        # MERGE with any previous run's metrics: a resumed run only
+        # revisits the remaining coordinates and restarts its validation
+        # history at the resume point — the pre-resume diagnostics live
+        # only in the file written before the interruption
+        old: dict = {}
+        if trainer.resumed_from is not None and os.path.exists(metrics_path):
+            # merge only on a genuine resume; a from-scratch rerun (fresh
+            # training, or a rejected-fingerprint retrain) REPLACES
+            try:
+                with open(metrics_path) as f:
+                    old = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                old = {}
+        if info or not old:
+            coordinates = dict(old.get("coordinates", {}))
+            coordinates.update(
+                {
                     cid: {
                         "final_loss": ci.final_loss,
                         "iterations": ci.iterations,
                         "converged": ci.converged,
                     }
                     for cid, ci in info.items()
-                },
-                "validation_history": [
+                }
+            )
+            metrics = {
+                "streaming_chunk_rows": chunk_rows,
+                "coordinates": coordinates,
+                "validation_history": list(old.get("validation_history", []))
+                + [
                     {cid: dict(res.metrics) for cid, res in entry.items()}
                     for entry in trainer.validation_history
                 ],
@@ -471,7 +516,12 @@ def main(argv: list[str] | None = None) -> None:
         help="out-of-core mode: keep the dataset in host RAM (row-"
              "partitioned across hosts under --multihost) and stream it "
              "through the device in uniform chunks of this many rows; "
-             "auto-enabled when the input exceeds the device HBM budget",
+             "auto-enabled when the input exceeds the cluster HBM budget",
+    )
+    p.add_argument(
+        "--no-auto-streaming", action="store_true",
+        help="never auto-select the out-of-core path on input size; "
+             "train in-memory unless --streaming-chunk-rows is given",
     )
     p.add_argument(
         "--profile-dir", default=None,
@@ -530,8 +580,10 @@ def main(argv: list[str] | None = None) -> None:
     # auto-select out-of-core when the input can't fit the device: CLI-only
     # (run()'s return type is part of the library contract; here nobody
     # consumes it)
-    if args.streaming_chunk_rows is None and _should_auto_stream(
-        train_data, logger
+    if (
+        args.streaming_chunk_rows is None
+        and not args.no_auto_streaming
+        and _should_auto_stream(train_data, config, logger)
     ):
         args.streaming_chunk_rows = 1 << 20
     if args.multihost and args.streaming_chunk_rows is None:

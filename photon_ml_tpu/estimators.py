@@ -395,6 +395,7 @@ class GameEstimator:
                 validation_batch=validation_batch,
                 evaluators=specs if validation_batch is not None else (),
                 logger=self._log,
+                mesh=self.mesh,
             )
             cd_result = descent.run(
                 cfg.coordinate_update_sequence,
@@ -420,6 +421,7 @@ class GameEstimator:
                     validation_batch.labels,
                     validation_batch.weights,
                     group_ids=validation_batch.host_id_tags(),
+                    mesh=self.mesh,
                 )
                 self._log(f"grid entry {i + 1}: validation {evaluation}")
             results.append(

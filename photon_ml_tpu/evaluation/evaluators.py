@@ -162,6 +162,9 @@ class Evaluator:
     _fn: Callable
     group_by: str | None = None
     k: int | None = None
+    # set for evaluators with a mesh-sharded implementation: called as
+    # _sharded_fn(scores, labels, weights, mesh) when a mesh is passed
+    _sharded_fn: Callable | None = None
 
     def __call__(
         self,
@@ -169,7 +172,10 @@ class Evaluator:
         labels,
         weights=None,
         group_ids: Mapping[str, np.ndarray] | None = None,
+        mesh=None,
     ) -> float:
+        if mesh is not None and self._sharded_fn is not None:
+            return float(self._sharded_fn(scores, labels, weights, mesh))
         if self.group_by is not None:
             if group_ids is None or self.group_by not in group_ids:
                 raise KeyError(
@@ -236,7 +242,10 @@ def make_evaluator(spec: str) -> Evaluator:
         return Evaluator(name=spec.upper(), larger_is_better=lib, _fn=fn)
     m = re.fullmatch(r"BUCKETED_AUC(?:\((\d+)\))?", spec, re.IGNORECASE)
     if m:
-        from photon_ml_tpu.evaluation.scalable import bucketed_auc
+        from photon_ml_tpu.evaluation.scalable import (
+            bucketed_auc,
+            bucketed_auc_sharded_padded,
+        )
 
         buckets = int(m.group(1)) if m.group(1) else 1 << 16
         if buckets < 1:
@@ -245,6 +254,12 @@ def make_evaluator(spec: str) -> Evaluator:
             name=spec.upper(),
             larger_is_better=True,
             _fn=lambda s, y, w=None: bucketed_auc(s, y, w, num_buckets=buckets),
+            # with a mesh: each device histograms its score shard and bin
+            # masses meet in one psum — the score vector never gathers to
+            # one device (SURVEY §7 "Distributed AUC at 1B rows")
+            _sharded_fn=lambda s, y, w, mesh: bucketed_auc_sharded_padded(
+                s, y, w, num_buckets=buckets, mesh=mesh
+            ),
         )
     m = re.fullmatch(r"MULTI_AUC\((\w+)\)", spec, re.IGNORECASE)
     if m:
@@ -301,7 +316,13 @@ def evaluate_all(
     labels,
     weights=None,
     group_ids: Mapping[str, np.ndarray] | None = None,
+    mesh=None,
 ) -> EvaluationResults:
+    """``mesh``: evaluators with a sharded implementation (BUCKETED_AUC)
+    compute over the mesh without gathering the score vector; the rest
+    evaluate as usual."""
     evs = [make_evaluator(s) if isinstance(s, str) else s for s in specs]
-    metrics = {e.name: e(scores, labels, weights, group_ids) for e in evs}
+    metrics = {
+        e.name: e(scores, labels, weights, group_ids, mesh=mesh) for e in evs
+    }
     return EvaluationResults(metrics=metrics, primary_name=evs[0].name if evs else None)

@@ -134,6 +134,37 @@ def bucketed_auc_sharded(
     )(*args)
 
 
+def bucketed_auc_sharded_padded(
+    scores: Array,
+    labels: Array,
+    weights: Array | None = None,
+    num_buckets: int = 1 << 16,
+    *,
+    mesh,
+    axis_name: str = "data",
+) -> Array:
+    """``bucketed_auc_sharded`` for arbitrary row counts: pads with
+    weight-0 rows (excluded, like everywhere else) so rows divide the mesh
+    axis. This is the evaluator-registry entry point — callers (descent
+    validation, scoring) don't control their row counts."""
+    n = scores.shape[0]
+    n_dev = mesh.shape[axis_name]
+    n_pad = -(-n // n_dev) * n_dev
+    if n_pad != n:
+        pad = n_pad - n
+        zs = jnp.zeros((pad,), scores.dtype)
+        scores = jnp.concatenate([scores, zs])
+        labels = jnp.concatenate([labels, jnp.zeros((pad,), labels.dtype)])
+        w = (
+            jnp.ones((n,), jnp.float32) if weights is None
+            else jnp.asarray(weights, jnp.float32)
+        )
+        weights = jnp.concatenate([w, jnp.zeros((pad,), jnp.float32)])
+    return bucketed_auc_sharded(
+        scores, labels, weights, num_buckets, mesh=mesh, axis_name=axis_name
+    )
+
+
 def _group_score_order(scores: Array, group_ids: Array) -> Array:
     """Permutation sorting by (group, score) ascending: stable sort by
     score, then stable sort by group preserves score order within groups."""
@@ -162,11 +193,24 @@ def grouped_auc_device(
 ) -> Array:
     """Exact mean per-group rank-sum AUC on device (MultiAUCEvaluator
     parity — identical values to the host ``grouped_auc``). ``num_groups``
-    must be static (it sizes the segment reductions)."""
+    must be static (it sizes the segment reductions).
+
+    Rank sums accumulate in f64 when x64 is enabled; otherwise the row
+    count is BOUNDED at 2^24 (f32 loses integer precision beyond that, and
+    ranks run up to n — the "exact" contract would quietly degrade).
+    Beyond the bound: enable jax_enable_x64, or use the histogram path."""
+    n = scores.shape[0]
+    acc_dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    if acc_dtype == jnp.float32 and n > (1 << 24):
+        raise ValueError(
+            f"grouped_auc_device: {n} rows exceed the exact-rank f32 bound "
+            f"2^24; enable jax_enable_x64 for f64 rank accumulation or use "
+            f"BUCKETED_AUC for O(n) histogram evaluation"
+        )
     order = _group_score_order(scores, group_ids)
     g = group_ids[order]
     s = scores[order]
-    y = (labels > 0).astype(jnp.float64 if scores.dtype == jnp.float64 else jnp.float32)[order]
+    y = (labels > 0).astype(acc_dtype)[order]
 
     new_seg = jnp.concatenate([jnp.array([True]), g[1:] != g[:-1]])
     new_run = jnp.concatenate(
@@ -174,7 +218,12 @@ def grouped_auc_device(
     )
     run_first, run_last = _run_bounds(new_run)
     seg_first, _ = _run_bounds(new_seg)
-    avg_rank = 0.5 * (run_first + run_last) - seg_first + 1.0
+    # rank arithmetic in the accumulation dtype: the int->float conversion
+    # itself is where precision dies at large n
+    avg_rank = (
+        0.5 * (run_first.astype(acc_dtype) + run_last.astype(acc_dtype))
+        - seg_first.astype(acc_dtype) + 1.0
+    )
 
     pos = jax.ops.segment_sum(y, g, num_segments=num_groups, indices_are_sorted=True)
     cnt = jax.ops.segment_sum(

@@ -72,6 +72,7 @@ class CoordinateDescent:
         validation_batch: GameBatch | None = None,
         evaluators: Sequence[str] = (),
         logger: Callable[[str], None] | None = None,
+        mesh=None,
     ):
         self.coordinates = dict(coordinates)
         self.batch = batch
@@ -79,6 +80,9 @@ class CoordinateDescent:
         self.validation_batch = validation_batch
         self.evaluators = list(evaluators)
         self._log = logger or (lambda msg: None)
+        # evaluators with sharded implementations (BUCKETED_AUC) compute
+        # over the mesh without gathering the score vector to one device
+        self.mesh = mesh
 
     def run(
         self,
@@ -177,6 +181,7 @@ class CoordinateDescent:
                         self.validation_batch.labels,
                         self.validation_batch.weights,
                         group_ids=self.validation_batch.host_id_tags(),
+                        mesh=self.mesh,
                     )
                     iter_validation[cid] = res
                     self._log(f"iter {it} coordinate {cid}: {res}")

@@ -131,6 +131,22 @@ def _chunk_ranges(n: int, chunk_rows: int) -> list[tuple[int, int]]:
     return [(lo, min(lo + chunk_rows, n)) for lo in range(0, n, chunk_rows)]
 
 
+def _host_digest(labels: np.ndarray, weights: np.ndarray) -> str:
+    """Host-side twin of ``checkpoint.batch_digest`` for data that must
+    NOT touch the device (the out-of-HBM path — ``jnp.asarray`` on the
+    full label/weight columns would move O(n) to a chip the dataset
+    already exceeds). Only self-consistency matters: the streamed trainer
+    both writes and checks this digest."""
+    import hashlib
+
+    return hashlib.sha256(
+        labels[:256].tobytes()
+        + labels[-256:].tobytes()
+        + np.float64(labels.sum(dtype=np.float64)).tobytes()
+        + np.float64(weights.sum(dtype=np.float64)).tobytes()
+    ).hexdigest()
+
+
 @jax.jit
 def _re_chunk_scores_dense(W_rows: Array, X: Array) -> Array:
     return jnp.sum(W_rows * X, axis=1)
@@ -231,6 +247,10 @@ class StreamedGameTrainer:
         self.checkpoint_dir = checkpoint_dir
         self.evaluators = list(evaluators)
         self.validation_history: list[dict[str, Any]] = []
+        # (outer iteration, coordinate index) the last fit resumed from, or
+        # None when it trained from scratch — drivers use this to decide
+        # whether previous-run diagnostics should be merged or replaced
+        self.resumed_from: tuple[int, int] | None = None
         # per-coordinate streamed objectives, reused across descent visits:
         # the jitted chunk kernels take the chunk as an argument, so only
         # the FIRST visit compiles; later visits just swap the chunk list
@@ -327,7 +347,14 @@ class StreamedGameTrainer:
             "weight": weights,
             "grow": grow,
         }
-        arrays.update(_take_features(feats, np.arange(n)))
+        # pass the feature arrays DIRECTLY: the exchange only slices
+        # [lo:hi] views per round; fancy-indexing a full-range copy here
+        # would transiently hold the whole shard twice
+        if isinstance(feats, DenseFeatures):
+            arrays["X"] = np.asarray(feats.X)
+        else:
+            arrays["indices"] = np.asarray(feats.indices)
+            arrays["values"] = np.asarray(feats.values)
         keep: dict[str, list[np.ndarray]] = {k: [] for k in arrays}
         for rnd in allgather_row_chunks(
             arrays, self.chunk_rows, pad_values={"ent": -1}
@@ -493,21 +520,34 @@ class StreamedGameTrainer:
             out[g[mine] - row_base] = s[mine]
         return out
 
-    def _gather_global(self, local: np.ndarray, row_base: int, n_global: int) -> np.ndarray:
+    def _gather_global(
+        self,
+        local: np.ndarray,
+        row_base: int,
+        n_global: int,
+        collect: bool = True,
+    ) -> np.ndarray | None:
         """Global (n_global,) vector from per-host row slices (checkpoint /
-        validation state), dtype-preserving. Single-process: identity."""
+        validation state), dtype-preserving. Single-process: identity.
+
+        ``collect=False`` joins every allgather round (the collective must
+        stay matched across processes) but allocates/returns nothing —
+        used by non-writer processes during checkpointing so only the
+        writer ever holds a global-scale array."""
         local = np.asarray(local)
         if not self._distributed():
-            return local
+            return local if collect else None
         from photon_ml_tpu.parallel.multihost import allgather_row_chunks
 
         n = len(local)
         grow = row_base + np.arange(n, dtype=np.int64)
-        out = np.zeros(n_global, local.dtype)
+        out = np.zeros(n_global, local.dtype) if collect else None
         for rnd in allgather_row_chunks(
             {"grow": grow, "v": local},
             self.chunk_rows, pad_values={"grow": -1},
         ):
+            if not collect:
+                continue
             g = rnd["grow"].reshape(-1)
             v = rnd["v"].reshape(-1)
             valid = g >= 0
@@ -836,12 +876,16 @@ class StreamedGameTrainer:
         from photon_ml_tpu.parallel.multihost import is_output_process
 
         model = self._assemble_model(model_state)
+        # only the WRITER materializes global-scale arrays; every other
+        # process joins the collectives and drops the rounds (the
+        # row-partitioned memory design must survive checkpointing)
+        writer = is_output_process()
         g_scores = {
-            cid: self._gather_global(s, row_base, n_global)
+            cid: self._gather_global(s, row_base, n_global, collect=writer)
             for cid, s in scores.items()
         }
-        g_total = self._gather_global(total, row_base, n_global)
-        if is_output_process() and self.checkpoint_dir is not None:
+        g_total = self._gather_global(total, row_base, n_global, collect=writer)
+        if writer and self.checkpoint_dir is not None:
             save_checkpoint(
                 self.checkpoint_dir,
                 model,
@@ -1010,6 +1054,7 @@ class StreamedGameTrainer:
         info: dict[str, StreamedCoordinateInfo] = {}
         total = base.copy()
         self.validation_history = []
+        self.resumed_from = None
 
         vstate = None
         if validation is not None:
@@ -1020,15 +1065,11 @@ class StreamedGameTrainer:
         start_it, start_ci = 0, 0
         fingerprint = digest = None
         if self.checkpoint_dir is not None:
-            from photon_ml_tpu.checkpoint import batch_digest
-
             fingerprint = self._fingerprint(data, n_global, row_layout)
-            digest = batch_digest(
-                jnp.asarray(np.asarray(data.labels, np.float32)),
-                jnp.asarray(
-                    np.ones(n, np.float32) if data.weights is None
-                    else np.asarray(data.weights, np.float32)
-                ),
+            digest = _host_digest(
+                np.asarray(data.labels, np.float32),
+                np.ones(n, np.float32) if data.weights is None
+                else np.asarray(data.weights, np.float32),
             )
             # shapes the non-0 processes need to receive the broadcast
             self._resume_n_global = n_global
@@ -1059,6 +1100,7 @@ class StreamedGameTrainer:
                 total = np.asarray(resume["total"], np.float32)[
                     row_base:row_base + n
                 ].copy()
+                self.resumed_from = (start_it, start_ci)
                 self._log(
                     f"resuming streamed descent at outer iteration {start_it}, "
                     f"coordinate index {start_ci}"

@@ -135,6 +135,19 @@ class GLMObjective:
         delta = w - self.prior_mean
         return 0.5 * self.l2_weight * jnp.sum(self.reg_mask * prec * delta * delta)
 
+    @property
+    def one_pass_value_grad(self) -> bool:
+        """Line-search policy hint for the optimizers: evaluate
+        value_and_grad at every TRIAL point (instead of value-only trials
+        plus a separate gradient pass at acceptance). True when (a) the
+        fused dense kernel makes value_and_grad cost one X read anyway, or
+        (b) the tile-COO sparse kernels make the typical one-trial
+        iteration cheaper that way (margins+grad = 2 kernel passes beats
+        margins-trial + margins+grad = 3)."""
+        from photon_ml_tpu.ops.sparse_tiled import TiledSparseBatch
+
+        return self.fused or isinstance(self.batch, TiledSparseBatch)
+
     def value(self, w: Array) -> Array:
         m = self.margins(w)
         local = jnp.sum(self._weighted(self.loss.value(m, self.batch.labels)))

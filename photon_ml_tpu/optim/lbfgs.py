@@ -108,7 +108,10 @@ def _lbfgs_impl(
     m = config.history_length
     T = config.max_iterations
     use_l1 = l1w is not None
-    fused_eval = bool(getattr(objective, "fused", False))
+    fused_eval = bool(
+        getattr(objective, "one_pass_value_grad",
+                getattr(objective, "fused", False))
+    )
     d = w0.shape[0]
     dtype = w0.dtype
 
