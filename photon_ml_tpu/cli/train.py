@@ -222,8 +222,8 @@ def _streamed_unsupported(config: GameTrainingConfig) -> list[str]:
         out.append("hyperparameter tuning")
     if config.regularization_weight_grid:
         out.append("regularization weight grids")
-    if config.model_input_dir:
-        out.append("warm start (model_input_dir)")
+    if config.incremental:
+        out.append("incremental MAP priors (warm start without 'incremental' works)")
     return out
 
 
@@ -304,9 +304,16 @@ def _run_streamed_game(
     )
     reader = AvroDataReader(config.feature_shards or None)
     train_paths = _expand_part_files(train_data)
+    # warm start: seed the entity dictionaries with the saved run's maps so
+    # the saved model's dense entity rows stay valid (new entities append)
+    warm_tag_maps = (
+        _load_entity_maps(config.model_input_dir) if config.model_input_dir else None
+    )
     with timed(logger, "streaming stats pass (all files)"):
         index_maps, max_nnz, entity_maps, n_global = (
-            reader.streaming_game_stats(train_paths, id_tags)
+            reader.streaming_game_stats(
+                train_paths, id_tags, entity_maps=warm_tag_maps
+            )
         )
     logger.info(
         f"streamed GAME: {n_global} global rows, shards "
@@ -339,6 +346,45 @@ def _run_streamed_game(
                 allow_empty=multihost,
             )
 
+    initial_model = None
+    if config.model_input_dir:
+        with timed(logger, "load warm-start model"):
+            entity_ids = None
+            if warm_tag_maps:
+                entity_ids = {
+                    cid: warm_tag_maps[c.random_effect_type]
+                    for cid, c in config.random_effect_coordinates.items()
+                    if c.random_effect_type in warm_tag_maps
+                }
+            initial_model = load_game_model(
+                config.model_input_dir,
+                index_maps=index_maps,
+                entity_ids=entity_ids,
+            )
+            # new entities (absent from the saved run) cold-start from
+            # zero rows, like the in-memory warm-start path
+            import jax.numpy as jnp
+
+            from photon_ml_tpu.game.models import RandomEffectModel
+
+            for cid, c in config.random_effect_coordinates.items():
+                sub = initial_model.models.get(cid)
+                if not isinstance(sub, RandomEffectModel):
+                    continue
+                e_new = len(entity_maps[c.random_effect_type])
+                if sub.num_entities < e_new:
+                    pad = e_new - sub.num_entities
+                    W = jnp.concatenate(
+                        [sub.coefficients,
+                         jnp.zeros((pad, sub.coefficients.shape[1]),
+                                   sub.coefficients.dtype)]
+                    )
+                    initial_model = initial_model.updated(
+                        cid, dataclasses.replace(
+                            sub, coefficients=W, variances=None
+                        )
+                    )
+
     intercepts = {sid: m.intercept_index for sid, m in index_maps.items()}
     trainer = StreamedGameTrainer(
         config,
@@ -352,7 +398,9 @@ def _run_streamed_game(
     with timed(logger, "streamed coordinate descent"), profile_trace(
         profile_dir, "streamed-game"
     ):
-        model, info = trainer.fit(data, validation=vdata)
+        model, info = trainer.fit(
+            data, validation=vdata, initial_model=initial_model
+        )
 
     if is_output_process():
         with timed(logger, "write models"):

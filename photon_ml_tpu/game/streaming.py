@@ -131,6 +131,13 @@ def _chunk_ranges(n: int, chunk_rows: int) -> list[tuple[int, int]]:
     return [(lo, min(lo + chunk_rows, n)) for lo in range(0, n, chunk_rows)]
 
 
+def seq_scores_init(cfg: GameTrainingConfig, model: GameModel) -> list[str]:
+    """Update-sequence coordinates the warm-start model carries."""
+    return [
+        cid for cid in cfg.coordinate_update_sequence if cid in model.models
+    ]
+
+
 def _host_digest(labels: np.ndarray, weights: np.ndarray) -> str:
     """Host-side twin of ``checkpoint.batch_digest`` for data that must
     NOT touch the device (the out-of-HBM path — ``jnp.asarray`` on the
@@ -251,6 +258,8 @@ class StreamedGameTrainer:
         # None when it trained from scratch — drivers use this to decide
         # whether previous-run diagnostics should be merged or replaced
         self.resumed_from: tuple[int, int] | None = None
+        # per-id-tag entity-count floors (set per fit from num_entities)
+        self._entity_count_floor: dict[str, int] = {}
         # per-coordinate streamed objectives, reused across descent visits:
         # the jitted chunk kernels take the chunk as an argument, so only
         # the FIRST visit compiles; later visits just swap the chunk list
@@ -302,16 +311,21 @@ class StreamedGameTrainer:
             tuple(int(c) for c in counts),
         )
 
-    def _global_num_entities(self, ids: np.ndarray) -> int:
+    def _global_num_entities(self, ids: np.ndarray, tag: str | None = None) -> int:
+        """Global entity count: max dense id across hosts + 1, floored by
+        any caller-declared count (warm start: the SAVED dictionary may
+        contain entities absent from the new data — their learned rows
+        must survive, not silently truncate)."""
         local_max = int(ids.max()) + 1 if len(ids) else 0
+        floor = self._entity_count_floor.get(tag, 0) if tag else 0
         if not self._distributed():
-            return local_max
+            return max(local_max, floor)
         from jax.experimental import multihost_utils
 
         maxes = np.asarray(
             multihost_utils.process_allgather(np.asarray([local_max]))
         ).reshape(-1)
-        return int(maxes.max())
+        return max(int(maxes.max()), floor)
 
     def _distributed(self) -> bool:
         return self.multihost and jax.process_count() > 1
@@ -829,6 +843,7 @@ class StreamedGameTrainer:
         data: StreamedGameData,
         n_global: int,
         row_layout: tuple[int, ...] = (),
+        initial_model: GameModel | None = None,
     ) -> str:
         """Trajectory-identifying fingerprint (same discipline as the
         estimator's): config minus non-trajectory fields, plus chunk size
@@ -848,9 +863,20 @@ class StreamedGameTrainer:
             sid: data.feature_container(sid).num_features
             for sid in sorted(data.features)
         }
+        warm_hash = None
+        if initial_model is not None:
+            warm_hash = {
+                cid: hashlib.sha256(
+                    np.ascontiguousarray(
+                        np.asarray(sub.coefficient_means)
+                    ).tobytes()
+                ).hexdigest()
+                for cid, sub in sorted(initial_model.models.items())
+            }
         payload = {
             "training_config": cfg,
             "chunk_rows": self.chunk_rows,
+            "initial_model": warm_hash,
             "data": {
                 "num_rows_global": n_global,
                 "row_layout": list(row_layout),
@@ -1017,7 +1043,15 @@ class StreamedGameTrainer:
         self,
         data: StreamedGameData,
         validation: StreamedGameData | None = None,
+        initial_model: GameModel | None = None,
     ) -> tuple[GameModel, dict[str, StreamedCoordinateInfo]]:
+        """``initial_model`` warm-starts every coordinate (reference:
+        ``modelInputDirectory``): fixed vectors and per-entity rows seed
+        the solves, and the warm model's scores seed the residual exchange
+        BEFORE the first visit — exactly the in-memory descent's warm-start
+        semantics. Entity rows must already be aligned to this dataset's
+        dense entity ids (the driver re-uses the saved run's entity maps
+        and pads new entities with zero rows)."""
         cfg = self.config
         n = data.num_rows
         n_global, row_base, row_layout = self._global_layout(n)
@@ -1033,6 +1067,9 @@ class StreamedGameTrainer:
             re_shards[cid] = self._build_re_shard(cid, data, row_base)
 
         # model state on HOST: fixed vectors + OWNED random-effect rows
+        pid, P = _num_processes()
+        if not self._distributed():
+            P, pid = 1, 0
         fixed_w: dict[str, np.ndarray] = {}
         re_W: dict[str, np.ndarray] = {}
         re_E: dict[str, int] = {}
@@ -1048,6 +1085,32 @@ class StreamedGameTrainer:
             re_E[cid] = self._global_num_entities(ids)
             re_W[cid] = np.zeros((shard.num_entities_local, d), np.float32)
 
+        warm = initial_model is not None
+        if warm:
+            for cid, sub in initial_model.models.items():
+                if cid in fixed_w:
+                    w0 = np.asarray(sub.model.coefficients.means, np.float32)
+                    if w0.shape[0] != shard_dims[cid]:
+                        raise ValueError(
+                            f"warm-start coordinate {cid}: {w0.shape[0]} "
+                            f"features != current shard {shard_dims[cid]}"
+                        )
+                    fixed_w[cid] = w0.copy()
+                elif cid in re_W:
+                    W_full = np.asarray(sub.coefficients, np.float32)
+                    if W_full.shape[0] < re_E[cid]:
+                        raise ValueError(
+                            f"warm-start coordinate {cid}: {W_full.shape[0]} "
+                            f"entities < current {re_E[cid]} — pad new "
+                            f"entities with zero rows before fit"
+                        )
+                    re_W[cid] = (
+                        W_full[pid::P][: re_W[cid].shape[0]].copy()
+                        if P > 1 else W_full[: re_E[cid]].copy()
+                    )
+                # coordinates absent from the update sequence are ignored
+                # (the streamed path has no locked-coordinate scoring)
+
         scores: dict[str, np.ndarray] = {
             cid: np.zeros(n, np.float32) for cid in cfg.coordinate_update_sequence
         }
@@ -1055,6 +1118,32 @@ class StreamedGameTrainer:
         total = base.copy()
         self.validation_history = []
         self.resumed_from = None
+
+        if warm:
+            # warm-start scores: every coordinate already in the model
+            # contributes to the residual exchange BEFORE its first visit
+            # (in-memory descent parity)
+            for cid in seq_scores_init(cfg, initial_model):
+                if cid in cfg.fixed_effect_coordinates:
+                    c = cfg.fixed_effect_coordinates[cid]
+                    feats = data.feature_container(c.feature_shard_id)
+                    chunks = _feature_chunk_dicts(
+                        feats, np.asarray(data.labels, np.float32),
+                        self.chunk_rows,
+                        offsets=np.zeros(n, np.float32),
+                        weights=np.ones(n, np.float32),
+                    )
+                    scores[cid] = stream_scores(
+                        chunks, fixed_w[cid], num_rows=n,
+                        num_features=feats.num_features,
+                    )
+                else:
+                    shard = re_shards[cid]
+                    s_re = self._score_re_rows(shard, re_W[cid])
+                    scores[cid] = self._scores_to_origin(
+                        shard.grow, s_re, n, row_base
+                    )
+                total = total + scores[cid]
 
         vstate = None
         if validation is not None:
@@ -1065,7 +1154,9 @@ class StreamedGameTrainer:
         start_it, start_ci = 0, 0
         fingerprint = digest = None
         if self.checkpoint_dir is not None:
-            fingerprint = self._fingerprint(data, n_global, row_layout)
+            fingerprint = self._fingerprint(
+                data, n_global, row_layout, initial_model=initial_model
+            )
             digest = _host_digest(
                 np.asarray(data.labels, np.float32),
                 np.ones(n, np.float32) if data.weights is None
@@ -1105,19 +1196,19 @@ class StreamedGameTrainer:
                     f"resuming streamed descent at outer iteration {start_it}, "
                     f"coordinate index {start_ci}"
                 )
-                if vstate is not None:
-                    # validation residual state must reflect the RESUMED
-                    # model — freshly-zeroed coordinate scores would make
-                    # the first post-resume metrics diverge from an
-                    # uninterrupted run until every coordinate is revisited
-                    for cid0 in seq:
-                        new0 = self._val_scores_for(
-                            cid0, vstate, validation, fixed_w, re_W
-                        )
-                        vstate["total"] = (
-                            vstate["total"] - vstate["scores"][cid0] + new0
-                        )
-                        vstate["scores"][cid0] = new0
+
+        if vstate is not None and (warm or self.resumed_from is not None):
+            # validation residual state must reflect the RESUMED/WARM
+            # model — freshly-zeroed coordinate scores would make the
+            # first metrics diverge until every coordinate is revisited
+            for cid0 in seq:
+                new0 = self._val_scores_for(
+                    cid0, vstate, validation, fixed_w, re_W
+                )
+                vstate["total"] = (
+                    vstate["total"] - vstate["scores"][cid0] + new0
+                )
+                vstate["scores"][cid0] = new0
 
         for it in range(start_it, cfg.coordinate_descent_iterations):
             ci0 = start_ci if it == start_it else 0

@@ -177,7 +177,10 @@ class AvroDataReader:
         return maps, max_nnz
 
     def streaming_game_stats(
-        self, path: str | Sequence[str], id_tags: Sequence[str] = ()
+        self,
+        path: str | Sequence[str],
+        id_tags: Sequence[str] = (),
+        entity_maps: Mapping[str, Mapping[str, int]] | None = None,
     ) -> tuple[dict[str, IndexMap], dict[str, int], dict[str, dict[str, int]], int]:
         """ONE streaming pass over ALL files producing everything the
         out-of-core GAME path needs to agree on globally BEFORE any host
@@ -187,10 +190,16 @@ class AvroDataReader:
         only the dictionaries are held, never the records (multi-host GAME
         ingest runs this pass on every host over the full file list so the
         dictionaries are identical everywhere; the FILL pass is per-host —
-        VERDICT r2 missing #1)."""
+        VERDICT r2 missing #1).
+
+        ``entity_maps`` SEEDS the entity dictionaries (warm start: the
+        saved model's dense entity rows stay valid; entities unseen by the
+        saved run get appended ids)."""
         paths = [path] if isinstance(path, str) else list(path)
         index_maps, max_nnz = self.streaming_ingest_stats(paths)
-        ent_maps: dict[str, dict[str, int]] = {t: {} for t in id_tags}
+        ent_maps: dict[str, dict[str, int]] = {
+            t: dict((entity_maps or {}).get(t, {})) for t in id_tags
+        }
         num_rows = 0
         if not id_tags:
             # row count still needed; reuse the scalars pass

@@ -308,3 +308,68 @@ def test_streamed_game_honest_re_diagnostics(rng):
     _, info2 = StreamedGameTrainer(cfg, chunk_rows=128).fit(data)
     assert info2["user"].iterations > 1
     assert info2["user"].converged is True
+
+
+def test_streamed_game_warm_start(rng):
+    """Warm start: the initial model's coordinates contribute scores
+    before their first visit, so a warm 1-iteration fit continues the
+    cold fit's trajectory (fixed coefficients move FROM the warm point,
+    and a warm+1 fit beats a cold 1-iteration fit's loss)."""
+    X, Xr, ids, y, _ = _data(rng, n=500)
+    data = StreamedGameData(labels=y, features={"g": X, "r": Xr},
+                            id_tags={"uid": ids})
+    cold1, info_cold1 = StreamedGameTrainer(_config(iters=1), chunk_rows=128).fit(data)
+    warm2, info_warm = StreamedGameTrainer(_config(iters=1), chunk_rows=128).fit(
+        data, initial_model=cold1
+    )
+    straight2, info_2 = StreamedGameTrainer(_config(iters=2), chunk_rows=128).fit(data)
+    # warm-started second iteration ~ the straight 2-iteration run
+    np.testing.assert_allclose(
+        np.asarray(warm2.models["fixed"].model.coefficients.means),
+        np.asarray(straight2.models["fixed"].model.coefficients.means),
+        rtol=1e-3, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(warm2.models["user"].coefficients),
+        np.asarray(straight2.models["user"].coefficients),
+        rtol=1e-3, atol=1e-4,
+    )
+
+
+def test_streamed_driver_warm_start_roundtrip(tmp_path, rng):
+    """Driver-level warm start: a saved streamed run seeds a second
+    streamed run via model_input_dir (entity maps re-used, new entities
+    cold-start)."""
+    import dataclasses
+    import json as _json
+
+    from photon_ml_tpu.data.synthetic import synthetic_game_data
+
+    from tests.test_drivers import _game_config, _quiet, _write_game_avro
+
+    data = synthetic_game_data(rng, 300, d_fixed=3, effects={"userId": (8, 2)})
+    train_path = tmp_path / "train.avro"
+    _write_game_avro(str(train_path), rng, data=data)
+    first = tmp_path / "first"
+    from photon_ml_tpu.cli import train as train_cli
+
+    cfg = _game_config(coordinate_descent_iterations=1)
+    train_cli.run(
+        cfg, [str(train_path)], str(first), logger=_quiet(tmp_path),
+        streaming_chunk_rows=64,
+    )
+    cfg_warm = dataclasses.replace(cfg, model_input_dir=str(first / "best"))
+    second = tmp_path / "second"
+    model = train_cli.run(
+        cfg_warm, [str(train_path)], str(second), logger=_quiet(tmp_path),
+        streaming_chunk_rows=64,
+    )
+    # same data, same entity dictionary: rows line up
+    with open(first / "entity-maps.json") as f:
+        m1 = _json.load(f)
+    with open(second / "entity-maps.json") as f:
+        m2 = _json.load(f)
+    assert m1 == m2
+    assert np.isfinite(
+        np.asarray(model.models["per_user"].coefficients)
+    ).all()
