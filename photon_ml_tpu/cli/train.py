@@ -394,6 +394,7 @@ def _run_streamed_game(
         multihost=multihost,
         checkpoint_dir=os.path.join(output_dir, "checkpoints"),
         evaluators=tuple(config.evaluators),
+        num_entities={t: len(m) for t, m in entity_maps.items()},
     )
     with timed(logger, "streamed coordinate descent"), profile_trace(
         profile_dir, "streamed-game"

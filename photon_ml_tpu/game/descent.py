@@ -163,8 +163,10 @@ class CoordinateDescent:
                 scores[cid] = new_score
                 model = model.updated(cid, sub_model)
                 # bound HBM retention of lazy per-entity diagnostics: the
-                # previous visit's device buffers are materialized (its
-                # programs finished at least one visit ago) and released
+                # previous visit's device buffers are released UNMATERIALIZED
+                # — earlier-visit per-entity histories are dropped by design
+                # (only the final visit's diagnostics are readable); reading
+                # a released tracker raises RuntimeError
                 if trackers[cid]:
                     release = getattr(
                         trackers[cid][-1], "release_device_diagnostics", None

@@ -245,6 +245,7 @@ class StreamedGameTrainer:
         multihost: bool = False,
         checkpoint_dir: str | None = None,
         evaluators: Sequence[str] = (),
+        num_entities: Mapping[str, int] | None = None,
     ):
         self.config = config
         self.chunk_rows = int(chunk_rows)
@@ -258,8 +259,13 @@ class StreamedGameTrainer:
         # None when it trained from scratch — drivers use this to decide
         # whether previous-run diagnostics should be merged or replaced
         self.resumed_from: tuple[int, int] | None = None
-        # per-id-tag entity-count floors (set per fit from num_entities)
-        self._entity_count_floor: dict[str, int] = {}
+        # per-id-tag entity-count floors. Base floors come from the caller's
+        # entity dictionaries (``num_entities``: tag -> dictionary size); each
+        # fit() additionally floors by the warm-start model's entity counts,
+        # so a saved model's rows for entities ABSENT from the new data
+        # survive instead of being truncated to max-seen-id+1
+        self._entity_count_base: dict[str, int] = dict(num_entities or {})
+        self._entity_count_floor: dict[str, int] = dict(self._entity_count_base)
         # per-coordinate streamed objectives, reused across descent visits:
         # the jitted chunk kernels take the chunk as an argument, so only
         # the FIRST visit compiles; later visits just swap the chunk list
@@ -441,7 +447,7 @@ class StreamedGameTrainer:
             grow_in = row_base + keep_rows.astype(np.int64)
         else:
             grow_in = row_base + np.arange(data.num_rows, dtype=np.int64)
-        E = self._global_num_entities(ids)
+        E = self._global_num_entities(ids, c.random_effect_type)
         pid, P = _num_processes()
         if not self._distributed():
             P, pid = 1, 0
@@ -813,7 +819,7 @@ class StreamedGameTrainer:
 
         from photon_ml_tpu.evaluation import evaluate_all
 
-        specs = self.evaluators or ("AUC",)
+        specs = self.evaluators
         scores = vstate["total"]
         if self._distributed():
             # global metrics identical on every host: per visit only the
@@ -877,6 +883,10 @@ class StreamedGameTrainer:
             "training_config": cfg,
             "chunk_rows": self.chunk_rows,
             "initial_model": warm_hash,
+            # entity-count floors shape re_E (and thus every RE matrix in
+            # the checkpoint): resuming under different declared dictionary
+            # sizes must be rejected like any other layout change
+            "entity_count_floor": sorted(self._entity_count_floor.items()),
             "data": {
                 "num_rows_global": n_global,
                 "row_layout": list(row_layout),
@@ -1054,6 +1064,19 @@ class StreamedGameTrainer:
         and pads new entities with zero rows)."""
         cfg = self.config
         n = data.num_rows
+        # entity-count floors for THIS fit: caller-declared dictionary sizes,
+        # additionally floored by the warm model (its dense rows index
+        # [0, num_entities) and must all stay addressable)
+        self._entity_count_floor = dict(self._entity_count_base)
+        if initial_model is not None:
+            for w_cid, w_c in cfg.random_effect_coordinates.items():
+                sub = initial_model.models.get(w_cid)
+                if sub is not None and hasattr(sub, "num_entities"):
+                    tag = w_c.random_effect_type
+                    self._entity_count_floor[tag] = max(
+                        self._entity_count_floor.get(tag, 0),
+                        int(sub.num_entities),
+                    )
         n_global, row_base, row_layout = self._global_layout(n)
         base = (
             np.zeros(n, np.float32)
@@ -1082,7 +1105,7 @@ class StreamedGameTrainer:
             d = data.feature_container(c.feature_shard_id).num_features
             shard = re_shards[cid]
             ids = np.asarray(data.id_tags[c.random_effect_type], np.int64)
-            re_E[cid] = self._global_num_entities(ids)
+            re_E[cid] = self._global_num_entities(ids, c.random_effect_type)
             re_W[cid] = np.zeros((shard.num_entities_local, d), np.float32)
 
         warm = initial_model is not None
@@ -1146,7 +1169,10 @@ class StreamedGameTrainer:
                 total = total + scores[cid]
 
         vstate = None
-        if validation is not None:
+        # no evaluators configured -> no per-visit validation (the in-memory
+        # CoordinateDescent has the same contract; a default metric would be
+        # wrong for half the task types)
+        if validation is not None and self.evaluators:
             vstate = self._prepare_validation(validation)
 
         # checkpoint/resume (per coordinate VISIT)

@@ -311,4 +311,7 @@ def supports_tiling(batch) -> bool:
         and batch.num_features >= 4096
         and SLAB <= batch.num_rows <= _MAX_TABLE_ROWS
         and batch.num_features <= _MAX_TABLE_COLS
+        # an all-padding batch tiles to 0 groups, and a 0-group kernel is
+        # not compilable (s32[0,128] operand) — the XLA path handles it
+        and bool(np.any(np.asarray(batch.values) != 0))
     )

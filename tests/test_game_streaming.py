@@ -373,3 +373,43 @@ def test_streamed_driver_warm_start_roundtrip(tmp_path, rng):
     assert np.isfinite(
         np.asarray(model.models["per_user"].coefficients)
     ).all()
+
+
+def test_streamed_game_warm_start_preserves_absent_entities(rng):
+    """A warm model's rows for entities ABSENT from the new data must
+    survive (the saved dictionary is authoritative, not max-seen-id+1):
+    regression for a truncation where a 5-entity warm model fit on data
+    mentioning only entities 0..2 came back with 3 rows."""
+    E_warm = 5
+    X, Xr, ids, y, _ = _data(rng, n=300, E=3)  # new data touches ids 0..2
+    data = StreamedGameData(labels=y, features={"g": X, "r": Xr},
+                            id_tags={"uid": ids})
+    cold, _ = StreamedGameTrainer(_config(iters=1), chunk_rows=128).fit(data)
+
+    # build a 5-entity warm model by padding the cold model's RE matrix
+    import dataclasses as _dc
+
+    import jax.numpy as jnp
+
+    sub = cold.models["user"]
+    W = np.asarray(sub.coefficients, np.float32)
+    pad = rng.normal(size=(E_warm - W.shape[0], W.shape[1])).astype(np.float32)
+    W5 = np.concatenate([W, pad])
+    warm_model = cold.updated(
+        "user", _dc.replace(sub, coefficients=jnp.asarray(W5), variances=None)
+    )
+
+    out, _ = StreamedGameTrainer(_config(iters=1), chunk_rows=128).fit(
+        data, initial_model=warm_model
+    )
+    W_out = np.asarray(out.models["user"].coefficients)
+    assert W_out.shape[0] == E_warm, W_out.shape
+    # warm-only entities have no data rows this fit: their rows survive
+    np.testing.assert_allclose(W_out[3:], W5[3:], rtol=1e-6, atol=1e-6)
+
+    # the declared-dictionary floor alone (no warm model) must also hold
+    t = StreamedGameTrainer(
+        _config(iters=1), chunk_rows=128, num_entities={"uid": E_warm}
+    )
+    out2, _ = t.fit(data)
+    assert np.asarray(out2.models["user"].coefficients).shape[0] == E_warm

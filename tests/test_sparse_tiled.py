@@ -132,6 +132,17 @@ class TestTiledSparse:
 
         assert not supports_tiling(densify(small))
 
+    def test_supports_tiling_rejects_all_zero_values(self, rng):
+        """All-padding batches tile to 0 groups (uncompilable kernel) —
+        the gate must send them down the XLA path."""
+        import dataclasses
+
+        big = _sparse_problem(rng, n=SLAB * 2, d=8192, k=4)
+        zeroed = dataclasses.replace(
+            big, values=np.zeros_like(np.asarray(big.values))
+        )
+        assert not supports_tiling(zeroed)
+
 
 def test_optimize_batch_layout_decision(rng):
     """Small-d sparse densifies; over-budget high-d sparse tiles; dense
