@@ -8,41 +8,50 @@ config BELOW one CPU core. The reference's platform (Breeze on JVM) does
 these as cache-friendly CSR loops; beating it needs the sparse pass to run
 out of VMEM at vector rates.
 
-Design — the doubly-blocked "tile-COO" layout, built ONCE at ingest:
+Design — write-slab-major tile-COO, built ONCE at ingest:
 
-- The weight vector lives in VMEM as a (d/128, 128) table; the per-row
-  residual vector as an (n/128, 128) table. Both fit VMEM for the shapes
-  this path serves (d up to ~2M, n up to ~4M per kernel call).
-- Every nonzero is assigned to a CELL = (row-slab, col-slab) where a slab
-  is 1024 consecutive rows/cols = an (8, 128) block of the corresponding
-  table. Nonzeros are sorted by cell and each cell padded to a multiple of
-  GROUP=128 (zero-valued fillers pointing at the cell's corner).
-- A GROUP (128 nonzeros, one vector-register row) therefore shares ONE
-  w-table slab and ONE m-table slab. Per group, the kernels do only
-  vector-rate work:
-    * table READ:  slab = table[cb*8 : cb*8+8] (dynamic slice);
-      per-lane gather ``take_along_axis(slab, lane, 1)`` pulls the wanted
-      lane from ALL 8 sublanes; an 8-way iota-compare select keeps the
-      right sublane. (Mosaic's TPU gather is lane/8-sublane scoped — this
-      structure is exactly what the hardware supports.)
-    * table WRITE: contributions become an (8,128) slab update through a
-      one-hot matmul (A = contribution masked by sub-index; B = lane
-      one-hot; MXU at HIGHEST precision), accumulated into a VMEM scratch
-      of the whole output table, written out once at the last grid step.
-- margins (``matvec``) reads the w-table and writes the m-table; the
-  gradient (``rmatvec``) reads the r-table and writes the g-table — SAME
-  nonzero arrays, mirrored roles, two kernels.
-
-Measured on a v5e chip at the A2 shape (n=2^19, k=32, d=2^17): ~18 ms per
-margins pass vs ~130 ms for the XLA gather path (7x), padding overhead
-1.24x; a full value+grad pass runs both kernels plus XLA elementwise work.
+- The source vector (w for margins, r for the gradient) lives in VMEM as a
+  (len/128, 128) table; so does the output (m / g), accumulated in a VMEM
+  scratch and written out at the last grid step.
+- Every nonzero is assigned to a CELL = (write-slab, read-slab) where a
+  slab is 1024 consecutive outputs/inputs = an (8, 128) block of the
+  corresponding table. Nonzeros are sorted by cell (write-slab major) and
+  each cell padded to a multiple of GROUP=128 (zero-valued fillers).
+- Each WRITE SLAB's nonzeros are further padded to a multiple of
+  GROUPS_PER_STEP groups, so one grid step processes GROUPS_PER_STEP
+  groups that ALL write to the same (8, 128) output slab. Per group the
+  kernel does only vector-rate work:
+    * read:  slab = src[rslab] (one (8,128) dynamic slice; slab id comes
+      from an SMEM-prefetched per-group array, not a vector lane read);
+      ``take_along_axis(slab, lane, 1)`` pulls the wanted lane from all 8
+      sublanes, an 8-way iota-compare select keeps the right sublane —
+      exactly Mosaic's lane/8-sublane gather scope.
+    * write: contributions are staged into an A matrix (8, G*128) masked
+      by output sublane, and a TRANSPOSED one-hot B_T (128, G*128) with
+      B_T[l, j] = (l == lane(j)). Building B transposed keeps the lane
+      indices in the LANE dimension (the straightforward (G*128, 128)
+      one-hot needs a lane->sublane transpose per group — measured ~2x
+      slower end to end).
+- One ``dot_general`` contracts A and B_T over their last dims: a single
+  (8, G*128) x (128, G*128) -> (8, 128) MXU call scatters ALL of the
+  step's nonzeros into the shared write slab (one matmul per G groups vs
+  one per group in the first design — matmul issue count was the round-3
+  bottleneck). B_T is exactly representable in bf16, and A is split into
+  hi+mid+lo bf16 terms (Dekker-style, 24 mantissa bits), so the scatter
+  runs at the MXU's bf16 rate while staying f32-exact (three passes
+  instead of six for HIGHEST-f32).
+- margins (``matvec``) and gradient (``rmatvec``) each get their OWN
+  layout — write=row/read=col and write=col/read=row respectively — the
+  one-time ingest cost buys both directions their batched write slab.
 
 ``TiledSparseBatch`` is a drop-in ``Batch``: ``GLMObjective`` consumes it
 through ``matvec``/``rmatvec``/``rmatvec_sq`` unchanged. Off-TPU the
 kernels run in Pallas interpreter mode, so CPU tests exercise the exact
-code path the TPU compiles. Single-device by design: under a mesh, shard
-rows first and build one tile-COO per shard (the objective's psum handles
-the reduction).
+code path the TPU compiles. Shapes beyond the single-kernel VMEM bounds
+are split into row/col chunks, each its own kernel call, with partial
+outputs concatenated (rows) or summed (cols). Single-device by design:
+under a mesh, shard rows first and build one tile-COO per shard (the
+objective's psum handles the reduction).
 """
 
 from __future__ import annotations
@@ -58,62 +67,110 @@ from jax.experimental.pallas import tpu as pltpu
 
 Array = jnp.ndarray
 
-GROUP = 128  # nonzeros per group: one vreg row, shares one cell
-GROUPS_PER_TILE = 8  # groups per grid step
-SLAB = 1024  # rows/cols per slab: an (8, 128) block of a table
+GROUP = 128  # nonzeros per group: one vreg row, shares one (write, read) cell
+GROUPS_PER_STEP = 16  # groups per grid step: all share ONE write slab
+SLAB = 1024  # outputs/inputs per slab: an (8, 128) block of a table
 
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def build_tiled_coo(
-    rows: np.ndarray, cols: np.ndarray, vals: np.ndarray, n_pad: int, d_pad: int
-) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Sort nonzeros by (row-slab, col-slab) cell and pad each cell to a
-    GROUP multiple (vectorized — no Python per-cell loop). Returns the
-    (M,) tiled rows/cols/vals with zero-valued fillers aimed at each
-    cell's corner (they contribute exactly 0 to every kernel)."""
-    rows = np.asarray(rows, np.int32)
-    cols = np.asarray(cols, np.int32)
-    vals = np.asarray(vals, np.float32)
-    ncs = d_pad // SLAB
-    cell = (rows // SLAB).astype(np.int64) * ncs + (cols // SLAB)
+@dataclass(frozen=True)
+class _Layout:
+    """One direction's write-slab-major tiling (host numpy)."""
+
+    tw: np.ndarray  # (M/GROUP, GROUP) int32 write indices
+    tr: np.ndarray  # (M/GROUP, GROUP) int32 read indices
+    tv: np.ndarray  # (M/GROUP, GROUP) f32 values (0 on fillers)
+    wslab: np.ndarray  # (n_steps,) int32 write slab id per grid step
+    rslab: np.ndarray  # (M/GROUP,) int32 read slab id per group
+
+
+def build_write_major_layout(
+    write_idx: np.ndarray,
+    read_idx: np.ndarray,
+    vals: np.ndarray,
+    write_pad: int,
+    read_pad: int,
+    groups_per_step: int = GROUPS_PER_STEP,
+) -> _Layout:
+    """Sort nonzeros by (write-slab, read-slab) cell, pad each cell to a
+    GROUP multiple, then pad each write slab's group count to a multiple
+    of ``groups_per_step`` (all vectorized — no Python per-cell loop).
+    Fillers carry value 0 (they contribute exactly 0 through any slab)."""
+    w = np.asarray(write_idx, np.int32)
+    r = np.asarray(read_idx, np.int32)
+    v = np.asarray(vals, np.float32)
+    nws = write_pad // SLAB
+    nrs = read_pad // SLAB
+    ws_of = (w // SLAB).astype(np.int64)
+    cell = ws_of * nrs + (r // SLAB)
     order = np.argsort(cell, kind="stable")
-    rows, cols, vals, cell = rows[order], cols[order], vals[order], cell[order]
+    w, r, v, cell = w[order], r[order], v[order], cell[order]
+
     uniq, start, counts = np.unique(cell, return_index=True, return_counts=True)
-    padded = (-(-counts // GROUP) * GROUP).astype(np.int64)
-    out_start = np.concatenate([[0], np.cumsum(padded)])
-    M = int(out_start[-1])
-    M_pad = -(-M // (GROUP * GROUPS_PER_TILE)) * (GROUP * GROUPS_PER_TILE)
+    pc = (-(-counts // GROUP) * GROUP).astype(np.int64)  # padded cell nnz
+    cell_ws = (uniq // nrs).astype(np.int64)
+    cell_rs = (uniq % nrs).astype(np.int32)
 
-    # initialize with per-cell corner fillers, then scatter the real nnz
-    corner_r = ((uniq // ncs) * SLAB).astype(np.int32)
-    corner_c = ((uniq % ncs) * SLAB).astype(np.int32)
-    out_rows = np.zeros(M_pad, np.int32)
-    out_cols = np.zeros(M_pad, np.int32)
-    out_vals = np.zeros(M_pad, np.float32)
-    out_rows[:M] = np.repeat(corner_r, padded)
-    out_cols[:M] = np.repeat(corner_c, padded)
-    within = np.arange(len(cell), dtype=np.int64) - np.repeat(start, counts)
-    pos = np.repeat(out_start[:-1], counts) + within
-    out_rows[pos] = rows
-    out_cols[pos] = cols
-    out_vals[pos] = vals
-    return out_rows, out_cols, out_vals
+    # write-slab blocks: sum of padded cell counts, padded to step multiple
+    step_nnz = groups_per_step * GROUP
+    nnz_per_ws = np.zeros(nws, np.int64)
+    np.add.at(nnz_per_ws, cell_ws, pc)
+    ws_padded = -(-nnz_per_ws // step_nnz) * step_nnz  # empty slabs -> 0
+    ws_out_start = np.concatenate([[0], np.cumsum(ws_padded)])
+    M = int(ws_out_start[-1])
 
+    # each cell's output offset: write-slab base + within-slab running sum
+    pc_excl = np.cumsum(pc) - pc
+    uws, uws_first, uws_ncells = np.unique(
+        cell_ws, return_index=True, return_counts=True
+    )
+    within_ws = pc_excl - np.repeat(pc_excl[uws_first], uws_ncells)
+    cell_out = ws_out_start[cell_ws] + within_ws
 
-def _tables(n_pad: int, d_pad: int) -> tuple[int, int]:
-    return n_pad // 128, d_pad // 128
+    # init with per-write-slab corner fillers, then scatter the real nnz
+    out_w = np.repeat(
+        (np.arange(nws, dtype=np.int64) * SLAB), ws_padded
+    ).astype(np.int32)
+    out_r = np.zeros(M, np.int32)
+    out_v = np.zeros(M, np.float32)
+    within_cell = np.arange(len(cell), dtype=np.int64) - np.repeat(start, counts)
+    pos = np.repeat(cell_out, counts) + within_cell
+    out_w[pos] = w
+    out_r[pos] = r
+    out_v[pos] = v
+
+    # per-group read slab: a cell's groups all read its slab; filler groups
+    # (write-slab tail padding) read slab 0 — their values are all 0
+    n_groups = M // GROUP
+    rslab = np.zeros(n_groups, np.int32)
+    gc = (pc // GROUP).astype(np.int64)  # groups per cell
+    gc_excl = np.cumsum(gc) - gc
+    gpos = (
+        np.repeat(cell_out // GROUP, gc)
+        + np.arange(int(gc.sum()), dtype=np.int64)
+        - np.repeat(gc_excl, gc)
+    )
+    rslab[gpos] = np.repeat(cell_rs, gc)
+
+    wslab = (out_w[::step_nnz] // SLAB).astype(np.int32)
+    shape2 = (n_groups, GROUP)
+    return _Layout(
+        tw=out_w.reshape(shape2),
+        tr=out_r.reshape(shape2),
+        tv=out_v.reshape(shape2),
+        wslab=wslab,
+        rslab=rslab,
+    )
 
 
 def _tile_kernel(
-    rows_ref, cols_ref, val_ref, src_ref, out_ref, acc_scratch,
-    *, n_tiles, transpose,
+    wslab_ref, rslab_ref, tw_ref, tr_ref, tv_ref, src_ref, out_ref,
+    acc_scratch, a_scratch, bt_scratch, *, n_steps, groups,
 ):
-    """One grid step = GROUPS_PER_TILE groups. ``transpose=False``:
-    margins (read w by col, write m by row). ``transpose=True``: gradient
-    (read r by row, write g by col)."""
+    """One grid step = ``groups`` groups, all writing one output slab."""
     t = pl.program_id(0)
 
     @pl.when(t == 0)
@@ -121,196 +178,277 @@ def _tile_kernel(
         acc_scratch[...] = jnp.zeros_like(acc_scratch)
 
     iota8 = jax.lax.broadcasted_iota(jnp.int32, (8, GROUP), 0)
-    iota128 = jax.lax.broadcasted_iota(jnp.int32, (GROUP, GROUP), 1)
-    for s in range(GROUPS_PER_TILE):
-        row = rows_ref[s, :]
-        col = cols_ref[s, :]
-        read_idx = row if transpose else col
-        write_idx = col if transpose else row
-        # every nonzero of a group shares its cell: slab ids are scalars
-        read_slab = (rows_ref[s, 0] if transpose else cols_ref[s, 0]) // SLAB
-        write_slab = (cols_ref[s, 0] if transpose else rows_ref[s, 0]) // SLAB
-
-        lane_r = read_idx & 127
-        sub_r = (read_idx >> 7) & 7
-        slab = src_ref[pl.ds(pl.multiple_of(read_slab * 8, 8), 8), :]
+    iota_sub = jax.lax.broadcasted_iota(jnp.int32, (GROUP, GROUP), 0)
+    for g in range(groups):
+        rd = tr_ref[g, :]
+        lane_r = rd & 127
+        sub_r = (rd >> 7) & 7
+        rslab = rslab_ref[t * groups + g]
+        slab = src_ref[pl.ds(pl.multiple_of(rslab * 8, 8), 8), :]
         gathered = jnp.take_along_axis(
             slab, jnp.broadcast_to(lane_r[None, :], (8, GROUP)), axis=1
         )
         sel = (iota8 == sub_r[None, :]).astype(jnp.float32)
         src_vals = jnp.sum(gathered * sel, axis=0)  # (GROUP,)
-        p = val_ref[s, :] * src_vals
+        p = tv_ref[g, :] * src_vals
 
-        lane_w = write_idx & 127
-        sub_w = (write_idx >> 7) & 7
-        A = jnp.where(iota8 == sub_w[None, :], p[None, :], 0.0)  # (8,GROUP)
-        B = (iota128 == lane_w[:, None]).astype(jnp.float32)  # (GROUP,128)
-        Ms = jnp.dot(
-            A, B, preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        idx = pl.ds(pl.multiple_of(write_slab * 8, 8), 8)
-        acc_scratch[idx, :] = acc_scratch[idx, :] + Ms
+        wr = tw_ref[g, :]
+        lane_w = wr & 127
+        sub_w = (wr >> 7) & 7
+        cols = pl.ds(g * GROUP, GROUP)
+        a_scratch[:, cols] = jnp.where(iota8 == sub_w[None, :], p[None, :], 0.0)
+        # TRANSPOSED one-hot: lane indices stay in the lane dimension
+        bt_scratch[:, cols] = (iota_sub == lane_w[None, :]).astype(jnp.bfloat16)
 
-    @pl.when(t == n_tiles - 1)
+    # one MXU scatter for the whole step: contract over the nnz dimension.
+    # B_T is exact in bf16; A splits into hi+mid+lo bf16 terms (Dekker
+    # style, each residual exactly representable -> 24 mantissa bits), so
+    # three bf16 passes reproduce the f32 product (vs six for HIGHEST f32)
+    a = a_scratch[...]
+    a_hi = a.astype(jnp.bfloat16)
+    rem = a - a_hi.astype(jnp.float32)
+    a_mid = rem.astype(jnp.bfloat16)
+    a_lo = (rem - a_mid.astype(jnp.float32)).astype(jnp.bfloat16)
+    bt = bt_scratch[...]
+    dims = (((1,), (1,)), ((), ()))
+    ms = (
+        jax.lax.dot_general(a_hi, bt, dims, preferred_element_type=jnp.float32)
+        + jax.lax.dot_general(a_mid, bt, dims, preferred_element_type=jnp.float32)
+        + jax.lax.dot_general(a_lo, bt, dims, preferred_element_type=jnp.float32)
+    )
+    ws = wslab_ref[t]
+    idx = pl.ds(pl.multiple_of(ws * 8, 8), 8)
+    acc_scratch[idx, :] = acc_scratch[idx, :] + ms
+
+    @pl.when(t == n_steps - 1)
     def _():
         out_ref[...] = acc_scratch[...]
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_pad", "d_pad", "transpose")
+    jax.jit, static_argnames=("out_pad", "src_pad", "groups")
 )
-def _tiled_apply(trows, tcols, tvals, src, n_pad, d_pad, transpose):
-    """margins (transpose=False): src = w (d_pad,) -> (n_pad,).
-    gradient (transpose=True): src = r (n_pad,) -> (d_pad,)."""
-    M = trows.shape[0] * GROUP
-    n_tiles = M // (GROUP * GROUPS_PER_TILE)
-    nrs, ncs128 = _tables(n_pad, d_pad)
-    src_shape = (ncs128, 128) if not transpose else (nrs, 128)
-    out_shape = (nrs, 128) if not transpose else (ncs128, 128)
+def _tiled_apply(layout_arrays, src, out_pad, src_pad, groups):
+    """Run one direction's kernel: src (src_pad,) -> out (out_pad,)."""
+    tw, tr, tv, wslab, rslab = layout_arrays
+    n_steps = int(tw.shape[0]) // groups
+    src_shape = (src_pad // 128, 128)
+    out_shape = (out_pad // 128, 128)
     f = pl.pallas_call(
-        functools.partial(
-            _tile_kernel, n_tiles=n_tiles, transpose=transpose
+        functools.partial(_tile_kernel, n_steps=n_steps, groups=groups),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(n_steps,),
+            in_specs=[
+                pl.BlockSpec((groups, GROUP), lambda i, *_: (i, 0)),
+                pl.BlockSpec((groups, GROUP), lambda i, *_: (i, 0)),
+                pl.BlockSpec((groups, GROUP), lambda i, *_: (i, 0)),
+                pl.BlockSpec(src_shape, lambda i, *_: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec(out_shape, lambda i, *_: (0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM(out_shape, jnp.float32),
+                pltpu.VMEM((8, groups * GROUP), jnp.float32),
+                pltpu.VMEM((GROUP, groups * GROUP), jnp.bfloat16),
+            ],
         ),
-        grid=(n_tiles,),
-        in_specs=[
-            pl.BlockSpec((GROUPS_PER_TILE, GROUP), lambda i: (i, 0)),
-            pl.BlockSpec((GROUPS_PER_TILE, GROUP), lambda i: (i, 0)),
-            pl.BlockSpec((GROUPS_PER_TILE, GROUP), lambda i: (i, 0)),
-            pl.BlockSpec(src_shape, lambda i: (0, 0)),
-        ],
-        out_specs=pl.BlockSpec(out_shape, lambda i: (0, 0)),
-        scratch_shapes=[pltpu.VMEM(out_shape, jnp.float32)],
         out_shape=jax.ShapeDtypeStruct(out_shape, jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
-            vmem_limit_bytes=100 * 1024 * 1024,
+            vmem_limit_bytes=120 * 1024 * 1024,
         ),
         interpret=_interpret(),
     )
-    return f(trows, tcols, tvals, src.reshape(src_shape)).reshape(-1)
+    return f(wslab, rslab, tw, tr, tv, src.reshape(src_shape)).reshape(-1)
 
 
 @functools.partial(
     jax.tree_util.register_dataclass,
-    data_fields=[
-        "trows", "tcols", "tvals", "tvals_sq", "labels", "offsets", "weights",
-    ],
-    meta_fields=["num_features", "num_rows_real"],
+    data_fields=["m_arrays", "g_arrays", "gsq_vals"],
+    meta_fields=["row_start", "col_start", "n_pad", "d_pad"],
+)
+@dataclass(frozen=True)
+class _TileChunk:
+    """One (row-range x col-range) kernel chunk: both direction layouts."""
+
+    m_arrays: tuple  # margins: (tw, tr, tv, wslab, rslab), write=row
+    g_arrays: tuple  # gradient: (tw, tr, tv, wslab, rslab), write=col
+    gsq_vals: Array  # squared values in the GRADIENT layout's order
+    row_start: int = field(metadata=dict(static=True))
+    col_start: int = field(metadata=dict(static=True))
+    n_pad: int = field(metadata=dict(static=True))
+    d_pad: int = field(metadata=dict(static=True))
+
+    def matvec_part(self, w_full: Array) -> Array:
+        w = jax.lax.dynamic_slice(w_full, (self.col_start,), (self.d_pad,))
+        return _tiled_apply(
+            self.m_arrays, w, self.n_pad, self.d_pad, GROUPS_PER_STEP
+        )
+
+    def rmatvec_part(self, r_full: Array, squared: bool) -> Array:
+        r = jax.lax.dynamic_slice(r_full, (self.row_start,), (self.n_pad,))
+        tw, tr, tv, wslab, rslab = self.g_arrays
+        if squared:
+            tv = self.gsq_vals
+        return _tiled_apply(
+            (tw, tr, tv, wslab, rslab), r, self.d_pad, self.n_pad,
+            GROUPS_PER_STEP,
+        )
+
+
+@functools.partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["chunks", "labels", "offsets", "weights"],
+    meta_fields=["num_features", "num_rows_real", "n_pad_total", "d_pad_total"],
 )
 @dataclass(frozen=True)
 class TiledSparseBatch:
     """Drop-in ``Batch`` whose margins/gradient run the tile-COO Pallas
     kernels. ``labels``/``offsets``/``weights`` are (n,) with the ORIGINAL
-    row indexing (the kernels scatter/gather by original row id).
+    row indexing. Build with ``tile_sparse_batch``; shapes beyond one
+    kernel's VMEM bounds arrive as multiple row/col chunks."""
 
-    Build with ``tile_sparse_batch`` — it handles table padding (n to a
-    SLAB multiple, d to a SLAB multiple) and precomputes the squared
-    values for ``rmatvec_sq`` (Hessian diagonal).
-    """
-
-    trows: Array  # (M/GROUP, GROUP) int32 tiled row ids
-    tcols: Array  # (M/GROUP, GROUP) int32 tiled col ids
-    tvals: Array  # (M/GROUP, GROUP) f32 values (0 on fillers)
-    tvals_sq: Array  # (M/GROUP, GROUP) f32 squared values
+    chunks: tuple  # tuple[_TileChunk, ...]
     labels: Array
     offsets: Array
     weights: Array
     num_features: int = field(metadata=dict(static=True))
     num_rows_real: int = field(metadata=dict(static=True))
+    n_pad_total: int = field(metadata=dict(static=True))
+    d_pad_total: int = field(metadata=dict(static=True))
 
     @property
     def num_rows(self) -> int:
         return self.labels.shape[0]
 
-    @property
-    def _n_pad(self) -> int:
-        return -(-self.num_rows // SLAB) * SLAB
-
-    @property
-    def _d_pad(self) -> int:
-        return -(-self.num_features // SLAB) * SLAB
-
-    def _pad_src_d(self, w: Array) -> Array:
-        d = self.num_features
-        return w if d == self._d_pad else jnp.pad(w, (0, self._d_pad - d))
-
-    def _pad_src_n(self, r: Array) -> Array:
-        n = self.num_rows
-        return r if n == self._n_pad else jnp.pad(r, (0, self._n_pad - n))
-
     def matvec(self, w: Array) -> Array:
-        m = _tiled_apply(
-            self.trows, self.tcols, self.tvals, self._pad_src_d(w),
-            self._n_pad, self._d_pad, transpose=False,
-        )
+        d = self.num_features
+        w_pad = w if d == self.d_pad_total else jnp.pad(w, (0, self.d_pad_total - d))
+        m = jnp.zeros((self.n_pad_total,), jnp.float32)
+        for c in self.chunks:
+            m = jax.lax.dynamic_update_slice(
+                m,
+                jax.lax.dynamic_slice(m, (c.row_start,), (c.n_pad,))
+                + c.matvec_part(w_pad),
+                (c.row_start,),
+            )
         return m[: self.num_rows]
 
-    def rmatvec(self, r: Array) -> Array:
-        g = _tiled_apply(
-            self.trows, self.tcols, self.tvals, self._pad_src_n(r),
-            self._n_pad, self._d_pad, transpose=True,
-        )
+    def _rmatvec(self, r: Array, squared: bool) -> Array:
+        n = self.num_rows
+        r_pad = r if n == self.n_pad_total else jnp.pad(r, (0, self.n_pad_total - n))
+        g = jnp.zeros((self.d_pad_total,), jnp.float32)
+        for c in self.chunks:
+            g = jax.lax.dynamic_update_slice(
+                g,
+                jax.lax.dynamic_slice(g, (c.col_start,), (c.d_pad,))
+                + c.rmatvec_part(r_pad, squared),
+                (c.col_start,),
+            )
         return g[: self.num_features]
 
+    def rmatvec(self, r: Array) -> Array:
+        return self._rmatvec(r, squared=False)
+
     def rmatvec_sq(self, r: Array) -> Array:
-        g = _tiled_apply(
-            self.trows, self.tcols, self.tvals_sq, self._pad_src_n(r),
-            self._n_pad, self._d_pad, transpose=True,
-        )
-        return g[: self.num_features]
+        return self._rmatvec(r, squared=True)
+
+
+# A chunk holds four tables in VMEM across its two kernels: the src block,
+# the out block, and the f32 accumulation scratch (out-sized), plus the
+# staged A/B_T step matrices. Bound each chunk's table sizes well inside
+# the ~128 MB VMEM limit; bigger problems are built as multiple chunks.
+_MAX_TABLE_ROWS = 1 << 22  # 4M rows -> out block + scratch = 2 x 16 MB
+_MAX_TABLE_COLS = 1 << 21  # 2M cols -> 2 x 8 MB
+
+
+def _build_chunk(
+    rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
+    row_start: int, col_start: int, n_pad: int, d_pad: int,
+) -> _TileChunk:
+    m = build_write_major_layout(rows, cols, vals, n_pad, d_pad)
+    g = build_write_major_layout(cols, rows, vals, d_pad, n_pad)
+    as_j = lambda lay: tuple(
+        jnp.asarray(a) for a in (lay.tw, lay.tr, lay.tv, lay.wslab, lay.rslab)
+    )
+    return _TileChunk(
+        m_arrays=as_j(m),
+        g_arrays=as_j(g),
+        gsq_vals=jnp.asarray(g.tv * g.tv),
+        row_start=row_start,
+        col_start=col_start,
+        n_pad=n_pad,
+        d_pad=d_pad,
+    )
 
 
 def tile_sparse_batch(batch) -> TiledSparseBatch:
     """Build a ``TiledSparseBatch`` from a padded-sparse ``SparseBatch``
     (host-side one-time transform; zero-valued padding slots are dropped
-    before tiling)."""
+    before tiling). Shapes beyond the per-kernel VMEM bounds are split
+    into row/col chunks along SLAB-aligned boundaries."""
     indices = np.asarray(batch.indices)
     values = np.asarray(batch.values)
     n, k = indices.shape
-    rows = np.repeat(np.arange(n, dtype=np.int32), k)
-    cols = indices.reshape(-1).astype(np.int32)
+    d = batch.num_features
+    rows = np.repeat(np.arange(n, dtype=np.int64), k)
+    cols = indices.reshape(-1).astype(np.int64)
     vals = values.reshape(-1).astype(np.float32)
     keep = vals != 0.0
     rows, cols, vals = rows[keep], cols[keep], vals[keep]
-    n_pad = -(-n // SLAB) * SLAB
-    d_pad = -(-batch.num_features // SLAB) * SLAB
-    trows, tcols, tvals = build_tiled_coo(rows, cols, vals, n_pad, d_pad)
-    shape2 = (-1, GROUP)
+
+    n_pad_total = -(-n // SLAB) * SLAB
+    d_pad_total = -(-d // SLAB) * SLAB
+    n_row_chunks = -(-n_pad_total // _MAX_TABLE_ROWS)
+    n_col_chunks = -(-d_pad_total // _MAX_TABLE_COLS)
+    chunks = []
+    for rc in range(n_row_chunks):
+        r0 = rc * _MAX_TABLE_ROWS
+        r1 = min(r0 + _MAX_TABLE_ROWS, n_pad_total)
+        in_r = (rows >= r0) & (rows < r1)
+        for cc in range(n_col_chunks):
+            c0 = cc * _MAX_TABLE_COLS
+            c1 = min(c0 + _MAX_TABLE_COLS, d_pad_total)
+            m = in_r & (cols >= c0) & (cols < c1)
+            if n_row_chunks * n_col_chunks > 1 and not m.any():
+                continue
+            chunks.append(
+                _build_chunk(
+                    rows[m] - r0, cols[m] - c0, vals[m],
+                    row_start=r0, col_start=c0,
+                    n_pad=r1 - r0, d_pad=c1 - c0,
+                )
+            )
     return TiledSparseBatch(
-        trows=jnp.asarray(trows.reshape(shape2)),
-        tcols=jnp.asarray(tcols.reshape(shape2)),
-        tvals=jnp.asarray(tvals.reshape(shape2)),
-        tvals_sq=jnp.asarray((tvals * tvals).reshape(shape2)),
+        chunks=tuple(chunks),
         labels=batch.labels,
         offsets=batch.offsets,
         weights=batch.weights,
-        num_features=batch.num_features,
+        num_features=d,
         num_rows_real=n,
+        n_pad_total=n_pad_total,
+        d_pad_total=d_pad_total,
     )
 
 
-# The kernels hold the FULL row table (margins output / r source) and col
-# table (w source / gradient output) in VMEM: each costs 4 bytes/row|col
-# for the block input plus the same again for the accumulation scratch.
-# Bound the accepted shapes well inside the ~100 MB VMEM limit.
-_MAX_TABLE_ROWS = 1 << 22  # 4M rows -> 2 x 16 MB (out block + scratch)
-_MAX_TABLE_COLS = 1 << 21  # 2M cols -> 2 x 8 MB
+# Beyond these totals the chunk count (each chunk = 2 kernel compiles)
+# stops paying for itself against the streamed/sharded paths.
+_MAX_TOTAL_ROWS = 1 << 25  # 32M rows = 8 row chunks
+_MAX_TOTAL_COLS = 1 << 23  # 8M cols = 4 col chunks
 
 
 def supports_tiling(batch) -> bool:
     """Static gate: shapes the tile-COO path handles well — a genuinely
-    sparse high-dimensional problem (the dense path beats it otherwise)
-    small enough that both VMEM-resident tables fit (beyond the bounds,
-    the XLA gather/scatter path is slow but correct; chunk rows and sum
-    partial gradients to stay inside them)."""
+    sparse high-dimensional problem (the dense path beats it otherwise).
+    Shapes beyond one kernel's VMEM bounds are row/col-chunked, so the
+    ceiling here is the chunk-count economy, not VMEM."""
     from photon_ml_tpu.ops.batch import SparseBatch
 
     return (
         isinstance(batch, SparseBatch)
         and batch.num_features >= 4096
-        and SLAB <= batch.num_rows <= _MAX_TABLE_ROWS
-        and batch.num_features <= _MAX_TABLE_COLS
+        and SLAB <= batch.num_rows <= _MAX_TOTAL_ROWS
+        and batch.num_features <= _MAX_TOTAL_COLS
         # an all-padding batch tiles to 0 groups, and a 0-group kernel is
         # not compilable (s32[0,128] operand) — the XLA path handles it
         and bool(np.any(np.asarray(batch.values) != 0))
