@@ -217,7 +217,11 @@ def _streamed_unsupported(config: GameTrainingConfig) -> list[str]:
     fast on an EXPLICIT --streaming-chunk-rows and to veto AUTO-selection
     — auto-streaming must never turn a runnable in-memory job into a
     ValueError)."""
+    from photon_ml_tpu.types import VarianceComputationType
+
     out = []
+    if config.variance_computation is VarianceComputationType.FULL:
+        out.append("FULL variance computation (streamed variances are SIMPLE)")
     if config.hyperparameter_tuning_iters > 0:
         out.append("hyperparameter tuning")
     if config.regularization_weight_grid:

@@ -110,14 +110,13 @@ def run(
                 f"--optimizer TRON with --regularization {regularization.value} "
                 f"(L1 routes through OWL-QN; use LBFGS)"
             )
-        if normalization is not NormalizationType.NONE:
-            unsupported.append(f"--normalization {normalization.value}")
-        if variance_computation is not VarianceComputationType.NONE:
-            unsupported.append(f"--variance {variance_computation.value}")
+        if variance_computation is VarianceComputationType.FULL:
+            unsupported.append(
+                f"--variance {variance_computation.value} (streamed variances "
+                "are SIMPLE — FULL needs the dense d×d Hessian)"
+            )
         if validate is not DataValidationType.VALIDATE_DISABLED:
             unsupported.append(f"--validate {validate.value}")
-        if summarize_features:
-            unsupported.append("--summarize-features")
         if prior_model_path:
             unsupported.append("--prior-model (incremental mode is in-memory)")
         if diagnostics:
@@ -132,6 +131,9 @@ def run(
             regularization, weights, max_iterations, tolerance,
             streaming_chunk_rows, advance, logger, multihost=multihost,
             profile_dir=profile_dir, optimizer=optimizer,
+            normalization=normalization,
+            variance_computation=variance_computation,
+            summarize_features=summarize_features,
         )
 
     advance("INIT")
@@ -289,6 +291,9 @@ def _run_streamed(
     chunk_rows, advance, logger, multihost: bool = False,
     profile_dir: str | None = None,
     optimizer: OptimizerType = OptimizerType.LBFGS,
+    normalization: NormalizationType = NormalizationType.NONE,
+    variance_computation: VarianceComputationType = VarianceComputationType.NONE,
+    summarize_features: bool = False,
 ):
     """Out-of-core branch: data is read in uniform chunks that live in host
     RAM and stream through the device per optimizer iteration (SURVEY.md §7
@@ -334,6 +339,27 @@ def _run_streamed(
             )
         ) if local_paths else []
     logger.info(f"{len(chunks)} training chunks of {chunk_rows} rows")
+
+    norm_context = None
+    if summarize_features or normalization is not NormalizationType.NONE:
+        from photon_ml_tpu.data.summary import summarize_chunks
+
+        with timed(logger, "summarize features (streamed, this host's chunks)"):
+            # cross_process makes the summary GLOBAL — every host builds the
+            # identical normalization context from its own chunks
+            summary = summarize_chunks(
+                chunks, num_features=imap.size, cross_process=multihost
+            )
+        if summarize_features and writer:
+            write_feature_summary(
+                os.path.join(output_dir, "summary", "part-00000.avro"),
+                summary,
+                imap,
+            )
+        if normalization is not NormalizationType.NONE:
+            norm_context = summary.normalization(
+                normalization, imap.intercept_index
+            )
     advance_once("PROCESSED")
 
     val_chunks = None
@@ -363,6 +389,8 @@ def _run_streamed(
             validation_chunks=val_chunks,
             cross_process=multihost,
             checkpoint_dir=os.path.join(output_dir, "checkpoints"),
+            normalization=norm_context,
+            variance_computation=variance_computation,
         )
     advance_once("TRAINED")
 

@@ -88,3 +88,139 @@ def summarize(batch: Batch) -> FeatureSummary:
         num_nonzeros=(Xa != 0).sum(0).astype(np.int64),
         count=int(active.sum()),
     )
+
+
+def shard_normalization_context(
+    summary: FeatureSummary,
+    norm_type: NormalizationType,
+    shard_id: str,
+    intercept_index: int | None,
+    log=None,
+) -> NormalizationContext:
+    """Shared per-shard context policy for the GAME trainers (in-memory
+    estimator AND streamed): a shard with no intercept cannot absorb the
+    shift term on the output model, so STANDARDIZATION degrades to
+    scale-only for that shard (logged, not silent)."""
+    if intercept_index is None and norm_type is NormalizationType.STANDARDIZATION:
+        norm_type = NormalizationType.SCALE_WITH_STANDARD_DEVIATION
+        if log is not None:
+            log(
+                f"shard {shard_id!r} has no intercept: STANDARDIZATION "
+                f"degraded to SCALE_WITH_STANDARD_DEVIATION (shifts need "
+                f"an intercept to absorb on the output model)"
+            )
+    return summary.normalization(norm_type, intercept_index)
+
+
+def summarize_chunks(
+    chunks, num_features: int, cross_process: bool = False
+) -> FeatureSummary:
+    """Streamed twin of ``summarize``: weighted feature statistics over
+    uniform host chunk dicts (``ops.streaming`` builders /
+    ``AvroDataReader.iter_batch_chunks``) without materializing the dense
+    matrix — one O(d) accumulator pass per chunk. Feeds the out-of-core
+    drivers' normalization contexts (reference: the summary/normalization
+    stage of ``photon-client::ml.Driver``, SURVEY.md §2.2 — the reference
+    computes these on its only, distributed path).
+
+    Semantics match ``summarize`` exactly: implicit zeros participate in
+    the moments and min/max; padded rows (weight 0) are inert; duplicate
+    (row, col) pairs accumulate before squaring. ``cross_process=True``
+    reduces the accumulators across hosts (each host passes only its own
+    chunks) so every process returns the GLOBAL summary.
+    """
+    d = num_features
+    w_total = 0.0
+    n_active = 0
+    s1 = np.zeros(d, np.float64)  # Σ w x
+    s2 = np.zeros(d, np.float64)  # Σ w x²
+    nnz = np.zeros(d, np.int64)
+    vmin = np.full(d, np.inf)
+    vmax = np.full(d, -np.inf)
+    n_present = np.zeros(d, np.int64)  # active rows where feature explicit
+
+    for chunk in chunks:
+        w = np.asarray(chunk["weights"], np.float64)
+        active = w > 0
+        w_total += w.sum()
+        n_active += int(active.sum())
+        if "X" in chunk:
+            X = np.asarray(chunk["X"], np.float64)
+            s1 += (w[:, None] * X).sum(0)
+            s2 += (w[:, None] * X * X).sum(0)
+            Xa = X[active]
+            if Xa.size:
+                vmin = np.minimum(vmin, Xa.min(0))
+                vmax = np.maximum(vmax, Xa.max(0))
+                nnz += (Xa != 0).sum(0)
+            n_present += int(active.sum())
+        else:
+            idx = np.asarray(chunk["indices"], np.int64)
+            val = np.asarray(chunk["values"], np.float64)
+            n, k = idx.shape
+            rows = np.repeat(np.arange(n, dtype=np.int64), k)
+            flat_c = idx.ravel()
+            flat_v = val.ravel()
+            # accumulate duplicates per (row, col) BEFORE squaring, like the
+            # dense scatter-add path; padding slots (value 0) drop out of
+            # nnz/min/max via keep, and contribute 0 to the moments anyway
+            key = rows * d + flat_c
+            uniq, inv = np.unique(key, return_inverse=True)
+            summed = np.zeros(len(uniq), np.float64)
+            np.add.at(summed, inv, flat_v)
+            explicit = np.zeros(len(uniq), np.bool_)
+            np.bitwise_or.at(explicit, inv, flat_v != 0.0)
+            urows = (uniq // d).astype(np.int64)
+            ucols = (uniq % d).astype(np.int64)
+            keep = explicit  # at least one real (nonzero-valued) slot
+            summed, urows, ucols = summed[keep], urows[keep], ucols[keep]
+            uw = w[urows]
+            np.add.at(s1, ucols, uw * summed)
+            np.add.at(s2, ucols, uw * summed * summed)
+            a = active[urows]
+            if a.any():
+                np.minimum.at(vmin, ucols[a], summed[a])
+                np.maximum.at(vmax, ucols[a], summed[a])
+                np.add.at(nnz, ucols[a], (summed[a] != 0).astype(np.int64))
+                np.add.at(n_present, ucols[a], 1)
+
+    if cross_process:
+        from photon_ml_tpu.parallel.multihost import (
+            allreduce_max_host,
+            allreduce_sum_host,
+        )
+
+        w_total_a, n_active_a, s1, s2, nnz_f, n_present_f = (
+            allreduce_sum_host(
+                np.asarray([w_total]), np.asarray([float(n_active)]), s1, s2,
+                nnz.astype(np.float64),
+                n_present.astype(np.float64),
+            )
+        )
+        w_total = float(w_total_a[0])
+        n_active = int(n_active_a[0])
+        nnz = nnz_f.astype(np.int64)
+        n_present = n_present_f.astype(np.int64)
+        (vmax, neg_vmin) = allreduce_max_host(vmax, -vmin)
+        vmin = -neg_vmin
+
+    if w_total <= 0:
+        raise ValueError("summarize: total sample weight is zero")
+    # implicit zeros: a feature absent from some active row has 0 as a
+    # min/max candidate; absent-row weight contributes 0 to the moments
+    has_implicit = n_present < n_active
+    vmin = np.where(n_present == 0, 0.0, np.where(has_implicit, np.minimum(vmin, 0.0), vmin))
+    vmax = np.where(n_present == 0, 0.0, np.where(has_implicit, np.maximum(vmax, 0.0), vmax))
+    mean = s1 / w_total
+    # E[w x²]/W − mean² (matches the dense two-pass variance algebraically;
+    # f64 accumulators keep it stable at ingest scale)
+    var = np.maximum(s2 / w_total - mean * mean, 0.0)
+    return FeatureSummary(
+        mean=mean,
+        variance=var,
+        min=vmin,
+        max=vmax,
+        max_magnitude=np.maximum(np.abs(vmin), np.abs(vmax)),
+        num_nonzeros=nnz,
+        count=n_active,
+    )

@@ -215,20 +215,16 @@ class GameEstimator:
             c.feature_shard_id
             for c in self.config.random_effect_coordinates.values()
         }
+        from photon_ml_tpu.data.summary import shard_normalization_context
+
         for sid in shard_ids:
-            summary = summarize(batch.batch_for(sid))
-            norm_type = self.config.normalization
-            intercept = self.intercept_indices.get(sid)
-            if intercept is None and norm_type is NormalizationType.STANDARDIZATION:
-                # a shard with no intercept cannot absorb the shift term on
-                # the output model; degrade to scale-only for that shard
-                norm_type = NormalizationType.SCALE_WITH_STANDARD_DEVIATION
-                self._log(
-                    f"shard {sid!r} has no intercept: STANDARDIZATION "
-                    f"degraded to SCALE_WITH_STANDARD_DEVIATION (shifts need "
-                    f"an intercept to absorb on the output model)"
-                )
-            contexts[sid] = summary.normalization(norm_type, intercept)
+            contexts[sid] = shard_normalization_context(
+                summarize(batch.batch_for(sid)),
+                self.config.normalization,
+                sid,
+                self.intercept_indices.get(sid),
+                log=self._log,
+            )
         return contexts
 
     def _entity_layouts(

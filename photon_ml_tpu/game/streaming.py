@@ -270,14 +270,16 @@ class StreamedGameTrainer:
         # the jitted chunk kernels take the chunk as an argument, so only
         # the FIRST visit compiles; later visits just swap the chunk list
         self._fixed_objectives: dict[str, StreamingGLMObjective] = {}
-        if config.normalization is not NormalizationType.NONE:
+        if config.variance_computation is VarianceComputationType.FULL:
             raise NotImplementedError(
-                "streamed GAME does not support normalization contexts"
+                "streamed GAME computes SIMPLE variances (per-visit "
+                "Hessian-diagonal); FULL needs the dense d×d Hessian of the "
+                "fixed effect — use the in-memory path"
             )
-        if config.variance_computation is not VarianceComputationType.NONE:
-            raise NotImplementedError(
-                "streamed GAME does not support variance computation"
-            )
+        # per-shard normalization contexts, built once per fit from a
+        # streamed feature summary (reference computes these on its only,
+        # distributed path — SURVEY §2.2 normalization row)
+        self._norm_contexts: dict[str, Any] = {}
         for cid, c in config.random_effect_coordinates.items():
             if c.random_projection_dim is not None:
                 raise NotImplementedError(
@@ -576,6 +578,48 @@ class StreamedGameTrainer:
 
     # -- coordinate training ------------------------------------------------
 
+    def _normalization_contexts(self, data: StreamedGameData) -> dict[str, Any]:
+        """Per-shard contexts from a STREAMED feature summary over every
+        shard in the update sequence (same semantics as the estimator's
+        ``_normalization_contexts``, incl. the no-intercept STANDARDIZATION
+        degrade). Multi-host: the summary reduces across processes, so all
+        hosts build identical contexts from their own rows."""
+        cfg = self.config
+        if cfg.normalization is NormalizationType.NONE:
+            return {}
+        from photon_ml_tpu.data.summary import (
+            shard_normalization_context,
+            summarize_chunks,
+        )
+
+        contexts: dict[str, Any] = {}
+        shard_ids = {
+            c.feature_shard_id for c in cfg.fixed_effect_coordinates.values()
+        } | {
+            c.feature_shard_id for c in cfg.random_effect_coordinates.values()
+        }
+        n = data.num_rows
+        weights = (
+            np.ones(n, np.float32) if data.weights is None
+            else np.asarray(data.weights, np.float32)
+        )
+        labels = np.asarray(data.labels, np.float32)
+        for sid in sorted(shard_ids):
+            feats = data.feature_container(sid)
+            chunks = _feature_chunk_dicts(
+                feats, labels, self.chunk_rows,
+                offsets=np.zeros(n, np.float32), weights=weights,
+            )
+            summary = summarize_chunks(
+                chunks, num_features=feats.num_features,
+                cross_process=self._distributed(),
+            )
+            contexts[sid] = shard_normalization_context(
+                summary, cfg.normalization, sid,
+                self.intercept_indices.get(sid), log=self._log,
+            )
+        return contexts
+
     def _train_fixed(
         self,
         cid: str,
@@ -585,6 +629,8 @@ class StreamedGameTrainer:
         opt: OptimizationConfig,
         w0: np.ndarray,
         intercept_index: int | None,
+        norm=None,
+        compute_var: bool = False,
     ):
         n = data.num_rows
         d = feats.num_features
@@ -605,15 +651,40 @@ class StreamedGameTrainer:
                 chunks, loss, num_features=d, l2_weight=l2,
                 intercept_index=intercept_index,
                 cross_process=self._distributed(),
+                norm=norm,
             )
             self._fixed_objectives[cid] = sobj
         else:
             sobj.chunks = chunks  # fresh residual offsets; kernels reused
         minimize_fn, extra = select_minimize_fn(opt.optimizer, l1, host=True)
-        res = minimize_fn(sobj, w0, opt.optimizer, **extra)
-        w = np.asarray(res.w, np.float32)
+        # the optimizer works in NORMALIZED space; trainer state (w0 and the
+        # returned w) stays in ORIGINAL space — same contract as the
+        # in-memory FixedEffectCoordinate
+        w0 = jnp.asarray(w0, jnp.float32)
+        if norm is not None:
+            w0 = norm.model_from_original_space(w0)
+        res = minimize_fn(sobj, np.asarray(w0, np.float32), opt.optimizer, **extra)
+        var = None
+        if (
+            compute_var
+            and self.config.variance_computation is VarianceComputationType.SIMPLE
+        ):
+            # one extra streamed pass at this visit's solution — the caller
+            # requests it only on the coordinate's LAST scheduled visit
+            # (earlier visits' variances never reach the saved model)
+            var = 1.0 / jnp.maximum(
+                sobj.hessian_diag(jnp.asarray(res.w, jnp.float32)), 1e-12
+            )
+        w_model = jnp.asarray(res.w, jnp.float32)
+        if norm is not None:
+            w_model, _ = norm.model_to_original_space(w_model)
+            if var is not None:
+                var = norm.factors**2 * var
+        w = np.asarray(w_model, np.float32)
+        # scores over RAW chunks with ORIGINAL-space coefficients (equal to
+        # normalized-space margins by construction)
         scores = stream_scores(chunks, w, num_rows=n, num_features=d)
-        return w, scores, res
+        return w, scores, res, (None if var is None else np.asarray(var, np.float32))
 
     def _solve_re_buckets(
         self,
@@ -622,21 +693,30 @@ class StreamedGameTrainer:
         opt: OptimizationConfig,
         W: np.ndarray,
         intercept_index: int | None,
+        norm=None,
+        V: np.ndarray | None = None,
     ) -> tuple[float, int, bool]:
         """Solve every bucket of this shard's OWNED entities against the
         current offsets, writing coefficient rows back into the host
-        (E_local, d) matrix ``W``. DOUBLE-BUFFERED: the next bucket's host
-        gather + transfer + dispatch are issued before the previous
-        bucket's results are read back, so the host/DMA work of bucket
-        ``i+1`` overlaps the device solve of bucket ``i`` (async dispatch).
-        Returns honest aggregates (loss sum, max iterations, all
-        converged)."""
+        (E_local, d) matrix ``W`` (and SIMPLE variances into ``V`` when
+        given). DOUBLE-BUFFERED: the next bucket's host gather + transfer +
+        dispatch are issued before the previous bucket's results are read
+        back, so the host/DMA work of bucket ``i+1`` overlaps the device
+        solve of bucket ``i`` (async dispatch). ``W``/``V`` stay in
+        ORIGINAL feature space; ``norm`` maps per bucket at the solve
+        boundary (entities partition across buckets, so per-bucket mapping
+        equals the in-memory path's whole-matrix mapping). Returns honest
+        aggregates (loss sum, max iterations, all converged)."""
         loss = loss_for_task(self.config.task_type)
         l1 = opt.regularization.l1_weight(opt.regularization_weight)
         l2 = jnp.asarray(
             opt.regularization.l2_weight(opt.regularization_weight), jnp.float32
         )
         minimize_fn, extra = select_minimize_fn(opt.optimizer, l1)
+        variance_computation = (
+            self.config.variance_computation if V is not None
+            else VarianceComputationType.NONE
+        )
         loss_sum = 0.0
         max_iters = 0
         all_converged = True
@@ -645,8 +725,13 @@ class StreamedGameTrainer:
 
         def collect(ent_ids, out):
             nonlocal loss_sum, max_iters, all_converged
-            w_b, f_b, it_b, reason_b, _ = out
+            w_b, f_b, it_b, reason_b, var_b = out
+            if norm is not None:
+                w_b = jax.vmap(lambda w: norm.model_to_original_space(w)[0])(w_b)
+                var_b = norm.factors**2 * var_b
             W[ent_ids] = np.asarray(w_b, np.float32)
+            if V is not None:
+                V[ent_ids] = np.asarray(var_b, np.float32)
             loss_sum += float(jnp.sum(f_b))
             max_iters = max(max_iters, int(jnp.max(it_b)))
             # reason 0 == MAX_ITERATIONS (not converged)
@@ -659,18 +744,20 @@ class StreamedGameTrainer:
                 shard.features, shard.labels, offs_re, shard.weights, rows
             )
             w0 = jnp.asarray(W[ent_ids], jnp.float32)
+            if norm is not None:
+                w0 = jax.vmap(norm.model_from_original_space)(w0)
             out = _solve_bucket(
                 bucket,
                 w0,
                 l2,
-                None,  # norm
+                norm,
                 None,  # prior_mu
                 None,  # prior_var
                 minimize_fn=minimize_fn,
                 loss=loss,
                 config=opt.optimizer,
                 intercept_index=intercept_index,
-                variance_computation=VarianceComputationType.NONE,
+                variance_computation=variance_computation,
                 **extra,
             )
             if pending is not None:
@@ -956,17 +1043,38 @@ class StreamedGameTrainer:
                 "scores": ckpt.scores,
                 "total": ckpt.total,
             }
+        cfg = self.config
+        # deterministic coordinate order for the per-cid variance-presence
+        # flags (the checkpoint may predate a coordinate's first visit)
+        var_cids = sorted(cfg.fixed_effect_coordinates) + sorted(
+            cfg.random_effect_coordinates
+        )
+
+        def _sub_var(sub):
+            if isinstance(sub, FixedEffectModel):
+                return sub.model.coefficients.variances
+            return sub.variances
+
+        flags = [0] * len(var_cids)
+        if jax.process_index() == 0 and ckpt is not None:
+            for i, v_cid in enumerate(var_cids):
+                sub = ckpt.model.models.get(v_cid)
+                if sub is not None and _sub_var(sub) is not None:
+                    flags[i] = 1
         has = np.asarray(
             [0 if (ckpt is None or ckpt.scores is None) else 1,
              0 if ckpt is None else ckpt.next_iteration,
-             0 if ckpt is None else ckpt.next_coordinate],
+             0 if ckpt is None else ckpt.next_coordinate,
+             *flags],
             np.int64,
         )
         has = broadcast_from_host0(has)
         if int(has[0]) == 0:
             return None
+        var_present = {
+            v_cid: bool(has[3 + i]) for i, v_cid in enumerate(var_cids)
+        }
         # broadcast the arrays with the globally-known structure
-        cfg = self.config
         arrays = {}
         if jax.process_index() == 0:
             for cid, sub in ckpt.model.models.items():
@@ -974,8 +1082,14 @@ class StreamedGameTrainer:
                     arrays[f"w__{cid}"] = np.asarray(
                         sub.model.coefficients.means, np.float32
                     )
+                    if var_present[cid]:
+                        arrays[f"v__{cid}"] = np.asarray(
+                            sub.model.coefficients.variances, np.float32
+                        )
                 else:
                     arrays[f"W__{cid}"] = np.asarray(sub.coefficients, np.float32)
+                    if var_present[cid]:
+                        arrays[f"V__{cid}"] = np.asarray(sub.variances, np.float32)
             for cid, s in ckpt.scores.items():
                 arrays[f"s__{cid}"] = np.asarray(s, np.float32)
             arrays["total"] = np.asarray(ckpt.total, np.float32)
@@ -987,10 +1101,18 @@ class StreamedGameTrainer:
                 arrays[f"w__{cid}"] = np.zeros(
                     self._resume_shard_dims[cid], np.float32
                 )
+                if var_present[cid]:
+                    arrays[f"v__{cid}"] = np.zeros(
+                        self._resume_shard_dims[cid], np.float32
+                    )
             for cid in cfg.random_effect_coordinates:
                 arrays[f"W__{cid}"] = np.zeros(
                     self._resume_re_dims[cid], np.float32
                 )
+                if var_present[cid]:
+                    arrays[f"V__{cid}"] = np.zeros(
+                        self._resume_re_dims[cid], np.float32
+                    )
             for cid in cfg.coordinate_update_sequence:
                 arrays[f"s__{cid}"] = np.zeros(n_global, np.float32)
             arrays["total"] = np.zeros(n_global, np.float32)
@@ -999,7 +1121,11 @@ class StreamedGameTrainer:
         for cid, c in cfg.fixed_effect_coordinates.items():
             models[cid] = FixedEffectModel(
                 model=GeneralizedLinearModel(
-                    Coefficients(jnp.asarray(arrays[f"w__{cid}"]), None),
+                    Coefficients(
+                        jnp.asarray(arrays[f"w__{cid}"]),
+                        jnp.asarray(arrays[f"v__{cid}"])
+                        if var_present[cid] else None,
+                    ),
                     cfg.task_type,
                 ),
                 feature_shard_id=c.feature_shard_id,
@@ -1007,7 +1133,10 @@ class StreamedGameTrainer:
         for cid, c in cfg.random_effect_coordinates.items():
             models[cid] = RandomEffectModel(
                 coefficients=jnp.asarray(arrays[f"W__{cid}"]),
-                variances=None,
+                variances=(
+                    jnp.asarray(arrays[f"V__{cid}"])
+                    if var_present[cid] else None
+                ),
                 random_effect_type=c.random_effect_type,
                 feature_shard_id=c.feature_shard_id,
                 task_type=cfg.task_type,
@@ -1026,10 +1155,16 @@ class StreamedGameTrainer:
     def _assemble_model(self, model_state: dict[str, Any]) -> GameModel:
         cfg = self.config
         models: dict[str, Any] = {}
+        fixed_var = model_state.get("fixed_var") or {}
+        re_V = model_state.get("re_V") or {}
         for cid, c in cfg.fixed_effect_coordinates.items():
+            var = fixed_var.get(cid)
             models[cid] = FixedEffectModel(
                 model=GeneralizedLinearModel(
-                    Coefficients(jnp.asarray(model_state["fixed_w"][cid]), None),
+                    Coefficients(
+                        jnp.asarray(model_state["fixed_w"][cid]),
+                        None if var is None else jnp.asarray(var),
+                    ),
                     cfg.task_type,
                 ),
                 feature_shard_id=c.feature_shard_id,
@@ -1038,9 +1173,14 @@ class StreamedGameTrainer:
             W_full = self._full_re_matrix(
                 model_state["re_W"][cid], model_state["re_E"][cid]
             )
+            V_local = re_V.get(cid)
+            V_full = (
+                None if V_local is None
+                else self._full_re_matrix(V_local, model_state["re_E"][cid])
+            )
             models[cid] = RandomEffectModel(
                 coefficients=jnp.asarray(W_full),
-                variances=None,
+                variances=None if V_full is None else jnp.asarray(V_full),
                 random_effect_type=c.random_effect_type,
                 feature_shard_id=c.feature_shard_id,
                 task_type=cfg.task_type,
@@ -1083,6 +1223,10 @@ class StreamedGameTrainer:
             if data.offsets is None
             else np.asarray(data.offsets, np.float32)
         )
+        # per-shard normalization from a streamed summary of THIS dataset;
+        # cached chunk kernels bake the context in, so they reset per fit
+        self._norm_contexts = self._normalization_contexts(data)
+        self._fixed_objectives = {}
 
         # entity layouts + the multi-host owner exchange, once (the shuffle)
         re_shards: dict[str, _ReShard] = {}
@@ -1107,6 +1251,13 @@ class StreamedGameTrainer:
             ids = np.asarray(data.id_tags[c.random_effect_type], np.int64)
             re_E[cid] = self._global_num_entities(ids, c.random_effect_type)
             re_W[cid] = np.zeros((shard.num_entities_local, d), np.float32)
+        want_var = (
+            cfg.variance_computation is VarianceComputationType.SIMPLE
+        )
+        fixed_var: dict[str, np.ndarray | None] = {c_: None for c_ in fixed_w}
+        re_V: dict[str, np.ndarray | None] = {
+            c_: (np.zeros_like(re_W[c_]) if want_var else None) for c_ in re_W
+        }
 
         warm = initial_model is not None
         if warm:
@@ -1207,9 +1358,17 @@ class StreamedGameTrainer:
                         fixed_w[cid] = np.asarray(
                             sub.model.coefficients.means, np.float32
                         )
+                        v = sub.model.coefficients.variances
+                        if v is not None and want_var:
+                            fixed_var[cid] = np.asarray(v, np.float32)
                     elif cid in re_W:
                         W_full = np.asarray(sub.coefficients, np.float32)
                         re_W[cid] = W_full[pid::P] if P > 1 else W_full.copy()
+                        if sub.variances is not None and want_var:
+                            V_full = np.asarray(sub.variances, np.float32)
+                            re_V[cid] = (
+                                V_full[pid::P] if P > 1 else V_full.copy()
+                            )
                 for cid in seq:
                     scores[cid] = np.asarray(
                         resume["scores"][cid], np.float32
@@ -1244,11 +1403,17 @@ class StreamedGameTrainer:
                 if cid in cfg.fixed_effect_coordinates:
                     c = cfg.fixed_effect_coordinates[cid]
                     feats = data.feature_container(c.feature_shard_id)
-                    w, new_scores, res = self._train_fixed(
+                    w, new_scores, res, var = self._train_fixed(
                         cid, feats, data, offs, c.optimization, fixed_w[cid],
                         self.intercept_indices.get(c.feature_shard_id),
+                        norm=self._norm_contexts.get(c.feature_shard_id),
+                        compute_var=(
+                            it == cfg.coordinate_descent_iterations - 1
+                        ),
                     )
                     fixed_w[cid] = w
+                    if var is not None:
+                        fixed_var[cid] = var
                     info[cid] = StreamedCoordinateInfo(
                         final_loss=float(res.value),
                         iterations=int(res.iterations),
@@ -1261,6 +1426,8 @@ class StreamedGameTrainer:
                     loss_sum, max_it, conv = self._solve_re_buckets(
                         shard, offs_re, c.optimization, re_W[cid],
                         self.intercept_indices.get(c.feature_shard_id),
+                        norm=self._norm_contexts.get(c.feature_shard_id),
+                        V=re_V[cid],
                     )
                     if self._distributed():
                         # per-owner partial diagnostics → global (sum the
@@ -1306,6 +1473,7 @@ class StreamedGameTrainer:
                     )
                     model_state = {
                         "fixed_w": fixed_w, "re_W": re_W, "re_E": re_E,
+                        "fixed_var": fixed_var, "re_V": re_V,
                     }
                     self._save_visit_checkpoint(
                         model_state, scores, total, nxt_it, nxt_ci,
@@ -1313,6 +1481,7 @@ class StreamedGameTrainer:
                     )
 
         model = self._assemble_model(
-            {"fixed_w": fixed_w, "re_W": re_W, "re_E": re_E}
+            {"fixed_w": fixed_w, "re_W": re_W, "re_E": re_E,
+             "fixed_var": fixed_var, "re_V": re_V}
         )
         return model, info

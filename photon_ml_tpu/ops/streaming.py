@@ -198,10 +198,18 @@ class StreamingGLMObjective:
             )
             return obj.hvp(wv[0], wv[1])
 
+        def chunk_hessian_diag(batch: Batch, w: Array):
+            obj = make_objective(
+                batch, self.loss, l2_weight=0.0, norm=self.norm,
+                intercept_index=self.intercept_index,
+            )
+            return obj.hessian_diag(w)
+
         # ONE compiled kernel per contract, re-entered for every chunk
         self._chunk_vg = jax.jit(chunk_value_grad)
         self._chunk_v = jax.jit(chunk_value)
         self._chunk_hvp = jax.jit(chunk_hvp)
+        self._chunk_hd = jax.jit(chunk_hessian_diag)
 
     def _stream(self, params, kernel: Callable, accumulate: Callable, init):
         """Double-buffered host→device chunk pipeline: the NEXT chunk's
@@ -250,6 +258,25 @@ class StreamingGLMObjective:
 
             hv = jnp.asarray(allreduce_sum_host(np.asarray(hv)))
         return hv + jnp.float32(self.l2_weight) * self.reg_mask * v
+
+    def hessian_diag(self, w: Array) -> Array:
+        """diag(H), streamed — VarianceComputationType.SIMPLE at the
+        solution costs one extra full-data pass (the in-memory formula is
+        linear in the per-chunk data sums, so chunk partials add; the L2
+        term lands once, after the cross-process sum)."""
+        w = jnp.asarray(w)
+        init = jnp.zeros((self.num_features,), jnp.float32)
+        diag = self._stream(
+            w,
+            lambda batch, wi: self._chunk_hd(batch, wi),
+            lambda acc, out: acc + out,
+            init,
+        )
+        if self.cross_process:
+            from photon_ml_tpu.parallel.multihost import allreduce_sum_host
+
+            diag = jnp.asarray(allreduce_sum_host(np.asarray(diag)))
+        return diag + jnp.float32(self.l2_weight) * self.reg_mask
 
     def value_and_grad(self, w: Array) -> tuple[Array, Array]:
         w = jnp.asarray(w)

@@ -212,3 +212,16 @@ def allreduce_sum_host(*arrays: np.ndarray):
     stacked = multihost_utils.process_allgather(arrays)  # each: (P, ...)
     summed = tuple(np.sum(np.asarray(a), axis=0) for a in stacked)
     return summed if len(summed) > 1 else summed[0]
+
+
+def allreduce_max_host(*arrays: np.ndarray):
+    """Elementwise max across ALL processes (identity on one process).
+    Used by the streamed feature summary for min/max statistics (min rides
+    as max of the negation)."""
+    if jax.process_count() <= 1:
+        return arrays if len(arrays) > 1 else arrays[0]
+    from jax.experimental import multihost_utils
+
+    stacked = multihost_utils.process_allgather(arrays)  # each: (P, ...)
+    maxed = tuple(np.max(np.asarray(a), axis=0) for a in stacked)
+    return maxed if len(maxed) > 1 else maxed[0]

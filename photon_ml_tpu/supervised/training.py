@@ -214,7 +214,8 @@ class _StreamedSweepCheckpoint:
     state so every process branches identically.
     """
 
-    def __init__(self, directory, task, chunks, num_features, opt_config, reg):
+    def __init__(self, directory, task, chunks, num_features, opt_config, reg,
+                 normalization=None):
         import hashlib
         import os
 
@@ -223,6 +224,22 @@ class _StreamedSweepCheckpoint:
         self.partial_path = os.path.join(directory, "sweep-partial.npz")
         first_labels = np.ascontiguousarray(chunks[0]["labels"]) if chunks else np.zeros(0)
         total_rows = sum(len(c["labels"]) for c in chunks)
+        # normalization reshapes the optimization trajectory AND the saved
+        # coefficient space — resuming under different factors/shifts must
+        # be rejected like any other setup change
+        norm_token = (
+            None
+            if normalization is None
+            else hashlib.sha256(
+                np.ascontiguousarray(
+                    np.asarray(normalization.factors, np.float32)
+                ).tobytes()
+                + np.ascontiguousarray(
+                    np.asarray(normalization.shifts, np.float32)
+                ).tobytes()
+                + repr(normalization.intercept_index).encode()
+            ).hexdigest()
+        )
         # NOTE: the λ list is deliberately NOT fingerprinted — completed
         # models are keyed by λ, so extending the sweep (the canonical
         # resume-and-extend workflow) reuses what finished and trains the
@@ -244,6 +261,7 @@ class _StreamedSweepCheckpoint:
                     opt_config.tolerance,
                     reg.regularization_type.value if reg is not None else None,
                     reg.alpha if reg is not None else None,
+                    norm_token,
                 )
             ).encode()
             + first_labels.tobytes()
@@ -411,11 +429,21 @@ def train_glm_streamed(
     initial_model: GeneralizedLinearModel | None = None,
     cross_process: bool = False,
     checkpoint_dir: str | None = None,
+    normalization: NormalizationContext | None = None,
+    variance_computation: VarianceComputationType = VarianceComputationType.NONE,
 ) -> GLMTrainingResult:
     """Out-of-core twin of ``train_glm``: the same ascending-λ warm-started
     sweep, driven by host L-BFGS over a ``StreamingGLMObjective`` (one
     streamed pass per value+gradient evaluation — the reference's Spark
     aggregation pattern; SURVEY.md §7 "Streaming 1B rows").
+
+    ``normalization`` applies inside every streamed objective evaluation
+    (factor-folding — zero extra HBM traffic) and is un-applied on the
+    saved models, exactly like the in-memory sweep; build the context from
+    ``data.summary.summarize_chunks`` over the SAME chunks.
+    ``variance_computation`` SIMPLE costs one extra streamed
+    Hessian-diagonal pass per λ at its solution; FULL needs the dense d×d
+    Hessian and is in-memory only (rejected here).
 
     ``chunks`` are uniform host chunk dicts (``photon_ml_tpu.ops.streaming``
     builders or ``AvroDataReader.iter_batch_chunks``). Validation scores
@@ -455,12 +483,22 @@ def train_glm_streamed(
             "regularization_weights > 0 with RegularizationType.NONE would be "
             "silently ignored; pass an L2 context or drop the weights"
         )
+    if variance_computation is VarianceComputationType.FULL:
+        raise ValueError(
+            "streamed sweep computes SIMPLE variances (one Hessian-diagonal "
+            "pass); FULL needs the dense d×d Hessian — use the in-memory path"
+        )
+    require_intercept_for_shifts(normalization)
     loss = loss_for_task(task)
-    w = (
-        np.asarray(initial_model.coefficients.means, np.float32)
-        if initial_model is not None
-        else np.zeros((num_features,), np.float32)
-    )
+    # the optimizer works in NORMALIZED coefficient space (models are saved
+    # in original space, same contract as the in-memory sweep)
+    if initial_model is not None:
+        w0 = jnp.asarray(initial_model.coefficients.means, jnp.float32)
+        if normalization is not None:
+            w0 = normalization.model_from_original_space(w0)
+        w = np.asarray(w0, np.float32)
+    else:
+        w = np.zeros((num_features,), np.float32)
 
     specs = list(evaluators)
     if validation_chunks is not None and not specs:
@@ -487,7 +525,7 @@ def train_glm_streamed(
     ckpt = (
         _StreamedSweepCheckpoint(
             checkpoint_dir, task, chunks, num_features, optimizer_config,
-            regularization,
+            regularization, normalization=normalization,
         )
         if checkpoint_dir is not None
         else None
@@ -504,12 +542,14 @@ def train_glm_streamed(
     sobj = StreamingGLMObjective(
         chunks, loss, num_features=num_features, l2_weight=0.0,
         intercept_index=intercept_index, cross_process=cross_process,
+        norm=normalization,
     )
     for lam in sorted(regularization_weights):
         done_w = ckpt.completed_model(lam) if ckpt is not None else None
         if done_w is not None:
             w = done_w
             result = None
+            sobj.l2_weight = float(regularization.l2_weight(lam))
         else:
             sobj.l2_weight = float(regularization.l2_weight(lam))
             resume_w = ckpt.partial_iterate(lam) if ckpt is not None else None
@@ -525,11 +565,24 @@ def train_glm_streamed(
                 ),
                 **extra,
             )
-            w = np.asarray(result.w)  # warm start the next λ
+            w = np.asarray(result.w)  # warm start the next λ (normalized space)
             if ckpt is not None:
                 ckpt.save_completed(lam, w)
+
+        variances = None
+        if variance_computation is VarianceComputationType.SIMPLE:
+            # one extra streamed pass at the solution (checkpoint-loaded λs
+            # included — variances are not checkpointed)
+            variances = 1.0 / jnp.maximum(
+                sobj.hessian_diag(jnp.asarray(w, jnp.float32)), 1e-12
+            )
+        w_model = jnp.asarray(w, jnp.float32)
+        if normalization is not None:
+            w_model, _ = normalization.model_to_original_space(w_model)
+            if variances is not None:
+                variances = normalization.factors**2 * variances
         model = GeneralizedLinearModel(
-            Coefficients(jnp.asarray(w, jnp.float32), None), task
+            Coefficients(w_model, variances), task
         )
         models[lam] = model
         if result is not None:
@@ -537,8 +590,11 @@ def train_glm_streamed(
 
         if validation_chunks is not None and specs:
             n_val = len(val_labels)
+            # validation chunks carry RAW features — score with the
+            # ORIGINAL-space coefficients
             margins = stream_scores(
-                validation_chunks, w, num_rows=n_val, num_features=num_features
+                validation_chunks, np.asarray(w_model), num_rows=n_val,
+                num_features=num_features,
             )
             res = evaluate_all(
                 specs,
