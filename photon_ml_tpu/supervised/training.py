@@ -571,10 +571,14 @@ def train_glm_streamed(
 
         variances = None
         if variance_computation is VarianceComputationType.SIMPLE:
+            from photon_ml_tpu.ops.glm import compute_variances
+
             # one extra streamed pass at the solution (checkpoint-loaded λs
-            # included — variances are not checkpointed)
-            variances = 1.0 / jnp.maximum(
-                sobj.hessian_diag(jnp.asarray(w, jnp.float32)), 1e-12
+            # included — variances are not checkpointed); the shared
+            # implementation consumes the streaming objective's
+            # hessian_diag directly
+            variances = compute_variances(
+                sobj, jnp.asarray(w, jnp.float32), variance_computation
             )
         w_model = jnp.asarray(w, jnp.float32)
         if normalization is not None:

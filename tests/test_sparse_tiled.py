@@ -164,3 +164,82 @@ def test_optimize_batch_layout_decision(rng):
 
     dense = optimize_batch_layout(small, hbm_budget_bytes=1e9)
     assert optimize_batch_layout(dense) is dense
+
+
+def test_game_fixed_effect_rides_tiled_kernel(rng):
+    """The ingest layout decision reaches the GAME fixed effect: a
+    high-dimensional sparse fixed shard trains and scores through the
+    cached tile-COO layout, matching the XLA path."""
+    import photon_ml_tpu.ops.sparse_tiled as st
+    from photon_ml_tpu.config import (
+        FixedEffectCoordinateConfig,
+        GameTrainingConfig,
+        OptimizationConfig,
+        OptimizerConfig,
+        RegularizationContext,
+    )
+    from photon_ml_tpu.estimators import GameEstimator
+    from photon_ml_tpu.game import make_game_batch
+    from photon_ml_tpu.types import RegularizationType, TaskType
+
+    from photon_ml_tpu.game.data import SparseFeatures
+
+    n, d, k = 1100, 4096, 4
+    idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    val = rng.normal(size=(n, k)).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    batch = make_game_batch(
+        y,
+        {"s": SparseFeatures(
+            indices=jnp.asarray(idx), values=jnp.asarray(val), num_features=d
+        )},
+        id_tags={},
+    )
+    cfg = GameTrainingConfig(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinate_update_sequence=("fixed",),
+        coordinate_descent_iterations=1,
+        fixed_effect_coordinates={
+            "fixed": FixedEffectCoordinateConfig(
+                feature_shard_id="s",
+                optimization=OptimizationConfig(
+                    optimizer=OptimizerConfig(max_iterations=25),
+                    regularization=RegularizationContext(RegularizationType.L2),
+                    regularization_weight=1.0,
+                ),
+            )
+        },
+    )
+    import photon_ml_tpu.ops.streaming as ops_streaming
+
+    built = {"n": 0}
+    orig = st.tile_sparse_batch
+
+    def counting(b):
+        built["n"] += 1
+        return orig(b)
+
+    # a tiny HBM budget forces the layout decision past densify into tiling
+    orig_budget = ops_streaming.device_hbm_budget_bytes
+    ops_streaming.device_hbm_budget_bytes = lambda *a, **k: 1.0
+    st.tile_sparse_batch = counting
+    try:
+        model_t = GameEstimator(cfg).fit(batch)[0].model
+    finally:
+        st.tile_sparse_batch = orig
+        ops_streaming.device_hbm_budget_bytes = orig_budget
+    assert built["n"] == 1, "fixed coordinate should tile exactly once"
+
+    orig_gate = st.supports_tiling
+    ops_streaming.device_hbm_budget_bytes = lambda *a, **k: 1.0
+    st.supports_tiling = lambda b: False
+    try:
+        model_x = GameEstimator(cfg).fit(batch)[0].model
+    finally:
+        st.supports_tiling = orig_gate
+        ops_streaming.device_hbm_budget_bytes = orig_budget
+    np.testing.assert_allclose(
+        np.asarray(model_t.models["fixed"].model.coefficients.means),
+        np.asarray(model_x.models["fixed"].model.coefficients.means),
+        rtol=1e-4, atol=1e-5,
+    )
