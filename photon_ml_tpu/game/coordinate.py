@@ -231,6 +231,100 @@ class FixedEffectCoordinate:
             return opt.matvec(model.model.coefficients.means)
         return model.score(self.batch)
 
+    def visit(
+        self, total: Array, own_score: Array | None,
+        initial: GameSubModel | None = None,
+    ) -> tuple[FixedEffectModel, OptimizationResult, Array, Array]:
+        """One descent visit as ONE compiled program: residual offsets →
+        solve → score → new running total. Returns (sub-model, tracker,
+        new own score, new total). On dispatch-latency-dominated platforms
+        (remote-attached chips) the unfused visit's 4-6 small program
+        launches were the wall-clock floor of every GAME config (VERDICT
+        r3 weak #3); the fused form launches once. ``own_score=None``
+        means this coordinate has not scored yet (cold start)."""
+        if self.mesh is not None or self.train_rows is not None:
+            # sharded solves stage host-side; down-sampling changes row
+            # sets per config — both keep the unfused path
+            offsets = total - own_score if own_score is not None else total
+            sub_model, tracker = self.train(offsets, initial)
+            new_score = self.score(sub_model)
+            return sub_model, tracker, new_score, offsets + new_score
+
+        base = self.__dict__.get("_visit_base")
+        if base is None:
+            # materialize the layout cache + the offset-free base batch
+            # OUTSIDE the trace (densify/tile are host-side transforms); the
+            # jit rebinds per-visit offsets onto this pytree ARGUMENT (a
+            # closure would bake the feature arrays into the executable)
+            base = self._training_batch(jnp.zeros_like(self.batch.offsets))
+            object.__setattr__(self, "_visit_base", base)
+            object.__setattr__(self, "_visit_fn", self._build_visit_fn())
+        fn = self.__dict__["_visit_fn"]
+
+        w0 = (
+            jnp.asarray(initial.model.coefficients.means, jnp.float32)
+            if initial is not None
+            else jnp.zeros((base.num_features,), jnp.float32)
+        )
+        if own_score is None:
+            own_score = jnp.zeros_like(total)
+        w, variances, tracker, new_score, new_total = fn(
+            base, total, own_score, w0
+        )
+        model = FixedEffectModel(
+            model=GeneralizedLinearModel(
+                Coefficients(w, variances), self.task_type
+            ),
+            feature_shard_id=self.feature_shard_id,
+        )
+        return model, tracker, new_score, new_total
+
+    def _build_visit_fn(self):
+        """The jitted visit body (built once per coordinate; closes over
+        the batch, config, prior, and cached layout)."""
+        opt = self.config
+        loss = loss_for_task(self.task_type)
+        l1 = opt.regularization.l1_weight(opt.regularization_weight)
+        l2 = opt.regularization.l2_weight(opt.regularization_weight)
+        minimize_fn, extra = select_minimize_fn(opt.optimizer, l1)
+        prior = None
+        if self.prior_model is not None:
+            from photon_ml_tpu.ops.glm import GaussianPrior
+
+            _require_prior_l2(self.config)
+            prior = GaussianPrior.from_coefficients(
+                self.prior_model.model.coefficients.means,
+                self.prior_model.model.coefficients.variances,
+                self.normalization,
+            )
+        norm = self.normalization
+
+        @jax.jit
+        def run(base_batch, total, own_score, w0):
+            import dataclasses as _dc
+
+            offsets = total - own_score
+            train_batch = _dc.replace(base_batch, offsets=offsets)
+            if norm is not None:
+                w0_n = norm.model_from_original_space(w0)
+            else:
+                w0_n = w0
+            obj = make_objective(
+                train_batch, loss, l2_weight=l2, norm=norm,
+                intercept_index=self.intercept_index, prior=prior,
+            )
+            result = minimize_fn(obj, w0_n, opt.optimizer, **extra)
+            w = result.w
+            variances = compute_variances(obj, w, self.variance_computation)
+            if norm is not None:
+                w, _ = norm.model_to_original_space(w)
+                if variances is not None:
+                    variances = norm.factors**2 * variances
+            new_score = train_batch.matvec(w)
+            return w, variances, result, new_score, offsets + new_score
+
+        return run
+
 
 @dataclass(frozen=True)
 class RandomEffectCoordinate:
@@ -401,3 +495,134 @@ class RandomEffectCoordinate:
 
     def score(self, model: RandomEffectModel) -> Array:
         return model.score(self.batch)
+
+    def visit(
+        self, total: Array, own_score: Array | None,
+        initial: GameSubModel | None = None,
+    ) -> tuple[RandomEffectModel, RandomEffectTrainingResult, Array, Array]:
+        """One descent visit as ONE compiled program (offsets → every
+        bucket solve → score → new total), the RE twin of
+        ``FixedEffectCoordinate.visit`` — the whole bucket ladder traces
+        into a single launch instead of one per bucket (VERDICT r3 weak
+        #3: E's per-visit dispatch count, not math, was the floor)."""
+        if self.mesh is not None:
+            offsets = total - own_score if own_score is not None else total
+            sub_model, tracker = self.train(offsets, initial)
+            new_score = self.score(sub_model)
+            return sub_model, tracker, new_score, offsets + new_score
+
+        _ = self._prepared  # stage bucket tensors OUTSIDE the trace
+        fn = self.__dict__.get("_visit_fn")
+        if fn is None:
+            fn = self._build_visit_fn()
+            object.__setattr__(self, "_visit_fn", fn)
+
+        W0 = None
+        if initial is not None:
+            W0 = initial.coefficients
+            if W0.shape[0] != self.num_entities:
+                raise ValueError(
+                    f"warm-start entity count {W0.shape[0]} != {self.num_entities}"
+                )
+            if self.projector is not None:
+                W0 = W0 @ self.projector.matrix
+        else:
+            W0 = jnp.zeros(
+                (self.num_entities, self._train_num_features), jnp.float32
+            )
+        if own_score is None:
+            own_score = jnp.zeros_like(total)
+        bucket_args = tuple(
+            (pb.static, pb.row_idx, pb.mask, pb.ids, pb.columns)
+            for pb in self._prepared
+        )
+        W, V, diag, new_score, new_total = fn(
+            total, own_score, W0, bucket_args, self._features(),
+            self.batch.id_tags[self.random_effect_type],
+        )
+        tracker = RandomEffectTrainingResult(
+            coefficients=W,
+            variances=V,
+            diag_refs=tuple(
+                (pb.entity_ids, f_k, it_k, reason_k)
+                for pb, (f_k, it_k, reason_k) in zip(self._prepared, diag)
+            ),
+            num_entities=self.num_entities,
+        )
+        model = RandomEffectModel(
+            coefficients=(
+                self.projector.coefficients_to_original(W)
+                if self.projector is not None else W
+            ),
+            variances=None if self.projector is not None else V,
+            random_effect_type=self.random_effect_type,
+            feature_shard_id=self.feature_shard_id,
+            task_type=self.task_type,
+        )
+        return model, tracker, new_score, new_total
+
+    def _build_visit_fn(self):
+        from photon_ml_tpu.game.random_effect import _train_prepared_core
+
+        opt = self.config
+        loss = loss_for_task(self.task_type)
+        l1 = opt.regularization.l1_weight(opt.regularization_weight)
+        l2 = opt.regularization.l2_weight(opt.regularization_weight)
+        prior_W = prior_V = None
+        if self.prior_model is not None:
+            _require_prior_l2(self.config)
+            prior_W = self.prior_model.coefficients
+            prior_V = self.prior_model.variances
+            if prior_W.shape[0] != self.num_entities:
+                raise ValueError(
+                    f"prior entity count {prior_W.shape[0]} != {self.num_entities}"
+                )
+            if self.projector is not None:
+                prior_W = prior_W @ self.projector.matrix
+                prior_V = None
+        prepared = self._prepared
+
+        @jax.jit
+        def run(total, own_score, W0, bucket_args, feats, ids):
+            import dataclasses as _dc
+
+            # rebind the device tensors through jit ARGUMENTS (closing over
+            # them would bake every bucket tensor and the feature shard
+            # into the executable as trace constants — the closure-capture
+            # accumulation bench.py isolates per-config subprocesses for);
+            # the host-side metadata (entity_ids, num_real) rides the
+            # closure, unused in the trace
+            prep = [
+                _dc.replace(pb, static=s, row_idx=ri, mask=mk, ids=bi, columns=co)
+                for pb, (s, ri, mk, bi, co) in zip(prepared, bucket_args)
+            ]
+            offsets = total - own_score
+            W, V, diag = _train_prepared_core(
+                prep,
+                offsets,
+                self._train_num_features,
+                self.num_entities,
+                loss,
+                opt.optimizer,
+                l2_weight=l2,
+                l1_weight=l1,
+                intercept_index=(
+                    None if self.projector is not None else self.intercept_index
+                ),
+                initial_coefficients=W0,
+                variance_computation=self.variance_computation,
+                norm=self.normalization,
+                prior_coefficients=prior_W,
+                prior_variances=prior_V,
+            )
+            # scoring in the TRAINING subspace: (XP)w_p == X(P w_p), so the
+            # projected-space score equals the original-space model's
+            from photon_ml_tpu.game.random_effect import random_effect_scores
+
+            in_range = (ids >= 0) & (ids < self.num_entities)
+            safe_ids = jnp.where(in_range, ids, 0)
+            raw = random_effect_scores(feats, safe_ids, W)
+            new_score = jnp.where(in_range, raw, 0.0)
+            return W, V, diag, new_score, offsets + new_score
+
+        return run

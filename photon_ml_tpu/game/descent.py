@@ -156,10 +156,21 @@ class CoordinateDescent:
             iter_validation: dict[str, EvaluationResults] = {}
             for cid in update_sequence:
                 coord = self.coordinates[cid]
-                offsets = total - scores[cid] if cid in scores else total
-                sub_model, tracker = coord.train(offsets, model.models.get(cid))
-                new_score = coord.score(sub_model)
-                total = offsets + new_score
+                visit = getattr(coord, "visit", None)
+                if visit is not None:
+                    # fused path: offsets → solve → score → total in ONE
+                    # program launch (the coordinate falls back internally
+                    # when its config needs host-side staging per visit)
+                    sub_model, tracker, new_score, total = visit(
+                        total, scores.get(cid), model.models.get(cid)
+                    )
+                else:
+                    offsets = total - scores[cid] if cid in scores else total
+                    sub_model, tracker = coord.train(
+                        offsets, model.models.get(cid)
+                    )
+                    new_score = coord.score(sub_model)
+                    total = offsets + new_score
                 scores[cid] = new_score
                 model = model.updated(cid, sub_model)
                 # bound HBM retention of lazy per-entity diagnostics: the

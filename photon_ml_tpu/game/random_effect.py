@@ -363,6 +363,58 @@ def train_prepared(
     inverts each entity's dense Hessian on device (batched ``linalg.inv``
     over the entity lane); dense features only, like the fixed effect's.
     """
+    W, V, diag = _train_prepared_core(
+        prepared,
+        offsets,
+        num_features,
+        num_entities,
+        loss,
+        config,
+        l2_weight=l2_weight,
+        l1_weight=l1_weight,
+        intercept_index=intercept_index,
+        initial_coefficients=initial_coefficients,
+        variance_computation=variance_computation,
+        mesh=mesh,
+        axis_name=axis_name,
+        norm=norm,
+        prior_coefficients=prior_coefficients,
+        prior_variances=prior_variances,
+    )
+    diag_refs = tuple(
+        (pb.entity_ids, f_k, it_k, reason_k)
+        for pb, (f_k, it_k, reason_k) in zip(prepared, diag)
+    )
+    return RandomEffectTrainingResult(
+        coefficients=W,
+        variances=V,
+        diag_refs=diag_refs,
+        num_entities=num_entities,
+    )
+
+
+def _train_prepared_core(
+    prepared: list[PreparedBucket],
+    offsets: Array,
+    num_features: int,
+    num_entities: int,
+    loss: PointwiseLoss,
+    config: OptimizerConfig,
+    l2_weight: float = 0.0,
+    l1_weight: float = 0.0,
+    intercept_index: int | None = None,
+    initial_coefficients: Array | None = None,
+    variance_computation: VarianceComputationType = VarianceComputationType.NONE,
+    mesh: Mesh | None = None,
+    axis_name: str = "data",
+    norm: Any = None,
+    prior_coefficients: Array | None = None,
+    prior_variances: Array | None = None,
+) -> tuple[Array, Array | None, list[tuple]]:
+    """Pure computational core of ``train_prepared``: jax ops only (also
+    traceable inside a caller's fused-visit jit), returning the coefficient
+    matrix, variances, and per-bucket device diagnostics WITHOUT wrapping
+    them in the (non-pytree) result object."""
     d = num_features
     compute_variance = variance_computation is not VarianceComputationType.NONE
     if norm is not None and any(pb.columns is not None for pb in prepared):
@@ -402,7 +454,7 @@ def train_prepared(
     # per-bucket diagnostics stay ON DEVICE — materialized lazily by the
     # result object on first access, so a descent visit that nobody
     # inspects costs ZERO host syncs (VERDICT weak #2)
-    diag_refs: list[tuple[np.ndarray, Array, Array, Array]] = []
+    diag: list[tuple[Array, Array, Array]] = []
 
     for pb in prepared:
         W, V, f_k, it_k, reason_k = _bucket_step(
@@ -427,7 +479,7 @@ def train_prepared(
             sharding=sharding,
             **extra,
         )
-        diag_refs.append((pb.entity_ids, f_k, it_k, reason_k))
+        diag.append((f_k, it_k, reason_k))
 
     if norm is not None:
         # back to the ORIGINAL feature space (W was held in normalized space
@@ -437,12 +489,7 @@ def train_prepared(
             # linear map u = f⊙w ⇒ variances scale by f² (diagonal approx.)
             V = norm.factors**2 * V
 
-    return RandomEffectTrainingResult(
-        coefficients=W,
-        variances=V,
-        diag_refs=tuple(diag_refs),
-        num_entities=num_entities,
-    )
+    return W, V, diag
 
 
 @partial(
