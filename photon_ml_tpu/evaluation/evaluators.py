@@ -94,9 +94,31 @@ smoothed_hinge_loss_metric = _mean_loss(losses_mod.smoothed_hinge_loss)
 # --------------------------------------------------------------------------
 # Host-side per-entity (multi) metrics — vectorized over segment boundaries
 # --------------------------------------------------------------------------
+def grouped_auc_parts(
+    scores: np.ndarray, labels: np.ndarray, group_ids: np.ndarray
+) -> tuple[float, int]:
+    """(Σ per-group AUC over valid groups, valid-group count) — the
+    summable halves of ``grouped_auc``: partials from disjoint COMPLETE
+    groups add across hosts (the multi-host streamed validation routes
+    each entity's rows to one owner, so every group is complete
+    somewhere)."""
+    s, n = _grouped_auc_impl(scores, labels, group_ids)
+    return s, n
+
+
 def grouped_auc(scores: np.ndarray, labels: np.ndarray, group_ids: np.ndarray) -> float:
     """Mean per-group AUC over groups containing both classes
     (MultiAUCEvaluator parity)."""
+    s, n = _grouped_auc_impl(scores, labels, group_ids)
+    return s / n if n else float("nan")
+
+
+def _grouped_auc_impl(
+    scores: np.ndarray, labels: np.ndarray, group_ids: np.ndarray
+) -> tuple[float, int]:
+    if len(np.asarray(scores)) == 0:
+        # a host may own zero groups of the tag; its partial is empty
+        return 0.0, 0
     scores = np.asarray(scores, np.float64)
     labels = np.asarray(labels, np.float64)
     group_ids = np.asarray(group_ids)
@@ -122,7 +144,20 @@ def grouped_auc(scores: np.ndarray, labels: np.ndarray, group_ids: np.ndarray) -
     valid = (pos_per_seg > 0) & (neg_per_seg > 0)
     u = rank_pos - pos_per_seg * (pos_per_seg + 1.0) / 2.0
     auc = np.where(valid, u / np.maximum(pos_per_seg * neg_per_seg, 1.0), np.nan)
-    return float(np.nanmean(np.where(valid, auc, np.nan))) if valid.any() else float("nan")
+    if not valid.any():
+        return 0.0, 0
+    return float(np.nansum(np.where(valid, auc, 0.0))), int(valid.sum())
+
+
+def grouped_precision_at_k_parts(
+    scores: np.ndarray, labels: np.ndarray, group_ids: np.ndarray, k: int
+) -> tuple[float, int]:
+    """(Σ per-group precision@k, group count) — summable across hosts
+    holding disjoint complete groups (see ``grouped_auc_parts``)."""
+    if len(np.asarray(scores)) == 0:
+        return 0.0, 0
+    s, n = _grouped_precision_impl(scores, labels, group_ids, k)
+    return s, n
 
 
 def grouped_precision_at_k(
@@ -131,6 +166,13 @@ def grouped_precision_at_k(
     """Mean per-group precision@k (MultiPrecisionAtKEvaluator parity):
     fraction of positives among each group's top-k scores, averaged over
     groups with ≥1 sample."""
+    s, n = _grouped_precision_impl(scores, labels, group_ids, k)
+    return s / n if n else float("nan")
+
+
+def _grouped_precision_impl(
+    scores: np.ndarray, labels: np.ndarray, group_ids: np.ndarray, k: int
+) -> tuple[float, int]:
     scores = np.asarray(scores, np.float64)
     labels = np.asarray(labels, np.float64)
     group_ids = np.asarray(group_ids)
@@ -143,7 +185,7 @@ def grouped_precision_at_k(
     topk = within_rank < k
     hits = np.add.reduceat(np.where(topk, y, 0.0), starts)
     denom = np.minimum(np.add.reduceat(np.ones_like(y), starts), k)
-    return float(np.mean(hits / denom))
+    return float(np.sum(hits / denom)), int(len(starts))
 
 
 # --------------------------------------------------------------------------

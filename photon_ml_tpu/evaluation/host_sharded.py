@@ -1,0 +1,166 @@
+"""Host-partial validation metrics for the multi-host streamed trainer.
+
+Reference parity: the reference computes every validation metric as a
+distributed Spark job over the row-partitioned validation RDD — no
+executor ever holds the global score vector (SURVEY §2.2 evaluators, §7
+"Distributed AUC at 1B rows"). Round 3's streamed trainer gathered the
+full global score vector to EVERY host per visit and ranked it on one
+device; this module replaces that with per-host PARTIALS combined by one
+small host allreduce per metric:
+
+- loss-style metrics (RMSE, LOGISTIC/POISSON/SQUARED/SMOOTHED_HINGE
+  losses): per-host (Σ w·loss, Σ w) sums.
+- AUC: the ``evaluation.scalable`` histogram recipe on host — a global
+  (lo, hi) score range (one max-allreduce), per-host positive/negative
+  bin masses, one bin-mass allreduce, Mann-Whitney over bins. Error
+  bounded by within-bin label mixing (< ~1e-4 at 2^16 bins — the same
+  contract as ``BUCKETED_AUC``); the exact-sort AUC would need the global
+  ranking no host can hold.
+- grouped metrics (MULTI_AUC, PRECISION_AT_K): per-group partial sums
+  from hosts holding COMPLETE groups (the streamed trainer routes each
+  entity's validation rows to its owner), combined as
+  (Σ group metric, group count) allreduce.
+
+Nothing here materializes an O(n_val_global) array on any host.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from photon_ml_tpu.evaluation.evaluators import (
+    EvaluationResults,
+    grouped_auc_parts,
+    grouped_precision_at_k_parts,
+    make_evaluator,
+)
+
+def _loss_values(up: str, scores: np.ndarray, labels: np.ndarray) -> np.ndarray:
+    """Per-row loss values through the SAME PointwiseLoss implementations
+    the in-memory metrics use (no numpy re-derivation to drift)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops import losses as losses_mod
+
+    if up == "RMSE":
+        return (scores - labels) ** 2
+    loss = {
+        "LOGISTIC_LOSS": losses_mod.logistic_loss,
+        "POISSON_LOSS": losses_mod.poisson_loss,
+        "SQUARED_LOSS": losses_mod.squared_loss,
+        "SMOOTHED_HINGE_LOSS": losses_mod.smoothed_hinge_loss,
+    }[up]
+    return np.asarray(
+        loss.value(
+            jnp.asarray(scores, jnp.float32), jnp.asarray(labels, jnp.float32)
+        ),
+        np.float64,
+    )
+
+
+def _hist_auc_partial(
+    scores: np.ndarray, labels: np.ndarray, weights: np.ndarray,
+    lo: float, hi: float, num_buckets: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per-host half of the histogram AUC (numpy twin of
+    ``scalable._score_histograms`` — the validation columns live on host)."""
+    inc = weights > 0
+    span = max(hi - lo, 1e-30)
+    s = np.where(inc, scores, lo)
+    bins = np.clip(
+        ((s - lo) / span * num_buckets).astype(np.int64), 0, num_buckets - 1
+    )
+    y = labels > 0
+    pos = np.bincount(bins[inc & y], minlength=num_buckets).astype(np.float64)
+    neg = np.bincount(bins[inc & ~y], minlength=num_buckets).astype(np.float64)
+    return pos, neg
+
+
+def _auc_from_hist(pos: np.ndarray, neg: np.ndarray) -> float:
+    p, n = pos.sum(), neg.sum()
+    if p <= 0 or n <= 0:
+        return float("nan")
+    neg_below = np.cumsum(neg) - neg
+    u = float(np.sum(pos * (neg_below + 0.5 * neg)))
+    return u / (p * n)
+
+
+def evaluate_host_sharded(
+    specs,
+    scores: np.ndarray,
+    labels: np.ndarray,
+    weights: np.ndarray,
+    owner_grouped: dict[str, tuple[np.ndarray, np.ndarray, np.ndarray]],
+    auc_buckets: int = 1 << 16,
+) -> EvaluationResults:
+    """Evaluate ``specs`` over row-partitioned validation columns.
+
+    ``scores``/``labels``/``weights`` are THIS host's rows. For grouped
+    specs, ``owner_grouped[tag] = (scores, labels, group_ids)`` must hold
+    complete groups (each group entirely on one host). Collective: every
+    process must call with the same specs in the same order.
+    """
+    from photon_ml_tpu.parallel.multihost import (
+        allreduce_max_host,
+        allreduce_sum_host,
+    )
+
+    metrics: dict[str, float] = {}
+    for spec in specs:
+        ev = make_evaluator(spec)
+        name = ev.name if ev.group_by is None else spec
+        up = spec.strip().upper()
+        if up in ("RMSE", "LOGISTIC_LOSS", "POISSON_LOSS", "SQUARED_LOSS",
+                  "SMOOTHED_HINGE_LOSS"):
+            inc = weights > 0
+            loss = _loss_values(
+                up, np.asarray(scores, np.float64), np.asarray(labels, np.float64)
+            )
+            part = np.asarray(
+                [float(np.sum(weights[inc] * loss[inc])),
+                 float(np.sum(weights[inc]))],
+                np.float64,
+            )
+            tot = allreduce_sum_host(part)
+            mean = tot[0] / tot[1] if tot[1] > 0 else float("nan")
+            metrics[name] = float(np.sqrt(mean)) if up == "RMSE" else float(mean)
+        elif up == "AUC" or re.fullmatch(r"BUCKETED_AUC(?:\(\d+\))?", up):
+            m = re.fullmatch(r"BUCKETED_AUC\((\d+)\)", up)
+            buckets = int(m.group(1)) if m else auc_buckets
+            inc = weights > 0
+            s_inc = scores[inc]
+            local_hi = float(s_inc.max()) if len(s_inc) else -np.inf
+            local_lo = float(s_inc.min()) if len(s_inc) else np.inf
+            hi, neg_lo = allreduce_max_host(
+                np.asarray([local_hi]), np.asarray([-local_lo])
+            )
+            lo, hi = float(-neg_lo[0]), float(hi[0])
+            pos, neg = _hist_auc_partial(
+                np.asarray(scores, np.float64),
+                np.asarray(labels, np.float64),
+                np.asarray(weights, np.float64), lo, hi, buckets,
+            )
+            pos, neg = allreduce_sum_host(pos, neg)
+            metrics[name] = _auc_from_hist(pos, neg)
+        elif ev.group_by is not None:
+            if ev.group_by not in owner_grouped:
+                raise KeyError(
+                    f"evaluator {spec}: no owner-routed validation rows for "
+                    f"id tag {ev.group_by!r} (grouped metrics on the "
+                    "multi-host streamed path need a random-effect "
+                    "coordinate of that type)"
+                )
+            s_o, y_o, g_o = owner_grouped[ev.group_by]
+            if ev.k is not None:
+                part = grouped_precision_at_k_parts(s_o, y_o, g_o, ev.k)
+            else:
+                part = grouped_auc_parts(s_o, y_o, g_o)
+            tot = allreduce_sum_host(np.asarray(part, np.float64))
+            metrics[name] = (
+                float(tot[0] / tot[1]) if tot[1] > 0 else float("nan")
+            )
+        else:  # pragma: no cover — registry and branches cover all specs
+            raise ValueError(f"unsupported sharded evaluator spec {spec!r}")
+    return EvaluationResults(metrics=metrics)

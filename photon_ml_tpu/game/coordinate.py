@@ -383,7 +383,15 @@ class RandomEffectCoordinate:
 
             if not isinstance(feats, DenseFeatures):
                 raise ValueError("random projection requires dense features")
-            return DenseFeatures(X=self.projector.project_features(feats.X))
+            # cache the projected shard: it is static across descent
+            # visits, and the fused visit path reads it every visit
+            cached = self.__dict__.get("_features_cache")
+            if cached is None:
+                cached = DenseFeatures(
+                    X=self.projector.project_features(feats.X)
+                )
+                object.__setattr__(self, "_features_cache", cached)
+            return cached
         return feats
 
     @property

