@@ -222,17 +222,39 @@ def _streamed_unsupported(config: GameTrainingConfig) -> list[str]:
     out = []
     if config.variance_computation is VarianceComputationType.FULL:
         out.append("FULL variance computation (streamed variances are SIMPLE)")
-    if config.hyperparameter_tuning_iters > 0:
-        out.append("hyperparameter tuning")
-    if config.regularization_weight_grid:
-        out.append("regularization weight grids")
     if config.incremental:
         out.append("incremental MAP priors (warm start without 'incremental' works)")
     return out
 
 
+def _config_with_optimizations(
+    config: GameTrainingConfig, configuration: dict
+) -> GameTrainingConfig:
+    """The training config with each coordinate's optimization replaced by
+    the grid/tuning entry's (the streamed twin of the estimator's
+    per-configuration coordinate rebuild)."""
+    fixed = {
+        cid: dataclasses.replace(
+            c, optimization=configuration.get(cid, c.optimization)
+        )
+        for cid, c in config.fixed_effect_coordinates.items()
+    }
+    rand = {
+        cid: dataclasses.replace(
+            c, optimization=configuration.get(cid, c.optimization)
+        )
+        for cid, c in config.random_effect_coordinates.items()
+    }
+    return dataclasses.replace(
+        config,
+        fixed_effect_coordinates=fixed,
+        random_effect_coordinates=rand,
+    )
+
+
 def _should_auto_stream(
-    train_data: list[str], config: GameTrainingConfig, logger
+    train_data: list[str], config: GameTrainingConfig, logger,
+    has_validation: bool = True,
 ) -> bool:
     """Auto-select the out-of-core path when the raw input bytes already
     exceed the CLUSTER's queried HBM budget (per-device
@@ -257,6 +279,16 @@ def _should_auto_stream(
     if total <= budget:
         return False
     unsupported = _streamed_unsupported(config)
+    if not has_validation and (
+        config.hyperparameter_tuning_iters > 0
+        or config.regularization_weight_grid
+    ):
+        # the streamed grid/tuning loop selects by validation metric; the
+        # in-memory path tolerates the absence (select_best falls back)
+        unsupported = unsupported + [
+            "regularization grids / hyperparameter tuning without "
+            "--validation-data"
+        ]
     if unsupported:
         logger.info(
             f"input bytes {total:.3g} exceed the cluster HBM budget "
@@ -390,22 +422,130 @@ def _run_streamed_game(
                     )
 
     intercepts = {sid: m.intercept_index for sid, m in index_maps.items()}
-    trainer = StreamedGameTrainer(
-        config,
-        chunk_rows=chunk_rows,
-        intercept_indices=intercepts,
-        logger=logger.info,
-        multihost=multihost,
-        checkpoint_dir=os.path.join(output_dir, "checkpoints"),
-        evaluators=tuple(config.evaluators),
-        num_entities={t: len(m) for t, m in entity_maps.items()},
+    num_entities = {t: len(m) for t, m in entity_maps.items()}
+    from photon_ml_tpu.estimators import build_configuration_grid
+    from photon_ml_tpu.evaluation import make_evaluator
+    from photon_ml_tpu.evaluation.evaluators import DEFAULT_EVALUATOR_BY_TASK
+
+    grid = build_configuration_grid(config)
+    multi_entry = len(grid) > 1 or config.hyperparameter_tuning_iters > 0
+    if multi_entry and vdata is None:
+        raise ValueError(
+            "regularization grids / hyperparameter tuning on the streamed "
+            "path select by validation metric — pass --validation-data"
+        )
+    # same evaluator fallback as the estimator: an empty evaluators tuple
+    # means the task's default metric, not "no validation"
+    specs = tuple(config.evaluators) or (
+        DEFAULT_EVALUATOR_BY_TASK[config.task_type],
     )
+    primary_ev = make_evaluator(specs[0])
+
+    # only the CURRENT BEST entry's model/trainer stay alive — a grid over
+    # the out-of-core path must not accumulate per-entry models in the
+    # host RAM the dataset already needs
+    best: dict | None = None
+    summaries: list[dict] = []
+
+    def fit_entry(configuration, tag):
+        """One full streamed descent under this grid entry's per-coordinate
+        optimization configs; per-entry checkpoint directory so the
+        fingerprint guard never thrashes between entries. Returns the
+        entry's validation primary (None without validation data)."""
+        nonlocal best
+        cfg_e = _config_with_optimizations(config, configuration)
+        ck_dir = (
+            os.path.join(output_dir, "checkpoints", tag)
+            if multi_entry else os.path.join(output_dir, "checkpoints")
+        )
+        if any(
+            c.random_projection_dim is not None
+            for c in config.random_effect_coordinates.values()
+        ):
+            # projected descent state does not round-trip the
+            # original-space checkpoint; the trainer rejects the combo
+            logger.info(
+                "random-projected coordinates: checkpoint/resume disabled "
+                "for the streamed descent"
+            )
+            ck_dir = None
+        trainer = StreamedGameTrainer(
+            cfg_e,
+            chunk_rows=chunk_rows,
+            intercept_indices=intercepts,
+            logger=logger.info,
+            multihost=multihost,
+            checkpoint_dir=ck_dir,
+            evaluators=specs if vdata is not None else (),
+            num_entities=num_entities,
+        )
+        m, inf = trainer.fit(
+            data, validation=vdata, initial_model=initial_model
+        )
+        primary = None
+        if trainer.validation_history:
+            (_, last_res), = trainer.validation_history[-1].items()
+            primary = last_res.primary
+        summaries.append({"configuration": configuration, "primary": primary})
+        entry = {
+            "model": m, "info": inf, "trainer": trainer,
+            "configuration": configuration, "primary": primary,
+            "index": len(summaries) - 1,
+        }
+        if best is None or (
+            primary is not None
+            and (
+                best["primary"] is None
+                or primary_ev.better(primary, best["primary"])
+            )
+        ):
+            best = entry  # the previous best's model/trainer drop here
+        return primary
+
     with timed(logger, "streamed coordinate descent"), profile_trace(
         profile_dir, "streamed-game"
     ):
-        model, info = trainer.fit(
-            data, validation=vdata, initial_model=initial_model
+        for i, configuration in enumerate(grid):
+            fit_entry(configuration, f"grid-{i:04d}")
+        if config.hyperparameter_tuning_iters > 0:
+            from photon_ml_tpu.hyperparameter.tuning import gp_tune_weights
+
+            cids = list(config.coordinate_update_sequence)
+            prior = [
+                (
+                    {
+                        cid: s["configuration"][cid].regularization_weight
+                        for cid in cids
+                    },
+                    s["primary"],
+                )
+                for s in summaries
+                if s["primary"] is not None
+            ]
+
+            def evaluate(weights, it):
+                configuration = {
+                    cid: dataclasses.replace(
+                        config.coordinate_config(cid).optimization,
+                        regularization_weight=weights[cid],
+                    )
+                    for cid in cids
+                }
+                return fit_entry(configuration, f"tune-{it:04d}")
+
+            with timed(logger, "streamed hyperparameter tuning"):
+                gp_tune_weights(
+                    cids, prior, config.hyperparameter_tuning_iters,
+                    evaluate, primary_ev.larger_is_better,
+                )
+
+    if multi_entry:
+        logger.info(
+            "selected streamed configuration: "
+            f"{ {c: o.regularization_weight for c, o in best['configuration'].items()} } "
+            f"(primary {best['primary']})"
         )
+    model, info, trainer = best["model"], best["info"], best["trainer"]
 
     if is_output_process():
         with timed(logger, "write models"):
@@ -464,6 +604,18 @@ def _run_streamed_game(
                     for entry in trainer.validation_history
                 ],
             }
+            if multi_entry:
+                metrics["results"] = [
+                    {
+                        "configuration": {
+                            cid: opt.to_dict()
+                            for cid, opt in s["configuration"].items()
+                        },
+                        "primary": s["primary"],
+                    }
+                    for s in summaries
+                ]
+                metrics["best_index"] = best["index"]
             with open(metrics_path, "w") as f:
                 json.dump(metrics, f, indent=2)
         else:
@@ -636,7 +788,10 @@ def main(argv: list[str] | None = None) -> None:
     if (
         args.streaming_chunk_rows is None
         and not args.no_auto_streaming
-        and _should_auto_stream(train_data, config, logger)
+        and _should_auto_stream(
+            train_data, config, logger,
+            has_validation=bool(validation_data),
+        )
     ):
         args.streaming_chunk_rows = 1 << 20
     if args.multihost and args.streaming_chunk_rows is None:

@@ -48,10 +48,12 @@ Parity features the in-memory descent has and this trainer matches:
   convergence, aggregated — never fabricated).
 
 Normalization contexts (per-shard, from a streamed summary), SIMPLE
-variance computation, and fixed-effect down-sampling are supported at
-full parity with the in-memory path. Scope (documented limits, not
-silent ones): no projection, no FULL variances — these remain
-in-memory-path features; unsupported configs raise at construction.
+variance computation, fixed-effect down-sampling, and shared random
+projection are supported at parity with the in-memory path. Scope
+(documented limits, not silent ones): no per-entity subspace projection,
+no FULL variances, and no checkpointing of projected coordinates — these
+remain in-memory-path features; unsupported configs raise at
+construction.
 """
 
 from __future__ import annotations
@@ -334,16 +336,33 @@ class StreamedGameTrainer:
         # streamed feature summary (reference computes these on its only,
         # distributed path — SURVEY §2.2 normalization row)
         self._norm_contexts: dict[str, Any] = {}
+        has_projection = any(
+            c.random_projection_dim is not None
+            for c in config.random_effect_coordinates.values()
+        )
+        if has_projection and checkpoint_dir is not None:
+            raise NotImplementedError(
+                "streamed GAME checkpointing is not supported with "
+                "random-projected coordinates: checkpoints store the "
+                "ORIGINAL-space model, and re-projecting it only "
+                "approximates the projected descent state (P^T P != I); "
+                "run projected configs without checkpoint_dir"
+            )
+        if has_projection and config.normalization is not NormalizationType.NONE:
+            raise NotImplementedError(
+                "normalization is not supported together with random "
+                "projection (the projected columns have no per-feature "
+                "stats) — same contract as the in-memory coordinate"
+            )
         for cid, c in config.random_effect_coordinates.items():
-            if c.random_projection_dim is not None:
-                raise NotImplementedError(
-                    f"coordinate {cid}: random projection is in-memory only"
-                )
             if c.features_to_samples_ratio_upper_bound is not None:
                 raise NotImplementedError(
                     f"coordinate {cid}: per-entity subspace projection is "
                     "in-memory only"
                 )
+        # shared random projectors, built lazily per coordinate (seed 0,
+        # like the estimator's default — deterministic on every host)
+        self._projectors: dict[str, Any] = {}
     # -- multi-host entity exchange (the ingest-time shuffle) ---------------
 
     def _global_layout(self, n_local: int) -> tuple[int, int, tuple[int, ...]]:
@@ -498,6 +517,25 @@ class StreamedGameTrainer:
         ent_g, labels, weights, feats_o, grow = self._exchange_to_owners(
             cid, data, grow_in, feats, ids
         )
+        if c.random_projection_dim is not None:
+            # shared random projection (reference: ProjectionMatrix):
+            # project the OWNER rows once at ingest; solves/scoring run in
+            # the projected space, and the assembled model maps back
+            # exactly ((XP) w_p = X (P w_p))
+            from photon_ml_tpu.game.projector import RandomProjector
+
+            if not isinstance(feats_o, DenseFeatures):
+                raise ValueError("random projection requires dense features")
+            proj = self._projectors.get(cid)
+            if proj is None:
+                proj = RandomProjector.build(
+                    feats_o.num_features, c.random_projection_dim, seed=0
+                )
+                self._projectors[cid] = proj
+            feats_o = DenseFeatures(
+                X=np.asarray(feats_o.X, np.float32)
+                @ np.asarray(proj.matrix, np.float32)
+            )
         ent_local = (ent_g // P).astype(np.int64) if P > 1 else ent_g
         E_local = (E - pid + P - 1) // P if P > 1 else E
         grouping = group_by_entity(
@@ -1432,8 +1470,13 @@ class StreamedGameTrainer:
                 None if V_local is None
                 else self._full_re_matrix(V_local, model_state["re_E"][cid])
             )
+            W_out = jnp.asarray(W_full)
+            if cid in self._projectors:
+                # back to the ORIGINAL feature space, score-exactly
+                W_out = self._projectors[cid].coefficients_to_original(W_out)
+                V_full = None
             models[cid] = RandomEffectModel(
-                coefficients=jnp.asarray(W_full),
+                coefficients=W_out,
                 variances=None if V_full is None else jnp.asarray(V_full),
                 random_effect_type=c.random_effect_type,
                 feature_shard_id=c.feature_shard_id,
@@ -1482,6 +1525,7 @@ class StreamedGameTrainer:
         self._norm_contexts = self._normalization_contexts(data)
         self._fixed_objectives = {}
         self._down_sample_cache = {}
+        self._projectors = {}
 
         # entity layouts + the multi-host owner exchange, once (the shuffle)
         re_shards: dict[str, _ReShard] = {}
@@ -1503,8 +1547,9 @@ class StreamedGameTrainer:
             shard_dims[cid] = d
             fixed_w[cid] = np.zeros(d, np.float32)
         for cid, c in cfg.random_effect_coordinates.items():
-            d = data.feature_container(c.feature_shard_id).num_features
             shard = re_shards[cid]
+            # the SOLVE-space width: the shard's (possibly projected) rows
+            d = shard.features.num_features
             ids = np.asarray(data.id_tags[c.random_effect_type], np.int64)
             re_E[cid] = self._global_num_entities(ids, c.random_effect_type)
             re_W[cid] = np.zeros((shard.num_entities_local, d), np.float32)
@@ -1513,7 +1558,13 @@ class StreamedGameTrainer:
         )
         fixed_var: dict[str, np.ndarray | None] = {c_: None for c_ in fixed_w}
         re_V: dict[str, np.ndarray | None] = {
-            c_: (np.zeros_like(re_W[c_]) if want_var else None) for c_ in re_W
+            # diagonal variances do not survive the projection map-back —
+            # projected coordinates report None (in-memory contract)
+            c_: (
+                np.zeros_like(re_W[c_])
+                if want_var and c_ not in self._projectors else None
+            )
+            for c_ in re_W
         }
 
         warm = initial_model is not None
@@ -1534,6 +1585,13 @@ class StreamedGameTrainer:
                             f"warm-start coordinate {cid}: {W_full.shape[0]} "
                             f"entities < current {re_E[cid]} — pad new "
                             f"entities with zero rows before fit"
+                        )
+                    if cid in self._projectors:
+                        # warm start arrives in ORIGINAL space; descent
+                        # runs projected (P is near-orthogonal, the
+                        # standard JL warm-start map — in-memory contract)
+                        W_full = W_full @ np.asarray(
+                            self._projectors[cid].matrix, np.float32
                         )
                     re_W[cid] = (
                         W_full[pid::P][: re_W[cid].shape[0]].copy()
@@ -1696,7 +1754,8 @@ class StreamedGameTrainer:
                     offs_re = self._offsets_to_owners(shard, offs, row_base)
                     loss_sum, max_it, conv = self._solve_re_buckets(
                         shard, offs_re, c.optimization, re_W[cid],
-                        self.intercept_indices.get(c.feature_shard_id),
+                        None if cid in self._projectors
+                        else self.intercept_indices.get(c.feature_shard_id),
                         norm=self._norm_contexts.get(c.feature_shard_id),
                         V=re_V[cid],
                     )

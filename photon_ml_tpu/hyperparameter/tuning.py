@@ -25,6 +25,38 @@ from photon_ml_tpu.hyperparameter.search import GaussianProcessSearch, SearchRan
 _DEFAULT_RANGE = SearchRange(lo=1e-4, hi=1e4, log_scale=True)
 
 
+def gp_tune_weights(
+    cids: Sequence[str],
+    prior: Sequence[tuple[dict, float]],
+    num_iterations: int,
+    evaluate,
+    larger_is_better: bool,
+    seed: int = 0,
+) -> None:
+    """The GP→EI→refit loop over per-coordinate regularization weights,
+    decoupled from the data path: ``prior`` holds (weights-by-cid, primary
+    metric) observations; ``evaluate(weights_by_cid, iteration) -> primary``
+    performs one full refit. Shared by the in-memory estimator loop and
+    the out-of-core streamed driver (same search, same range, same
+    observation algebra)."""
+    sign = -1.0 if larger_is_better else 1.0  # search minimizes
+    search = GaussianProcessSearch(
+        ranges=[_DEFAULT_RANGE] * len(cids), seed=seed, num_init=0
+    )
+    for weights, y in prior:
+        x = np.array(
+            [
+                np.clip(weights[cid], _DEFAULT_RANGE.lo, _DEFAULT_RANGE.hi)
+                for cid in cids
+            ]
+        )
+        search.observe(x, sign * y)
+    for it in range(num_iterations):
+        x = search.suggest()
+        y = evaluate({cid: float(x[i]) for i, cid in enumerate(cids)}, it)
+        search.observe(x, sign * y)
+
+
 def tune_game_hyperparameters(
     estimator: GameEstimator,
     batch: GameBatch,
@@ -39,37 +71,36 @@ def tune_game_hyperparameters(
     cids = list(cfg.coordinate_update_sequence)
     specs = estimator._evaluator_specs()
     primary = make_evaluator(specs[0])
-    sign = -1.0 if primary.larger_is_better else 1.0  # search minimizes
 
-    search = GaussianProcessSearch(
-        ranges=[_DEFAULT_RANGE] * len(cids), seed=seed, num_init=0
-    )
-    for r in prior_results:
-        if r.evaluation is None:
-            continue
-        x = np.array(
-            [
-                np.clip(
-                    r.configuration[cid].regularization_weight,
-                    _DEFAULT_RANGE.lo,
-                    _DEFAULT_RANGE.hi,
-                )
+    prior = [
+        (
+            {
+                cid: r.configuration[cid].regularization_weight
                 for cid in cids
-            ]
+            },
+            r.evaluation.primary,
         )
-        search.observe(x, sign * r.evaluation.primary)
-
+        for r in prior_results
+        if r.evaluation is not None
+    ]
     results: list[GameResult] = []
-    for _ in range(num_iterations):
-        x = search.suggest()
+
+    def evaluate(weights: dict, _it: int) -> float:
         configuration = {
             cid: dataclasses.replace(
                 cfg.coordinate_config(cid).optimization,
-                regularization_weight=float(x[i]),
+                regularization_weight=weights[cid],
             )
-            for i, cid in enumerate(cids)
+            for cid in cids
         }
-        fit = estimator.fit(batch, validation_batch, configurations=[configuration])[0]
-        search.observe(x, sign * fit.evaluation.primary)
+        fit = estimator.fit(
+            batch, validation_batch, configurations=[configuration]
+        )[0]
         results.append(fit)
+        return fit.evaluation.primary
+
+    gp_tune_weights(
+        cids, prior, num_iterations, evaluate, primary.larger_is_better,
+        seed=seed,
+    )
     return results
