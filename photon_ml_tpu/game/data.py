@@ -373,11 +373,17 @@ def gather_bucket(
     offsets: np.ndarray,
     weights: np.ndarray,
     row_indices: np.ndarray,
+    columns: np.ndarray | None = None,
 ) -> Batch:
     """Materialize one bucket's (k, C, …) batched Batch from host columns.
 
     Padded slots (row index -1) get weight 0 — inert in the objective
-    (`GLMObjective._weighted` forces their loss/grad contributions to 0).
+    (`GLMObjective._weighted` forces their loss/grad contributions to 0) —
+    and ZEROED features (everything that reads the raw feature values,
+    e.g. per-entity column-frequency counts, must not see a phantom copy
+    of row 0). ``columns`` (subspace projection: per-entity (k, p) column
+    maps) gathers the dense features to width p ON HOST, before the
+    device upload pays for the full width.
     """
     idx = np.maximum(row_indices, 0)
     mask = (row_indices >= 0).astype(np.float32)
@@ -385,13 +391,17 @@ def gather_bucket(
     off = np.asarray(offsets)[idx] * mask
     wgt = np.asarray(weights)[idx] * mask
     if isinstance(features, DenseFeatures):
-        X = np.asarray(features.X)[idx]  # (k, C, d)
+        X = np.asarray(features.X)[idx] * mask[:, :, None]  # (k, C, d)
+        if columns is not None:
+            X = np.take_along_axis(X, columns[:, None, :], axis=2)
         return DenseBatch(
             X=jnp.asarray(X),
             labels=jnp.asarray(lab),
             offsets=jnp.asarray(off),
             weights=jnp.asarray(wgt),
         )
+    if columns is not None:
+        raise ValueError("subspace column maps require dense features")
     ind = np.asarray(features.indices)[idx]  # (k, C, nnz)
     val = np.asarray(features.values)[idx] * mask[..., None]
     return SparseBatch(

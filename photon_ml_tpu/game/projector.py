@@ -32,6 +32,29 @@ import numpy as np
 Array = jnp.ndarray
 
 
+def subspace_columns(
+    X: np.ndarray,  # (k, C, d) host bucket features (zeroed padded slots)
+    ratio: float,
+    intercept_index: int | None,
+) -> np.ndarray | None:
+    """Per-entity subspace column maps for one bucket, shared by the
+    in-memory ``prepare_buckets`` and the streamed trainer (one copy of
+    the p formula + intercept convention): p = min(d, ceil(ratio · C));
+    returns None when that keeps full width. Columns sort ascending, so a
+    (required-last-column) intercept lands at slot p-1."""
+    d = X.shape[-1]
+    capacity = X.shape[1]
+    p = min(d, max(1, int(np.ceil(ratio * capacity))))
+    if p >= d:
+        return None
+    if intercept_index is not None and intercept_index != d - 1:
+        raise ValueError(
+            "subspace projection requires the intercept at the last "
+            "column (framework convention)"
+        )
+    return entity_top_columns(X, p, always_include=intercept_index)
+
+
 def entity_top_columns(
     X: np.ndarray,  # (k, C, d) bucket features (zero-padded slots)
     p: int,

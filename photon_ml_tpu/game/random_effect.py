@@ -164,7 +164,7 @@ def prepare_buckets(
     p = min(d, ceil(ratio · capacity)) over each entity's most-frequent
     columns. Dense features only (sparse rows are already width-bounded).
     """
-    from photon_ml_tpu.game.projector import entity_top_columns
+    from photon_ml_tpu.game.projector import subspace_columns
 
     n_dev = mesh.shape[axis_name] if mesh is not None else 1
     zeros_off = np.zeros_like(np.asarray(labels))
@@ -179,18 +179,11 @@ def prepare_buckets(
             features_to_samples_ratio is not None
             and isinstance(static, DenseBatch)
         ):
-            d = static.X.shape[-1]
-            capacity = static.X.shape[1]
-            p = min(d, max(1, int(np.ceil(features_to_samples_ratio * capacity))))
-            if p < d:
-                if intercept_index is not None and intercept_index != d - 1:
-                    raise ValueError(
-                        "subspace projection requires the intercept at the "
-                        "last column (framework convention)"
-                    )
-                cols = entity_top_columns(
-                    np.asarray(static.X), p, always_include=intercept_index
-                )  # (k, p) sorted ascending → intercept (=d-1) lands at p-1
+            cols = subspace_columns(
+                np.asarray(static.X), features_to_samples_ratio,
+                intercept_index,
+            )  # (k, p) sorted ascending → intercept (=d-1) lands at p-1
+            if cols is not None:
                 Xp = np.take_along_axis(
                     np.asarray(static.X), cols[:, None, :], axis=2
                 )  # (k, C, p)

@@ -24,16 +24,18 @@ Per coordinate:
   analog).
 - Random effects: entities are partitioned across processes by
   ``entity_id % process_count``; each host receives its OWNED entities'
-  rows through a chunk-wise host exchange at setup
-  (``parallel.multihost.allgather_row_chunks`` — the ingest-time
-  replacement for the reference's group-by-entity shuffle, peak memory
-  O(processes · chunk); this setup shuffle is the ONLY O(P·n)-traffic
-  step), groups/buckets them locally, and solves buckets with the same
-  vmap-batched device kernel the in-memory path uses
-  (``random_effect._solve_bucket``). Per VISIT, residual offsets flow
-  owner-ward and scores flow back origin-ward POINT-TO-POINT
-  (``parallel.multihost.exchange_rows`` all-to-all: O(n_local) traffic
-  per host per visit, like the reference's per-iteration Spark exchange).
+  rows through chunked POINT-TO-POINT all-to-all rounds at setup
+  (``parallel.multihost.exchange_rows`` — the ingest-time replacement
+  for the reference's group-by-entity Spark shuffle: peak memory
+  O(processes · chunk), O(n_local) traffic per host), groups/buckets
+  them locally, and solves buckets with the same vmap-batched device
+  kernel the in-memory path uses (``random_effect._solve_bucket``). Per
+  VISIT, residual offsets flow owner-ward and scores flow back
+  origin-ward through the same point-to-point exchange (like the
+  reference's per-iteration Spark exchange — NO step of this trainer
+  broadcasts the dataset; only gathered-mode checkpoints and the tiny
+  per-metric validation partials use collectives over more than
+  O(n_local) rows... the former is opt-in, the latter O(bins)).
   The bucket loop is DOUBLE-BUFFERED: bucket ``i+1``'s host gather
   and transfer overlap bucket ``i``'s device solve (async dispatch; the
   result readback happens one bucket late).
@@ -48,11 +50,11 @@ Parity features the in-memory descent has and this trainer matches:
   convergence, aggregated — never fabricated).
 
 Normalization contexts (per-shard, from a streamed summary), SIMPLE
-variance computation, fixed-effect down-sampling, and shared random
-projection are supported at parity with the in-memory path. Scope
-(documented limits, not silent ones): no per-entity subspace projection,
-no FULL variances, and no checkpointing of projected coordinates — these
-remain in-memory-path features; unsupported configs raise at
+variance computation, fixed-effect down-sampling, shared random
+projection, and per-entity subspace projection are supported at parity
+with the in-memory path. Scope (documented limits, not silent ones): no
+FULL variances, no normalization × projection, and no checkpointing of
+RANDOM-projected coordinates — unsupported configs raise at
 construction.
 """
 
@@ -237,6 +239,9 @@ class _ReShard:
     origin_dest: np.ndarray | None = None  # (n_kept,) int64 owner process
     # owner side — each owned row's ORIGIN process (from the row layout)
     owner_dest: np.ndarray | None = None  # (m,) int64
+    # per-bucket per-entity subspace column maps ((k, p) int arrays, or
+    # None entries for full-width buckets), computed ONCE at ingest
+    subspace_cols: tuple | None = None
 
 
 class StreamedGameTrainer:
@@ -354,12 +359,17 @@ class StreamedGameTrainer:
                 "projection (the projected columns have no per-feature "
                 "stats) — same contract as the in-memory coordinate"
             )
-        for cid, c in config.random_effect_coordinates.items():
-            if c.features_to_samples_ratio_upper_bound is not None:
-                raise NotImplementedError(
-                    f"coordinate {cid}: per-entity subspace projection is "
-                    "in-memory only"
-                )
+        has_subspace = any(
+            c.features_to_samples_ratio_upper_bound is not None
+            for c in config.random_effect_coordinates.values()
+        )
+        if has_subspace and config.normalization is not NormalizationType.NONE:
+            raise NotImplementedError(
+                "normalization is not supported together with per-entity "
+                "subspace projection (per-entity column maps would need "
+                "per-entity normalization slices) — same contract as the "
+                "in-memory coordinate"
+            )
         # shared random projectors, built lazily per coordinate (seed 0,
         # like the estimator's default — deterministic on every host)
         self._projectors: dict[str, Any] = {}
@@ -412,13 +422,18 @@ class StreamedGameTrainer:
         grow: np.ndarray,
         feats: Features,
         ids: np.ndarray,
+        row_layout: tuple[int, ...] = (),
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray, Features, np.ndarray]:
         """Route every row of this coordinate to its entity's owner process
-        (owner = ``entity_id % P``). ``grow`` carries each row's GLOBAL row
-        id (callers may pass a filtered subset's original ids). Returns the
-        OWNED rows' (global entity ids, labels, weights, features, global
-        row ids). Single-process: identity, no copies beyond the container
-        wrap."""
+        (owner = ``entity_id % P``) in chunked POINT-TO-POINT rounds: each
+        round exchanges one ``chunk_rows`` slice through the all-to-all
+        (peak memory O(P·chunk) like the old broadcast rounds, but
+        O(n_local) total traffic per host instead of O(P·n) — with this,
+        NO step of the streamed trainer broadcasts the dataset).
+        ``grow`` carries each row's GLOBAL row id (callers may pass a
+        filtered subset's original ids). Returns the OWNED rows' (global
+        entity ids, labels, weights, features, global row ids).
+        Single-process: identity, no copies beyond the container wrap."""
         n = data.num_rows
         weights = (
             np.ones(n, np.float32) if data.weights is None
@@ -427,7 +442,7 @@ class StreamedGameTrainer:
         labels = np.asarray(data.labels, np.float32)
         if not self._distributed():
             return ids, labels, weights, feats, grow
-        from photon_ml_tpu.parallel.multihost import allgather_row_chunks
+        from photon_ml_tpu.parallel.multihost import exchange_rows
 
         pid, P = _num_processes()
         arrays: dict[str, np.ndarray] = {
@@ -436,23 +451,29 @@ class StreamedGameTrainer:
             "weight": weights,
             "grow": grow,
         }
-        # pass the feature arrays DIRECTLY: the exchange only slices
-        # [lo:hi] views per round; fancy-indexing a full-range copy here
-        # would transiently hold the whole shard twice
+        # pass the feature arrays DIRECTLY: the rounds only slice [lo:hi]
+        # views; fancy-indexing a full-range copy here would transiently
+        # hold the whole shard twice
         if isinstance(feats, DenseFeatures):
             arrays["X"] = np.asarray(feats.X)
         else:
             arrays["indices"] = np.asarray(feats.indices)
             arrays["values"] = np.asarray(feats.values)
+        n_rows = len(arrays["ent"])
+        # every process must run the SAME number of collective rounds:
+        # size by the largest host's row count (exhausted hosts send
+        # empty buckets)
+        max_rows = max(row_layout) if row_layout else n_rows
+        n_rounds = max(-(-max_rows // self.chunk_rows), 1)
         keep: dict[str, list[np.ndarray]] = {k: [] for k in arrays}
-        for rnd in allgather_row_chunks(
-            arrays, self.chunk_rows, pad_values={"ent": -1}
-        ):
-            ent = rnd["ent"].reshape(-1)  # (P*c,)
-            mask = (ent >= 0) & (ent % P == pid)
-            for k, v in rnd.items():
-                flat = v.reshape((-1,) + v.shape[2:])
-                keep[k].append(flat[mask])
+        for r in range(n_rounds):
+            lo = min(r * self.chunk_rows, n_rows)
+            hi = min(lo + self.chunk_rows, n_rows)
+            sub = {k: v[lo:hi] for k, v in arrays.items()}
+            dest = (sub["ent"] % P).astype(np.int64)
+            recv = exchange_rows(sub, dest)
+            for k, v in recv.items():
+                keep[k].append(v)
         merged = {k: np.concatenate(v) if v else np.zeros((0,)) for k, v in keep.items()}
         if isinstance(feats, DenseFeatures):
             out_f: Features = DenseFeatures(X=merged["X"])
@@ -515,7 +536,7 @@ class StreamedGameTrainer:
         if not self._distributed():
             P, pid = 1, 0
         ent_g, labels, weights, feats_o, grow = self._exchange_to_owners(
-            cid, data, grow_in, feats, ids
+            cid, data, grow_in, feats, ids, row_layout
         )
         if c.random_projection_dim is not None:
             # shared random projection (reference: ProjectionMatrix):
@@ -559,6 +580,31 @@ class StreamedGameTrainer:
         owner_dest = (
             np.searchsorted(row_starts, grow, side="right") - 1
         ).astype(np.int64)
+        subspace_cols = None
+        if (
+            c.features_to_samples_ratio_upper_bound is not None
+            and isinstance(feats_o, DenseFeatures)
+        ):
+            # per-entity subspace column maps, once per shard: computable
+            # host-side from the owner rows (every entity's rows live
+            # wholly at its owner) — per-visit bucket gathers then upload
+            # only width-p features
+            from photon_ml_tpu.game.projector import subspace_columns
+
+            Xh = np.asarray(feats_o.X)
+            intercept = self.intercept_indices.get(c.feature_shard_id)
+            cols_list = []
+            for rows in buckets.row_indices:
+                idx = np.maximum(rows, 0)
+                mask = (rows >= 0).astype(np.float32)
+                Xb = Xh[idx] * mask[:, :, None]
+                cols_list.append(
+                    subspace_columns(
+                        Xb, c.features_to_samples_ratio_upper_bound,
+                        intercept,
+                    )
+                )
+            subspace_cols = tuple(cols_list)
         return _ReShard(
             ent_local=ent_local,
             labels=labels,
@@ -573,6 +619,7 @@ class StreamedGameTrainer:
             origin_grow=grow_in,
             origin_dest=(ids % max(P, 1)).astype(np.int64),
             owner_dest=owner_dest,
+            subspace_cols=subspace_cols,
         )
 
     def _offsets_to_owners(
@@ -828,8 +875,16 @@ class StreamedGameTrainer:
         solve of bucket ``i`` (async dispatch). ``W``/``V`` stay in
         ORIGINAL feature space; ``norm`` maps per bucket at the solve
         boundary (entities partition across buckets, so per-bucket mapping
-        equals the in-memory path's whole-matrix mapping). Returns honest
-        aggregates (loss sum, max iterations, all converged)."""
+        equals the in-memory path's whole-matrix mapping).
+        ``shard.subspace_cols`` activates per-entity subspace projection
+        (IndexMapProjection parity): each bucket solves at width
+        p = ceil(ratio · capacity) over each entity's most-frequent
+        columns (computed once at ingest —
+        every entity's rows live wholly at its owner); the bucket gather
+        uploads only width-p features, and solved rows scatter back to
+        full width with unselected columns ZERO — matching the in-memory
+        scatter into a fresh matrix. Returns honest aggregates (loss sum,
+        max iterations, all converged)."""
         loss = loss_for_task(self.config.task_type)
         l1 = opt.regularization.l1_weight(opt.regularization_weight)
         l2 = jnp.asarray(
@@ -846,27 +901,51 @@ class StreamedGameTrainer:
         any_entities = False
         pending: tuple[np.ndarray, tuple] | None = None
 
-        def collect(ent_ids, out):
+        def collect(ent_ids, cols, out):
             nonlocal loss_sum, max_iters, all_converged
             w_b, f_b, it_b, reason_b, var_b = out
             if norm is not None:
                 w_b = jax.vmap(lambda w: norm.model_to_original_space(w)[0])(w_b)
                 var_b = norm.factors**2 * var_b
-            W[ent_ids] = np.asarray(w_b, np.float32)
-            if V is not None:
-                V[ent_ids] = np.asarray(var_b, np.float32)
+            if cols is not None:
+                # scatter the width-p solution back to full width
+                full = np.zeros((len(ent_ids), W.shape[1]), np.float32)
+                np.put_along_axis(full, cols, np.asarray(w_b, np.float32), axis=1)
+                W[ent_ids] = full
+                if V is not None:
+                    vfull = np.zeros_like(full)
+                    np.put_along_axis(
+                        vfull, cols, np.asarray(var_b, np.float32), axis=1
+                    )
+                    V[ent_ids] = vfull
+            else:
+                W[ent_ids] = np.asarray(w_b, np.float32)
+                if V is not None:
+                    V[ent_ids] = np.asarray(var_b, np.float32)
             loss_sum += float(jnp.sum(f_b))
             max_iters = max(max_iters, int(jnp.max(it_b)))
             # reason 0 == MAX_ITERATIONS (not converged)
             all_converged = all_converged and bool(jnp.all(reason_b != 0))
 
         buckets = shard.buckets
-        for ent_ids, rows in zip(buckets.entity_ids, buckets.row_indices):
+        sub_cols = shard.subspace_cols or (None,) * len(buckets.entity_ids)
+        for ent_ids, rows, cols in zip(
+            buckets.entity_ids, buckets.row_indices, sub_cols
+        ):
             any_entities = True
             bucket = gather_bucket(
-                shard.features, shard.labels, offs_re, shard.weights, rows
+                shard.features, shard.labels, offs_re, shard.weights, rows,
+                columns=cols,
             )
-            w0 = jnp.asarray(W[ent_ids], jnp.float32)
+            b_intercept = intercept_index
+            if cols is not None and intercept_index is not None:
+                # intercept (always the last full-space column) lands at
+                # the last subspace slot
+                b_intercept = cols.shape[1] - 1
+            w0_rows = W[ent_ids]
+            if cols is not None:
+                w0_rows = np.take_along_axis(w0_rows, cols, axis=1)
+            w0 = jnp.asarray(w0_rows, jnp.float32)
             if norm is not None:
                 w0 = jax.vmap(norm.model_from_original_space)(w0)
             out = _solve_bucket(
@@ -879,13 +958,13 @@ class StreamedGameTrainer:
                 minimize_fn=minimize_fn,
                 loss=loss,
                 config=opt.optimizer,
-                intercept_index=intercept_index,
+                intercept_index=b_intercept,
                 variance_computation=variance_computation,
                 **extra,
             )
             if pending is not None:
                 collect(*pending)  # blocks on the PREVIOUS bucket only
-            pending = (ent_ids, out)
+            pending = (ent_ids, cols, out)
         if pending is not None:
             collect(*pending)
         if not any_entities:

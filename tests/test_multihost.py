@@ -685,8 +685,9 @@ _TRAFFIC_WORKER = textwrap.dedent(
     trainer = StreamedGameTrainer(cfg, chunk_rows=64, multihost=True)
     model, info = trainer.fit(data)
 
-    # 2 descent iterations x (1 offsets exchange + 1 scores exchange)
-    assert len(calls) == 4, calls
+    # ingest: ceil(200/64) = 4 point-to-point rounds (the entity shuffle
+    # is p2p now too); then 2 descent iterations x (offsets + scores)
+    assert len(calls) == 4 + 4, calls
     for c in calls:
         # O(owned rows): offsets exchanges send exactly this host's rows;
         # score exchanges send its owned rows (n_global/P up to entity
