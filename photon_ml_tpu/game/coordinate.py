@@ -231,25 +231,21 @@ class FixedEffectCoordinate:
             return opt.matvec(model.model.coefficients.means)
         return model.score(self.batch)
 
-    def visit(
-        self, total: Array, own_score: Array | None,
-        initial: GameSubModel | None = None,
-    ) -> tuple[FixedEffectModel, OptimizationResult, Array, Array]:
-        """One descent visit as ONE compiled program: residual offsets →
-        solve → score → new running total. Returns (sub-model, tracker,
-        new own score, new total). On dispatch-latency-dominated platforms
-        (remote-attached chips) the unfused visit's 4-6 small program
-        launches were the wall-clock floor of every GAME config (VERDICT
-        r3 weak #3); the fused form launches once. ``own_score=None``
-        means this coordinate has not scored yet (cold start)."""
+    def _fused_visit_parts(self):
+        """(make_static, apply, postprocess) for fused execution, or None
+        when this coordinate needs host-side staging per visit.
+
+        ``make_static(initial)`` builds the non-flowing jit arguments;
+        ``apply(static, total, own_score)`` runs the visit INSIDE a trace
+        and returns (aux, new_score, new_total); ``postprocess(aux)``
+        rebuilds (sub-model, tracker) on host. ``visit`` composes these
+        for a single-coordinate launch; ``descent._build_fused_outer``
+        chains every coordinate's ``apply`` into ONE program per outer
+        iteration."""
         if self.mesh is not None or self.train_rows is not None:
             # sharded solves stage host-side; down-sampling changes row
             # sets per config — both keep the unfused path
-            offsets = total - own_score if own_score is not None else total
-            sub_model, tracker = self.train(offsets, initial)
-            new_score = self.score(sub_model)
-            return sub_model, tracker, new_score, offsets + new_score
-
+            return None
         base = self.__dict__.get("_visit_base")
         if base is None:
             # materialize the layout cache + the offset-free base batch
@@ -261,22 +257,57 @@ class FixedEffectCoordinate:
             object.__setattr__(self, "_visit_fn", self._build_visit_fn())
         fn = self.__dict__["_visit_fn"]
 
-        w0 = (
-            jnp.asarray(initial.model.coefficients.means, jnp.float32)
-            if initial is not None
-            else jnp.zeros((base.num_features,), jnp.float32)
-        )
+        def make_static(initial):
+            w0 = (
+                jnp.asarray(initial.model.coefficients.means, jnp.float32)
+                if initial is not None
+                else jnp.zeros((base.num_features,), jnp.float32)
+            )
+            return (base, w0)
+
+        def apply(static, total, own_score):
+            b, w0 = static
+            w, variances, tracker, new_score, new_total = fn(
+                b, total, own_score, w0
+            )
+            return (w, variances, tracker), new_score, new_total
+
+        def postprocess(aux):
+            w, variances, tracker = aux
+            model = FixedEffectModel(
+                model=GeneralizedLinearModel(
+                    Coefficients(w, variances), self.task_type
+                ),
+                feature_shard_id=self.feature_shard_id,
+            )
+            return model, tracker
+
+        return make_static, apply, postprocess
+
+    def visit(
+        self, total: Array, own_score: Array | None,
+        initial: GameSubModel | None = None,
+    ) -> tuple[FixedEffectModel, OptimizationResult, Array, Array]:
+        """One descent visit as ONE compiled program: residual offsets →
+        solve → score → new running total. Returns (sub-model, tracker,
+        new own score, new total). On dispatch-latency-dominated platforms
+        (remote-attached chips) the unfused visit's 4-6 small program
+        launches were the wall-clock floor of every GAME config (VERDICT
+        r3 weak #3); the fused form launches once. ``own_score=None``
+        means this coordinate has not scored yet (cold start)."""
+        parts = self._fused_visit_parts()
+        if parts is None:
+            offsets = total - own_score if own_score is not None else total
+            sub_model, tracker = self.train(offsets, initial)
+            new_score = self.score(sub_model)
+            return sub_model, tracker, new_score, offsets + new_score
+        make_static, apply, postprocess = parts
         if own_score is None:
             own_score = jnp.zeros_like(total)
-        w, variances, tracker, new_score, new_total = fn(
-            base, total, own_score, w0
+        aux, new_score, new_total = apply(
+            make_static(initial), total, own_score
         )
-        model = FixedEffectModel(
-            model=GeneralizedLinearModel(
-                Coefficients(w, variances), self.task_type
-            ),
-            feature_shard_id=self.feature_shard_id,
-        )
+        model, tracker = postprocess(aux)
         return model, tracker, new_score, new_total
 
     def _build_visit_fn(self):
@@ -504,6 +535,70 @@ class RandomEffectCoordinate:
     def score(self, model: RandomEffectModel) -> Array:
         return model.score(self.batch)
 
+    def _fused_visit_parts(self):
+        """See ``FixedEffectCoordinate._fused_visit_parts``."""
+        if self.mesh is not None:
+            return None
+        _ = self._prepared  # stage bucket tensors OUTSIDE the trace
+        fn = self.__dict__.get("_visit_fn")
+        if fn is None:
+            fn = self._build_visit_fn()
+            object.__setattr__(self, "_visit_fn", fn)
+        bucket_args = tuple(
+            (pb.static, pb.row_idx, pb.mask, pb.ids, pb.columns)
+            for pb in self._prepared
+        )
+        feats = self._features()
+        ids = self.batch.id_tags[self.random_effect_type]
+
+        def make_static(initial):
+            if initial is not None:
+                W0 = initial.coefficients
+                if W0.shape[0] != self.num_entities:
+                    raise ValueError(
+                        f"warm-start entity count {W0.shape[0]} != "
+                        f"{self.num_entities}"
+                    )
+                if self.projector is not None:
+                    W0 = W0 @ self.projector.matrix
+            else:
+                W0 = jnp.zeros(
+                    (self.num_entities, self._train_num_features), jnp.float32
+                )
+            return (W0, bucket_args, feats, ids)
+
+        def apply(static, total, own_score):
+            W0, b_args, f_s, i_s = static
+            W, V, diag, new_score, new_total = fn(
+                total, own_score, W0, b_args, f_s, i_s
+            )
+            return (W, V, diag), new_score, new_total
+
+        def postprocess(aux):
+            W, V, diag = aux
+            tracker = RandomEffectTrainingResult(
+                coefficients=W,
+                variances=V,
+                diag_refs=tuple(
+                    (pb.entity_ids, f_k, it_k, reason_k)
+                    for pb, (f_k, it_k, reason_k) in zip(self._prepared, diag)
+                ),
+                num_entities=self.num_entities,
+            )
+            model = RandomEffectModel(
+                coefficients=(
+                    self.projector.coefficients_to_original(W)
+                    if self.projector is not None else W
+                ),
+                variances=None if self.projector is not None else V,
+                random_effect_type=self.random_effect_type,
+                feature_shard_id=self.feature_shard_id,
+                task_type=self.task_type,
+            )
+            return model, tracker
+
+        return make_static, apply, postprocess
+
     def visit(
         self, total: Array, own_score: Array | None,
         initial: GameSubModel | None = None,
@@ -513,60 +608,19 @@ class RandomEffectCoordinate:
         ``FixedEffectCoordinate.visit`` — the whole bucket ladder traces
         into a single launch instead of one per bucket (VERDICT r3 weak
         #3: E's per-visit dispatch count, not math, was the floor)."""
-        if self.mesh is not None:
+        parts = self._fused_visit_parts()
+        if parts is None:
             offsets = total - own_score if own_score is not None else total
             sub_model, tracker = self.train(offsets, initial)
             new_score = self.score(sub_model)
             return sub_model, tracker, new_score, offsets + new_score
-
-        _ = self._prepared  # stage bucket tensors OUTSIDE the trace
-        fn = self.__dict__.get("_visit_fn")
-        if fn is None:
-            fn = self._build_visit_fn()
-            object.__setattr__(self, "_visit_fn", fn)
-
-        W0 = None
-        if initial is not None:
-            W0 = initial.coefficients
-            if W0.shape[0] != self.num_entities:
-                raise ValueError(
-                    f"warm-start entity count {W0.shape[0]} != {self.num_entities}"
-                )
-            if self.projector is not None:
-                W0 = W0 @ self.projector.matrix
-        else:
-            W0 = jnp.zeros(
-                (self.num_entities, self._train_num_features), jnp.float32
-            )
+        make_static, apply, postprocess = parts
         if own_score is None:
             own_score = jnp.zeros_like(total)
-        bucket_args = tuple(
-            (pb.static, pb.row_idx, pb.mask, pb.ids, pb.columns)
-            for pb in self._prepared
+        aux, new_score, new_total = apply(
+            make_static(initial), total, own_score
         )
-        W, V, diag, new_score, new_total = fn(
-            total, own_score, W0, bucket_args, self._features(),
-            self.batch.id_tags[self.random_effect_type],
-        )
-        tracker = RandomEffectTrainingResult(
-            coefficients=W,
-            variances=V,
-            diag_refs=tuple(
-                (pb.entity_ids, f_k, it_k, reason_k)
-                for pb, (f_k, it_k, reason_k) in zip(self._prepared, diag)
-            ),
-            num_entities=self.num_entities,
-        )
-        model = RandomEffectModel(
-            coefficients=(
-                self.projector.coefficients_to_original(W)
-                if self.projector is not None else W
-            ),
-            variances=None if self.projector is not None else V,
-            random_effect_type=self.random_effect_type,
-            feature_shard_id=self.feature_shard_id,
-            task_type=self.task_type,
-        )
+        model, tracker = postprocess(aux)
         return model, tracker, new_score, new_total
 
     def _build_visit_fn(self):

@@ -29,6 +29,56 @@ from photon_ml_tpu.types import TaskType
 Array = jnp.ndarray
 
 
+def _build_fused_outer(coordinates: Mapping[str, Any], seq: Sequence[str]):
+    """One jitted program per OUTER iteration: every coordinate's fused
+    visit (offsets → solve → score → total) chained in sequence. Returns
+    a host callable ``run_outer(model, total, scores) -> (model, total,
+    scores, trackers_by_cid)``, or None when any coordinate needs
+    host-side staging per visit (mesh-sharded, per-visit down-sampling).
+
+    Why: each program launch costs fixed latency on remote-attached
+    accelerators; with K coordinates the per-visit fusion still pays K
+    launches per outer iteration — this pays ONE."""
+    import jax
+
+    parts = []
+    for cid in seq:
+        get = getattr(coordinates[cid], "_fused_visit_parts", None)
+        p = get() if get is not None else None
+        if p is None:
+            return None
+        parts.append(p)
+    applies = tuple(p[1] for p in parts)
+
+    @jax.jit
+    def fused(total, owns, statics):
+        outs = []
+        for i in range(len(applies)):
+            aux, s_new, total = applies[i](statics[i], total, owns[i])
+            outs.append((aux, s_new))
+        return total, tuple(outs)
+
+    def run_outer(model, total, scores):
+        owns = tuple(
+            scores[cid] if cid in scores else jnp.zeros_like(total)
+            for cid in seq
+        )
+        statics = tuple(
+            p[0](model.models.get(cid)) for p, cid in zip(parts, seq)
+        )
+        total, outs = fused(total, owns, statics)
+        scores = dict(scores)
+        iter_trackers: dict[str, Any] = {}
+        for (aux, s_new), cid, p in zip(outs, seq, parts):
+            sub_model, tracker = p[2](aux)
+            model = model.updated(cid, sub_model)
+            scores[cid] = s_new
+            iter_trackers[cid] = tracker
+        return model, total, scores, iter_trackers
+
+    return run_outer
+
+
 def _is_output_process() -> bool:
     """Multi-host: every process loads checkpoints (read-only); exactly one
     writes them — concurrent writers to shared storage corrupt files."""
@@ -83,6 +133,9 @@ class CoordinateDescent:
         # evaluators with sharded implementations (BUCKETED_AUC) compute
         # over the mesh without gathering the score vector to one device
         self.mesh = mesh
+        # fused outer-iteration programs, keyed by update sequence (the
+        # jitted chain compiles once and re-enters across run() calls)
+        self._fused_outer_cache: dict[tuple, Any] = {}
 
     def run(
         self,
@@ -152,8 +205,61 @@ class CoordinateDescent:
             for s in scores.values():
                 total = total + s
 
+        # whole-outer-iteration fusion: when no per-visit validation is
+        # configured and every coordinate runs the fused-visit fast path,
+        # ALL coordinate visits of an outer iteration trace into ONE
+        # program — on launch-latency-dominated platforms the per-launch
+        # cost is the wall-clock floor, so K coordinates at one launch
+        # beat K launches regardless of the math inside
+        fused_outer = None
+        if not (self.validation_batch is not None and self.evaluators):
+            key = tuple(update_sequence)
+            if key not in self._fused_outer_cache:
+                self._fused_outer_cache[key] = _build_fused_outer(
+                    self.coordinates, update_sequence
+                )
+            fused_outer = self._fused_outer_cache[key]
+
+        def append_tracker(cid: str, tracker) -> None:
+            # bound HBM retention of lazy per-entity diagnostics: the
+            # previous visit's device buffers are released UNMATERIALIZED
+            # — earlier-visit per-entity histories are dropped by design
+            # (only the final visit's diagnostics are readable); reading
+            # a released tracker raises RuntimeError
+            if trackers[cid]:
+                release = getattr(
+                    trackers[cid][-1], "release_device_diagnostics", None
+                )
+                if release is not None:
+                    release()
+            trackers[cid].append(tracker)
+
+        def end_of_iteration(it: int, iter_validation) -> None:
+            validation_history.append(iter_validation)
+            if checkpoint_dir is not None and _is_output_process():
+                from photon_ml_tpu.checkpoint import save_checkpoint
+
+                save_checkpoint(
+                    checkpoint_dir,
+                    model,
+                    next_iteration=it + 1,
+                    fingerprint=checkpoint_fingerprint,
+                    scores={cid: np.asarray(s) for cid, s in scores.items()},
+                    total=np.asarray(total),
+                    data_digest=digest,
+                )
+
         for it in range(start_iteration, num_iterations):
             iter_validation: dict[str, EvaluationResults] = {}
+            if fused_outer is not None:
+                model, total, scores, iter_trackers = fused_outer(
+                    model, total, scores
+                )
+                for cid in update_sequence:
+                    append_tracker(cid, iter_trackers[cid])
+                    self._log(f"iter {it} coordinate {cid}: trained")
+                end_of_iteration(it, iter_validation)
+                continue
             for cid in update_sequence:
                 coord = self.coordinates[cid]
                 visit = getattr(coord, "visit", None)
@@ -173,18 +279,7 @@ class CoordinateDescent:
                     total = offsets + new_score
                 scores[cid] = new_score
                 model = model.updated(cid, sub_model)
-                # bound HBM retention of lazy per-entity diagnostics: the
-                # previous visit's device buffers are released UNMATERIALIZED
-                # — earlier-visit per-entity histories are dropped by design
-                # (only the final visit's diagnostics are readable); reading
-                # a released tracker raises RuntimeError
-                if trackers[cid]:
-                    release = getattr(
-                        trackers[cid][-1], "release_device_diagnostics", None
-                    )
-                    if release is not None:
-                        release()
-                trackers[cid].append(tracker)
+                append_tracker(cid, tracker)
 
                 if self.validation_batch is not None and self.evaluators:
                     vscores = model.score(self.validation_batch)
@@ -200,19 +295,7 @@ class CoordinateDescent:
                     self._log(f"iter {it} coordinate {cid}: {res}")
                 else:
                     self._log(f"iter {it} coordinate {cid}: trained")
-            validation_history.append(iter_validation)
-            if checkpoint_dir is not None and _is_output_process():
-                from photon_ml_tpu.checkpoint import save_checkpoint
-
-                save_checkpoint(
-                    checkpoint_dir,
-                    model,
-                    next_iteration=it + 1,
-                    fingerprint=checkpoint_fingerprint,
-                    scores={cid: np.asarray(s) for cid, s in scores.items()},
-                    total=np.asarray(total),
-                    data_digest=digest,
-                )
+            end_of_iteration(it, iter_validation)
 
         return CoordinateDescentResult(
             model=model,

@@ -584,6 +584,9 @@ class StreamedGameTrainer:
         if (
             c.features_to_samples_ratio_upper_bound is not None
             and isinstance(feats_o, DenseFeatures)
+            and not drop_unseen  # TRAINING shards only: validation shards
+            # never solve, and their row frequencies would disagree with
+            # the training-side column maps anyway
         ):
             # per-entity subspace column maps, once per shard: computable
             # host-side from the owner rows (every entity's rows live
@@ -592,7 +595,12 @@ class StreamedGameTrainer:
             from photon_ml_tpu.game.projector import subspace_columns
 
             Xh = np.asarray(feats_o.X)
-            intercept = self.intercept_indices.get(c.feature_shard_id)
+            # under shared random projection the solve space has no
+            # intercept column (same contract as the solve call sites)
+            intercept = (
+                None if cid in self._projectors
+                else self.intercept_indices.get(c.feature_shard_id)
+            )
             cols_list = []
             for rows in buckets.row_indices:
                 idx = np.maximum(rows, 0)

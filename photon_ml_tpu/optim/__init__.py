@@ -9,4 +9,5 @@ round-trip per evaluation; here each optimizer is a jit-compiled
 
 from photon_ml_tpu.optim.common import OptimizationResult, make_optimizer  # noqa: F401
 from photon_ml_tpu.optim.lbfgs import lbfgs_minimize, owlqn_minimize  # noqa: F401
+from photon_ml_tpu.optim.newton import newton_minimize  # noqa: F401
 from photon_ml_tpu.optim.tron import tron_minimize  # noqa: F401

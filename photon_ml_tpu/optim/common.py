@@ -113,6 +113,20 @@ def select_minimize_fn(
 
         lbfgs_fn, owlqn_fn, tron_fn = lbfgs_minimize, owlqn_minimize, tron_minimize
 
+    if config.optimizer_type is OptimizerType.NEWTON_CHOLESKY:
+        if l1_weight > 0.0:
+            raise ValueError(
+                "NEWTON_CHOLESKY does not support L1 regularization "
+                "(non-smooth; use LBFGS, which routes through OWL-QN)"
+            )
+        if host:
+            raise ValueError(
+                "NEWTON_CHOLESKY is a device-resident small-d solver; the "
+                "streamed/out-of-core objectives use LBFGS or TRON"
+            )
+        from photon_ml_tpu.optim.newton import newton_minimize
+
+        return newton_minimize, {}
     if config.optimizer_type is OptimizerType.TRON:
         if l1_weight > 0.0:
             raise ValueError("TRON does not support L1 regularization (reference parity)")

@@ -45,11 +45,16 @@ class OptimizerType(enum.Enum):
     """Parity: ``photon-lib::ml.optimization.OptimizerType`` (LBFGS, TRON).
 
     OWLQN is selected implicitly when L1 regularization is active, matching
-    the reference's behavior.
+    the reference's behavior. NEWTON_CHOLESKY is a TPU-first EXTENSION
+    beyond the reference: exact damped Newton for small-d problems (dense
+    features), built for the per-entity random-effect solves where a
+    batched (d, d) Cholesky converges in a few big fused kernels instead
+    of many small sequential ones.
     """
 
     LBFGS = "LBFGS"
     TRON = "TRON"
+    NEWTON_CHOLESKY = "NEWTON_CHOLESKY"
 
 
 class RegularizationType(enum.Enum):

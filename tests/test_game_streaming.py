@@ -650,3 +650,31 @@ def test_streamed_game_subspace_projection_matches_in_memory(rng):
     # both solve width-p subspaces per entity; unselected columns are 0
     np.testing.assert_array_equal(W_st == 0.0, W_mem == 0.0)
     np.testing.assert_allclose(W_st, W_mem, rtol=0.2, atol=0.05)
+
+
+def test_streamed_game_projection_with_subspace_and_intercept(rng):
+    """Random projection + subspace + a registered RE intercept must fit
+    (the projected solve space has no intercept column; regression for
+    the subspace-column builder passing the original-space index)."""
+    import dataclasses
+
+    X, Xr, ids, y, _ = _data(rng, n=400, dr=8)
+    cfg = _config(iters=1)
+    cfg = dataclasses.replace(
+        cfg,
+        random_effect_coordinates={
+            "user": dataclasses.replace(
+                cfg.random_effect_coordinates["user"],
+                random_projection_dim=4,
+                features_to_samples_ratio_upper_bound=0.02,
+            )
+        },
+    )
+    data = StreamedGameData(
+        labels=y, features={"g": X, "r": Xr}, id_tags={"uid": ids}
+    )
+    model, _ = StreamedGameTrainer(
+        cfg, chunk_rows=128, intercept_indices={"r": 7}
+    ).fit(data)
+    W = np.asarray(model.models["user"].coefficients)
+    assert W.shape[1] == 8 and np.isfinite(W).all()

@@ -186,3 +186,108 @@ def test_already_converged_start(rng):
     res = lbfgs_minimize(obj, obj.b, cfg)
     assert int(res.iterations) == 0
     assert int(res.reason) == ConvergenceReason.GRADIENT_CONVERGED
+
+
+class TestNewtonCholesky:
+    def test_matches_lbfgs_optimum(self, rng):
+        """Damped Newton lands on the L-BFGS optimum in far fewer
+        iterations (small-d logistic + L2)."""
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.config import OptimizerConfig
+        from photon_ml_tpu.ops.batch import dense_batch_from_numpy
+        from photon_ml_tpu.ops.glm import make_objective
+        from photon_ml_tpu.ops.losses import loss_for_task
+        from photon_ml_tpu.optim import lbfgs_minimize, newton_minimize
+        from photon_ml_tpu.types import TaskType
+
+        n, d = 800, 8
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        w_true = (rng.normal(size=d) * 0.7).astype(np.float32)
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(X @ w_true)))).astype(
+            np.float32
+        )
+        obj = make_objective(
+            dense_batch_from_numpy(X, y),
+            loss_for_task(TaskType.LOGISTIC_REGRESSION), l2_weight=1.0,
+        )
+        w0 = jnp.zeros(d, jnp.float32)
+        cfg = OptimizerConfig(max_iterations=50, tolerance=1e-9)
+        a = lbfgs_minimize(obj, w0, cfg)
+        b = newton_minimize(obj, w0, cfg)
+        np.testing.assert_allclose(float(b.value), float(a.value), rtol=1e-6)
+        # each solver stops on its own f32 plateau around the optimum
+        np.testing.assert_allclose(
+            np.asarray(b.w), np.asarray(a.w), rtol=1e-2, atol=2e-4
+        )
+        assert int(b.iterations) <= 10  # quadratic convergence
+
+    def test_selection_and_rejections(self):
+        from photon_ml_tpu.config import OptimizerConfig
+        from photon_ml_tpu.optim.common import select_minimize_fn
+        from photon_ml_tpu.optim.newton import newton_minimize
+        from photon_ml_tpu.types import OptimizerType
+
+        cfg = OptimizerConfig(optimizer_type=OptimizerType.NEWTON_CHOLESKY)
+        fn, extra = select_minimize_fn(cfg)
+        assert fn is newton_minimize and extra == {}
+        with pytest.raises(ValueError, match="L1"):
+            select_minimize_fn(cfg, l1_weight=0.5)
+        with pytest.raises(ValueError, match="device-resident"):
+            select_minimize_fn(cfg, host=True)
+
+    def test_random_effect_bucket_parity(self, rng):
+        """A GAME RE coordinate solved with NEWTON_CHOLESKY matches the
+        LBFGS solution (same optimum, different iteration counts)."""
+        import dataclasses
+
+        from photon_ml_tpu.config import (
+            GameTrainingConfig, OptimizationConfig, OptimizerConfig,
+            RandomEffectCoordinateConfig, RegularizationContext,
+        )
+        from photon_ml_tpu.game.streaming import (
+            StreamedGameData, StreamedGameTrainer,
+        )
+        from photon_ml_tpu.types import (
+            OptimizerType, RegularizationType, TaskType,
+        )
+
+        n, dr, E = 500, 5, 10
+        Xr = rng.normal(size=(n, dr)).astype(np.float32)
+        ids = rng.integers(0, E, size=n).astype(np.int64)
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        data = StreamedGameData(
+            labels=y, features={"r": Xr}, id_tags={"uid": ids}
+        )
+
+        def cfg(opt_type):
+            return GameTrainingConfig(
+                task_type=TaskType.LOGISTIC_REGRESSION,
+                coordinate_update_sequence=("user",),
+                coordinate_descent_iterations=1,
+                random_effect_coordinates={
+                    "user": RandomEffectCoordinateConfig(
+                        feature_shard_id="r", random_effect_type="uid",
+                        optimization=OptimizationConfig(
+                            optimizer=OptimizerConfig(
+                                optimizer_type=opt_type,
+                                max_iterations=40, tolerance=1e-9,
+                            ),
+                            regularization=RegularizationContext(
+                                RegularizationType.L2
+                            ),
+                            regularization_weight=1.0,
+                        ),
+                    )
+                },
+            )
+
+        m_l, _ = StreamedGameTrainer(cfg(OptimizerType.LBFGS), chunk_rows=128).fit(data)
+        m_n, _ = StreamedGameTrainer(
+            cfg(OptimizerType.NEWTON_CHOLESKY), chunk_rows=128
+        ).fit(data)
+        np.testing.assert_allclose(
+            np.asarray(m_n.models["user"].coefficients),
+            np.asarray(m_l.models["user"].coefficients),
+            rtol=1e-2, atol=1e-3,
+        )
