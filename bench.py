@@ -63,6 +63,20 @@ def _hbm_utilization(bytes_per_pass: float, sec_per_pass: float) -> dict:
     }
 
 
+def _guard_marginal(bytes_per_pass: float, marginal: float | None):
+    """A differenced marginal implying more than the HBM roofline is a
+    timing artifact (relay noise/dedup between the two solves), not a
+    result — reject it so it reaches neither the utilization figures nor
+    the reported marginal fields (the same never-report-impossible rule
+    ``_timed_solves`` enforces on end-to-end times)."""
+    if (
+        marginal is not None
+        and bytes_per_pass / marginal > HBM_ROOFLINE_BYTES_PER_S
+    ):
+        return None
+    return marginal
+
+
 def _materialize(result) -> float:
     """Force completion: pull the loss scalar AND the weights to host."""
     np.asarray(result.w)
@@ -105,6 +119,14 @@ def _log(msg: str) -> None:
 
 
 # ----------------------------------------------------------------- proxies
+
+
+def _median_of_runs(fn, runs: int = 3) -> float:
+    """Median of repeated one-core proxy measurements: the shared host's
+    load spikes swing a single measurement ~1.7x (documented in
+    BASELINE.md), which swings the vs-proxy ratio with it; the median of
+    three runs is the honest middle in both directions."""
+    return float(np.median([fn() for _ in range(runs)]))
 
 
 def _proxy_logistic_dense(n: int, d: int, iters: int = 5) -> float:
@@ -258,13 +280,14 @@ def bench_dense_logistic(jax, jnp, dtype=None):
         if passes > passes_s and dt > dt_s:
             marginal_pass = (dt - dt_s) / (passes - passes_s)
     bytes_per_pass = float(n) * d * itemsize
+    marginal_pass = _guard_marginal(bytes_per_pass, marginal_pass)
     util = (
         _hbm_utilization(bytes_per_pass, marginal_pass)
         if marginal_pass is not None
         else _hbm_utilization(bytes_per_pass, dt / passes)
     )
     sps = n * iters / dt
-    proxy = _proxy_logistic_dense(1 << 16, d)
+    proxy = _median_of_runs(lambda: _proxy_logistic_dense(1 << 16, d))
     return {
         "samples_per_sec": round(sps, 1),
         "sec_per_solve": round(dt, 6),
@@ -385,13 +408,14 @@ def _sparse_logistic_bench(jax, jnp, n, d, k, iters, densify_dtype,
             marginal = (dt - dt_s) / (iters - its_s)
         if passes > passes_s and dt > dt_s:
             marginal_pass = (dt - dt_s) / (passes - passes_s)
+    marginal_pass = _guard_marginal(bytes_per_pass, marginal_pass)
     util = (
         _hbm_utilization(bytes_per_pass, marginal_pass)
         if marginal_pass is not None
         else _hbm_utilization(bytes_per_pass, dt / passes)
     )
     sps = n * iters / dt
-    proxy = _proxy_logistic_sparse(1 << 15, d, k)
+    proxy = _median_of_runs(lambda: _proxy_logistic_sparse(1 << 15, d, k))
     return {
         "samples_per_sec": round(sps, 1),
         "sec_per_solve": round(dt, 6),
@@ -491,13 +515,14 @@ def bench_b_linear_tron(jax, jnp):
         its_s = max(int(res_s.iterations), 1)
         if its > its_s and dt > dt_s:
             marginal = (dt - dt_s) / (its - its_s)
+    marginal = _guard_marginal(float(n) * d * 4, marginal)
     sps = n * its / dt
     util = (
         _hbm_utilization(float(n) * d * 4, marginal)
         if marginal is not None
         else _hbm_utilization(float(n) * d * 4, dt / its)
     )
-    proxy = _proxy_linear_tron(1 << 16, d)
+    proxy = _median_of_runs(lambda: _proxy_linear_tron(1 << 16, d))
     return {
         "samples_per_sec": round(sps, 1),
         "sec_per_solve": round(dt, 6),
@@ -574,13 +599,14 @@ def bench_c_poisson(jax, jnp):
             marginal = (dt - dt_s) / (iters - its_s)
         if passes > passes_s and dt > dt_s:
             marginal_pass = (dt - dt_s) / (passes - passes_s)
+    marginal_pass = _guard_marginal(float(n) * d * 4, marginal_pass)
     sps = n * iters / dt
     util = (
         _hbm_utilization(float(n) * d * 4, marginal_pass)
         if marginal_pass is not None
         else _hbm_utilization(float(n) * d * 4, dt / passes)
     )
-    proxy = _proxy_poisson_dense(1 << 16, d)
+    proxy = _median_of_runs(lambda: _proxy_poisson_dense(1 << 16, d))
     return {
         "samples_per_sec": round(sps, 1),
         "sec_per_solve": round(dt, 6),
