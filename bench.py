@@ -280,6 +280,10 @@ def bench_dense_logistic(jax, jnp, dtype=None):
         if passes > passes_s and dt > dt_s:
             marginal_pass = (dt - dt_s) / (passes - passes_s)
     bytes_per_pass = float(n) * d * itemsize
+    # one iteration costs AT LEAST one pass, so the same roofline bound
+    # applies to the iteration-denominated marginal
+    marginal = _guard_marginal(bytes_per_pass, marginal)
+    marginal = _guard_marginal(bytes_per_pass, marginal)
     marginal_pass = _guard_marginal(bytes_per_pass, marginal_pass)
     util = (
         _hbm_utilization(bytes_per_pass, marginal_pass)
@@ -408,6 +412,7 @@ def _sparse_logistic_bench(jax, jnp, n, d, k, iters, densify_dtype,
             marginal = (dt - dt_s) / (iters - its_s)
         if passes > passes_s and dt > dt_s:
             marginal_pass = (dt - dt_s) / (passes - passes_s)
+    marginal = _guard_marginal(bytes_per_pass, marginal)
     marginal_pass = _guard_marginal(bytes_per_pass, marginal_pass)
     util = (
         _hbm_utilization(bytes_per_pass, marginal_pass)
@@ -599,6 +604,7 @@ def bench_c_poisson(jax, jnp):
             marginal = (dt - dt_s) / (iters - its_s)
         if passes > passes_s and dt > dt_s:
             marginal_pass = (dt - dt_s) / (passes - passes_s)
+    marginal = _guard_marginal(float(n) * d * 4, marginal)
     marginal_pass = _guard_marginal(float(n) * d * 4, marginal_pass)
     sps = n * iters / dt
     util = (

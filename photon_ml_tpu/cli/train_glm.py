@@ -115,8 +115,6 @@ def run(
                 f"--variance {variance_computation.value} (streamed variances "
                 "are SIMPLE — FULL needs the dense d×d Hessian)"
             )
-        if validate is not DataValidationType.VALIDATE_DISABLED:
-            unsupported.append(f"--validate {validate.value}")
         if prior_model_path:
             unsupported.append("--prior-model (incremental mode is in-memory)")
         if diagnostics:
@@ -134,6 +132,7 @@ def run(
             normalization=normalization,
             variance_computation=variance_computation,
             summarize_features=summarize_features,
+            validate=validate,
         )
 
     advance("INIT")
@@ -294,6 +293,7 @@ def _run_streamed(
     normalization: NormalizationType = NormalizationType.NONE,
     variance_computation: VarianceComputationType = VarianceComputationType.NONE,
     summarize_features: bool = False,
+    validate: DataValidationType = DataValidationType.VALIDATE_DISABLED,
 ):
     """Out-of-core branch: data is read in uniform chunks that live in host
     RAM and stream through the device per optimizer iteration (SURVEY.md §7
@@ -339,6 +339,45 @@ def _run_streamed(
             )
         ) if local_paths else []
     logger.info(f"{len(chunks)} training chunks of {chunk_rows} rows")
+
+    if validate is not DataValidationType.VALIDATE_DISABLED:
+        from photon_ml_tpu.data.validation import DataValidationError
+
+        with timed(logger, "validate data (streamed, per chunk)"):
+            # FULL checks every chunk; SAMPLE thins rows inside each chunk
+            # (validate_arrays' own sampling, seeded per chunk) — either
+            # way the whole dataset is covered chunk by chunk, the
+            # streamed twin of the in-memory one-shot validation
+            failure: str | None = None
+            for ci, chunk in enumerate(chunks):
+                try:
+                    validate_arrays(
+                        task,
+                        chunk["labels"],
+                        chunk.get("X", chunk.get("values")),
+                        offsets=chunk.get("offsets"),
+                        weights=chunk.get("weights"),
+                        mode=validate,
+                        seed=ci,
+                    )
+                except DataValidationError as e:
+                    failure = str(e)
+                    break
+            if multihost:
+                # agree across hosts BEFORE raising: a host that raised
+                # alone would abandon the later collectives and hang the
+                # clean hosts
+                from photon_ml_tpu.parallel.multihost import (
+                    allreduce_max_host,
+                )
+
+                any_failed = allreduce_max_host(
+                    np.asarray([1.0 if failure is not None else 0.0])
+                )
+                if float(any_failed[0]) > 0 and failure is None:
+                    failure = "validation failed on another host"
+            if failure is not None:
+                raise DataValidationError(failure)
 
     norm_context = None
     if summarize_features or normalization is not NormalizationType.NONE:

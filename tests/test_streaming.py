@@ -556,3 +556,55 @@ class TestStreamedSummaryAndNormalization:
                 chunks, TaskType.LOGISTIC_REGRESSION, num_features=3,
                 variance_computation=VarianceComputationType.FULL,
             )
+
+
+class TestStreamedDataValidation:
+    def test_streamed_validate_catches_bad_values(self, tmp_path, rng):
+        """--validate on the out-of-core path: per-chunk validation covers
+        the whole dataset and rejects non-finite features / bad labels
+        like the in-memory one-shot check."""
+        import io as _io
+
+        from photon_ml_tpu.cli import train_glm as cli
+        from photon_ml_tpu.data.validation import DataValidationError
+        from photon_ml_tpu.io import TRAINING_EXAMPLE_SCHEMA, write_avro_file
+        from photon_ml_tpu.types import DataValidationType
+        from photon_ml_tpu.utils import PhotonLogger
+
+        quiet = lambda: PhotonLogger(None, stream=_io.StringIO())
+
+        def write(path, bad_row=None):
+            recs = []
+            for i in range(150):
+                v = float("nan") if i == bad_row else float(rng.normal())
+                recs.append({
+                    "uid": f"s{i}", "response": float(rng.integers(0, 2)),
+                    "offset": None, "weight": None,
+                    "features": [
+                        {"name": "g", "term": "0", "value": v},
+                        {"name": "g", "term": "1", "value": float(rng.normal())},
+                    ],
+                    "metadataMap": {},
+                })
+            write_avro_file(
+                path, json.loads(json.dumps(TRAINING_EXAMPLE_SCHEMA)), recs
+            )
+
+        good = str(tmp_path / "good.avro")
+        write(good)
+        cli.run(
+            TaskType.LOGISTIC_REGRESSION, [good], str(tmp_path / "ok"),
+            data_format="avro", weights=[1.0], max_iterations=20,
+            streaming_chunk_rows=64, logger=quiet(),
+            validate=DataValidationType.VALIDATE_FULL,
+        )
+
+        bad = str(tmp_path / "bad.avro")
+        write(bad, bad_row=130)  # lands in the LAST chunk
+        with pytest.raises(DataValidationError):
+            cli.run(
+                TaskType.LOGISTIC_REGRESSION, [bad], str(tmp_path / "nope"),
+                data_format="avro", weights=[1.0], max_iterations=20,
+                streaming_chunk_rows=64, logger=quiet(),
+                validate=DataValidationType.VALIDATE_FULL,
+            )
