@@ -283,7 +283,6 @@ def bench_dense_logistic(jax, jnp, dtype=None):
     # one iteration costs AT LEAST one pass, so the same roofline bound
     # applies to the iteration-denominated marginal
     marginal = _guard_marginal(bytes_per_pass, marginal)
-    marginal = _guard_marginal(bytes_per_pass, marginal)
     marginal_pass = _guard_marginal(bytes_per_pass, marginal_pass)
     util = (
         _hbm_utilization(bytes_per_pass, marginal_pass)

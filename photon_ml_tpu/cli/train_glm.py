@@ -361,7 +361,13 @@ def _run_streamed(
                         seed=ci,
                     )
                 except DataValidationError as e:
-                    failure = str(e)
+                    # chunk-addressed: on a billion-row stream the operator
+                    # needs WHERE, not just what
+                    failure = (
+                        f"chunk {ci} (rows {ci * chunk_rows}.."
+                        f"{ci * chunk_rows + len(chunk['labels'])} of this "
+                        f"host's stream): {e}"
+                    )
                     break
             if multihost:
                 # agree across hosts BEFORE raising: a host that raised

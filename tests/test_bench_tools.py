@@ -1,0 +1,75 @@
+"""The bench harness's honesty machinery (guards + generated BASELINE)."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "bench_module",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "bench.py"),
+)
+bench = importlib.util.module_from_spec(_SPEC)
+sys.modules.setdefault("bench_module", bench)
+_SPEC.loader.exec_module(bench)
+
+
+class TestGuards:
+    def test_guard_marginal_rejects_impossible(self):
+        bytes_per_pass = 1e9
+        # implies 10 TB/s > roofline -> rejected
+        assert bench._guard_marginal(bytes_per_pass, 1e-4) is None
+        # implies 100 GB/s -> kept
+        assert bench._guard_marginal(bytes_per_pass, 1e-2) == 1e-2
+        assert bench._guard_marginal(bytes_per_pass, None) is None
+
+    def test_timed_solves_rejects_impossible(self):
+        class R:
+            w = np.zeros(3)
+            value = 0.0
+
+        with pytest.raises(RuntimeError, match="timing artifact"):
+            bench._timed_solves(lambda: R(), bytes_lower_bound_per_run=1e18)
+
+    def test_median_of_runs(self):
+        vals = iter([5.0, 1.0, 100.0])
+        assert bench._median_of_runs(lambda: next(vals)) == 5.0
+
+
+class TestBaselineGeneration:
+    def test_update_baseline_renders_from_artifact(self, tmp_path, monkeypatch):
+        """The measured table is generated VERBATIM from the artifact and
+        replaces only the marked region (hand-edits inside don't survive;
+        text outside does)."""
+        results = {
+            "cfg_a": {
+                "samples_per_sec": 123456.0,
+                "sec_per_pass_marginal": 0.005,
+                "sec_per_iteration": 0.01,
+                "implied_hbm_fraction": 0.25,
+                "vs_one_core_proxy": 7.5,
+                "quality_ok": True,
+            },
+            "cfg_err": {"error": "boom"},
+        }
+        (tmp_path / "BENCH_DETAIL.json").write_text(json.dumps(results))
+        (tmp_path / "BASELINE.md").write_text(
+            "# header stays\n\n"
+            f"{bench._BASELINE_BEGIN}\nHAND EDIT MUST DIE\n{bench._BASELINE_END}\n"
+            "\nfooter stays\n"
+        )
+        monkeypatch.setattr(
+            bench.os.path, "abspath", lambda p: str(tmp_path / "bench.py")
+        )
+        bench.update_baseline()
+        text = (tmp_path / "BASELINE.md").read_text()
+        assert "# header stays" in text and "footer stays" in text
+        assert "HAND EDIT MUST DIE" not in text
+        assert "| cfg_a | 123456 | 0.005 | 0.01 | 0.25 | 7.5 | yes |" in text
+        assert "cfg_err" in text and "boom" in text
