@@ -118,6 +118,60 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _trace_device_execs(fn, prefix: str) -> tuple[int, float] | None:
+    """Run ``fn`` under a profiler trace; return (count, device_seconds)
+    over DEVICE executions of compiled programs whose name starts with
+    ``prefix``.
+
+    This is how launch-count and device-time fields are produced: counted
+    from the hardware trace of an actual run, never derived from the code
+    shape (an asserted count can silently contradict what executes — r4's
+    artifact claimed one launch per coordinate while the fused-outer path
+    launched one per ITERATION). Device duration comes from the chip's own
+    counters, so it is immune to the relay's wall-clock noise (the
+    documented ~3× session swings live in dispatch latency, not on the
+    device). Returns None when the trace has no device-side process (e.g.
+    CPU-only runs, where neither number would describe the accelerator).
+    """
+    import glob as _glob
+    import gzip as _gzip
+    import json as _json
+    import tempfile
+
+    import jax
+
+    with tempfile.TemporaryDirectory(prefix="bench_trace_") as tdir:
+        with jax.profiler.trace(tdir):
+            fn()
+        count = 0
+        device_ps = 0
+        saw_device = False
+        for path in _glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True):
+            with _gzip.open(path) as f:
+                trace = _json.load(f)
+            events = trace.get("traceEvents", [])
+            device_pids = {
+                e.get("pid")
+                for e in events
+                if e.get("ph") == "M"
+                and e.get("name") == "process_name"
+                and "/device:" in e.get("args", {}).get("name", "")
+            }
+            if device_pids:
+                saw_device = True
+            for e in events:
+                if (
+                    e.get("ph") == "X"
+                    and e.get("pid") in device_pids
+                    and e.get("name", "").startswith(prefix)
+                ):
+                    count += 1
+                    device_ps += int(
+                        e.get("args", {}).get("device_duration_ps", "0")
+                    )
+    return (count, device_ps / 1e12) if saw_device else None
+
+
 # ----------------------------------------------------------------- proxies
 
 
@@ -504,11 +558,14 @@ def bench_b_linear_tron(jax, jnp):
     )
     rmse = float(jnp.sqrt(jnp.mean((batch.matvec(res.w) - y) ** 2)))
     its = max(int(res.iterations), 1)
-    # marginal per outer iteration (differences out the relay's fixed
-    # dispatch latency — VERDICT r3 weak #7). One TRON iteration is one
-    # value+grad pass plus its CG Hv passes, so the per-X-read bandwidth
-    # is at LEAST the implied figure (bytes counted as one X read)
-    marginal = None
+    passes = max(int(res.objective_passes), its)
+    # marginal per PASS (one full X read: the fused value_and_grad and the
+    # fused Hv each stream X once) — TRON's CG makes passes, not outer
+    # iterations, the physical work unit; the solver counts them inside
+    # the CG loop and the short-solve differencing cancels the relay's
+    # fixed dispatch latency (VERDICT r4 weak #4: B's roofline was derived
+    # from END-TO-END time, which says nothing about kernel quality)
+    marginal = marginal_pass = None
     short_T = max(its // 3, 2)
     if its > short_T:
         cfg_s = OptimizerConfig(max_iterations=short_T, tolerance=0.0)
@@ -517,14 +574,18 @@ def bench_b_linear_tron(jax, jnp):
             bytes_lower_bound_per_run=float(n) * d * 4,
         )
         its_s = max(int(res_s.iterations), 1)
+        passes_s = max(int(res_s.objective_passes), its_s)
         if its > its_s and dt > dt_s:
             marginal = (dt - dt_s) / (its - its_s)
+        if passes > passes_s and dt > dt_s:
+            marginal_pass = (dt - dt_s) / (passes - passes_s)
     marginal = _guard_marginal(float(n) * d * 4, marginal)
+    marginal_pass = _guard_marginal(float(n) * d * 4, marginal_pass)
     sps = n * its / dt
     util = (
-        _hbm_utilization(float(n) * d * 4, marginal)
-        if marginal is not None
-        else _hbm_utilization(float(n) * d * 4, dt / its)
+        _hbm_utilization(float(n) * d * 4, marginal_pass)
+        if marginal_pass is not None
+        else _hbm_utilization(float(n) * d * 4, dt / passes)
     )
     proxy = _median_of_runs(lambda: _proxy_linear_tron(1 << 16, d))
     return {
@@ -537,14 +598,19 @@ def bench_b_linear_tron(jax, jnp):
         "samples_per_sec_marginal": (
             None if marginal is None else round(n / marginal, 1)
         ),
+        "objective_passes": passes,
+        "sec_per_pass": round(dt / passes, 6),
+        "sec_per_pass_marginal": (
+            None if marginal_pass is None else round(marginal_pass, 6)
+        ),
         "final_loss": round(value, 6),
         "rmse": round(rmse, 6),
         "noise_floor": noise,
         "quality_ok": bool(rmse <= 2.0 * noise),
         "vs_one_core_proxy": round(sps / proxy, 2),
         **util,
-        "hbm_note": "bytes counted as ONE X read per iteration (lower bound; CG Hv passes add more)",
-        "shape": {"n": n, "d": d, "iters": its},
+        "hbm_note": "bytes = one X read per PASS (value_and_grad or CG Hv, each fused to a single X stream); roofline from sec_per_pass_marginal",
+        "shape": {"n": n, "d": d, "iters": its, "passes": passes},
     }
 
 
@@ -699,7 +765,7 @@ def _game_setup(jax, jnp, n, effects):
     return cd, batch, data
 
 
-def _game_bench(jax, jnp, n, effects, outer_iters):
+def _game_bench(jax, jnp, n, effects, outer_iters, long_factor=3):
     import dataclasses
 
     from photon_ml_tpu.evaluation.evaluators import auc_roc
@@ -752,22 +818,49 @@ def _game_bench(jax, jnp, n, effects, outer_iters):
 
     warm = cd.run(seq, 2).model  # compile warm-up (cold + warm-start paths)
     timed_run(1, 999, warm)  # compile the warm-scores-init branch too
+    long_iters = outer_iters * long_factor
+    # compile every power-of-two chunk variant the timed lengths will use
+    # (descent runs fused iterations in pow2 chunks; a variant compiling
+    # inside a timed window would swamp the differencing)
+    timed_run(outer_iters, 998, warm)
+    timed_run(long_iters, 997, warm)
     dt, result = timed_run(outer_iters, 0, warm)
 
-    # marginal sec/outer-iteration: difference a longer run out of this one
-    # — cancels the fixed per-run dispatch+readback latency of the relay
+    # marginal sec/outer-iteration: difference a longer run out of a short
+    # one — cancels the fixed per-run dispatch+readback latency of the relay
     # platform (~0.1-0.25 s/sync), the same accounting the dense GLM
-    # configs report (VERDICT r2 weak #2: D/E lacked marginal numbers)
-    long_iters = outer_iters * 3
-    dt_long, _ = timed_run(long_iters, 1, warm)
-    marginal = (
-        (dt_long - dt) / (long_iters - outer_iters)
-        if dt_long > dt else None
+    # configs report. THREE independent estimates (fresh perturbed starts
+    # each — the relay dedup cache forbids reuse) so borderline pass/fail
+    # is judged on min/median, not one draw of the documented session
+    # noise (VERDICT r4 weak #8 / next-9).
+    marginals = []
+    for rep in range(3):
+        dt_s, _ = timed_run(outer_iters, 100 + 2 * rep, warm)
+        dt_l, _ = timed_run(long_iters, 101 + 2 * rep, warm)
+        if dt_l > dt_s:
+            marginals.append((dt_l - dt_s) / (long_iters - outer_iters))
+    marginal = float(np.median(marginals)) if marginals else None
+
+    # MEASURED launch count + device time: execute one run under the
+    # profiler, count the descent-loop program's device executions and sum
+    # their chip-counter durations — the previous artifact asserted
+    # len(seq) for the launch count, which contradicted the whole-outer
+    # fusion actually running (VERDICT r4 weak #3). Device time is the
+    # noise-immune per-iteration cost: with iteration chunking the launch
+    # latency amortizes toward zero, which pushes the wall marginal BELOW
+    # the relay's differencing noise floor — the chip counters stay exact.
+    traced = _trace_device_execs(
+        lambda: timed_run(long_iters, 200, warm), prefix="jit_fused"
     )
-    # marginal None = the longer run took no longer: per-iteration device
-    # compute is below the relay's dispatch/readback noise floor (the
-    # end-to-end number is almost pure latency, not solve time)
-    marginal_note = None if marginal is not None else "dispatch_dominated"
+    launches_per_outer = None
+    sec_per_outer_device = None
+    if traced is not None:
+        launch_count, device_sec = traced
+        launches_per_outer = round(launch_count / long_iters, 3)
+        if device_sec > 0.0:
+            # duration-less traces (count still valid) keep device fields
+            # absent rather than dividing by zero
+            sec_per_outer_device = device_sec / long_iters
 
     # quality (outside the timed window — AUC compiles its own program)
     scores = result.model.score(batch)
@@ -781,39 +874,81 @@ def _game_bench(jax, jnp, n, effects, outer_iters):
         )
     auc_true = float(auc_roc(jnp.asarray(margin), batch.labels))
     sec_per_outer = dt / outer_iters
+
+    # primary marginal estimator: chip counters when available (immune to
+    # the relay's wall noise — with chunked launches the per-iteration
+    # wall difference is SMALLER than the documented session jitter, so
+    # the differencing reps spread ~20× around the device truth), else
+    # the wall differencing median. marginal_method says which one this
+    # artifact used; the raw wall reps stay visible either way.
+    if sec_per_outer_device is not None:
+        marginal_primary = sec_per_outer_device
+        marginal_method = "device_counters"
+    else:
+        marginal_primary = marginal
+        marginal_method = (
+            "wall_differencing" if marginal is not None else None
+        )
+    # wall-rep note only describes the WALL estimator (the device-counter
+    # primary, when present, stands on its own regardless)
+    marginal_note = None if marginals else "wall_differencing_below_noise_floor"
     return {
         "sec_per_outer_iteration": round(sec_per_outer, 4),
         "sec_per_outer_iteration_marginal": (
-            None if marginal is None else round(marginal, 4)
+            None if marginal_primary is None else round(marginal_primary, 4)
         ),
+        "marginal_method": marginal_method,
+        "sec_per_outer_iteration_marginal_wall_all": [
+            round(m, 4) for m in sorted(marginals)
+        ],
         "marginal_note": marginal_note,
         "samples_per_sec": round(n * outer_iters / dt, 1),
         "samples_per_sec_marginal": (
-            None if marginal is None else round(n / marginal, 1)
+            None if marginal_primary is None
+            else round(n / marginal_primary, 1)
+        ),
+        # chip-counter accounting (profiler trace of a fresh perturbed
+        # run): immune to relay dispatch/wall noise; the honest
+        # per-iteration number now that chunked launches push the wall
+        # marginal below the differencing noise floor
+        "sec_per_outer_iteration_device": (
+            None if sec_per_outer_device is None
+            else round(sec_per_outer_device, 4)
+        ),
+        "samples_per_sec_device": (
+            None if sec_per_outer_device is None
+            else round(n / sec_per_outer_device, 1)
         ),
         "auc": round(auc_model, 6),
         "auc_generating_model": round(auc_true, 6),
         "quality_ok": bool(auc_model >= 0.95 * auc_true),
         "vs_one_core_proxy": None,
-        # fused coordinate visits: ONE program launch per coordinate per
-        # outer iteration (offsets -> solve -> score -> total), r3 weak #3
-        "fused_launches_per_outer_iteration": len(seq),
+        # MEASURED count of descent-program device executions per outer
+        # iteration (profiler trace), NOT an assertion from the code shape
+        "fused_launches_per_outer_iteration": launches_per_outer,
         "shape": {"n": n, "effects": {k: list(v) for k, v in effects.items()},
                    "outer_iters": outer_iters},
     }
 
 
 def bench_d_game_fixed(jax, jnp):
-    """Config D: GAME fixed-effect-only logistic (single-coordinate CD)."""
+    """Config D: GAME fixed-effect-only logistic (single-coordinate CD).
+
+    3 vs 9 iterations chunk as [2,1] vs [8,1] — equal launch counts, so
+    the differencing cancels dispatch latency (same reasoning as E)."""
     return _game_bench(jax, jnp, n=1 << 18, effects={}, outer_iters=3)
 
 
 def bench_e_game_glmm(jax, jnp):
-    """Config E: GAME GLMM — fixed + per-user + per-item random effects."""
+    """Config E: GAME GLMM — fixed + per-user + per-item random effects.
+
+    outer_iters=4 with long=2× so BOTH differenced runs are exactly ONE
+    pow2-chunked launch (r=4 vs r=8): equal launch counts make the wall
+    differencing cancel dispatch latency instead of embedding it."""
     return _game_bench(
         jax, jnp, n=1 << 18,
         effects={"userId": (20000, 8), "itemId": (4000, 8)},
-        outer_iters=2,
+        outer_iters=4, long_factor=2,
     )
 
 

@@ -232,16 +232,20 @@ class FixedEffectCoordinate:
         return model.score(self.batch)
 
     def _fused_visit_parts(self):
-        """(make_static, apply, postprocess) for fused execution, or None
-        when this coordinate needs host-side staging per visit.
+        """(make_static, apply, postprocess, advance) for fused execution,
+        or None when this coordinate needs host-side staging per visit.
 
         ``make_static(initial)`` builds the non-flowing jit arguments;
         ``apply(static, total, own_score)`` runs the visit INSIDE a trace
         and returns (aux, new_score, new_total); ``postprocess(aux)``
-        rebuilds (sub-model, tracker) on host. ``visit`` composes these
-        for a single-coordinate launch; ``descent._build_fused_outer``
+        rebuilds (sub-model, tracker) on host; ``advance(aux, static)`` is
+        the PURE in-trace twin of postprocess→make_static, wiring one
+        visit's result into the next visit's static inputs so multiple
+        outer iterations can chain inside one program. ``visit`` composes
+        these for a single-coordinate launch; ``descent._build_fused_outer``
         chains every coordinate's ``apply`` into ONE program per outer
-        iteration."""
+        iteration — and, through ``advance``, one program per CHUNK of
+        outer iterations."""
         if self.mesh is not None or self.train_rows is not None:
             # sharded solves stage host-side; down-sampling changes row
             # sets per config — both keep the unfused path
@@ -272,8 +276,10 @@ class FixedEffectCoordinate:
             )
             return (w, variances, tracker), new_score, new_total
 
-        def postprocess(aux):
+        def postprocess(aux, build_model=True):
             w, variances, tracker = aux
+            if not build_model:
+                return None, tracker
             model = FixedEffectModel(
                 model=GeneralizedLinearModel(
                     Coefficients(w, variances), self.task_type
@@ -282,7 +288,13 @@ class FixedEffectCoordinate:
             )
             return model, tracker
 
-        return make_static, apply, postprocess
+        def advance(aux, static):
+            # in-trace twin of postprocess→make_static: the next visit
+            # warm-starts from this visit's coefficients
+            b, _ = static
+            return (b, aux[0])
+
+        return make_static, apply, postprocess, advance
 
     def visit(
         self, total: Array, own_score: Array | None,
@@ -301,7 +313,7 @@ class FixedEffectCoordinate:
             sub_model, tracker = self.train(offsets, initial)
             new_score = self.score(sub_model)
             return sub_model, tracker, new_score, offsets + new_score
-        make_static, apply, postprocess = parts
+        make_static, apply, postprocess, _advance = parts
         if own_score is None:
             own_score = jnp.zeros_like(total)
         aux, new_score, new_total = apply(
@@ -574,7 +586,7 @@ class RandomEffectCoordinate:
             )
             return (W, V, diag), new_score, new_total
 
-        def postprocess(aux):
+        def postprocess(aux, build_model=True):
             W, V, diag = aux
             tracker = RandomEffectTrainingResult(
                 coefficients=W,
@@ -585,6 +597,8 @@ class RandomEffectCoordinate:
                 ),
                 num_entities=self.num_entities,
             )
+            if not build_model:
+                return None, tracker
             model = RandomEffectModel(
                 coefficients=(
                     self.projector.coefficients_to_original(W)
@@ -597,7 +611,19 @@ class RandomEffectCoordinate:
             )
             return model, tracker
 
-        return make_static, apply, postprocess
+        def advance(aux, static):
+            # in-trace twin of postprocess→make_static: the next visit
+            # warm-starts from this visit's coefficients. With a random
+            # projector the host loop round-trips original→projected space
+            # between visits (an approximate JL map) — replicate it so the
+            # chunked path is numerically the host path, not a better one.
+            W = aux[0]
+            if self.projector is not None:
+                W = self.projector.coefficients_to_original(W) @ self.projector.matrix
+            _, b_args, f_s, i_s = static
+            return (W, b_args, f_s, i_s)
+
+        return make_static, apply, postprocess, advance
 
     def visit(
         self, total: Array, own_score: Array | None,
@@ -614,7 +640,7 @@ class RandomEffectCoordinate:
             sub_model, tracker = self.train(offsets, initial)
             new_score = self.score(sub_model)
             return sub_model, tracker, new_score, offsets + new_score
-        make_static, apply, postprocess = parts
+        make_static, apply, postprocess, _advance = parts
         if own_score is None:
             own_score = jnp.zeros_like(total)
         aux, new_score, new_total = apply(

@@ -265,12 +265,17 @@ class EntityBuckets:
         return sum(len(e) for e in self.entity_ids)
 
 
-def default_capacities(max_count: int, smallest: int = 8, growth: int = 4) -> tuple[int, ...]:
-    """Geometric capacity ladder: [8, 32, 128, ...] up to max_count.
+def default_capacities(max_count: int, smallest: int = 8, growth: int = 2) -> tuple[int, ...]:
+    """Geometric capacity ladder: [8, 16, 32, ...] up to max_count.
 
-    ``growth=4`` bounds padding waste at 4× worst-case while keeping the
-    number of compiled bucket geometries ~log₄(max/min) — the XLA-compile
-    count is the real cost of fine-grained ladders.
+    ``growth=2`` bounds per-entity padding at 2× worst-case. Since
+    whole-outer fusion (``descent._build_fused_outer``) put every bucket
+    inside ONE compiled program, launch count no longer scales with bucket
+    count — padded compute (the in-loop offset gathers and masked Newton
+    lanes) is what shows up on the profile, so the ladder is fine and the
+    merge below trims geometry count, not the other way around. Profiled
+    on bench config E (Zipf entities): the old growth-4 ladder merged to 4
+    classes padded 5.0×; growth-2 merged to 8 classes pads 2.0×.
     """
     caps = [smallest]
     while caps[-1] < max_count:
@@ -281,19 +286,21 @@ def default_capacities(max_count: int, smallest: int = 8, growth: int = 4) -> tu
 def bucket_entities(
     grouping: EntityGrouping,
     capacities: tuple[int, ...] | None = None,
-    target_buckets: int = 4,
-    max_padded_ratio: float = 4.0,
+    target_buckets: int = 8,
+    max_padded_ratio: float = 0.5,
 ) -> EntityBuckets:
     """Assign each entity (with ≥1 active sample) to the smallest bucket
     capacity ≥ its active count; build padded row-index matrices.
 
     When ``capacities`` is not given, the fine geometric ladder is then
-    GREEDILY MERGED down toward ``target_buckets`` classes: each bucket is
-    one device program per descent iteration, and program count — not the
-    padded compute (inert zero-weight slots) — dominates wall-clock for
-    small-d random effects. Merges stop when the total padded cells would
-    exceed ``max_padded_ratio`` x the active sample count, so pathological
-    ladders (many tiny entities + one huge) can't blow up memory."""
+    GREEDILY MERGED down toward ``target_buckets`` classes, stopping when
+    the padding ADDED by merging would exceed ``max_padded_ratio`` × the
+    active sample count. Bucket count only costs XLA compile time (all
+    buckets execute inside one fused program per descent iteration), while
+    padded slots cost gather bytes and masked solver lanes EVERY iteration
+    — so the budget is deliberately tight (0.5×) and the target loose (8):
+    on bench config E this keeps total padding ≈2× active samples where the
+    old launch-count-minimizing policy (4 classes, 4× budget) paid 5×."""
     active = np.flatnonzero(grouping.active_counts > 0)
     if len(active) == 0:
         return EntityBuckets(capacities=(), entity_ids=[], row_indices=[])

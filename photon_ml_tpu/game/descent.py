@@ -15,6 +15,7 @@ retrained — matching the reference's treatment of pre-trained coordinates.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Any, Callable, Mapping, Sequence
 
 import jax.numpy as jnp
@@ -30,16 +31,23 @@ Array = jnp.ndarray
 
 
 def _build_fused_outer(coordinates: Mapping[str, Any], seq: Sequence[str]):
-    """One jitted program per OUTER iteration: every coordinate's fused
-    visit (offsets → solve → score → total) chained in sequence. Returns
-    a host callable ``run_outer(model, total, scores) -> (model, total,
-    scores, trackers_by_cid)``, or None when any coordinate needs
-    host-side staging per visit (mesh-sharded, per-visit down-sampling).
+    """One jitted program per CHUNK of outer iterations: every coordinate's
+    fused visit (offsets → solve → score → total) chained in sequence, and
+    the whole sequence chained over R iterations by ``lax.scan`` (the
+    coordinates' pure ``advance`` hooks wire one visit's result into the
+    next visit's warm start, exactly as the host loop does through the
+    model objects). Returns a host callable ``run_outer(model, total,
+    scores, r) -> (model, total, scores, trackers_by_cid_per_iter)``, or
+    None when any coordinate needs host-side staging per visit
+    (mesh-sharded, per-visit down-sampling).
 
     Why: each program launch costs fixed latency on remote-attached
-    accelerators; with K coordinates the per-visit fusion still pays K
-    launches per outer iteration — this pays ONE."""
+    accelerators; per-visit fusion pays K launches per outer iteration,
+    per-outer fusion pays one — and the scan amortizes even that one over
+    R iterations, so the launch cost vanishes from the per-iteration
+    marginal entirely."""
     import jax
+    from jax import lax
 
     parts = []
     for cid in seq:
@@ -49,16 +57,38 @@ def _build_fused_outer(coordinates: Mapping[str, Any], seq: Sequence[str]):
             return None
         parts.append(p)
     applies = tuple(p[1] for p in parts)
+    advances = tuple(p[3] for p in parts)
 
-    @jax.jit
-    def fused(total, owns, statics):
-        outs = []
-        for i in range(len(applies)):
-            aux, s_new, total = applies[i](statics[i], total, owns[i])
-            outs.append((aux, s_new))
-        return total, tuple(outs)
+    @partial(jax.jit, static_argnames=("r",))
+    def fused(total, owns, statics, r):
+        def step(carry, _):
+            total, owns, statics = carry
+            outs = []
+            owns = list(owns)
+            statics = list(statics)
+            for i in range(len(applies)):
+                aux, s_new, total = applies[i](statics[i], total, owns[i])
+                owns[i] = s_new
+                statics[i] = advances[i](aux, statics[i])
+                outs.append(aux)  # scores come from the carry, not the ys
+            return (total, tuple(owns), tuple(statics)), tuple(outs)
 
-    def run_outer(model, total, scores):
+        (total, owns, _), stacked = lax.scan(
+            step, (total, owns, statics), None, length=r
+        )
+        return total, owns, stacked
+
+    @partial(jax.jit, static_argnames=("r",))
+    def slice_all(stacked, r):
+        # unstack the per-iteration aux in ONE dispatch: slicing leaf-by-
+        # leaf on the host side costs one tiny device program PER LEAF per
+        # iteration per coordinate (~100 relay dispatches per chunk —
+        # measured 10× the whole chunk's solve time)
+        return tuple(
+            jax.tree.map(lambda a: a[i], stacked) for i in range(r)
+        )
+
+    def run_outer(model, total, scores, r=1):
         owns = tuple(
             scores[cid] if cid in scores else jnp.zeros_like(total)
             for cid in seq
@@ -66,17 +96,41 @@ def _build_fused_outer(coordinates: Mapping[str, Any], seq: Sequence[str]):
         statics = tuple(
             p[0](model.models.get(cid)) for p, cid in zip(parts, seq)
         )
-        total, outs = fused(total, owns, statics)
+        total, owns, stacked = fused(total, owns, statics, r)
         scores = dict(scores)
-        iter_trackers: dict[str, Any] = {}
-        for (aux, s_new), cid, p in zip(outs, seq, parts):
-            sub_model, tracker = p[2](aux)
-            model = model.updated(cid, sub_model)
-            scores[cid] = s_new
-            iter_trackers[cid] = tracker
-        return model, total, scores, iter_trackers
+        # per-iteration trackers come back STACKED (leading R axis);
+        # postprocess each iteration's slice — one dispatch, no host syncs
+        sliced = slice_all(stacked, r)
+        trackers_per_iter: list[dict[str, Any]] = []
+        for it in range(r):
+            iter_trackers: dict[str, Any] = {}
+            for i, (cid, p) in enumerate(zip(seq, parts)):
+                aux_it = sliced[it][i]
+                # only the chunk's LAST iteration needs the sub-model (a
+                # projected coordinate's model build dispatches a device
+                # matmul — r−1 of those per chunk would claw back the
+                # dispatch savings the chunking exists for)
+                last = it == r - 1
+                sub_model, tracker = p[2](aux_it, build_model=last)
+                iter_trackers[cid] = tracker
+                if last:
+                    model = model.updated(cid, sub_model)
+            trackers_per_iter.append(iter_trackers)
+        for i, cid in enumerate(seq):
+            scores[cid] = owns[i]
+        return model, total, scores, trackers_per_iter
 
     return run_outer
+
+
+# chunk cap: bounds the stacked per-iteration tracker/diagnostic buffers a
+# single launch returns (R × the per-iteration aux, e.g. R·(E·d) coefficient
+# snapshots) while still amortizing dispatch latency R-fold
+_MAX_FUSED_CHUNK = 16
+
+
+def _pow2_floor(x: int) -> int:
+    return 1 << (max(x, 1).bit_length() - 1)
 
 
 def _is_output_process() -> bool:
@@ -249,17 +303,37 @@ class CoordinateDescent:
                     data_digest=digest,
                 )
 
+        if fused_outer is not None:
+            # iteration chunking: run outer iterations in power-of-two
+            # chunks (largest first), each chunk ONE device launch — the
+            # per-launch dispatch latency of remote-attached chips then
+            # amortizes over the chunk instead of bounding every
+            # iteration's wall-clock. Checkpoint cadence is per-iteration
+            # by contract, so an enabled checkpoint_dir pins r=1. Chunks
+            # are powers of two so at most log₂(cap) program variants
+            # compile (the scan body itself compiles once per variant).
+            cap = 1 if checkpoint_dir is not None else _MAX_FUSED_CHUNK
+            it = start_iteration
+            while it < num_iterations:
+                r = min(_pow2_floor(num_iterations - it), cap)
+                model, total, scores, trackers_per_iter = fused_outer(
+                    model, total, scores, r
+                )
+                for j in range(r):
+                    for cid in update_sequence:
+                        append_tracker(cid, trackers_per_iter[j][cid])
+                        self._log(f"iter {it + j} coordinate {cid}: trained")
+                    end_of_iteration(it + j, {})
+                it += r
+            return CoordinateDescentResult(
+                model=model,
+                validation_history=validation_history,
+                trackers=trackers,
+                training_scores=scores,
+            )
+
         for it in range(start_iteration, num_iterations):
             iter_validation: dict[str, EvaluationResults] = {}
-            if fused_outer is not None:
-                model, total, scores, iter_trackers = fused_outer(
-                    model, total, scores
-                )
-                for cid in update_sequence:
-                    append_tracker(cid, iter_trackers[cid])
-                    self._log(f"iter {it} coordinate {cid}: trained")
-                end_of_iteration(it, iter_validation)
-                continue
             for cid in update_sequence:
                 coord = self.coordinates[cid]
                 visit = getattr(coord, "visit", None)

