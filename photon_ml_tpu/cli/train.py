@@ -216,15 +216,11 @@ def _streamed_unsupported(config: GameTrainingConfig) -> list[str]:
     """Config features the out-of-core branch rejects (used both to fail
     fast on an EXPLICIT --streaming-chunk-rows and to veto AUTO-selection
     — auto-streaming must never turn a runnable in-memory job into a
-    ValueError)."""
-    from photon_ml_tpu.types import VarianceComputationType
-
-    out = []
-    if config.variance_computation is VarianceComputationType.FULL:
-        out.append("FULL variance computation (streamed variances are SIMPLE)")
-    if config.incremental:
-        out.append("incremental MAP priors (warm start without 'incremental' works)")
-    return out
+    ValueError). Round 5 closed the last entries (FULL variance now
+    chunk-accumulates the d×d Hessian; incremental MAP priors fold into
+    the streamed objectives like L2), so nothing is rejected today; the
+    hook stays for future combinations."""
+    return []
 
 
 def _config_with_optimizations(

@@ -110,15 +110,6 @@ def run(
                 f"--optimizer TRON with --regularization {regularization.value} "
                 f"(L1 routes through OWL-QN; use LBFGS)"
             )
-        if variance_computation is VarianceComputationType.FULL:
-            unsupported.append(
-                f"--variance {variance_computation.value} (streamed variances "
-                "are SIMPLE — FULL needs the dense d×d Hessian)"
-            )
-        if prior_model_path:
-            unsupported.append("--prior-model (incremental mode is in-memory)")
-        if diagnostics:
-            unsupported.append("--diagnostics (in-memory mode only)")
         if unsupported:
             raise ValueError(
                 "--streaming-chunk-rows does not support: "
@@ -133,6 +124,8 @@ def run(
             variance_computation=variance_computation,
             summarize_features=summarize_features,
             validate=validate,
+            prior_model_path=prior_model_path,
+            diagnostics=diagnostics,
         )
 
     advance("INIT")
@@ -294,6 +287,8 @@ def _run_streamed(
     variance_computation: VarianceComputationType = VarianceComputationType.NONE,
     summarize_features: bool = False,
     validate: DataValidationType = DataValidationType.VALIDATE_DISABLED,
+    prior_model_path: str | None = None,
+    diagnostics: bool = False,
 ):
     """Out-of-core branch: data is read in uniform chunks that live in host
     RAM and stream through the device per optimizer iteration (SURVEY.md §7
@@ -416,6 +411,21 @@ def _run_streamed(
                 )
             )
 
+    prior_model = None
+    if prior_model_path:
+        # incremental training on the streamed path: the loaded model
+        # becomes warm start + Gaussian MAP prior, folded into the
+        # streamed objective exactly like L2 (same contract as in-memory)
+        with timed(logger, "load prior model"):
+            from photon_ml_tpu.io.model_io import load_glm
+
+            prior_model = load_glm(
+                prior_model_path,
+                index_map=imap,
+                num_features=imap.size,
+                task=task,
+            )
+
     with timed(logger, "train (streamed)"), profile_trace(
         profile_dir, "glm-sweep-streamed"
     ):
@@ -432,6 +442,8 @@ def _run_streamed(
             regularization_weights=list(weights),
             intercept_index=imap.intercept_index,
             validation_chunks=val_chunks,
+            initial_model=prior_model,
+            incremental=prior_model is not None,
             cross_process=multihost,
             checkpoint_dir=os.path.join(output_dir, "checkpoints"),
             normalization=norm_context,
@@ -465,6 +477,17 @@ def _run_streamed(
         }
         with open(os.path.join(output_dir, "report.json"), "w") as f:
             json.dump(report, f, indent=2)
+        if diagnostics:
+            # the report consumes only the training RESULT (models,
+            # trackers, validation) — no raw data — so the streamed sweep
+            # feeds it exactly like the in-memory one
+            from photon_ml_tpu.diagnostics import glm_sweep_diagnostics, write_report
+
+            with timed(logger, "write diagnostics"):
+                write_report(
+                    glm_sweep_diagnostics(result, index_map=imap, task=task),
+                    output_dir,
+                )
         advance("VALIDATED")
     sync_processes("train-glm-outputs-written")
     return result

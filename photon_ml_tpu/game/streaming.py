@@ -311,12 +311,6 @@ class StreamedGameTrainer:
         # the jitted chunk kernels take the chunk as an argument, so only
         # the FIRST visit compiles; later visits just swap the chunk list
         self._fixed_objectives: dict[str, StreamingGLMObjective] = {}
-        if config.variance_computation is VarianceComputationType.FULL:
-            raise NotImplementedError(
-                "streamed GAME computes SIMPLE variances (per-visit "
-                "Hessian-diagonal); FULL needs the dense d×d Hessian of the "
-                "fixed effect — use the in-memory path"
-            )
         if self.multihost:
             # multi-host grouped validation metrics evaluate OWNER-side
             # through the tag's validation re-shard; a tag with no
@@ -778,6 +772,7 @@ class StreamedGameTrainer:
         intercept_index: int | None,
         norm=None,
         compute_var: bool = False,
+        prior: tuple[np.ndarray, np.ndarray | None] | None = None,
     ):
         n = data.num_rows
         d = feats.num_features
@@ -822,11 +817,24 @@ class StreamedGameTrainer:
         l2 = opt.regularization.l2_weight(opt.regularization_weight)
         sobj = self._fixed_objectives.get(cid)
         if sobj is None:
+            prior_mean = prior_precision = None
+            if prior is not None:
+                # incremental training: the loaded model's means/variances
+                # become a Gaussian MAP prior in the SOLVER's space, folded
+                # into the streamed objective exactly like L2 (the prior is
+                # data-free, so it rides the objective's outside-the-stream
+                # terms). Same transform home as every other prior user.
+                from photon_ml_tpu.ops.glm import GaussianPrior
+
+                p = GaussianPrior.from_coefficients(prior[0], prior[1], norm)
+                prior_mean, prior_precision = p.means, p.precisions
             sobj = StreamingGLMObjective(
                 obj_chunks, loss, num_features=d, l2_weight=l2,
                 intercept_index=intercept_index,
                 cross_process=self._distributed(),
                 norm=norm,
+                prior_mean=prior_mean,
+                prior_precision=prior_precision,
             )
             self._fixed_objectives[cid] = sobj
         else:
@@ -842,7 +850,8 @@ class StreamedGameTrainer:
         var = None
         if (
             compute_var
-            and self.config.variance_computation is VarianceComputationType.SIMPLE
+            and self.config.variance_computation
+            is not VarianceComputationType.NONE
         ):
             from photon_ml_tpu.ops.glm import compute_variances
 
@@ -873,6 +882,8 @@ class StreamedGameTrainer:
         intercept_index: int | None,
         norm=None,
         V: np.ndarray | None = None,
+        W_prior: np.ndarray | None = None,
+        V_prior: np.ndarray | None = None,
     ) -> tuple[float, int, bool]:
         """Solve every bucket of this shard's OWNED entities against the
         current offsets, writing coefficient rows back into the host
@@ -945,6 +956,23 @@ class StreamedGameTrainer:
                 shard.features, shard.labels, offs_re, shard.weights, rows,
                 columns=cols,
             )
+            # incremental training: this bucket's rows of the (already
+            # solver-space) per-entity prior; subspace projection selects
+            # the same columns the solve runs over. Re-sliced per visit —
+            # the same O(k·d) host→device traffic as the unavoidable w0
+            # rows above (caching device slices would need bucket-keyed
+            # trainer state for a 2× upload saving on this one path)
+            prior_mu = prior_var = None
+            if W_prior is not None:
+                mu_rows = W_prior[ent_ids]
+                var_rows = None if V_prior is None else V_prior[ent_ids]
+                if cols is not None:
+                    mu_rows = np.take_along_axis(mu_rows, cols, axis=1)
+                    if var_rows is not None:
+                        var_rows = np.take_along_axis(var_rows, cols, axis=1)
+                prior_mu = jnp.asarray(mu_rows, jnp.float32)
+                if var_rows is not None:
+                    prior_var = jnp.asarray(var_rows, jnp.float32)
             b_intercept = intercept_index
             if cols is not None and intercept_index is not None:
                 # intercept (always the last full-space column) lands at
@@ -961,8 +989,8 @@ class StreamedGameTrainer:
                 w0,
                 l2,
                 norm,
-                None,  # prior_mu
-                None,  # prior_var
+                prior_mu,
+                prior_var,
                 minimize_fn=minimize_fn,
                 loss=loss,
                 config=opt.optimizer,
@@ -1631,6 +1659,18 @@ class StreamedGameTrainer:
         shard_dims: dict[str, int] = {}
         for cid, c in cfg.fixed_effect_coordinates.items():
             d = data.feature_container(c.feature_shard_id).num_features
+            if (
+                cfg.variance_computation is VarianceComputationType.FULL
+                and d > StreamingGLMObjective.FULL_HESSIAN_MAX_D
+            ):
+                # the bound would otherwise only surface on the LAST visit
+                # (variances are computed at the final solution) — after
+                # all descent work is already done
+                raise ValueError(
+                    f"streamed FULL variance supports fixed-effect shards "
+                    f"of d <= {StreamingGLMObjective.FULL_HESSIAN_MAX_D} "
+                    f"(coordinate {cid!r} has d={d}); use SIMPLE"
+                )
             shard_dims[cid] = d
             fixed_w[cid] = np.zeros(d, np.float32)
         for cid, c in cfg.random_effect_coordinates.items():
@@ -1641,7 +1681,7 @@ class StreamedGameTrainer:
             re_E[cid] = self._global_num_entities(ids, c.random_effect_type)
             re_W[cid] = np.zeros((shard.num_entities_local, d), np.float32)
         want_var = (
-            cfg.variance_computation is VarianceComputationType.SIMPLE
+            cfg.variance_computation is not VarianceComputationType.NONE
         )
         fixed_var: dict[str, np.ndarray | None] = {c_: None for c_ in fixed_w}
         re_V: dict[str, np.ndarray | None] = {
@@ -1686,6 +1726,61 @@ class StreamedGameTrainer:
                     )
                 # coordinates absent from the update sequence are ignored
                 # (the streamed path has no locked-coordinate scoring)
+
+        # incremental training: the loaded model is held FIXED as Gaussian
+        # MAP priors across all visits (the evolving warm state is separate
+        # — anchoring the prior to it would drift the objective every
+        # pass). Fixed priors stay in ORIGINAL space (mapped at objective
+        # construction); RE priors are pre-mapped into the solver's space
+        # ONCE here, then sliced per bucket per visit.
+        prior_fixed: dict[str, tuple] = {}
+        re_W_prior: dict[str, np.ndarray] = {}
+        re_V_prior: dict[str, np.ndarray | None] = {}
+        if cfg.incremental:
+            if not warm:
+                raise ValueError(
+                    "incremental training requires a prior model "
+                    "(model_input_dir)"
+                )
+            from photon_ml_tpu.game.coordinate import _require_prior_l2
+            from photon_ml_tpu.ops.glm import GaussianPrior
+
+            for cid, sub in initial_model.models.items():
+                if cid in fixed_w:
+                    _require_prior_l2(
+                        cfg.fixed_effect_coordinates[cid].optimization
+                    )
+                    co = sub.model.coefficients
+                    prior_fixed[cid] = (
+                        np.asarray(co.means, np.float32),
+                        None if co.variances is None
+                        else np.asarray(co.variances, np.float32),
+                    )
+                elif cid in re_W:
+                    _require_prior_l2(
+                        cfg.random_effect_coordinates[cid].optimization
+                    )
+                    # the prior shares the warm start's slicing/projection
+                    # (re_W holds exactly those rows right now); variances
+                    # do not survive a dense projection (in-memory contract)
+                    V_loc = None
+                    if cid not in self._projectors and sub.variances is not None:
+                        V_full = np.asarray(sub.variances, np.float32)
+                        V_loc = (
+                            V_full[pid::P][: re_W[cid].shape[0]].copy()
+                            if P > 1 else V_full[: re_E[cid]].copy()
+                        )
+                    c_norm = self._norm_contexts.get(
+                        cfg.random_effect_coordinates[cid].feature_shard_id
+                    )
+                    pr = GaussianPrior.from_coefficients(
+                        re_W[cid].copy(), V_loc, c_norm
+                    )
+                    re_W_prior[cid] = np.asarray(pr.means, np.float32)
+                    re_V_prior[cid] = (
+                        None if pr.variances is None
+                        else np.asarray(pr.variances, np.float32)
+                    )
 
         scores: dict[str, np.ndarray] = {
             cid: np.zeros(n, np.float32) for cid in cfg.coordinate_update_sequence
@@ -1826,6 +1921,7 @@ class StreamedGameTrainer:
                         compute_var=(
                             it == cfg.coordinate_descent_iterations - 1
                         ),
+                        prior=prior_fixed.get(cid),
                     )
                     fixed_w[cid] = w
                     if var is not None:
@@ -1845,6 +1941,8 @@ class StreamedGameTrainer:
                         else self.intercept_indices.get(c.feature_shard_id),
                         norm=self._norm_contexts.get(c.feature_shard_id),
                         V=re_V[cid],
+                        W_prior=re_W_prior.get(cid),
+                        V_prior=re_V_prior.get(cid),
                     )
                     if self._distributed():
                         # per-owner partial diagnostics → global (sum the

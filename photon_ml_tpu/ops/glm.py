@@ -41,6 +41,31 @@ from photon_ml_tpu.types import VarianceComputationType
 Array = jnp.ndarray
 
 
+def reg_delta(w: Array, prior_mean, prior_precision) -> Array:
+    """prec·(w − μ) — the (L2 or Gaussian-MAP) regularizer's gradient
+    direction; w itself for plain L2. The ONE home for this math: both the
+    device objective and the streamed twin delegate here, so the MAP policy
+    cannot diverge between the paths."""
+    if prior_mean is None:
+        return w
+    prec = jnp.ones_like(w) if prior_precision is None else prior_precision
+    return prec * (w - prior_mean)
+
+
+def reg_curvature(like: Array, prior_mean, prior_precision) -> Array:
+    """The regularizer's diagonal curvature scale (prec, or ones)."""
+    if prior_mean is None or prior_precision is None:
+        return jnp.ones_like(like)
+    return prior_precision
+
+
+def reg_term(w: Array, l2_weight, reg_mask, prior_mean, prior_precision) -> Array:
+    """0.5·λ₂·Σ maskⱼ·precⱼ·(wⱼ−μⱼ)² (μ=0, prec=1 for plain L2)."""
+    delta = w if prior_mean is None else w - prior_mean
+    prec = reg_curvature(w, prior_mean, prior_precision)
+    return 0.5 * l2_weight * jnp.sum(reg_mask * prec * delta * delta)
+
+
 def _interpret_fused() -> bool:
     """Pallas kernels run compiled on TPU, interpreter-mode elsewhere (the
     CPU test suite exercises the identical program)."""
@@ -111,29 +136,17 @@ class GLMObjective:
 
     # -- regularizer (plain L2 or Gaussian prior) ------------------------------
     def _reg_delta(self, w: Array) -> Array:
-        """prec·(w − μ) — the vector the regularizer's value/grad/Hessian
-        are built from (w itself for plain L2)."""
-        if self.prior_mean is None:
-            return w
-        prec = (
-            jnp.ones_like(w) if self.prior_precision is None
-            else self.prior_precision
-        )
-        return prec * (w - self.prior_mean)
+        return reg_delta(w, self.prior_mean, self.prior_precision)
 
     def _reg_curvature(self, like: Array) -> Array:
-        """The regularizer's diagonal curvature scale (prec, or ones)."""
-        if self.prior_mean is None or self.prior_precision is None:
-            return jnp.ones_like(like)
-        return self.prior_precision
+        return reg_curvature(like, self.prior_mean, self.prior_precision)
 
     # -- objective contracts ---------------------------------------------------
     def _l2_term(self, w: Array) -> Array:
-        if self.prior_mean is None:
-            return 0.5 * self.l2_weight * jnp.sum(self.reg_mask * w * w)
-        prec = self._reg_curvature(w)
-        delta = w - self.prior_mean
-        return 0.5 * self.l2_weight * jnp.sum(self.reg_mask * prec * delta * delta)
+        return reg_term(
+            w, self.l2_weight, self.reg_mask, self.prior_mean,
+            self.prior_precision,
+        )
 
     @property
     def one_pass_value_grad(self) -> bool:

@@ -166,6 +166,14 @@ class StreamingGLMObjective:
     # evaluation (each host streams only its own chunks — the treeAggregate
     # analog). The L2 term is added once, AFTER the cross-process sum.
     cross_process: bool = False
+    # incremental training: (d,) Gaussian MAP prior in the SOLVER's
+    # coefficient space (normalized space when ``norm`` is set — build via
+    # ``GaussianPrior.from_coefficients``, same as the device objective).
+    # The regularizer becomes 0.5·λ₂·Σ maskⱼ·precⱼ·(wⱼ−μⱼ)²; plain L2 is
+    # the μ=0, prec=1 default. Like the L2 term, the prior lands ONCE
+    # outside the per-chunk stream (it does not depend on the data).
+    prior_mean: Array | None = None
+    prior_precision: Array | None = None
 
     def __post_init__(self):
         if not self.chunks and not self.cross_process:
@@ -176,6 +184,10 @@ class StreamingGLMObjective:
         # public: the host OWL-QN twin applies scalar L1 over this mask,
         # exactly like the device objective's reg_mask contract
         self.reg_mask = mask
+        if self.prior_mean is not None:
+            self.prior_mean = jnp.asarray(self.prior_mean, jnp.float32)
+        if self.prior_precision is not None:
+            self.prior_precision = jnp.asarray(self.prior_precision, jnp.float32)
 
         def chunk_value_grad(batch: Batch, w: Array):
             obj = make_objective(
@@ -205,11 +217,26 @@ class StreamingGLMObjective:
             )
             return obj.hessian_diag(w)
 
+        def chunk_hessian(batch: Batch, w: Array):
+            from photon_ml_tpu.ops.batch import SparseBatch, densify
+
+            if isinstance(batch, SparseBatch):
+                # FULL variance only runs under the d-bound, where a
+                # chunk-rows × d dense view is small; densifying per chunk
+                # keeps ONE hessian implementation
+                batch = densify(batch)
+            obj = make_objective(
+                batch, self.loss, l2_weight=0.0, norm=self.norm,
+                intercept_index=self.intercept_index,
+            )
+            return obj.hessian(w)
+
         # ONE compiled kernel per contract, re-entered for every chunk
         self._chunk_vg = jax.jit(chunk_value_grad)
         self._chunk_v = jax.jit(chunk_value)
         self._chunk_hvp = jax.jit(chunk_hvp)
         self._chunk_hd = jax.jit(chunk_hessian_diag)
+        self._chunk_h = jax.jit(chunk_hessian)
 
     def _stream(self, params, kernel: Callable, accumulate: Callable, init):
         """Double-buffered host→device chunk pipeline: the NEXT chunk's
@@ -227,8 +254,23 @@ class StreamingGLMObjective:
                 acc = accumulate(acc, out)
         return acc
 
+    def _reg_delta(self, w: Array) -> Array:
+        from photon_ml_tpu.ops.glm import reg_delta
+
+        return reg_delta(w, self.prior_mean, self.prior_precision)
+
+    def _reg_curvature(self, like: Array) -> Array:
+        from photon_ml_tpu.ops.glm import reg_curvature
+
+        return reg_curvature(like, self.prior_mean, self.prior_precision)
+
     def _l2_term(self, w: Array) -> Array:
-        return 0.5 * self.l2_weight * jnp.sum(self.reg_mask * w * w)
+        from photon_ml_tpu.ops.glm import reg_term
+
+        return reg_term(
+            jnp.asarray(w), jnp.float32(self.l2_weight), self.reg_mask,
+            self.prior_mean, self.prior_precision,
+        )
 
     def value(self, w: Array) -> Array:
         total = self._stream(
@@ -257,7 +299,10 @@ class StreamingGLMObjective:
             from photon_ml_tpu.parallel.multihost import allreduce_sum_host
 
             hv = jnp.asarray(allreduce_sum_host(np.asarray(hv)))
-        return hv + jnp.float32(self.l2_weight) * self.reg_mask * v
+        return hv + (
+            jnp.float32(self.l2_weight) * self.reg_mask
+            * self._reg_curvature(v) * v
+        )
 
     def hessian_diag(self, w: Array) -> Array:
         """diag(H), streamed — VarianceComputationType.SIMPLE at the
@@ -276,7 +321,49 @@ class StreamingGLMObjective:
             from photon_ml_tpu.parallel.multihost import allreduce_sum_host
 
             diag = jnp.asarray(allreduce_sum_host(np.asarray(diag)))
-        return diag + jnp.float32(self.l2_weight) * self.reg_mask
+        return diag + (
+            jnp.float32(self.l2_weight) * self.reg_mask
+            * self._reg_curvature(diag)
+        )
+
+    # d-bound on the streamed FULL Hessian: the (d, d) f32 accumulator is
+    # d²·4 bytes ON DEVICE for the whole pass (8192 → 256 MB) and the host
+    # inverts it afterwards — FULL variance is a small-to-mid-d feature in
+    # the reference too (it inverts d×d on the driver)
+    FULL_HESSIAN_MAX_D = 8192
+
+    def hessian(self, w: Array) -> Array:
+        """Full (d, d) Hessian at ``w``, streamed — FULL variance at the
+        solution is ONE extra pass accumulating the per-chunk d×d Gram
+        contractions (Σ Zᵀ(d2·Z), linear in the chunks, exactly like the
+        streamed gradient), then a host-side inverse by the caller. The
+        d-bound keeps the accumulator a bounded device buffer; beyond it
+        FULL is refused eagerly with the limit in the message."""
+        if self.num_features > self.FULL_HESSIAN_MAX_D:
+            raise NotImplementedError(
+                f"streamed FULL variance supports d <= "
+                f"{self.FULL_HESSIAN_MAX_D} (the dense d×d Hessian "
+                f"accumulator would be {self.num_features}² floats); use "
+                f"SIMPLE variances at this width"
+            )
+        w = jnp.asarray(w)
+        init = jnp.zeros(
+            (self.num_features, self.num_features), jnp.float32
+        )
+        h = self._stream(
+            w,
+            lambda batch, wi: self._chunk_h(batch, wi),
+            lambda acc, out: acc + out,
+            init,
+        )
+        if self.cross_process:
+            from photon_ml_tpu.parallel.multihost import allreduce_sum_host
+
+            h = jnp.asarray(allreduce_sum_host(np.asarray(h)))
+        return h + jnp.diag(
+            jnp.float32(self.l2_weight) * self.reg_mask
+            * self._reg_curvature(self.reg_mask)
+        )
 
     def value_and_grad(self, w: Array) -> tuple[Array, Array]:
         w = jnp.asarray(w)
@@ -291,7 +378,7 @@ class StreamingGLMObjective:
 
             v, g = allreduce_sum_host(np.asarray(v), np.asarray(g))
             v, g = jnp.asarray(v), jnp.asarray(g)
-        g = g + jnp.float32(self.l2_weight) * self.reg_mask * w
+        g = g + jnp.float32(self.l2_weight) * self.reg_mask * self._reg_delta(w)
         return v + self._l2_term(w), g
 
 

