@@ -835,6 +835,15 @@ class StreamedGameTrainer:
                 norm=norm,
                 prior_mean=prior_mean,
                 prior_precision=prior_precision,
+                # FULL variance needs the raw per-chunk indices for its
+                # densified Hessian pass; the auto tile-COO layout drops
+                # them (same override as the GLM sweep)
+                tile_sparse=(
+                    False
+                    if self.config.variance_computation
+                    is VarianceComputationType.FULL
+                    else None
+                ),
             )
             self._fixed_objectives[cid] = sobj
         else:

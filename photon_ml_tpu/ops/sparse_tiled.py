@@ -433,11 +433,16 @@ def _build_chunk(
     )
 
 
-def tile_sparse_batch(batch) -> TiledSparseBatch:
+def tile_sparse_batch(batch, keep_empty_chunks: bool = False) -> TiledSparseBatch:
     """Build a ``TiledSparseBatch`` from a padded-sparse ``SparseBatch``
     (host-side one-time transform; zero-valued padding slots are dropped
     before tiling). Shapes beyond the per-kernel VMEM bounds are split
-    into row/col chunks along SLAB-aligned boundaries."""
+    into row/col chunks along SLAB-aligned boundaries.
+
+    ``keep_empty_chunks`` keeps nonzero-free chunks instead of skipping
+    them — the per-device-shard builder needs every shard to carry the
+    SAME chunk structure so the stacked pytrees line up under shard_map.
+    """
     indices = np.asarray(batch.indices)
     values = np.asarray(batch.values)
     n, k = indices.shape
@@ -461,7 +466,11 @@ def tile_sparse_batch(batch) -> TiledSparseBatch:
             c0 = cc * _MAX_TABLE_COLS
             c1 = min(c0 + _MAX_TABLE_COLS, d_pad_total)
             m = in_r & (cols >= c0) & (cols < c1)
-            if n_row_chunks * n_col_chunks > 1 and not m.any():
+            if (
+                n_row_chunks * n_col_chunks > 1
+                and not keep_empty_chunks
+                and not m.any()
+            ):
                 continue
             chunks.append(
                 _build_chunk(
@@ -504,3 +513,122 @@ def supports_tiling(batch) -> bool:
         # not compilable (s32[0,128] operand) — the XLA path handles it
         and bool(np.any(np.asarray(batch.values) != 0))
     )
+
+
+def _pad_layout_groups(arrays: tuple, target_groups: int) -> tuple:
+    """Extend one direction's (packed, wslab, rslab) stream with filler
+    segments up to ``target_groups`` groups. Fillers use the builder's tail
+    convention — write slab 0, read slab 0, value 0 — and contribute
+    exactly 0; ``target_groups`` must be a whole-DMA-step multiple (every
+    built stream already is, so the max over shards is too)."""
+    packed, wslab, rslab = arrays
+    n_groups = packed.shape[0]  # packed is (n_groups, 3, GROUP)
+    if n_groups == target_groups:
+        return arrays
+    add = target_groups - n_groups
+    packed = jnp.concatenate(
+        [packed, jnp.zeros((add,) + packed.shape[1:], packed.dtype)]
+    )
+    rslab = jnp.concatenate([rslab, jnp.zeros((add,), rslab.dtype)])
+    segs = add // GROUPS_PER_STEP
+    wslab = jnp.concatenate([wslab, jnp.zeros((segs,), wslab.dtype)])
+    return (packed, wslab, rslab)
+
+
+def pad_chunks_to_common_groups(tbs: list) -> list[list]:
+    """Pad every ``TiledSparseBatch`` in ``tbs`` (identical chunk
+    structure) so that chunk j's streams have the SAME group count across
+    all batches — the shared prerequisite for stacking per-shard layouts
+    under ``shard_map`` and for serving every streamed chunk with one
+    compiled kernel. Returns ``out[j][i]`` = batch i's padded chunk j."""
+    n_chunks = len(tbs[0].chunks)
+    assert all(len(tb.chunks) == n_chunks for tb in tbs)
+    out = []
+    for j in range(n_chunks):
+        targets = {
+            side: max(
+                getattr(tb.chunks[j], side)[0].shape[0] for tb in tbs
+            )
+            for side in ("m_arrays", "g_arrays")
+        }
+        out.append(
+            [
+                _TileChunk(
+                    m_arrays=_pad_layout_groups(
+                        tb.chunks[j].m_arrays, targets["m_arrays"]
+                    ),
+                    g_arrays=_pad_layout_groups(
+                        tb.chunks[j].g_arrays, targets["g_arrays"]
+                    ),
+                    row_start=tb.chunks[j].row_start,
+                    col_start=tb.chunks[j].col_start,
+                    n_pad=tb.chunks[j].n_pad,
+                    d_pad=tb.chunks[j].d_pad,
+                )
+                for tb in tbs
+            ]
+        )
+    return out
+
+
+def tile_sparse_batch_sharded(batch, n_dev: int):
+    """Per-device tile-COO for a row-sharded mesh solve — the module
+    docstring's own multi-device recipe ("shard rows first and build one
+    tile-COO per shard; the objective's psum handles the reduction"),
+    implemented as a host-side ingest transform:
+
+    - rows pad to an ``n_dev`` multiple and split into ``n_dev``
+      contiguous shards (equal row counts → identical chunk structure);
+    - each shard tiles independently (``keep_empty_chunks`` so the chunk
+      lists line up), streams pad to the max group count across shards;
+    - every array leaf stacks on a LEADING DEVICE AXIS. The result is a
+      ``TiledSparseBatch``-shaped pytree whose leaves are (n_dev, ...) —
+      shard it with ``PartitionSpec(axis)`` and drop the unit leading axis
+      inside ``shard_map`` to recover each device's local batch.
+
+    Returns (stacked_batch, rows_per_shard).
+    """
+    from photon_ml_tpu.ops.batch import pad_batch
+
+    n = batch.num_rows
+    rows_per_shard = -(-n // n_dev)
+    batch = pad_batch(batch, rows_per_shard * n_dev)
+    shards = [
+        jax.tree.map(
+            lambda a: a[i * rows_per_shard:(i + 1) * rows_per_shard], batch
+        )
+        for i in range(n_dev)
+    ]
+    tbs = [tile_sparse_batch(sh, keep_empty_chunks=True) for sh in shards]
+    ref = tbs[0]
+    padded = pad_chunks_to_common_groups(tbs)
+
+    stacked_chunks = []
+    for j in range(len(ref.chunks)):
+        stacked_chunks.append(
+            _TileChunk(
+                m_arrays=tuple(
+                    jnp.stack([c.m_arrays[i] for c in padded[j]])
+                    for i in range(3)
+                ),
+                g_arrays=tuple(
+                    jnp.stack([c.g_arrays[i] for c in padded[j]])
+                    for i in range(3)
+                ),
+                row_start=ref.chunks[j].row_start,
+                col_start=ref.chunks[j].col_start,
+                n_pad=ref.chunks[j].n_pad,
+                d_pad=ref.chunks[j].d_pad,
+            )
+        )
+    stacked = TiledSparseBatch(
+        chunks=tuple(stacked_chunks),
+        labels=jnp.stack([tb.labels for tb in tbs]),
+        offsets=jnp.stack([tb.offsets for tb in tbs]),
+        weights=jnp.stack([tb.weights for tb in tbs]),
+        num_features=ref.num_features,
+        num_rows_real=ref.num_rows_real,
+        n_pad_total=ref.n_pad_total,
+        d_pad_total=ref.d_pad_total,
+    )
+    return stacked, rows_per_shard

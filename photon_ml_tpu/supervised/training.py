@@ -594,6 +594,13 @@ def train_glm_streamed(
         norm=normalization,
         prior_mean=None if prior is None else prior.means,
         prior_precision=None if prior is None else prior.precisions,
+        # FULL needs the raw per-chunk indices for its densified Hessian
+        # pass; the auto tile-COO layout drops them
+        tile_sparse=(
+            False
+            if variance_computation is VarianceComputationType.FULL
+            else None
+        ),
     )
     for lam in sorted(regularization_weights):
         done_w = ckpt.completed_model(lam) if ckpt is not None else None

@@ -243,3 +243,80 @@ def test_game_fixed_effect_rides_tiled_kernel(rng):
         np.asarray(model_x.models["fixed"].model.coefficients.means),
         rtol=1e-4, atol=1e-5,
     )
+
+
+class TestTiledMesh:
+    def test_sharded_minimize_routes_tiled_and_matches_single_device(self, rng):
+        """sharded_minimize on a high-dim SparseBatch must take the
+        per-shard tile-COO route (not the XLA gather/scatter fallback) and
+        reach the single-device tiled optimum (VERDICT r4 missing #4 /
+        next-2b: the file's own multi-device recipe, implemented)."""
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.config import OptimizerConfig
+        from photon_ml_tpu.ops.batch import SparseBatch
+        from photon_ml_tpu.ops.glm import make_objective
+        from photon_ml_tpu.ops.losses import loss_for_task
+        from photon_ml_tpu.optim import lbfgs_minimize
+        from photon_ml_tpu.parallel import data_mesh
+        from photon_ml_tpu.parallel.distributed import sharded_minimize
+        from photon_ml_tpu.types import TaskType
+
+        n, d, k = 4096, 8192, 6  # d >= 4096 satisfies supports_tiling;
+        # dense = 128 MB > the CPU fallback budget? force the sparse route
+        # by monkeypatching the budget below instead of relying on it
+        idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        val = rng.normal(size=(n, k)).astype(np.float32)
+        w_true = (rng.normal(size=d) * 0.3).astype(np.float32)
+        m = (val * w_true[idx]).sum(axis=1)
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-m))).astype(np.float32)
+        batch = SparseBatch(
+            indices=jnp.asarray(idx), values=jnp.asarray(val),
+            labels=jnp.asarray(y),
+            offsets=jnp.zeros(n, jnp.float32),
+            weights=jnp.ones(n, jnp.float32),
+            num_features=d,
+        )
+        loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+        cfg = OptimizerConfig(max_iterations=40, tolerance=1e-8)
+
+        # single-device tiled reference
+        from photon_ml_tpu.ops.sparse_tiled import tile_sparse_batch
+
+        tb = tile_sparse_batch(batch)
+        obj = make_objective(tb, loss, l2_weight=1.0)
+        ref = lbfgs_minimize(obj, jnp.zeros(d, jnp.float32), cfg)
+
+        # mesh route: shrink the densify budget so the sparse batch stays
+        # sparse and must take the tiled route
+        import photon_ml_tpu.parallel.distributed as dist
+
+        calls = {"tiled": 0}
+        orig = dist._sharded_tiled_solve
+
+        def spy(*a, **kw):
+            calls["tiled"] += 1
+            return orig(*a, **kw)
+
+        dist._sharded_tiled_solve = spy
+        try:
+            import photon_ml_tpu.ops.streaming as ost
+
+            orig_budget = ost.device_hbm_budget_bytes
+            ost.device_hbm_budget_bytes = lambda *a, **kw: 1.0
+            try:
+                res = sharded_minimize(
+                    lbfgs_minimize, batch, jnp.zeros(d, jnp.float32), cfg,
+                    data_mesh(8), loss, l2_weight=1.0,
+                )
+            finally:
+                ost.device_hbm_budget_bytes = orig_budget
+        finally:
+            dist._sharded_tiled_solve = orig
+        assert calls["tiled"] == 1, "mesh solve did not take the tiled route"
+        np.testing.assert_allclose(
+            np.asarray(res.w), np.asarray(ref.w), rtol=5e-3, atol=5e-4
+        )
+        np.testing.assert_allclose(
+            float(res.value), float(ref.value), rtol=1e-5
+        )
