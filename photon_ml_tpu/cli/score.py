@@ -47,10 +47,13 @@ def run(
     (replicated, on-disk) model, scores ITS round-robin slice of the input
     part files, and writes its own output partition
     (``part-{process_index:05d}.avro``) — no collectives on the scoring
-    path itself. Requested metrics are computed GLOBALLY by allgathering
-    (score, label, weight) with zero-weight padding (inert to every
-    evaluator), identically on every host; grouped (Multi*) evaluators are
-    rejected in this mode. Process 0 writes ``metrics.json``.
+    path itself. Requested scalar metrics are computed GLOBALLY by
+    allgathering (score, label, weight) with zero-weight padding (inert to
+    every evaluator), identically on every host; grouped (Multi*)
+    evaluators owner-route (score, label, entity id) rows once per id tag
+    and combine per-group partials — which needs the tag's GLOBAL entity
+    dictionary, i.e. a training-saved entity map. Process 0 writes
+    ``metrics.json``.
     """
     import jax
 
@@ -67,16 +70,6 @@ def run(
             output_dir if is_output_process() else None
         )
 
-        if evaluators:
-            from photon_ml_tpu.evaluation import make_evaluator
-
-            grouped = [s for s in evaluators if make_evaluator(s).group_by]
-            if grouped:
-                raise ValueError(
-                    f"--multihost scoring does not support grouped "
-                    f"evaluators {grouped} (the global allgather carries "
-                    f"no entity ids); run them single-host"
-                )
         files: list[str] = []
         for p_ in data:
             files.extend(list_avro_files(p_))
@@ -121,6 +114,32 @@ def run(
         for sub in model.models.values()
         if isinstance(sub, RandomEffectModel)
     )
+    if evaluators:
+        # grouped (Multi*) evaluators group on ANY datum id tag, not only
+        # the model's random-effect types (SURVEY §2.2 evaluators row) —
+        # the reader must extract those columns too
+        from photon_ml_tpu.evaluation import make_evaluator
+
+        eval_tags = [
+            make_evaluator(s).group_by
+            for s in evaluators
+            if make_evaluator(s).group_by is not None
+        ]
+        id_tags = tuple(dict.fromkeys([*id_tags, *eval_tags]))
+        missing = [t for t in eval_tags if t not in entity_maps]
+        if missing and (multihost or entity_maps):
+            # multihost: per-host reader dictionaries would disagree.
+            # single-host with OTHER frozen maps present: the reader would
+            # freeze the missing tag to an empty map (every id -> the -1
+            # sentinel), silently evaluating the metric over nothing. Only
+            # a model dir with NO entity-maps.json at all lets the reader
+            # build fresh single-host dictionaries for every tag.
+            raise ValueError(
+                f"grouped evaluators need the id tags in the "
+                f"training-saved entity-maps.json; missing: {missing} "
+                f"(declare the evaluator at training time so its tag's "
+                f"entity map is extracted and saved)"
+            )
     reader = AvroDataReader(feature_shards)
     ds = None
     # single-host empty input keeps its loud error; only a multihost member
@@ -147,12 +166,32 @@ def run(
         else:
             scores = np.zeros(0)
         if evaluators and multihost:
-            metrics = _global_metrics_multihost(
-                list(evaluators),
-                np.asarray(scores),
-                np.asarray(ds.batch.labels) if ds is not None else np.zeros(0),
-                np.asarray(ds.batch.weights) if ds is not None else np.zeros(0),
-            )
+            from photon_ml_tpu.evaluation import make_evaluator
+
+            scalar_specs = [
+                s for s in evaluators if make_evaluator(s).group_by is None
+            ]
+            grouped_specs = [
+                s for s in evaluators if make_evaluator(s).group_by is not None
+            ]
+            metrics = {}
+            if scalar_specs:
+                metrics.update(_global_metrics_multihost(
+                    scalar_specs,
+                    np.asarray(scores),
+                    np.asarray(ds.batch.labels) if ds is not None else np.zeros(0),
+                    np.asarray(ds.batch.weights) if ds is not None else np.zeros(0),
+                ))
+            if grouped_specs:
+                metrics.update(_grouped_metrics_multihost(
+                    grouped_specs,
+                    np.asarray(scores),
+                    np.asarray(ds.batch.labels) if ds is not None else np.zeros(0),
+                    {
+                        t: np.asarray(v)
+                        for t, v in (ds.batch.id_tags if ds is not None else {}).items()
+                    },
+                ))
             logger.info(f"scoring evaluation (global): {metrics}")
 
     with timed(logger, "write scores"):
@@ -199,6 +238,64 @@ def _global_metrics_multihost(
     )
     results = evaluate_all(specs, s.ravel(), y.ravel(), w.ravel())
     return dict(results.metrics)
+
+
+def _grouped_metrics_multihost(
+    specs: list[str],
+    scores: np.ndarray,
+    labels: np.ndarray,
+    id_tag_values: dict[str, np.ndarray],
+) -> dict:
+    """Grouped (Multi*) metrics over multihost-scored rows: one
+    owner-routing exchange per id tag (each row's (score, label, entity
+    id) travels to the entity's owner — global dense ids from the
+    training-saved entity map, unseen-entity sentinel -1 rows dropped),
+    per-group partials from COMPLETE groups, one (sum, count) allreduce
+    per spec. No host ever gathers a global score column (the same
+    owner-side recipe as the streamed trainer's validation —
+    ``evaluation.host_sharded``). Collective: every process calls with the
+    same specs in the same order; a host with no input rows participates
+    with empty arrays."""
+    import jax
+
+    from photon_ml_tpu.evaluation import make_evaluator
+    from photon_ml_tpu.evaluation.evaluators import (
+        grouped_auc_parts,
+        grouped_precision_at_k_parts,
+    )
+    from photon_ml_tpu.parallel.multihost import (
+        allreduce_sum_host,
+        exchange_rows,
+    )
+
+    P_ = max(jax.process_count(), 1)
+    routed: dict[str, tuple] = {}
+    out: dict[str, float] = {}
+    for spec in specs:
+        ev = make_evaluator(spec)
+        tag = ev.group_by
+        if tag not in routed:
+            gids = np.asarray(
+                id_tag_values.get(tag, np.zeros(0, np.int64)), np.int64
+            )
+            keep = np.flatnonzero(gids >= 0)
+            recv = exchange_rows(
+                {
+                    "gid": gids[keep],
+                    "score": np.asarray(scores, np.float32)[keep],
+                    "label": np.asarray(labels, np.float32)[keep],
+                },
+                (gids[keep] % P_).astype(np.int64),
+            )
+            routed[tag] = (recv["score"], recv["label"], recv["gid"])
+        s_o, y_o, g_o = routed[tag]
+        if ev.k is not None:
+            part = grouped_precision_at_k_parts(s_o, y_o, g_o, ev.k)
+        else:
+            part = grouped_auc_parts(s_o, y_o, g_o)
+        tot = allreduce_sum_host(np.asarray(part, np.float64))
+        out[spec] = float(tot[0] / tot[1]) if tot[1] > 0 else float("nan")
+    return out
 
 
 def _random_effects(game_dir: str) -> dict:

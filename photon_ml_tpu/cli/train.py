@@ -58,9 +58,7 @@ def run(
             multihost=multihost,
             profile_dir=profile_dir,
         )
-    id_tags = tuple(
-        cfg.random_effect_type for cfg in config.random_effect_coordinates.values()
-    )
+    id_tags = _game_id_tags(config)
     reader = AvroDataReader(config.feature_shards or None)
 
     # prepareFeatureMaps parity: load prebuilt index stores when given
@@ -212,6 +210,26 @@ def run(
     return best
 
 
+
+def _game_id_tags(config: GameTrainingConfig) -> tuple[str, ...]:
+    """Id-tag columns the datums must carry: every random-effect type PLUS
+    every grouped evaluator's group-by tag — the reference's Multi*
+    evaluators group on ANY datum id tag, not only coordinate entity
+    types (SURVEY §2.2 evaluators row), so a validation-only tag must be
+    extracted (and its entity map saved) too."""
+    from photon_ml_tpu.evaluation import make_evaluator
+
+    tags = [
+        c.random_effect_type
+        for c in config.random_effect_coordinates.values()
+    ]
+    for spec in config.evaluators:
+        gb = make_evaluator(spec).group_by
+        if gb is not None:
+            tags.append(gb)
+    return tuple(dict.fromkeys(tags))
+
+
 def _streamed_unsupported(config: GameTrainingConfig) -> list[str]:
     """Config features the out-of-core branch rejects (used both to fail
     fast on an EXPLICIT --streaming-chunk-rows and to veto AUTO-selection
@@ -331,9 +349,7 @@ def _run_streamed_game(
             "--streaming-chunk-rows does not support: " + ", ".join(unsupported)
         )
 
-    id_tags = tuple(
-        cfg.random_effect_type for cfg in config.random_effect_coordinates.values()
-    )
+    id_tags = _game_id_tags(config)
     reader = AvroDataReader(config.feature_shards or None)
     train_paths = _expand_part_files(train_data)
     # warm start: seed the entity dictionaries with the saved run's maps so

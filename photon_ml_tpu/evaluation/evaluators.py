@@ -223,17 +223,32 @@ class Evaluator:
                 raise KeyError(
                     f"evaluator {self.name} needs id tag {self.group_by!r}"
                 )
-            # Densify ids first: arbitrary (sparse, negative, even string)
-            # ids become contiguous [0, G) — every distinct id is a group,
-            # exactly the host-lexsort semantics, and the device segment
+            # INTEGER tags: unseen-entity sentinel rows (id -1, from
+            # frozen entity maps) are EXCLUDED, matching the streamed /
+            # multi-host paths — the sentinel is not an entity, and
+            # pooling unrelated unseen rows into one pseudo-group silently
+            # degraded the metric toward the global value. (Framework
+            # readers never emit real negative entity ids.) Non-integer
+            # (e.g. string) tags have no sentinel and pass unfiltered.
+            # Then densify: arbitrary (sparse, even string) ids become
+            # contiguous [0, G) — every distinct id is a group, exactly
+            # the host-lexsort semantics, and the device segment
             # reductions size by G, not by max(id).
             gids_host = np.asarray(group_ids[self.group_by])
+            scores_k, labels_k = np.asarray(scores), np.asarray(labels)
+            if np.issubdtype(gids_host.dtype, np.signedinteger):
+                keep = gids_host >= 0
+                if not keep.all():
+                    gids_host = gids_host[keep]
+                    scores_k, labels_k = scores_k[keep], labels_k[keep]
+            if len(gids_host) == 0:
+                return float("nan")
             uniq, dense = np.unique(gids_host, return_inverse=True)
             num_groups = max(len(uniq), 1)
             return float(
                 self._fn(
-                    jnp.asarray(scores),
-                    jnp.asarray(labels),
+                    jnp.asarray(scores_k),
+                    jnp.asarray(labels_k),
                     jnp.asarray(dense.astype(np.int32)),
                     num_groups,
                 )

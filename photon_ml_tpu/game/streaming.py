@@ -49,11 +49,16 @@ Parity features the in-memory descent has and this trainer matches:
 - honest per-coordinate diagnostics (real per-entity iteration counts and
   convergence, aggregated — never fabricated).
 
-Normalization contexts (per-shard, from a streamed summary), SIMPLE
-variance computation, fixed-effect down-sampling, shared random
-projection, and per-entity subspace projection are supported at parity
-with the in-memory path. Scope (documented limits, not silent ones): no
-FULL variances, no normalization × projection, and no checkpointing of
+Normalization contexts (per-shard, from a streamed summary), SIMPLE and
+FULL variance computation (FULL: one extra streamed pass accumulating
+the d×d fixed-effect Hessian chunk-wise, bounded at
+``StreamingGLMObjective.FULL_HESSIAN_MAX_D``), incremental MAP priors,
+diagnostics, fixed-effect down-sampling, shared random projection, and
+per-entity subspace projection are supported at parity with the
+in-memory path. Grouped validation metrics work multi-host for ANY id
+tag — tags without a random-effect coordinate get a one-time
+owner-routing pass (``_build_val_route``). Scope (documented limits, not
+silent ones): no normalization × projection, and no checkpointing of
 RANDOM-projected coordinates — unsupported configs raise at
 construction.
 """
@@ -311,26 +316,6 @@ class StreamedGameTrainer:
         # the jitted chunk kernels take the chunk as an argument, so only
         # the FIRST visit compiles; later visits just swap the chunk list
         self._fixed_objectives: dict[str, StreamingGLMObjective] = {}
-        if self.multihost:
-            # multi-host grouped validation metrics evaluate OWNER-side
-            # through the tag's validation re-shard; a tag with no
-            # random-effect coordinate has no owner routing — reject at
-            # construction, not mid-fit
-            from photon_ml_tpu.evaluation.evaluators import make_evaluator
-
-            re_types = {
-                c.random_effect_type
-                for c in config.random_effect_coordinates.values()
-            }
-            for spec in self.evaluators:
-                ev = make_evaluator(spec)
-                if ev.group_by is not None and ev.group_by not in re_types:
-                    raise NotImplementedError(
-                        f"evaluator {spec}: multi-host streamed validation "
-                        f"computes grouped metrics owner-side and needs a "
-                        f"random-effect coordinate of type "
-                        f"{ev.group_by!r}"
-                    )
         # per-shard normalization contexts, built once per fit from a
         # streamed feature summary (reference computes these on its only,
         # distributed path — SURVEY §2.2 normalization row)
@@ -1093,10 +1078,14 @@ class StreamedGameTrainer:
         state["total"] = state["base_offsets"].copy()
         if self._distributed():
             # grouped evaluators (MULTI_AUC / PRECISION_AT_K) evaluate
-            # OWNER-side: the tag's validation re-shard already routed each
-            # entity's rows to one host, so per-group metrics compute
-            # exactly from complete groups and combine as (sum, count)
-            # partials — no host ever gathers a global column
+            # OWNER-side: for a tag with a random-effect coordinate, the
+            # tag's validation re-shard already routed each entity's rows
+            # to one host; a VALIDATION-ONLY tag (no coordinate — the
+            # reference's Multi* evaluators group on ANY datum id tag,
+            # SURVEY §2.2 evaluators row) gets its own one-time routing
+            # pass. Either way per-group metrics compute exactly from
+            # complete groups and combine as (sum, count) partials — no
+            # host ever gathers a global column
             from photon_ml_tpu.evaluation.evaluators import make_evaluator
 
             by_type = {
@@ -1104,12 +1093,65 @@ class StreamedGameTrainer:
                 for cid, c in cfg.random_effect_coordinates.items()
             }
             grouped_tags: dict[str, str] = {}
+            val_routes: dict[str, _ReShard] = {}
             for spec in self.evaluators:
                 ev = make_evaluator(spec)
-                if ev.group_by is not None and ev.group_by in by_type:
-                    grouped_tags[ev.group_by] = by_type[ev.group_by]
+                tag = ev.group_by
+                if tag is None or tag in grouped_tags or tag in val_routes:
+                    continue
+                if tag in by_type:
+                    grouped_tags[tag] = by_type[tag]
+                elif tag in validation.id_tags:
+                    val_routes[tag] = self._build_val_route(
+                        tag, validation, val_base
+                    )
+                else:
+                    raise KeyError(
+                        f"evaluator {spec}: validation data carries no id "
+                        f"tag {tag!r}"
+                    )
             state["grouped_tags"] = grouped_tags
+            state["val_routes"] = val_routes
         return state
+
+    def _build_val_route(
+        self, tag: str, validation: StreamedGameData, row_base: int
+    ) -> _ReShard:
+        """One-time owner routing for a grouped-evaluator id tag WITHOUT a
+        random-effect coordinate: ship (entity id, label, global row id)
+        to each entity's owner once at validation setup; per visit only the
+        current total scores flow through ``_offsets_to_owners`` (the same
+        exchange the re-shards use). The result is a featureless
+        ``_ReShard`` — grouping columns only, nothing to solve."""
+        from photon_ml_tpu.parallel.multihost import exchange_rows
+
+        pid, P = _num_processes()
+        ids = np.asarray(validation.id_tags[tag], np.int64)
+        keep = np.flatnonzero(ids >= 0)
+        gids = ids[keep]
+        grow_in = row_base + keep.astype(np.int64)
+        dest = (gids % max(P, 1)).astype(np.int64)
+        labels = np.asarray(validation.labels, np.float32)[keep]
+        recv = exchange_rows(
+            {"gid": gids, "label": labels, "grow": grow_in}, dest
+        )
+        grow = recv["grow"]
+        order = np.argsort(grow)
+        return _ReShard(
+            ent_local=(recv["gid"] // max(P, 1)).astype(np.int64),
+            labels=recv["label"],
+            weights=np.ones(len(grow), np.float32),
+            features=None,
+            grow=grow,
+            grow_sorted=grow[order],
+            grow_order=order,
+            grouping=None,
+            buckets=None,
+            num_entities_local=0,
+            origin_grow=grow_in,
+            origin_dest=dest,
+            owner_dest=None,
+        )
 
     def _val_scores_for(
         self,
@@ -1197,7 +1239,10 @@ class StreamedGameTrainer:
                 continue
             tag = ev.group_by
             if self._distributed():
-                shard = vstate["re_shards"][vstate["grouped_tags"][tag]]
+                if tag in vstate["grouped_tags"]:
+                    shard = vstate["re_shards"][vstate["grouped_tags"][tag]]
+                else:  # validation-only tag: its dedicated routing shard
+                    shard = vstate["val_routes"][tag]
                 tot_o = self._offsets_to_owners(
                     shard, vstate["total"], vstate["base"]
                 )

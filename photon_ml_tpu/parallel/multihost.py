@@ -268,26 +268,38 @@ def exchange_rows(arrays, dest: np.ndarray):
     point-to-point shuffle the reference does with a Spark exchange.
 
     Unlike ``allgather_row_chunks`` (every row to EVERY host: O(P·n)
-    traffic), this routes each row only to its destination via
-    ``lax.all_to_all`` over the process mesh: per-host traffic is
-    O(max-bucket · P) ≈ O(n_local) when destinations are balanced.
+    traffic), this routes each row only to its destination. Two transports,
+    chosen per call from the globally-consistent (P, P) bucket-count
+    matrix:
+
+    - **Balanced** (padded allocation ≤ 2× payload): one
+      ``lax.all_to_all`` over the process mesh — rides ICI on pods, one
+      compiled program re-entered when per-visit counts are stable.
+      SPMD collectives require UNIFORM (source, dest) block sizes, so
+      every bucket pads to the global max — fine when destinations are
+      balanced, structurally O(P×payload) under entity skew (one hot
+      entity ⇒ one hot owner ⇒ one huge bucket sets every bucket's pad).
+    - **Skewed** (padding would exceed 2× payload): a host-side TCP
+      point-to-point exchange (``_host_p2p_exchange``) sending each
+      bucket EXACTLY — zero padding under any skew, the direct analog of
+      the reference's Netty shuffle riding DCN (SURVEY §2.7). Per-host
+      traffic is O(rows sent + rows owned) always.
+
     Returns a dict of received rows (grouped by source process, sources in
     ascending order — every process receives with the same layout rule, so
-    the result is deterministic). Single process: identity.
-
-    All processes must call this collectively with the same key set.
-    Bucket padding is sized by a global max, so the compiled exchange is
-    re-entered (not recompiled) when per-visit counts are stable.
+    the result is deterministic and transport-independent). Single
+    process: identity. All processes must call this collectively with the
+    same key set.
     """
     arrays = {k: np.asarray(v) for k, v in arrays.items()}
     P_ = jax.process_count()
     if P_ <= 1:
         LAST_EXCHANGE_STATS.update(
-            bytes_sent=0, rows_sent=len(dest), padded_rows=len(dest)
+            bytes_sent=0, rows_sent=len(dest), padded_rows=len(dest),
+            transport="local",
         )
         return arrays
     from jax.experimental import multihost_utils as mhu
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     dest = np.asarray(dest, np.int64)
@@ -300,6 +312,13 @@ def exchange_rows(arrays, dest: np.ndarray):
         mhu.process_allgather(counts)
     ).reshape(P_, P_)
     maxc = max(int(counts_matrix.max()), 1)
+
+    # transport decision — identical on every process (counts_matrix is):
+    # all_to_all allocates P·maxc slots per process against its
+    # counts.sum() real rows; beyond 2× padding, go point-to-point.
+    total_payload = max(int(counts_matrix.sum()), 1)
+    if P_ * P_ * maxc > 2 * total_payload:
+        return _host_p2p_exchange(arrays, order, starts, counts_matrix)
 
     mesh = _process_mesh()
     pid = jax.process_index()
@@ -325,8 +344,196 @@ def exchange_rows(arrays, dest: np.ndarray):
         bytes_sent=bytes_sent,
         rows_sent=int(counts.sum()),
         padded_rows=P_ * maxc * len(arrays),
+        transport="all_to_all",
     )
     return out
+
+
+# lazily-built full TCP mesh between processes for the skewed-exchange
+# transport: {"send": {peer: socket}, "recv": {peer: socket}}
+_HOST_LINKS: dict | None = None
+
+
+def _local_ip() -> str:
+    """This host's address as peers should dial it. Override with
+    ``PHOTON_EXCHANGE_HOST`` to pin a specific NIC. Otherwise discover the
+    OUTBOUND interface by UDP-connecting toward the ``jax.distributed``
+    coordinator (no packet is sent; the kernel just picks the route) —
+    ``gethostbyname(gethostname())`` is NOT used because stock
+    Debian/Ubuntu ``/etc/hosts`` maps the hostname to 127.0.1.1, which
+    would advertise an undialable loopback to remote peers."""
+    explicit = os.environ.get("PHOTON_EXCHANGE_HOST")
+    if explicit:
+        return explicit
+    import socket
+
+    target = os.environ.get("JAX_COORDINATOR_ADDRESS", "")
+    host = target.rsplit(":", 1)[0] if target else ""
+    for probe in filter(None, [host, "8.8.8.8"]):
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.connect((probe, 53))
+                return s.getsockname()[0]
+        except OSError:
+            continue
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    chunks = []
+    while n:
+        part = sock.recv(min(n, 1 << 20))
+        if not part:
+            raise ConnectionError("exchange peer closed the connection")
+        chunks.append(part)
+        n -= len(part)
+    return b"".join(chunks)
+
+
+def _host_links() -> dict:
+    """Build (once) the P×P socket mesh: every ordered pair (i → j) gets a
+    dedicated unidirectional TCP connection, so concurrent sends and
+    receives never share a stream. Address discovery bootstraps over the
+    existing ``jax.distributed`` runtime: each process allgathers its
+    (IPv4, port) as five small ints — the only use of a collective here.
+    Must be called collectively."""
+    global _HOST_LINKS
+    if _HOST_LINKS is not None:
+        return _HOST_LINKS
+    import socket
+    import struct
+    import threading
+
+    from jax.experimental import multihost_utils as mhu
+
+    P_ = jax.process_count()
+    pid = jax.process_index()
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("0.0.0.0", 0))
+    srv.listen(P_)
+    port = srv.getsockname()[1]
+    ip = np.frombuffer(
+        socket.inet_aton(_local_ip()), np.uint8
+    ).astype(np.int64)
+    addrs = np.asarray(
+        mhu.process_allgather(np.concatenate([ip, [port]]))
+    ).reshape(P_, 5)
+
+    recv_socks: dict[int, socket.socket] = {}
+
+    def accept_all():
+        for _ in range(P_ - 1):
+            conn, _ = srv.accept()
+            conn.settimeout(300.0)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            src = struct.unpack("!i", _recv_exact(conn, 4))[0]
+            recv_socks[src] = conn
+
+    acceptor = threading.Thread(target=accept_all, daemon=True)
+    acceptor.start()
+    send_socks: dict[int, socket.socket] = {}
+    for r in range(1, P_):
+        peer = (pid + r) % P_
+        peer_ip = socket.inet_ntoa(
+            addrs[peer, :4].astype(np.uint8).tobytes()
+        )
+        s = socket.create_connection(
+            (peer_ip, int(addrs[peer, 4])), timeout=300.0
+        )
+        s.settimeout(300.0)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.sendall(struct.pack("!i", pid))
+        send_socks[peer] = s
+    acceptor.join(timeout=300.0)
+    if len(recv_socks) != P_ - 1:
+        raise RuntimeError(
+            f"host exchange mesh incomplete: accepted {len(recv_socks)} "
+            f"of {P_ - 1} peers"
+        )
+    srv.close()
+    _HOST_LINKS = {"send": send_socks, "recv": recv_socks}
+    return _HOST_LINKS
+
+
+def _host_p2p_exchange(arrays, order, starts, counts_matrix):
+    """Skew-robust transport for ``exchange_rows``: each (source, dest)
+    bucket travels EXACTLY, length-prefixed, over its pair's dedicated TCP
+    link — no padding under any skew (an SPMD collective must pad every
+    bucket to a uniform size, which costs O(P × payload) when one entity
+    dominates). Sends run on a helper thread in rotation order (round r:
+    send to pid+r, receive from pid−r) so every process's receiver drains
+    concurrently — no cyclic wait. Layout of the result matches the
+    all_to_all transport exactly (ascending source, stable within source).
+    """
+    import struct
+    import threading
+
+    P_ = jax.process_count()
+    pid = jax.process_index()
+    links = _host_links()
+    keys = sorted(arrays)
+    parts: dict[str, dict[int, np.ndarray]] = {
+        k: {pid: np.ascontiguousarray(
+            arrays[k][order[starts[pid]:starts[pid + 1]]]
+        )}
+        for k in keys
+    }
+    bytes_sent = 0
+    send_err: list[BaseException] = []
+
+    def send_all():
+        nonlocal bytes_sent
+        try:
+            for r in range(1, P_):
+                peer = (pid + r) % P_
+                sock = links["send"][peer]
+                for k in keys:
+                    rows = order[starts[peer]:starts[peer + 1]]
+                    buf = np.ascontiguousarray(arrays[k][rows]).tobytes()
+                    sock.sendall(struct.pack("!q", len(buf)))
+                    sock.sendall(buf)
+                    bytes_sent += len(buf)
+        except BaseException as e:  # surfaced after join
+            send_err.append(e)
+
+    sender = threading.Thread(target=send_all)
+    sender.start()
+    for r in range(1, P_):
+        src = (pid - r) % P_
+        sock = links["recv"][src]
+        for k in keys:
+            a = arrays[k]
+            n = int(counts_matrix[src, pid])
+            want = n * a.itemsize * int(np.prod(a.shape[1:], dtype=np.int64))
+            got = struct.unpack("!q", _recv_exact(sock, 8))[0]
+            if got != want:
+                raise RuntimeError(
+                    f"exchange size mismatch from process {src} key {k!r}: "
+                    f"expected {want} bytes ({n} rows), got {got}"
+                )
+            raw = _recv_exact(sock, got)
+            parts[k][src] = np.frombuffer(raw, a.dtype).reshape(
+                (n,) + a.shape[1:]
+            ).copy()
+    sender.join()
+    if send_err:
+        raise send_err[0]
+    counts_local = counts_matrix[pid]
+    LAST_EXCHANGE_STATS.update(
+        bytes_sent=bytes_sent,
+        rows_sent=int(counts_local.sum()),
+        # same accounting as the all_to_all branch (allocated row-slots,
+        # summed over keys) — here exactly the payload: zero padded slots
+        padded_rows=int(counts_local.sum()) * len(arrays),
+        transport="p2p_host",
+    )
+    return {
+        k: np.concatenate([parts[k][s] for s in range(P_)]) for k in keys
+    }
 
 
 def allreduce_max_host(*arrays: np.ndarray):

@@ -196,14 +196,18 @@ class TestScalableEvaluators:
 
     def test_multi_evaluator_uses_device_path_with_unseen_entities(self, rng):
         """MULTI_AUC through the registry (device path) must match the host
-        implementation, including id -1 (unseen entity) forming a group."""
+        implementation; id -1 (unseen-entity sentinel) rows are EXCLUDED —
+        the streamed/multi-host contract (r5: the in-memory path used to
+        pool them as one pseudo-group, silently pulling the metric toward
+        the global value)."""
         n = 800
         scores = rng.normal(size=n)
         labels = (rng.uniform(size=n) < 0.4).astype(float)
         gids = rng.integers(-1, 6, size=n).astype(np.int32)  # includes -1
         ev = make_evaluator("MULTI_AUC(userId)")
         got = ev(scores, labels, group_ids={"userId": gids})
-        expect = grouped_auc(scores, labels, gids)
+        keep = gids >= 0
+        expect = grouped_auc(scores[keep], labels[keep], gids[keep])
         np.testing.assert_allclose(got, expect, rtol=1e-9)
 
     def test_bucketed_auc_registry_spec(self, rng):
@@ -354,3 +358,23 @@ class TestHostShardedEvaluation:
                 ["MULTI_AUC(missing)"], scores, labels, weights,
                 owner_grouped={},
             )
+
+
+def test_grouped_evaluator_excludes_unseen_sentinel(rng):
+    """Rows whose group id is the unseen-entity sentinel (-1, from frozen
+    entity maps) are EXCLUDED from grouped metrics — matching the
+    streamed/multi-host paths; pooling them as one pseudo-group silently
+    pulled the metric toward the global value. All-sentinel input: nan."""
+    from photon_ml_tpu.evaluation.evaluators import make_evaluator
+
+    n = 64
+    scores = rng.normal(size=n).astype(np.float32)
+    labels = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    gids = rng.integers(0, 4, size=n).astype(np.int64)
+    gids[::3] = -1
+    ev = make_evaluator("MULTI_AUC(q)")
+    got = ev(scores, labels, group_ids={"q": gids})
+    keep = gids >= 0
+    want = ev(scores[keep], labels[keep], group_ids={"q": gids[keep]})
+    np.testing.assert_allclose(got, want)
+    assert np.isnan(ev(scores, labels, group_ids={"q": np.full(n, -1)}))

