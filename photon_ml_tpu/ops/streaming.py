@@ -200,13 +200,15 @@ class StreamingGLMObjective:
         self._tile_layouts = None
         self._tile_meta = None
         self._tile_fingerprints = None
+        from photon_ml_tpu.ops.sparse_tiled import tiling_economical_features
+
         sparse = bool(self.chunks) and "indices" in self.chunks[0]
         want_tiling = (
             self.tile_sparse
             if self.tile_sparse is not None
             else (
                 sparse
-                and self.num_features >= 4096
+                and tiling_economical_features(self.num_features)
                 and jax.default_backend() == "tpu"
             )
         )
@@ -308,6 +310,26 @@ class StreamingGLMObjective:
             hashlib.sha256(val.tobytes()).hexdigest(),
         )
 
+    @staticmethod
+    def _same_storage(a, b) -> bool:
+        """True when ``a`` and ``b`` are numpy arrays over the SAME memory
+        (identical object, or fresh views of one base with the same data
+        pointer/shape/strides). The GAME trainer re-slices its feature
+        arrays every visit — each swap passes NEW view objects over
+        unchanged storage, so a plain ``is`` check would re-hash the whole
+        design matrix once per coordinate visit."""
+        if a is b:
+            return True
+        if not (isinstance(a, np.ndarray) and isinstance(b, np.ndarray)):
+            return False
+        ai, bi = a.__array_interface__, b.__array_interface__
+        return (
+            ai["data"] == bi["data"]
+            and ai["shape"] == bi["shape"]
+            and ai["strides"] == bi["strides"]
+            and a.dtype == b.dtype
+        )
+
     def __setattr__(self, name, value):
         if (
             name == "chunks"
@@ -315,9 +337,9 @@ class StreamingGLMObjective:
         ):
             # the cached layouts were built from the PREVIOUS chunks'
             # indices/values; a swap may only change labels/offsets/weights
-            # (the GAME trainer's per-visit residual swap). Identity check
-            # first: the common swap reuses the very same arrays, and the
-            # byte-exact hash is only worth paying for fresh ones.
+            # (the GAME trainer's per-visit residual swap). Same-storage
+            # check first: the common swap re-slices the same arrays, and
+            # the byte-exact hash is only worth paying for fresh storage.
             old_chunks = getattr(self, "chunks", None)
             for i, c in enumerate(value):
                 prev = (
@@ -327,8 +349,8 @@ class StreamingGLMObjective:
                 )
                 if (
                     prev is not None
-                    and c.get("indices") is prev.get("indices")
-                    and c.get("values") is prev.get("values")
+                    and self._same_storage(c.get("indices"), prev.get("indices"))
+                    and self._same_storage(c.get("values"), prev.get("values"))
                 ):
                     continue
                 if (

@@ -52,6 +52,25 @@ def shard_batch(batch: Batch, mesh: Mesh, axis_name: str = "data") -> Batch:
     return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
 
 
+def _densify_sharded(batch, mesh: Mesh, axis_name: str = "data"):
+    """Densify a sparse batch whose dense form fits the MESH's HBM but not
+    one chip's: row-shard the sparse arrays first, then scatter each
+    device's own (n/P, d) block under ``shard_map`` — the full (n, d)
+    matrix never exists on any single device."""
+    from photon_ml_tpu.ops.batch import densify
+
+    batch = shard_batch(batch, mesh, axis_name)
+    fn = jax.jit(
+        jax.shard_map(
+            densify,
+            mesh=mesh,
+            in_specs=P(axis_name),
+            out_specs=P(axis_name),
+        )
+    )
+    return fn(batch)
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -163,11 +182,17 @@ def sharded_minimize(
         )
         from photon_ml_tpu.ops.streaming import device_hbm_budget_bytes
 
-        # the densified batch row-shards 1/n_dev per device — budget the
-        # WHOLE mesh's HBM, not one chip's
-        batch = maybe_densify(
-            batch, device_hbm_budget_bytes() * mesh.shape[axis_name]
-        )
+        # densify when the dense matrix fits the MESH's total HBM — but
+        # never materialize more than one chip's worth on one chip: over
+        # one-chip budget, the rows are sharded first and each device
+        # scatters only its own (n/P, d) block
+        n_dev = mesh.shape[axis_name]
+        one_chip = device_hbm_budget_bytes()
+        dense_bytes = batch.num_rows * batch.num_features * 4
+        if dense_bytes <= one_chip:
+            batch = maybe_densify(batch, one_chip)
+        elif dense_bytes <= one_chip * n_dev:
+            batch = _densify_sharded(batch, mesh, axis_name)
         if isinstance(batch, SparseBatch) and supports_tiling(batch):
             stacked, _ = tile_sparse_batch_sharded(
                 batch, mesh.shape[axis_name]

@@ -110,9 +110,20 @@ class TestNarrativeNumberDiscipline:
         ]
 
     def test_prose_multipliers_are_artifact_backed(self):
+        import glob
+
         here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        with open(os.path.join(here, "BENCH_DETAIL.json")) as f:
-            artifact = f.read()
+        # Union of the session artifact (BENCH_DETAIL.json, gitignored — may
+        # not exist on a fresh checkout) and every COMMITTED driver capture
+        # (BENCH_r*.json): a prose claim backed by either survives.
+        pieces = []
+        for path in sorted(
+            glob.glob(os.path.join(here, "BENCH_*.json"))
+        ):
+            with open(path) as f:
+                pieces.append(f.read())
+        assert pieces, "no BENCH_*.json artifact found to audit against"
+        artifact = "\n".join(pieces)
         offenders = []
         for name in ("README.md", "BASELINE.md"):
             with open(os.path.join(here, name)) as f:

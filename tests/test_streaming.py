@@ -863,3 +863,55 @@ class TestTiledStreamedChunks:
         idx2 = rng.integers(0, d, size=(n, k)).astype(np.int32)
         with pytest.raises(ValueError, match="indices/values"):
             tiled.chunks = sparse_chunks(idx2, val, y, chunk_rows=1024)
+
+
+class TestChunkSwapFastPath:
+    def test_view_swap_skips_rehash(self, rng, monkeypatch):
+        """The per-visit residual swap passes FRESH numpy views over the
+        same feature storage (the trainer re-slices its arrays each
+        visit); the layout guard must recognize same-storage views and
+        skip the SHA-256 over the whole design matrix — byte-identical
+        COPIES still take the hash path (and pass). Cached layouts are
+        SIMULATED (sentinel `_tile_layouts`) so this guard test compiles
+        no kernels — the tiled numerics are covered by
+        TestTiledStreamedChunks."""
+        n, d, k = 2048, 4096, 4
+        idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        val = rng.normal(size=(n, k)).astype(np.float32)
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        tiled = StreamingGLMObjective(
+            sparse_chunks(idx, val, y, chunk_rows=1024),
+            LOSS, num_features=d, l2_weight=0.4, tile_sparse=False,
+        )
+        tiled._tile_fingerprints = [
+            StreamingGLMObjective._chunk_fingerprint(c) for c in tiled.chunks
+        ]
+        tiled._tile_layouts = [None] * len(tiled.chunks)  # activate guard
+        hashed = []
+        orig = StreamingGLMObjective._chunk_fingerprint
+
+        def counting(chunk):
+            hashed.append(1)
+            return orig(chunk)
+
+        monkeypatch.setattr(
+            StreamingGLMObjective, "_chunk_fingerprint",
+            staticmethod(counting),
+        )
+        # fresh view objects, same storage: fast path, no hashing
+        new_off = rng.normal(size=n).astype(np.float32)
+        tiled.chunks = sparse_chunks(
+            idx, val, y, chunk_rows=1024, offsets=new_off
+        )
+        assert not hashed
+        # byte-equal copies: different storage, hash verifies and accepts
+        tiled.chunks = sparse_chunks(
+            idx.copy(), val.copy(), y, chunk_rows=1024, offsets=new_off
+        )
+        assert hashed
+        # changed bytes: rejected through the hash path
+        hashed.clear()
+        idx2 = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        with pytest.raises(ValueError, match="indices/values"):
+            tiled.chunks = sparse_chunks(idx2, val, y, chunk_rows=1024)
+        assert hashed
