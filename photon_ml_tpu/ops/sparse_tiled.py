@@ -40,6 +40,16 @@ Design — write-slab-major tile-COO, built ONCE at ingest:
   hi+mid+lo bf16 terms (Dekker-style, 24 mantissa bits), so the scatter
   runs at the MXU's bf16 rate while staying f32-exact (three passes
   instead of six for HIGHEST-f32).
+- The one-hot operands stage per SEGMENT, not per group
+  (``SEGMENT_BATCHED``, the r5 kernel): the r5 ablation (see the note at
+  the kernel) measured the read gather as fully hidden and the per-group
+  A/B_T staging as the cost center; batching the staging bought 1.41x on
+  the margins direction (30.3 -> 21.4 ms on the A2 shapes, same relay
+  session). Known open asymmetry: the gradient direction (write=col)
+  runs ~3x the margins direction on identical group counts, invariant to
+  read-table size (row chunking), staging mode, and MXU term count — the
+  next profiling step needs per-op visibility inside the kernel that the
+  dev relay cannot provide.
 - margins (``matvec``) and gradient (``rmatvec``) each get their OWN
   layout — write=row/read=col and write=col/read=row respectively — the
   one-time ingest cost buys both directions their batched write slab.
@@ -180,6 +190,121 @@ def build_write_major_layout(
     return _Layout(packed=packed, wslab=wslab, rslab=rslab)
 
 
+# r5 ablation on the A2 shapes (n=2^19, d=2^17, k=32; one chunk,
+# 21.2M padded nnz; relay session of 2026-07-31, ms/matvec):
+#   full 30.3 | single-matmul 25.7 | no-B_T-build 22.1 | no-A-staging
+#   20.4 | no-gather 31.2
+# i.e. the READ gather is fully hidden behind the scatter pipeline, and
+# the cost is the per-group staging of the one-hot operands (A ~33%,
+# B_T ~27%, Dekker's two extra matmuls ~15%). SEGMENT_BATCHED stages
+# whole segments instead: ONE relayout of the packed block to a
+# (1, seg_nnz) row per stream, one batched one-hot compare per segment,
+# matmul operands built as
+# VALUES (no a/bt VMEM scratch round-trip), one batched one-hot build
+# per segment instead of ``groups`` per-group ones.
+SEGMENT_BATCHED = True
+
+
+def _tile_kernel_seg(
+    wslab_ref, rslab_ref, packed_hbm, src_ref, out_ref,
+    acc_scratch, p_scratch, pk_buf, dma_sem,
+    *, n_steps, groups, segs, square_vals,
+):
+    """Segment-batched kernel (see SEGMENT_BATCHED note): per group only
+    the source gather runs (hidden behind the scatter per the ablation);
+    the scatter operands for all ``groups`` groups of a segment stage in
+    one batched build, then the same 3-term Dekker bf16 MXU contraction
+    as the per-group kernel."""
+    step_groups = segs * groups
+    seg_nnz = groups * GROUP
+    iota8 = jax.lax.broadcasted_iota(jnp.int32, (8, GROUP), 0)
+    # int32 iota: this hardware supports no narrower iota (8- and 16-bit
+    # both rejected by Mosaic) — the win here is the batching, not density
+    iota8_seg = jax.lax.broadcasted_iota(jnp.int32, (8, seg_nnz), 0)
+    iota_sub_seg = jax.lax.broadcasted_iota(jnp.int32, (GROUP, seg_nnz), 0)
+    acc_scratch[...] = jnp.zeros_like(acc_scratch)
+
+    def dma(slot, t):
+        return pltpu.make_async_copy(
+            packed_hbm.at[pl.ds(t * step_groups, step_groups)],
+            pk_buf.at[slot],
+            dma_sem.at[slot],
+        )
+
+    dma(0, 0).start()
+
+    def step(t, carry):
+        slot = jax.lax.rem(t, 2)
+        nxt = jax.lax.rem(t + 1, 2)
+
+        @pl.when(t + 1 < n_steps)
+        def _():
+            dma(nxt, t + 1).start()
+
+        dma(slot, t).wait()
+
+        for s2 in range(segs):
+            g0 = s2 * groups
+            for gi in range(groups):
+                g = g0 + gi
+                rd = pk_buf[slot, g, 1, :]
+                lane_r = rd & 127
+                sub_r = (rd >> 7) & 7
+                rslab = rslab_ref[t * step_groups + g]
+                slab = src_ref[pl.ds(pl.multiple_of(rslab * 8, 8), 8), :]
+                gathered = jnp.take_along_axis(
+                    slab, jnp.broadcast_to(lane_r[None, :], (8, GROUP)), axis=1
+                )
+                sel = (iota8 == sub_r[None, :]).astype(jnp.float32)
+                src_vals = jnp.sum(gathered * sel, axis=0)  # (GROUP,)
+                vals = pltpu.bitcast(pk_buf[slot, g, 2:3, :], jnp.float32)[0, :]
+                if square_vals:
+                    vals = vals * vals
+                p_scratch[gi, :] = vals * src_vals
+
+            # whole-segment scatter staging: one relayout per stream,
+            # int8 one-hot compares, operands as values
+            wr = pk_buf[slot, g0:g0 + groups, 0, :]  # (groups, GROUP) i32
+            wr_row = wr.reshape(1, seg_nnz)
+            lane_w = wr_row & 127
+            sub_w = (wr_row >> 7) & 7
+            p_row = p_scratch[...].reshape(1, seg_nnz)
+            # explicit broadcasts + mask-multiply: the implicit (1, n) ->
+            # (8, n) broadcast inside compare/select trips a Mosaic
+            # "invalid relayout" on the i1 mask
+            mask8 = iota8_seg == jnp.broadcast_to(sub_w, (8, seg_nnz))
+            a = (
+                jnp.broadcast_to(p_row, (8, seg_nnz))
+                * mask8.astype(jnp.float32)
+            )
+            a_hi = a.astype(jnp.bfloat16)
+            rem = a - a_hi.astype(jnp.float32)
+            a_mid = rem.astype(jnp.bfloat16)
+            a_lo = (rem - a_mid.astype(jnp.float32)).astype(jnp.bfloat16)
+            bt = (
+                iota_sub_seg == jnp.broadcast_to(lane_w, (GROUP, seg_nnz))
+            ).astype(jnp.bfloat16)
+            dims = (((1,), (1,)), ((), ()))
+            ms = (
+                jax.lax.dot_general(
+                    a_hi, bt, dims, preferred_element_type=jnp.float32
+                )
+                + jax.lax.dot_general(
+                    a_mid, bt, dims, preferred_element_type=jnp.float32
+                )
+                + jax.lax.dot_general(
+                    a_lo, bt, dims, preferred_element_type=jnp.float32
+                )
+            )
+            ws = wslab_ref[t * segs + s2]
+            idx = pl.ds(pl.multiple_of(ws * 8, 8), 8)
+            acc_scratch[idx, :] = acc_scratch[idx, :] + ms
+        return carry
+
+    jax.lax.fori_loop(0, n_steps, step, 0)
+    out_ref[...] = acc_scratch[...]
+
+
 def _tile_kernel(
     wslab_ref, rslab_ref, packed_hbm, src_ref, out_ref,
     acc_scratch, a_scratch, bt_scratch, pk_buf, dma_sem,
@@ -290,11 +415,31 @@ def _tiled_apply(layout_arrays, src, out_pad, src_pad, square_vals=False):
     n_steps = int(packed.shape[0]) // step_groups
     src_shape = (src_pad // 128, 128)
     out_shape = (out_pad // 128, 128)
-    f = pl.pallas_call(
-        functools.partial(
+    if SEGMENT_BATCHED:
+        kernel = functools.partial(
+            _tile_kernel_seg, n_steps=n_steps, groups=groups, segs=segs,
+            square_vals=square_vals,
+        )
+        scratch = [
+            pltpu.VMEM(out_shape, jnp.float32),
+            pltpu.VMEM((groups, GROUP), jnp.float32),  # p_scratch
+            pltpu.VMEM((2, step_groups, 3, GROUP), jnp.int32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
+    else:
+        kernel = functools.partial(
             _tile_kernel, n_steps=n_steps, groups=groups, segs=segs,
             square_vals=square_vals,
-        ),
+        )
+        scratch = [
+            pltpu.VMEM(out_shape, jnp.float32),
+            pltpu.VMEM((8, step_groups * GROUP), jnp.float32),
+            pltpu.VMEM((GROUP, step_groups * GROUP), jnp.bfloat16),
+            pltpu.VMEM((2, step_groups, 3, GROUP), jnp.int32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
+    f = pl.pallas_call(
+        kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(1,),
@@ -303,13 +448,7 @@ def _tiled_apply(layout_arrays, src, out_pad, src_pad, square_vals=False):
                 pl.BlockSpec(src_shape, lambda i, *_: (0, 0)),
             ],
             out_specs=pl.BlockSpec(out_shape, lambda i, *_: (0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM(out_shape, jnp.float32),
-                pltpu.VMEM((8, step_groups * GROUP), jnp.float32),
-                pltpu.VMEM((GROUP, step_groups * GROUP), jnp.bfloat16),
-                pltpu.VMEM((2, step_groups, 3, GROUP), jnp.int32),
-                pltpu.SemaphoreType.DMA((2,)),
-            ],
+            scratch_shapes=scratch,
         ),
         out_shape=jax.ShapeDtypeStruct(out_shape, jnp.float32),
         compiler_params=pltpu.CompilerParams(
@@ -497,6 +636,14 @@ _MAX_TOTAL_ROWS = 1 << 25  # 32M rows = 8 row chunks
 _MAX_TOTAL_COLS = 1 << 23  # 8M cols = 4 col chunks
 
 
+def tiling_economical_features(num_features: int) -> bool:
+    """The feature-dimension half of the tiling gate, shared with the
+    streamed objective's auto rule (one decision, two ingest paths —
+    duplicating it let the streamed rule drop the upper cap): genuinely
+    high-dimensional, but within the chunk-count economy ceiling."""
+    return 4096 <= num_features <= _MAX_TOTAL_COLS
+
+
 def supports_tiling(batch) -> bool:
     """Static gate: shapes the tile-COO path handles well — a genuinely
     sparse high-dimensional problem (the dense path beats it otherwise).
@@ -506,9 +653,8 @@ def supports_tiling(batch) -> bool:
 
     return (
         isinstance(batch, SparseBatch)
-        and batch.num_features >= 4096
+        and tiling_economical_features(batch.num_features)
         and SLAB <= batch.num_rows <= _MAX_TOTAL_ROWS
-        and batch.num_features <= _MAX_TOTAL_COLS
         # an all-padding batch tiles to 0 groups, and a 0-group kernel is
         # not compilable (s32[0,128] operand) — the XLA path handles it
         and bool(np.any(np.asarray(batch.values) != 0))
