@@ -450,23 +450,52 @@ def _sparse_logistic_bench(jax, jnp, n, d, k, iters, densify_dtype,
     iters = max(int(res.iterations), 1)
     passes = max(int(res.objective_passes), iters)
     # marginal differencing: cancels the relay's fixed per-solve dispatch
-    # latency, exactly like the dense configs (VERDICT r3 weak #7)
+    # latency, exactly like the dense configs (VERDICT r3 weak #7).
+    # THREE independent (long, short) pairs — the first reuses the main
+    # timed solve; the others perturb w0 so the relay's dedup cache can't
+    # replay either run — and the reported marginal is the MEDIAN, with
+    # every rep kept in the artifact: borderline pass/fail bars (A2's
+    # vs-one-core, roofline fractions) are judged on min/median, not one
+    # draw of the documented session noise (VERDICT r4 next-9).
     marginal = marginal_pass = None
+    pass_reps: list[float] = []
+    iter_reps: list[float] = []
     short_T = max(iters // 3, 2)
     if iters > short_T:
         cfg_s = OptimizerConfig(max_iterations=short_T, tolerance=0.0)
-        dt_s, _, res_s = _timed_solves(
-            lambda: lbfgs_minimize(obj, w0, cfg_s),
-            bytes_lower_bound_per_run=float(bytes_per_pass),
-        )
-        its_s = max(int(res_s.iterations), 1)
-        passes_s = max(int(res_s.objective_passes), its_s)
-        if iters > its_s and dt > dt_s:
-            marginal = (dt - dt_s) / (iters - its_s)
-        if passes > passes_s and dt > dt_s:
-            marginal_pass = (dt - dt_s) / (passes - passes_s)
-    marginal = _guard_marginal(bytes_per_pass, marginal)
-    marginal_pass = _guard_marginal(bytes_per_pass, marginal_pass)
+        for rep in range(3):
+            w0_r = w0 if rep == 0 else w0 + (1e-4 * rep)
+            if rep == 0:
+                dt_l, passes_l, its_l = dt, passes, iters
+            else:
+                dt_l, _, res_l = _timed_solves(
+                    lambda w=w0_r: lbfgs_minimize(obj, w, cfg),
+                    bytes_lower_bound_per_run=float(bytes_per_pass),
+                )
+                its_l = max(int(res_l.iterations), 1)
+                passes_l = max(int(res_l.objective_passes), its_l)
+            dt_s, _, res_s = _timed_solves(
+                lambda w=w0_r: lbfgs_minimize(obj, w, cfg_s),
+                bytes_lower_bound_per_run=float(bytes_per_pass),
+            )
+            its_s = max(int(res_s.iterations), 1)
+            passes_s = max(int(res_s.objective_passes), its_s)
+            if its_l > its_s and dt_l > dt_s:
+                m = _guard_marginal(
+                    bytes_per_pass, (dt_l - dt_s) / (its_l - its_s)
+                )
+                if m is not None:
+                    iter_reps.append(m)
+            if passes_l > passes_s and dt_l > dt_s:
+                m = _guard_marginal(
+                    bytes_per_pass, (dt_l - dt_s) / (passes_l - passes_s)
+                )
+                if m is not None:
+                    pass_reps.append(m)
+        if iter_reps:
+            marginal = float(np.median(iter_reps))
+        if pass_reps:
+            marginal_pass = float(np.median(pass_reps))
     util = (
         _hbm_utilization(bytes_per_pass, marginal_pass)
         if marginal_pass is not None
@@ -487,6 +516,9 @@ def _sparse_logistic_bench(jax, jnp, n, d, k, iters, densify_dtype,
         "sec_per_pass_marginal": (
             None if marginal_pass is None else round(marginal_pass, 6)
         ),
+        # every differencing rep, sorted — min/median visible for
+        # borderline-bar audits (VERDICT r4 next-9)
+        "sec_per_pass_marginal_all": [round(m, 6) for m in sorted(pass_reps)],
         "objective_passes": passes,
         "final_loss": round(value, 6),
         "auc": round(auc_model, 6),
