@@ -325,3 +325,40 @@ class TestTiledMesh:
         np.testing.assert_allclose(
             float(res.value), float(ref.value), rtol=1e-5
         )
+
+
+def test_layout_tracks_retuned_segment_constants(rng, monkeypatch):
+    """The layout builder must read GROUPS_PER_STEP / SEGMENTS_PER_DMA at
+    CALL time: a default-arg capture froze the import-time value, so
+    layouts built after retuning the constants silently disagreed with
+    the kernel consuming them — garbage outputs with no error (caught by
+    an on-hardware parity probe during the r5 G=32 retune)."""
+    import photon_ml_tpu.ops.sparse_tiled as st
+    from photon_ml_tpu.ops.batch import SparseBatch
+
+    monkeypatch.setattr(st, "GROUPS_PER_STEP", 8)
+    monkeypatch.setattr(st, "SEGMENTS_PER_DMA", 2)
+    n, d, k = 2048, 4096, 4
+    idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    val = rng.normal(size=(n, k)).astype(np.float32)
+    b = SparseBatch(
+        indices=jnp.asarray(idx), values=jnp.asarray(val),
+        labels=jnp.zeros(n, jnp.float32),
+        offsets=jnp.zeros(n, jnp.float32),
+        weights=jnp.ones(n, jnp.float32), num_features=d,
+    )
+    tb = st.tile_sparse_batch(b)
+    # stream must divide into whole retuned DMA steps
+    step = 8 * 2 * st.GROUP
+    for c in tb.chunks:
+        assert c.m_arrays[0].shape[0] * st.GROUP % step == 0
+    w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    r = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(tb.matvec(w)), np.asarray(b.matvec(w)),
+        rtol=2e-4, atol=2e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(tb.rmatvec(r)), np.asarray(b.rmatvec(r)),
+        rtol=2e-3, atol=2e-3,
+    )
