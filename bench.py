@@ -28,6 +28,9 @@ Output contract: stdout carries EXACTLY ONE JSON line — the headline metric
 {"metric", "value", "unit", "vs_baseline", ...} with per-config results
 embedded under "configs". Per-config progress lines go to stderr, and the
 full detail is also written to BENCH_DETAIL.json next to this file.
+``--quick`` keeps the same contract over the A/A2/F smoke subset at toy
+shapes (seconds, one timed rep, no artifact writes) — the cheap regression
+gate; kernel constants retune from the environment via RETUNE_ENV.
 """
 
 from __future__ import annotations
@@ -45,6 +48,22 @@ for _v in ("OMP_NUM_THREADS", "OPENBLAS_NUM_THREADS", "MKL_NUM_THREADS"):
 import numpy as np
 
 REPEATS = 3
+# --quick: a smoke-sized subset (configs A/A2/F at toy shapes, one timed
+# rep, no marginal differencing) that finishes in seconds and keeps the
+# stdout single-JSON-line contract — the cheap regression gate for perf
+# changes. Quick runs never touch BENCH_DETAIL.json or BASELINE.md (toy
+# numbers must not overwrite the real artifact).
+QUICK = False
+QUICK_CONFIGS = ("A_sparse_logistic", "A2_sparse_highdim", "F_streaming")
+# Kernel retune knobs: the sparse-tiled constants are module globals read
+# at call time (layout builder AND kernel), so a child process can retune
+# them from the environment — the bench-side lever for the
+# GROUPS_PER_STEP/SEGMENTS_PER_DMA/GROUPS_PER_RUN sweep.
+RETUNE_ENV = {
+    "PHOTON_GROUPS_PER_STEP": "GROUPS_PER_STEP",
+    "PHOTON_SEGMENTS_PER_DMA": "SEGMENTS_PER_DMA",
+    "PHOTON_GROUPS_PER_RUN": "GROUPS_PER_RUN",
+}
 # No TPU generation exceeds this HBM bandwidth (v5p ~2.8 TB/s); a
 # measurement implying more is a timing artifact, not a fast solve.
 HBM_ROOFLINE_BYTES_PER_S = 4.0e12
@@ -511,7 +530,7 @@ def _sparse_logistic_bench(jax, jnp, n, d, k, iters, densify_dtype,
     marginal = marginal_pass = None
     mreps = {"iter_reps": [], "pass_reps": [], "rejected": 0}
     short_T = max(iters // 3, 2)
-    if iters > short_T:
+    if iters > short_T and not QUICK:  # quick: one solve, no differencing
         mreps = _marginal_reps(
             lambda w, c: lbfgs_minimize(obj, w, c),
             w0, cfg, short_T, float(bytes_per_pass),
@@ -526,6 +545,27 @@ def _sparse_logistic_bench(jax, jnp, n, d, k, iters, densify_dtype,
     )
     sps = n * iters / dt
     proxy = _median_of_runs(lambda: _proxy_logistic_sparse(1 << 15, d, k))
+    constants = {}
+    if tiled:
+        import photon_ml_tpu.ops.sparse_tiled as st
+
+        # the tuned constants this run's layouts+kernel were built with —
+        # retune sweeps (RETUNE_ENV) are auditable from the artifact
+        constants["kernel_constants"] = {
+            "groups_per_step": st.GROUPS_PER_STEP,
+            "segments_per_dma": st.SEGMENTS_PER_DMA,
+            "groups_per_run": st.GROUPS_PER_RUN,
+            "segment_batched": bool(st.SEGMENT_BATCHED),
+        }
+        # run-padding overhead of the slab-run lever: padded stream nnz
+        # over the raw nonzero count (GROUPS_PER_RUN=1 reproduces the
+        # pre-run-batching padding exactly)
+        raw_nnz = int(np.count_nonzero(np.asarray(sparse_batch.values)))
+        packed_nnz = sum(
+            int(c.m_arrays[0].shape[0] + c.g_arrays[0].shape[0]) * 128
+            for c in batch.chunks
+        ) // 2
+        constants["stream_padding_ratio"] = round(packed_nnz / raw_nnz, 4)
     return {
         "samples_per_sec": round(sps, 1),
         "sec_per_solve": round(dt, 6),
@@ -554,6 +594,7 @@ def _sparse_logistic_bench(jax, jnp, n, d, k, iters, densify_dtype,
         **util,
         "densified": densified,
         "tiled_coo_kernels": tiled,
+        **constants,
         "shape": {"n": n, "d": d, "nnz_per_row": k, "iters": iters},
     }
 
@@ -562,6 +603,11 @@ def bench_a_sparse_logistic(jax, jnp):
     """Config A: a9a-shaped sparse binary logistic (scaled up ~16x in rows
     and ~33x in features), ingested sparse, auto-densified to bf16 for the
     solve (the framework's standard ingest decision at this size)."""
+    if QUICK:
+        return _sparse_logistic_bench(
+            jax, jnp, n=1 << 13, d=2048, k=16, iters=8,
+            densify_dtype=jnp.bfloat16,
+        )
     return _sparse_logistic_bench(
         jax, jnp, n=1 << 19, d=4096, k=64, iters=20, densify_dtype=jnp.bfloat16
     )
@@ -574,7 +620,13 @@ def bench_a2_sparse_highdim(jax, jnp):
     VMEM vector rates instead of XLA's ~6e7 elem/s latency-bound
     gather/scatter (round 2 ran 0.37x ONE CPU core on that path).
     n=2^20 kernel-faults this platform's TPU worker (reproduced in
-    isolation); 2^19 is stable."""
+    isolation); 2^19 is stable. Quick mode keeps the kernel path (layout
+    build + both directions end-to-end) at smoke shapes."""
+    if QUICK:
+        return _sparse_logistic_bench(
+            jax, jnp, n=1 << 11, d=4096, k=4, iters=6, densify_dtype=None,
+            tiled=True,
+        )
     return _sparse_logistic_bench(
         jax, jnp, n=1 << 19, d=1 << 17, k=32, iters=30, densify_dtype=None,
         tiled=True,
@@ -1023,6 +1075,8 @@ def bench_f_streaming(jax, jnp):
     from photon_ml_tpu.types import TaskType
 
     n, d, iters, chunk_rows = 1 << 16, 256, 3, 1 << 14
+    if QUICK:
+        n, d, iters, chunk_rows = 1 << 13, 128, 2, 1 << 11
 
     rng = np.random.default_rng(5)
     X = rng.normal(size=(n, d)).astype(np.float32)
@@ -1232,51 +1286,85 @@ CONFIGS = {
 }
 
 
-def _run_one(name: str) -> None:
+def _apply_retune_env() -> None:
+    """Apply RETUNE_ENV overrides to the sparse-tiled module constants
+    (call-time-read globals, so layout builder and kernel both track)."""
+    pending = {
+        attr: int(os.environ[var])
+        for var, attr in RETUNE_ENV.items()
+        if os.environ.get(var)
+    }
+    if pending:
+        import photon_ml_tpu.ops.sparse_tiled as st
+
+        for attr, value in pending.items():
+            setattr(st, attr, value)
+        _log(f"[bench] retuned kernel constants from env: {pending}")
+
+
+def _run_one(name: str, quick: bool = False) -> None:
     """Child mode: run one config, print its result JSON on stdout."""
+    global QUICK, REPEATS
+    if quick:
+        QUICK = True
+        REPEATS = 1
+    _apply_retune_env()
     import jax
     import jax.numpy as jnp
 
     print(json.dumps(CONFIGS[name](jax, jnp)))
 
 
-def main() -> None:
+def _run_config_subprocess(name: str, quick: bool = False) -> dict:
+    """Run one config in a fresh subprocess; return its result dict (or an
+    {"error": ...} dict — an impossible number or a crash is reported,
+    never faked). Factored out so the contract test can stub the child."""
     import subprocess
 
+    here = os.path.abspath(__file__)
+    argv = [sys.executable, here, "--config", name] + (
+        ["--quick"] if quick else []
+    )
+    try:
+        proc = subprocess.run(
+            argv, capture_output=True, text=True, timeout=900,
+        )
+        sys.stderr.write(proc.stderr)
+        if proc.returncode == 0:
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        return {"error": f"rc={proc.returncode}: {' | '.join(tail)}"}
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def main(quick: bool = False) -> None:
     # Each config runs in its OWN subprocess, sequentially (two concurrent
     # TPU processes deadlock on this platform's relay): device memory is
     # fully released between configs — closure-captured batches baked into
     # cached executables otherwise accumulate until the worker OOM-crashes —
     # and one config crashing cannot poison the rest.
     results: dict[str, dict] = {}
-    here = os.path.abspath(__file__)
-    for name in CONFIGS:
+    names = QUICK_CONFIGS if quick else tuple(CONFIGS)
+    for name in names:
         _log(f"[bench] {name} ...")
-        try:
-            proc = subprocess.run(
-                [sys.executable, here, "--config", name],
-                capture_output=True, text=True, timeout=900,
-            )
-            sys.stderr.write(proc.stderr)
-            if proc.returncode == 0:
-                results[name] = json.loads(proc.stdout.strip().splitlines()[-1])
-            else:
-                tail = (proc.stderr or "").strip().splitlines()[-3:]
-                results[name] = {"error": f"rc={proc.returncode}: {' | '.join(tail)}"}
-        except Exception as e:  # an impossible number or a crash: report, don't fake
-            results[name] = {"error": f"{type(e).__name__}: {e}"}
+        results[name] = _run_config_subprocess(name, quick=quick)
         _log(f"[bench] {name}: {json.dumps(results[name])[:300]}")
 
     head = results.get("headline_dense_logistic", {})
-    detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BENCH_DETAIL.json")
-    with open(detail_path, "w") as f:
-        json.dump(results, f, indent=2)
+    if not quick:
+        # quick mode writes NO artifacts: toy-shape numbers must never
+        # overwrite the measured table or BENCH_DETAIL.json
+        detail_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
+        )
+        with open(detail_path, "w") as f:
+            json.dump(results, f, indent=2)
 
-    try:
-        update_baseline(results)
-    except Exception as e:  # never let doc rendering break the bench output
-        _log(f"[bench] BASELINE.md update failed: {type(e).__name__}: {e}")
+        try:
+            update_baseline(results)
+        except Exception as e:  # never let doc rendering break the bench output
+            _log(f"[bench] BASELINE.md update failed: {type(e).__name__}: {e}")
 
     print(
         json.dumps(
@@ -1285,6 +1373,7 @@ def main() -> None:
                 "value": head.get("samples_per_sec"),
                 "unit": "samples/s",
                 "vs_baseline": head.get("vs_one_core_proxy"),
+                "quick": quick,
                 "quality": {
                     "auc": head.get("auc"),
                     "auc_generating_model": head.get("auc_generating_model"),
@@ -1381,9 +1470,16 @@ def update_baseline(results: dict | None = None) -> None:
 
 
 if __name__ == "__main__":
-    if len(sys.argv) == 3 and sys.argv[1] == "--config":
-        _run_one(sys.argv[2])
-    elif len(sys.argv) == 2 and sys.argv[1] == "--update-baseline":
+    args = sys.argv[1:]
+    if len(args) >= 2 and args[0] == "--config":
+        _run_one(args[1], quick="--quick" in args[2:])
+    elif args == ["--update-baseline"]:
         update_baseline()
-    else:
+    elif args == ["--quick"]:
+        main(quick=True)
+    elif not args:
         main()
+    else:
+        _log(f"usage: bench.py [--quick | --update-baseline | "
+             f"--config NAME [--quick]]; got {args}")
+        sys.exit(2)

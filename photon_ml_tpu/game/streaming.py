@@ -862,9 +862,16 @@ class StreamedGameTrainer:
             if var is not None:
                 var = norm.factors**2 * var
         w = np.asarray(w_model, np.float32)
-        # scores over RAW chunks with ORIGINAL-space coefficients (equal to
-        # normalized-space margins by construction)
-        scores = stream_scores(chunks, w, num_rows=n, num_features=d)
+        # scores with ORIGINAL-space coefficients (equal to
+        # normalized-space margins by construction) — through the
+        # objective's own device-resident tile-COO layouts when it trained
+        # on the full chunk list (down-sampled objectives cover a row
+        # subset, so scoring falls back to the raw chunks; the module
+        # scorer still rides the process-wide layout cache there)
+        if train_rows is None:
+            scores = sobj.stream_scores(w, num_rows=n)
+        else:
+            scores = stream_scores(chunks, w, num_rows=n, num_features=d)
         return w, scores, res, (None if var is None else np.asarray(var, np.float32))
 
     def _solve_re_buckets(

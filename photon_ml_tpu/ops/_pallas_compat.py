@@ -1,0 +1,41 @@
+"""Version-compat shims for Pallas-TPU API drift.
+
+JAX renamed the Pallas TPU parameter/memory-space types between releases
+(``TPUCompilerParams``/``TPUMemorySpace`` in the 0.4.x line became
+``CompilerParams``/``MemorySpace`` later). The kernels in ``fused.py`` and
+``sparse_tiled.py`` must import-compile on both spellings — the seed
+regression was a module-level ``pltpu.CompilerParams`` that raised
+``AttributeError`` at import on the installed JAX, taking every test that
+transitively imports the fused kernels down with it. All Pallas-TPU
+call sites resolve the names through this module instead of touching
+``pltpu`` attributes directly.
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _first_attr(*names):
+    for name in names:
+        obj = getattr(pltpu, name, None)
+        if obj is not None:
+            return obj
+    raise AttributeError(
+        f"installed jax.experimental.pallas.tpu exposes none of {names}"
+    )
+
+
+# The params dataclass: new spelling first so behavior tracks the
+# installed JAX once it drops the TPU prefix.
+_CompilerParams = _first_attr("CompilerParams", "TPUCompilerParams")
+_MemorySpace = _first_attr("MemorySpace", "TPUMemorySpace")
+
+# Memory-space constant for ``pl.BlockSpec(memory_space=...)`` — ANY keeps
+# an operand in HBM for manual DMA.
+ANY = _MemorySpace.ANY
+
+
+def compiler_params(**kwargs):
+    """Build the TPU compiler-params object under either spelling."""
+    return _CompilerParams(**kwargs)

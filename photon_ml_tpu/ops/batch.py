@@ -184,13 +184,13 @@ def optimize_batch_layout(
     leave everything else unchanged."""
     out = maybe_densify(batch, hbm_budget_bytes, dtype)
     if isinstance(out, SparseBatch):
-        from photon_ml_tpu.ops.sparse_tiled import (
-            supports_tiling,
-            tile_sparse_batch,
-        )
+        from photon_ml_tpu.ops import tile_cache
+        from photon_ml_tpu.ops.sparse_tiled import supports_tiling
 
         if supports_tiling(out):
-            return tile_sparse_batch(out)
+            # process-wide layout cache: identical sparsity structure
+            # (re-ingested data, repeated fits) never re-packs
+            return tile_cache.tiled_layout_for(out)
     return out
 
 

@@ -16,7 +16,11 @@ Design — write-slab-major tile-COO, built ONCE at ingest:
 - Every nonzero is assigned to a CELL = (write-slab, read-slab) where a
   slab is 1024 consecutive outputs/inputs = an (8, 128) block of the
   corresponding table. Nonzeros are sorted by cell (write-slab major) and
-  each cell padded to a multiple of GROUP=128 (zero-valued fillers).
+  each cell padded to a whole number of GROUPS_PER_RUN-group RUNS of
+  GROUP=128 nonzeros (zero-valued fillers) — consecutive groups of one
+  cell read ONE source slab, so the kernel loads each shared slab once
+  per run and batches the gather over the whole run (the r5 ablation's
+  per-group skeleton floor, hoisted; see GROUPS_PER_RUN).
 - Each WRITE SLAB's nonzeros are further padded to a multiple of
   GROUPS_PER_STEP groups, so one grid step processes GROUPS_PER_STEP
   groups that ALL write to the same (8, 128) output slab. Per group the
@@ -75,6 +79,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from photon_ml_tpu.ops import _pallas_compat
+
 Array = jnp.ndarray
 
 GROUP = 128  # nonzeros per group: one vreg row, shares one (write, read) cell
@@ -85,6 +91,16 @@ GROUP = 128  # nonzeros per group: one vreg row, shares one (write, read) cell
 # The DMA step stays at 128 groups (16K nnz per fetch).
 GROUPS_PER_STEP = 32  # groups per SEGMENT: all share ONE write slab
 SEGMENTS_PER_DMA = 4  # segments per DMA step (128 groups = 16K nnz per fetch)
+# Slab-RUN batching (the r5 addendum's recorded next lever): consecutive
+# groups of one cell read the SAME source slab, so the builder pads every
+# cell to whole runs of GROUPS_PER_RUN groups and the kernel loads the
+# shared slab ONCE per run, gathering/staging all of the run's nonzeros in
+# batched ops instead of per group. Bigger runs amortize more of the
+# per-group skeleton but pad scattered cells harder (a cell always pads to
+# a whole run): at the A2 shapes cells average ~2 groups, so 2 is the
+# padding-neutral default — retune per workload like the two constants
+# above (must divide GROUPS_PER_STEP).
+GROUPS_PER_RUN = 2  # groups per slab RUN: all read ONE source slab
 SLAB = 1024  # outputs/inputs per slab: an (8, 128) block of a table
 
 
@@ -107,6 +123,22 @@ class _Layout:
     packed: np.ndarray  # (M/GROUP, 3, GROUP) int32: [write, read, val bits]
     wslab: np.ndarray  # (M/(GROUP*GROUPS_PER_STEP),) int32: per-segment slab
     rslab: np.ndarray  # (M/GROUP,) int32 read slab id per group
+    rrun: np.ndarray  # (M/(GROUP*GROUPS_PER_RUN),) int32: per-RUN read slab
+
+
+def detect_slab_runs(rslab: np.ndarray) -> np.ndarray:
+    """Run-length metadata over a per-group read-slab stream: maximal runs
+    of consecutive groups reading one slab, as an (n_runs, 3) int64 array
+    of [start group, length, slab id]. This is the host-side view the
+    fixed-size ``GROUPS_PER_RUN`` blocks are carved from (the kernel
+    consumes the aligned ``rrun`` stream; this helper backs builder
+    assertions, tests and padding diagnostics)."""
+    r = np.asarray(rslab, np.int64)
+    if not len(r):
+        return np.zeros((0, 3), np.int64)
+    starts = np.flatnonzero(np.concatenate([[True], r[1:] != r[:-1]]))
+    lengths = np.diff(np.concatenate([starts, [len(r)]]))
+    return np.stack([starts, lengths, r[starts]], axis=1)
 
 
 def build_write_major_layout(
@@ -116,18 +148,30 @@ def build_write_major_layout(
     write_pad: int,
     read_pad: int,
     groups_per_step: int | None = None,
+    groups_per_run: int | None = None,
 ) -> _Layout:
     """Sort nonzeros by (write-slab, read-slab) cell, pad each cell to a
-    GROUP multiple, then pad each write slab's group count to a multiple
+    whole number of ``groups_per_run``-group RUNS (every group of a cell
+    reads the cell's slab, so an aligned run is single-slab by
+    construction), then pad each write slab's group count to a multiple
     of ``groups_per_step`` (all vectorized — no Python per-cell loop).
     Fillers carry value 0 (they contribute exactly 0 through any slab).
 
-    ``groups_per_step=None`` reads the module's GROUPS_PER_STEP at CALL
-    time — a default-arg capture froze the import-time value, so layouts
-    built after retuning the constant silently disagreed with the kernel
-    consuming them (garbage outputs, caught by a parity probe)."""
+    ``groups_per_step=None``/``groups_per_run=None`` read the module's
+    GROUPS_PER_STEP / GROUPS_PER_RUN at CALL time — a default-arg capture
+    froze the import-time value, so layouts built after retuning the
+    constant silently disagreed with the kernel consuming them (garbage
+    outputs, caught by a parity probe)."""
     if groups_per_step is None:
         groups_per_step = GROUPS_PER_STEP
+    if groups_per_run is None:
+        groups_per_run = GROUPS_PER_RUN
+    if groups_per_step % groups_per_run:
+        raise ValueError(
+            f"GROUPS_PER_RUN={groups_per_run} must divide "
+            f"GROUPS_PER_STEP={groups_per_step}: segments are carved into "
+            f"whole aligned runs"
+        )
     w = np.asarray(write_idx, np.int32)
     r = np.asarray(read_idx, np.int32)
     v = np.asarray(vals, np.float32)
@@ -139,7 +183,8 @@ def build_write_major_layout(
     w, r, v, cell = w[order], r[order], v[order], cell[order]
 
     uniq, start, counts = np.unique(cell, return_index=True, return_counts=True)
-    pc = (-(-counts // GROUP) * GROUP).astype(np.int64)  # padded cell nnz
+    run_nnz = GROUP * groups_per_run
+    pc = (-(-counts // run_nnz) * run_nnz).astype(np.int64)  # padded cell nnz
     cell_ws = (uniq // nrs).astype(np.int64)
     cell_rs = (uniq % nrs).astype(np.int32)
 
@@ -191,6 +236,12 @@ def build_write_major_layout(
     rslab[gpos] = np.repeat(cell_rs, gc)
 
     wslab = (out_w[::step_nnz] // SLAB).astype(np.int32)
+    # per-run read slab: cells pad to whole runs and write-slab/tail
+    # fillers (rslab 0) start run-aligned, so every aligned block is
+    # single-slab — the invariant the kernel's once-per-run load rests on
+    blocks = rslab.reshape(-1, groups_per_run)
+    assert (blocks == blocks[:, :1]).all(), "slab run crosses a run block"
+    rrun = np.ascontiguousarray(blocks[:, 0])
     packed = np.stack(
         [
             out_w.reshape(n_groups, GROUP),
@@ -199,7 +250,7 @@ def build_write_major_layout(
         ],
         axis=1,
     )
-    return _Layout(packed=packed, wslab=wslab, rslab=rslab)
+    return _Layout(packed=packed, wslab=wslab, rslab=rslab, rrun=rrun)
 
 
 # r5 ablation on the A2 shapes (n=2^19, d=2^17, k=32; one chunk,
@@ -213,25 +264,35 @@ def build_write_major_layout(
 # (1, seg_nnz) row per stream, one batched one-hot compare per segment,
 # matmul operands built as
 # VALUES (no a/bt VMEM scratch round-trip), one batched one-hot build
-# per segment instead of ``groups`` per-group ones.
+# per segment instead of ``groups`` per-group ones. The r6 follow-up (the
+# retuned-state ablation's recorded lever) batches PHASE 1 the same way:
+# skeleton loads/bitcast hoist per segment and the source slab loads once
+# per GROUPS_PER_RUN-group run — see _tile_kernel_seg.
 SEGMENT_BATCHED = True
 
 
 def _tile_kernel_seg(
-    wslab_ref, rslab_ref, packed_hbm, src_ref, out_ref,
+    wslab_ref, rslab_ref, rrun_ref, packed_hbm, src_ref, out_ref,
     acc_scratch, p_scratch, pk_buf, dma_sem,
-    *, n_steps, groups, segs, square_vals,
+    *, n_steps, groups, segs, run_groups, square_vals,
 ):
-    """Segment-batched kernel (see SEGMENT_BATCHED note): per group only
-    the source gather runs (hidden behind the scatter per the ablation);
-    the scatter operands for all ``groups`` groups of a segment stage in
-    one batched build, then the same 3-term Dekker bf16 MXU contraction
-    as the per-group kernel."""
+    """Segment-batched kernel with slab-RUN phase 1 (see SEGMENT_BATCHED
+    note): the per-group skeleton the r5 retuned-state ablation measured
+    as the floor (packed-buffer loads, value bitcast, p-scratch store,
+    ~135 ns per 128-nnz group) hoists to ONE batched load/bitcast per
+    segment, and the source slab loads once per ``run_groups``-group RUN
+    (the layout builder guarantees aligned runs are single-slab), with the
+    gather/sublane-select/product batched over the whole run. Phase 2 is
+    the unchanged whole-segment scatter staging + 3-term Dekker bf16 MXU
+    contraction."""
     step_groups = segs * groups
     seg_nnz = groups * GROUP
-    iota8 = jax.lax.broadcasted_iota(jnp.int32, (8, GROUP), 0)
+    run_nnz = run_groups * GROUP
+    seg_runs = groups // run_groups
+    step_runs = step_groups // run_groups
     # int32 iota: this hardware supports no narrower iota (8- and 16-bit
     # both rejected by Mosaic) — the win here is the batching, not density
+    iota8_run = jax.lax.broadcasted_iota(jnp.int32, (8, run_nnz), 0)
     iota8_seg = jax.lax.broadcasted_iota(jnp.int32, (8, seg_nnz), 0)
     iota_sub_seg = jax.lax.broadcasted_iota(jnp.int32, (GROUP, seg_nnz), 0)
     acc_scratch[...] = jnp.zeros_like(acc_scratch)
@@ -257,22 +318,35 @@ def _tile_kernel_seg(
 
         for s2 in range(segs):
             g0 = s2 * groups
-            for gi in range(groups):
-                g = g0 + gi
-                rd = pk_buf[slot, g, 1, :]
-                lane_r = rd & 127
-                sub_r = (rd >> 7) & 7
-                rslab = rslab_ref[t * step_groups + g]
+            # per-group skeleton, hoisted: one packed-buffer load per
+            # stream and one value bitcast for the WHOLE segment
+            rd_all = pk_buf[slot, g0:g0 + groups, 1, :]  # (groups, GROUP)
+            lane_all = rd_all & 127
+            sub_all = (rd_all >> 7) & 7
+            vals_all = pltpu.bitcast(
+                pk_buf[slot, g0:g0 + groups, 2, :], jnp.float32
+            )
+            if square_vals:
+                vals_all = vals_all * vals_all
+            for b in range(seg_runs):
+                gb = b * run_groups
+                # ONE shared-slab load per run; the gather pulls all of
+                # the run's nonzeros from it in one batched op
+                rslab = rrun_ref[t * step_runs + s2 * seg_runs + b]
                 slab = src_ref[pl.ds(pl.multiple_of(rslab * 8, 8), 8), :]
+                lanes = lane_all[gb:gb + run_groups, :].reshape(1, run_nnz)
                 gathered = jnp.take_along_axis(
-                    slab, jnp.broadcast_to(lane_r[None, :], (8, GROUP)), axis=1
+                    slab, jnp.broadcast_to(lanes, (8, run_nnz)), axis=1
                 )
-                sel = (iota8 == sub_r[None, :]).astype(jnp.float32)
-                src_vals = jnp.sum(gathered * sel, axis=0)  # (GROUP,)
-                vals = pltpu.bitcast(pk_buf[slot, g, 2:3, :], jnp.float32)[0, :]
-                if square_vals:
-                    vals = vals * vals
-                p_scratch[gi, :] = vals * src_vals
+                sub_r = sub_all[gb:gb + run_groups, :].reshape(1, run_nnz)
+                sel = (
+                    iota8_run == jnp.broadcast_to(sub_r, (8, run_nnz))
+                ).astype(jnp.float32)
+                src_vals = jnp.sum(gathered * sel, axis=0)  # (run_nnz,)
+                p_scratch[gb:gb + run_groups, :] = (
+                    vals_all[gb:gb + run_groups, :]
+                    * src_vals.reshape(run_groups, GROUP)
+                )
 
             # whole-segment scatter staging: one relayout per stream,
             # int8 one-hot compares, operands as values
@@ -318,14 +392,16 @@ def _tile_kernel_seg(
 
 
 def _tile_kernel(
-    wslab_ref, rslab_ref, packed_hbm, src_ref, out_ref,
+    wslab_ref, rslab_ref, rrun_ref, packed_hbm, src_ref, out_ref,
     acc_scratch, a_scratch, bt_scratch, pk_buf, dma_sem,
     *, n_steps, groups, segs, square_vals,
 ):
     """Single-launch kernel: a ``fori_loop`` over DMA steps, each step
     fetching ``segs * groups`` groups in ONE double-buffered DMA and
     running ``segs`` segment scatters (one batched MXU call per segment,
-    whose groups all write one output slab)."""
+    whose groups all write one output slab). ``rrun_ref`` rides along for
+    prefetch-signature parity with the segment-batched kernel; this
+    per-group variant reads the per-group ``rslab_ref`` stream."""
     step_groups = segs * groups
     iota8 = jax.lax.broadcasted_iota(jnp.int32, (8, GROUP), 0)
     iota_sub = jax.lax.broadcasted_iota(jnp.int32, (GROUP, GROUP), 0)
@@ -416,21 +492,25 @@ def _tile_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("out_pad", "src_pad", "square_vals")
+    jax.jit,
+    static_argnames=(
+        "out_pad", "src_pad", "square_vals",
+        "groups", "segs", "run_groups", "seg_batched", "interpret",
+    ),
 )
-def _tiled_apply(layout_arrays, src, out_pad, src_pad, square_vals=False):
-    """Run one direction's kernel: src (src_pad,) -> out (out_pad,)."""
-    packed, wslab, rslab = layout_arrays
-    groups = GROUPS_PER_STEP
-    segs = SEGMENTS_PER_DMA
+def _tiled_apply_jit(
+    layout_arrays, src, out_pad, src_pad, square_vals,
+    groups, segs, run_groups, seg_batched, interpret,
+):
+    packed, wslab, rslab, rrun = layout_arrays
     step_groups = segs * groups
     n_steps = int(packed.shape[0]) // step_groups
     src_shape = (src_pad // 128, 128)
     out_shape = (out_pad // 128, 128)
-    if SEGMENT_BATCHED:
+    if seg_batched:
         kernel = functools.partial(
             _tile_kernel_seg, n_steps=n_steps, groups=groups, segs=segs,
-            square_vals=square_vals,
+            run_groups=run_groups, square_vals=square_vals,
         )
         scratch = [
             pltpu.VMEM(out_shape, jnp.float32),
@@ -453,23 +533,43 @@ def _tiled_apply(layout_arrays, src, out_pad, src_pad, square_vals=False):
     f = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=3,
             grid=(1,),
             in_specs=[
-                pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+                pl.BlockSpec(memory_space=_pallas_compat.ANY),
                 pl.BlockSpec(src_shape, lambda i, *_: (0, 0)),
             ],
             out_specs=pl.BlockSpec(out_shape, lambda i, *_: (0, 0)),
             scratch_shapes=scratch,
         ),
         out_shape=jax.ShapeDtypeStruct(out_shape, jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_pallas_compat.compiler_params(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=120 * 1024 * 1024,
         ),
-        interpret=_interpret(),
+        interpret=interpret,
     )
-    return f(wslab, rslab, packed, src.reshape(src_shape)).reshape(-1)
+    return f(wslab, rslab, rrun, packed, src.reshape(src_shape)).reshape(-1)
+
+
+def _tiled_apply(layout_arrays, src, out_pad, src_pad, square_vals=False):
+    """Run one direction's kernel: src (src_pad,) -> out (out_pad,).
+
+    The tuned constants enter the jitted call as STATIC arguments, read
+    from the module at CALL time: they are part of the executable's cache
+    key, so a retune after a compile can never silently reuse a stale
+    executable whose argument shapes happen to coincide (e.g. swapping
+    GROUPS_PER_STEP=32/SEGMENTS_PER_DMA=4 for 16/8 keeps every stream
+    shape identical while changing the kernel's segment carve). This is
+    also what makes the compiled kernel a PROCESS-WIDE executable cache:
+    any layout with the same stream shapes and constants — across
+    streaming chunks, GAME visits and CV folds — re-enters the same
+    compiled program."""
+    return _tiled_apply_jit(
+        layout_arrays, src, out_pad, src_pad, square_vals,
+        GROUPS_PER_STEP, SEGMENTS_PER_DMA, GROUPS_PER_RUN, SEGMENT_BATCHED,
+        _interpret(),
+    )
 
 
 @functools.partial(
@@ -572,7 +672,7 @@ def _build_chunk(
     m = build_write_major_layout(rows, cols, vals, n_pad, d_pad)
     g = build_write_major_layout(cols, rows, vals, d_pad, n_pad)
     as_j = lambda lay: tuple(
-        jnp.asarray(a) for a in (lay.packed, lay.wslab, lay.rslab)
+        jnp.asarray(a) for a in (lay.packed, lay.wslab, lay.rslab, lay.rrun)
     )
     return _TileChunk(
         m_arrays=as_j(m),
@@ -674,12 +774,13 @@ def supports_tiling(batch) -> bool:
 
 
 def _pad_layout_groups(arrays: tuple, target_groups: int) -> tuple:
-    """Extend one direction's (packed, wslab, rslab) stream with filler
-    segments up to ``target_groups`` groups. Fillers use the builder's tail
-    convention — write slab 0, read slab 0, value 0 — and contribute
-    exactly 0; ``target_groups`` must be a whole-DMA-step multiple (every
-    built stream already is, so the max over shards is too)."""
-    packed, wslab, rslab = arrays
+    """Extend one direction's (packed, wslab, rslab, rrun) stream with
+    filler segments up to ``target_groups`` groups. Fillers use the
+    builder's tail convention — write slab 0, read slab 0, value 0 — and
+    contribute exactly 0; ``target_groups`` must be a whole-DMA-step
+    multiple (every built stream already is, so the max over shards is
+    too), and a DMA step is a whole number of runs."""
+    packed, wslab, rslab, rrun = arrays
     n_groups = packed.shape[0]  # packed is (n_groups, 3, GROUP)
     if n_groups == target_groups:
         return arrays
@@ -690,7 +791,9 @@ def _pad_layout_groups(arrays: tuple, target_groups: int) -> tuple:
     rslab = jnp.concatenate([rslab, jnp.zeros((add,), rslab.dtype)])
     segs = add // GROUPS_PER_STEP
     wslab = jnp.concatenate([wslab, jnp.zeros((segs,), wslab.dtype)])
-    return (packed, wslab, rslab)
+    runs = add // GROUPS_PER_RUN
+    rrun = jnp.concatenate([rrun, jnp.zeros((runs,), rrun.dtype)])
+    return (packed, wslab, rslab, rrun)
 
 
 def pad_chunks_to_common_groups(tbs: list) -> list[list]:
@@ -767,11 +870,11 @@ def tile_sparse_batch_sharded(batch, n_dev: int):
             _TileChunk(
                 m_arrays=tuple(
                     jnp.stack([c.m_arrays[i] for c in padded[j]])
-                    for i in range(3)
+                    for i in range(4)
                 ),
                 g_arrays=tuple(
                     jnp.stack([c.g_arrays[i] for c in padded[j]])
-                    for i in range(3)
+                    for i in range(4)
                 ),
                 row_start=ref.chunks[j].row_start,
                 col_start=ref.chunks[j].col_start,

@@ -263,6 +263,7 @@ class StreamingGLMObjective:
         self._chunk_hvp = jax.jit(chunk_hvp)
         self._chunk_hd = jax.jit(chunk_hessian_diag)
         self._chunk_h = jax.jit(chunk_hessian)
+        self._chunk_score = jax.jit(lambda b, wi: b.matvec(wi))
 
     def _build_tile_layouts(self):
         """Tile every sparse chunk ONCE (host transform): per-chunk
@@ -270,12 +271,14 @@ class StreamingGLMObjective:
         compiled kernel serves every chunk, staged to device where they
         stay for the whole objective lifetime (only labels/offsets/weights
         ride the per-pass host→device stream — the packed index/value
-        streams replace the raw indices/values entirely)."""
+        streams replace the raw indices/values entirely). The per-chunk
+        pack goes through the PROCESS-WIDE layout cache
+        (``ops/tile_cache``): a rebuilt objective over the same data —
+        GAME trainers rebuild per fit, drivers per sweep — reuses the
+        packed streams instead of re-sorting every nonzero."""
+        from photon_ml_tpu.ops import tile_cache
         from photon_ml_tpu.ops.batch import SparseBatch
-        from photon_ml_tpu.ops.sparse_tiled import (
-            pad_chunks_to_common_groups,
-            tile_sparse_batch,
-        )
+        from photon_ml_tpu.ops.sparse_tiled import pad_chunks_to_common_groups
 
         tbs = []
         fps = []
@@ -285,8 +288,16 @@ class StreamingGLMObjective:
                 offsets=c["offsets"], weights=c["weights"],
                 num_features=self.num_features,
             )
-            tbs.append(tile_sparse_batch(sb, keep_empty_chunks=True))
-            fps.append(self._chunk_fingerprint(c))
+            fp = self._chunk_fingerprint(c)
+            tbs.append(
+                tile_cache.tiled_layout_for(
+                    sb, keep_empty_chunks=True,
+                    # same hash serves the swap guard (structure) and the
+                    # cache key (structure + feature width) — computed once
+                    fingerprint=(fp[0], self.num_features, fp[1], fp[2]),
+                )
+            )
+            fps.append(fp)
         layouts = pad_chunks_to_common_groups(tbs)
         ref = tbs[0]
         self._tile_layouts = [
@@ -300,14 +311,12 @@ class StreamingGLMObjective:
 
     @staticmethod
     def _chunk_fingerprint(chunk: dict) -> tuple:
-        import hashlib
+        # one hash serves both the swap guard and (widened with the
+        # feature count) the process-wide layout cache key
+        from photon_ml_tpu.ops import tile_cache
 
-        idx = np.ascontiguousarray(np.asarray(chunk["indices"]))
-        val = np.ascontiguousarray(np.asarray(chunk["values"]))
-        return (
-            idx.shape,
-            hashlib.sha256(idx.tobytes()).hexdigest(),
-            hashlib.sha256(val.tobytes()).hexdigest(),
+        return tile_cache.structure_fingerprint(
+            chunk["indices"], chunk["values"]
         )
 
     @staticmethod
@@ -523,6 +532,22 @@ class StreamingGLMObjective:
             * self._reg_curvature(self.reg_mask)
         )
 
+    def stream_scores(self, w: Array, num_rows: int) -> np.ndarray:
+        """Margins (X·w, no offsets) over this objective's chunks, trimmed
+        to ``num_rows`` — through the SAME device-resident tile-COO
+        layouts the solve used when they exist (the GAME trainer scores
+        every coordinate visit; re-running those scores through the XLA
+        gather path forfeited the kernel the visit just trained on), else
+        the plain per-chunk matvec."""
+        if not self.chunks:
+            return np.zeros(num_rows, np.float32)
+        w = jnp.asarray(w)
+        outs = [
+            np.asarray(self._chunk_score(self._chunk_batch(c, i), w))
+            for i, c in enumerate(self.chunks)
+        ]
+        return np.concatenate(outs)[:num_rows]
+
     def value_and_grad(self, w: Array) -> tuple[Array, Array]:
         w = jnp.asarray(w)
         init = (jnp.float32(0.0), jnp.zeros((self.num_features,), jnp.float32))
@@ -540,17 +565,46 @@ class StreamingGLMObjective:
         return v + self._l2_term(w), g
 
 
+_score_matvec = jax.jit(lambda b, wi: b.matvec(wi))
+
+
 def stream_scores(
     chunks: Sequence[dict],
     w: np.ndarray,
     num_rows: int,
     num_features: int | None = None,
+    tile_sparse: bool | None = None,
 ) -> np.ndarray:
     """Margins over all chunks (scoring an out-of-core dataset), trimmed to
-    the dataset's true ``num_rows`` (the last chunk is padded)."""
+    the dataset's true ``num_rows`` (the last chunk is padded).
+
+    ``tile_sparse=None`` applies the streamed objective's auto rule: on
+    TPU, genuinely high-dimensional sparse chunks score through tile-COO
+    layouts from the PROCESS-WIDE cache (``ops/tile_cache``) — per-visit
+    GAME validation scoring packs each chunk once and hits the cache every
+    visit after, instead of re-running XLA's latency-bound gather."""
     if not chunks:
         return np.zeros(num_rows, np.float32)  # 0-row host shard
-    score = jax.jit(lambda b, w: b.matvec(w))
+    from photon_ml_tpu.ops.sparse_tiled import tiling_economical_features
+
+    sparse = "indices" in chunks[0]
+    want_tiling = (
+        tile_sparse
+        if tile_sparse is not None
+        else (
+            sparse
+            and num_features is not None
+            and tiling_economical_features(num_features)
+            and jax.default_backend() == "tpu"
+        )
+    )
     w = jnp.asarray(w)
-    outs = [np.asarray(score(_to_batch(c, num_features), w)) for c in chunks]
+    outs = []
+    for c in chunks:
+        b = _to_batch(c, num_features)
+        if want_tiling and sparse:
+            from photon_ml_tpu.ops import tile_cache
+
+            b = tile_cache.tiled_layout_for(b, keep_empty_chunks=True)
+        outs.append(np.asarray(_score_matvec(b, w)))
     return np.concatenate(outs)[:num_rows]

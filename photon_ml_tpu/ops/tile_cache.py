@@ -1,0 +1,202 @@
+"""Process-wide tile-COO layout cache.
+
+Packing a ``SparseBatch`` into the write-slab-major tile-COO layout
+(``ops/sparse_tiled.py``) is a host-side sort + scatter over every nonzero
+— cheap next to a full solve, but it was being re-paid for IDENTICAL
+sparsity structure all over the system: every ``StreamingGLMObjective``
+re-tiled its chunks even when a previous objective over the same data had
+already done so (GAME trainers rebuild objectives per fit; drivers rebuild
+them per sweep), and every cross-validation invocation re-tiled its fold
+subsets from scratch. The compiled kernel executable was similarly
+re-specialized per call site.
+
+This module is the one shared answer: a process-wide LRU keyed by
+
+    (sparsity fingerprint, chunking mode, tuned kernel constants)
+
+where the fingerprint hashes the nonzero STRUCTURE (indices/values bytes,
+shape, feature count) and the tuned constants are the module-level
+GROUPS_PER_STEP / SEGMENTS_PER_DMA / GROUPS_PER_RUN / SEGMENT_BATCHED
+knobs read at call time — a retune invalidates by key, never by luck.
+Only the layout (the ``_TileChunk`` tuple + pad metadata) is cached;
+labels/offsets/weights always come from the caller's batch, so GAME
+coordinate visits that only swap residual offsets hit the cache by
+construction. Executable reuse is the other half: ``_tiled_apply`` keys
+its jit cache on the same tuned constants, so any two cache entries with
+equal stream shapes re-enter one compiled kernel.
+
+Thread-safe; bounded by BOTH entry count (``capacity()``, LRU) and total
+packed-stream bytes (``byte_budget()``) — the entries pin device-resident
+streams, so an entry cap alone would let a handful of billion-nonzero
+layouts hold multiple GB of HBM for the process lifetime. ``clear()``
+drops everything (tests, or to release device memory eagerly).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+_DEFAULT_CAPACITY = 32
+# total packed-stream bytes the cache may pin across entries: an A2-scale
+# layout (both directions) is ~0.5 GB, so the default holds a few large
+# layouts or many small ones and evicts LRU beyond that — worst case a
+# re-pack, never an OOM
+_DEFAULT_BYTE_BUDGET = 2 * 1024**3
+
+_lock = threading.Lock()
+_entries: "OrderedDict[tuple, object]" = OrderedDict()
+_entry_bytes: dict = {}
+_stats = {"hits": 0, "misses": 0}
+_capacity = _DEFAULT_CAPACITY
+_byte_budget = _DEFAULT_BYTE_BUDGET
+
+
+def tuned_constants() -> tuple:
+    """The kernel-shaping constants, read at CALL time (the same
+    discipline as the layout builder: import-time capture breaks
+    retuning)."""
+    import photon_ml_tpu.ops.sparse_tiled as st
+
+    return (
+        st.GROUP,
+        st.SLAB,
+        st.GROUPS_PER_STEP,
+        st.SEGMENTS_PER_DMA,
+        st.GROUPS_PER_RUN,
+        bool(st.SEGMENT_BATCHED),
+    )
+
+
+def structure_fingerprint(indices, values) -> tuple:
+    """Byte-exact hash of the nonzero structure alone (shape + index and
+    value bytes) — the streamed objective's swap guard uses exactly this
+    (labels/offsets/weights are deliberately absent: the GAME trainer's
+    per-visit residual swap keeps the same layout)."""
+    idx = np.ascontiguousarray(np.asarray(indices))
+    val = np.ascontiguousarray(np.asarray(values, np.float32))
+    return (
+        idx.shape,
+        hashlib.sha256(idx.tobytes()).hexdigest(),
+        hashlib.sha256(val.tobytes()).hexdigest(),
+    )
+
+
+def sparsity_fingerprint(indices, values, num_features: int) -> tuple:
+    """The full cache key half: structure + the feature-space width the
+    layout pads to."""
+    shape, h_idx, h_val = structure_fingerprint(indices, values)
+    return (shape, int(num_features), h_idx, h_val)
+
+
+def stats() -> dict:
+    with _lock:
+        return dict(
+            _stats,
+            entries=len(_entries),
+            bytes=sum(_entry_bytes.values()),
+        )
+
+
+def capacity() -> int:
+    return _capacity
+
+
+def byte_budget() -> int:
+    return _byte_budget
+
+
+def _evict_over_limits_locked() -> None:
+    while _entries and (
+        len(_entries) > _capacity
+        or sum(_entry_bytes.values()) > _byte_budget
+    ):
+        key, _ = _entries.popitem(last=False)
+        _entry_bytes.pop(key, None)
+
+
+def set_capacity(n: int) -> None:
+    global _capacity
+    with _lock:
+        _capacity = max(int(n), 1)
+        _evict_over_limits_locked()
+
+
+def set_byte_budget(n: int) -> None:
+    global _byte_budget
+    with _lock:
+        _byte_budget = max(int(n), 0)
+        _evict_over_limits_locked()
+
+
+def clear() -> None:
+    with _lock:
+        _entries.clear()
+        _entry_bytes.clear()
+        _stats["hits"] = 0
+        _stats["misses"] = 0
+
+
+def _chunks_nbytes(chunks) -> int:
+    total = 0
+    for c in chunks:
+        for arrays in (c.m_arrays, c.g_arrays):
+            total += sum(int(a.nbytes) for a in arrays)
+    return total
+
+
+def tiled_layout_for(batch, keep_empty_chunks: bool = False,
+                     fingerprint: tuple | None = None):
+    """A ``TiledSparseBatch`` for ``batch``, reusing the cached layout when
+    an identical sparsity structure was already packed under the current
+    tuned constants. The returned batch ALWAYS carries the caller's
+    labels/offsets/weights (only the packed streams are shared).
+    ``fingerprint`` lets callers that already hashed the chunk (the
+    streamed objective's swap guard) skip the second hash."""
+    import photon_ml_tpu.ops.sparse_tiled as st
+
+    if fingerprint is None:
+        fingerprint = sparsity_fingerprint(
+            batch.indices, batch.values, batch.num_features
+        )
+    key = (fingerprint, bool(keep_empty_chunks), tuned_constants())
+    with _lock:
+        cached = _entries.get(key)
+        if cached is not None:
+            _entries.move_to_end(key)
+            _stats["hits"] += 1
+    if cached is not None:
+        # only the layout is cached — never the first caller's per-row
+        # arrays (which a stored full batch would pin alive)
+        chunks, num_rows_real, n_pad_total, d_pad_total = cached
+        return st.TiledSparseBatch(
+            chunks=chunks,
+            labels=batch.labels,
+            offsets=batch.offsets,
+            weights=batch.weights,
+            num_features=batch.num_features,
+            num_rows_real=num_rows_real,
+            n_pad_total=n_pad_total,
+            d_pad_total=d_pad_total,
+        )
+    # build OUTSIDE the lock (packing is the expensive part) through the
+    # module attribute, so instrumented/monkeypatched builders see misses
+    # (and keep the plain one-arg call shape they expect)
+    if keep_empty_chunks:
+        tb = st.tile_sparse_batch(batch, keep_empty_chunks=True)
+    else:
+        tb = st.tile_sparse_batch(batch)
+    nbytes = _chunks_nbytes(tb.chunks)
+    with _lock:
+        _stats["misses"] += 1
+        if nbytes <= _byte_budget:  # over-budget layouts are never pinned
+            _entries[key] = (
+                tb.chunks, tb.num_rows_real, tb.n_pad_total, tb.d_pad_total
+            )
+            _entry_bytes[key] = nbytes
+            _entries.move_to_end(key)
+            _evict_over_limits_locked()
+    return tb

@@ -34,6 +34,7 @@ from photon_ml_tpu.ops.batch import Batch, pad_batch
 from photon_ml_tpu.ops.glm import make_objective
 from photon_ml_tpu.ops.losses import PointwiseLoss
 from photon_ml_tpu.optim.common import OptimizationResult, select_minimize_fn
+from photon_ml_tpu.utils import compat
 
 Array = jnp.ndarray
 
@@ -61,7 +62,7 @@ def _densify_sharded(batch, mesh: Mesh, axis_name: str = "data"):
 
     batch = shard_batch(batch, mesh, axis_name)
     fn = jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             densify,
             mesh=mesh,
             in_specs=P(axis_name),
@@ -122,7 +123,7 @@ def _sharded_solve(
         kwargs = {"l1_weight": l1w} if use_l1 else {}
         return minimize_fn(obj, w0, config, **kwargs)
 
-    return jax.shard_map(
+    return compat.shard_map(
         solve,
         mesh=mesh,
         in_specs=(P(axis_name), P(), P(), P(), P(), P()),
@@ -296,7 +297,7 @@ def _sharded_tiled_solve(
         kwargs = {"l1_weight": l1w} if use_l1 else {}
         return minimize_fn(obj, w0, config, **kwargs)
 
-    return jax.shard_map(
+    return compat.shard_map(
         solve,
         mesh=mesh,
         in_specs=(P(axis_name), P(), P(), P(), P(), P()),

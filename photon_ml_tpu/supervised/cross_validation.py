@@ -68,6 +68,21 @@ def _row_select(batch: Batch, rows: np.ndarray) -> Batch:
     return jax.tree.map(lambda a: a[rows], batch)
 
 
+def _ingest_training_batch(batch: Batch) -> Batch:
+    """The fold/refit ingest decision — the framework's ONE standard rule
+    (``optimize_batch_layout``: densify when the dense matrix fits,
+    tile-COO for genuinely high-dimensional sparse, through the
+    PROCESS-WIDE layout cache). A repeated ``cross_validate_glm`` over the
+    same data (outer hyperparameter search, repeated experiments) re-packs
+    no fold, and the final refit reuses any layout the caller's own ingest
+    already built. Dense batches pass through unchanged."""
+    from photon_ml_tpu.ops.batch import SparseBatch, optimize_batch_layout
+
+    if isinstance(batch, SparseBatch):
+        return optimize_batch_layout(batch)
+    return batch
+
+
 def cross_validate_glm(
     batch: Batch,
     task: TaskType,
@@ -105,7 +120,7 @@ def cross_validate_glm(
     for held_out in folds:
         train_rows = np.setdiff1d(perm, held_out, assume_unique=True)
         result = train_glm(
-            _row_select(batch, train_rows),
+            _ingest_training_batch(_row_select(batch, train_rows)),
             task,
             optimizer_config=optimizer_config,
             regularization=regularization,
@@ -128,7 +143,7 @@ def cross_validate_glm(
             best_weight, best_mean = lam, m
 
     final = train_glm(
-        batch,
+        _ingest_training_batch(batch),
         task,
         optimizer_config=optimizer_config,
         regularization=regularization,

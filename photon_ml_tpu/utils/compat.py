@@ -1,0 +1,22 @@
+"""JAX version-compat shims outside Pallas (see ``ops/_pallas_compat`` for
+the Pallas-TPU ones).
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to ``jax.shard_map``
+across the JAX line this repo straddles, and the replication-check kwarg
+was renamed ``check_rep`` → ``check_vma`` in the same move. Call sites use
+this wrapper so either JAX works.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, **kwargs):
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn  # type: ignore
+
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+    return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)

@@ -75,6 +75,104 @@ class TestBaselineGeneration:
         assert "cfg_err" in text and "boom" in text
 
 
+class TestQuickMode:
+    """--quick is the cheap perf regression gate: same single-JSON-line
+    stdout contract, A/A2/F subset, NO artifact writes (toy numbers must
+    never overwrite the measured table)."""
+
+    FAKE = {
+        "A_sparse_logistic": {"samples_per_sec": 1.0, "quality_ok": True},
+        "A2_sparse_highdim": {
+            "samples_per_sec": 2.0,
+            "quality_ok": True,
+            "implied_hbm_fraction": 0.1,
+            "kernel_constants": {"groups_per_run": 2},
+        },
+        "F_streaming": {"samples_per_sec": 3.0, "quality_ok": True},
+    }
+
+    def _run_main(self, monkeypatch, capsys, results, quick=True):
+        calls = []
+        monkeypatch.setattr(
+            bench, "_run_config_subprocess",
+            lambda name, quick=False: (calls.append((name, quick)),
+                                       results[name])[1],
+        )
+        baseline_writes = []
+        monkeypatch.setattr(
+            bench, "update_baseline",
+            lambda *a, **k: baseline_writes.append(a),
+        )
+        detail_writes = []
+        monkeypatch.setattr(
+            bench.json, "dump",
+            lambda *a, **k: detail_writes.append(a),
+        )
+        bench.main(quick=quick)
+        return calls, baseline_writes, detail_writes, capsys.readouterr()
+
+    def test_quick_keeps_single_json_line_contract(self, monkeypatch, capsys):
+        calls, baseline_writes, detail_writes, cap = self._run_main(
+            monkeypatch, capsys, self.FAKE
+        )
+        lines = [l for l in cap.out.splitlines() if l.strip()]
+        assert len(lines) == 1, f"stdout must be ONE JSON line, got {lines}"
+        payload = json.loads(lines[0])
+        assert payload["quick"] is True
+        assert set(payload["configs"]) == set(bench.QUICK_CONFIGS)
+        assert [c for c, _ in calls] == list(bench.QUICK_CONFIGS)
+        assert all(q for _, q in calls)
+        # quick writes NO artifacts (BENCH_DETAIL.json / BASELINE.md)
+        assert not baseline_writes and not detail_writes
+
+    def test_quick_quality_failure_exits_nonzero_with_contract(
+        self, monkeypatch, capsys
+    ):
+        results = {
+            k: dict(v) for k, v in self.FAKE.items()
+        }
+        results["A2_sparse_highdim"]["quality_ok"] = False
+        with pytest.raises(SystemExit) as exc:
+            self._run_main(monkeypatch, capsys, results)
+        assert exc.value.code == 1
+        lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+        assert len(lines) == 1 and json.loads(lines[0])["quick"] is True
+
+    def test_full_mode_still_writes_artifacts(self, monkeypatch, capsys):
+        results = {
+            name: {"samples_per_sec": 1.0, "quality_ok": True}
+            for name in bench.CONFIGS
+        }
+        monkeypatch.setattr(
+            bench, "_run_config_subprocess",
+            lambda name, quick=False: results[name],
+        )
+        baseline_writes = []
+        monkeypatch.setattr(
+            bench, "update_baseline",
+            lambda *a, **k: baseline_writes.append(a),
+        )
+        detail_writes = []
+        monkeypatch.setattr(
+            bench.json, "dump", lambda *a, **k: detail_writes.append(a)
+        )
+        bench.main(quick=False)
+        lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+        assert len(lines) == 1 and json.loads(lines[0])["quick"] is False
+        assert baseline_writes and detail_writes  # full mode DOES write
+
+    def test_retune_env_reaches_kernel_constants(self, monkeypatch):
+        import photon_ml_tpu.ops.sparse_tiled as st
+
+        monkeypatch.setattr(st, "GROUPS_PER_RUN", 2)
+        monkeypatch.setattr(st, "GROUPS_PER_STEP", 32)
+        monkeypatch.setenv("PHOTON_GROUPS_PER_RUN", "4")
+        monkeypatch.setenv("PHOTON_GROUPS_PER_STEP", "16")
+        bench._apply_retune_env()
+        assert st.GROUPS_PER_RUN == 4
+        assert st.GROUPS_PER_STEP == 16
+
+
 class TestNarrativeNumberDiscipline:
     """Every 'Nx'/'N×' multiplier in README/BASELINE prose must be backed by
     a committed artifact or be an explicitly reviewed protocol constant —

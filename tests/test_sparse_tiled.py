@@ -246,12 +246,22 @@ def test_game_fixed_effect_rides_tiled_kernel(rng):
 
 
 class TestTiledMesh:
-    def test_sharded_minimize_routes_tiled_and_matches_single_device(self, rng):
+    def test_sharded_minimize_routes_tiled_and_matches_single_device(
+        self, rng, monkeypatch
+    ):
         """sharded_minimize on a high-dim SparseBatch must take the
         per-shard tile-COO route (not the XLA gather/scatter fallback) and
         reach the single-device tiled optimum (VERDICT r4 missing #4 /
-        next-2b: the file's own multi-device recipe, implemented)."""
+        next-2b: the file's own multi-device recipe, implemented). Small
+        segment constants: this gates the MESH plumbing (stacked 4-array
+        layouts, shard padding, psum), not the default-constant kernel —
+        both sides of the comparison retune together."""
         import jax.numpy as jnp
+
+        import photon_ml_tpu.ops.sparse_tiled as st_mod
+
+        monkeypatch.setattr(st_mod, "GROUPS_PER_STEP", 8)
+        monkeypatch.setattr(st_mod, "SEGMENTS_PER_DMA", 2)
 
         from photon_ml_tpu.config import OptimizerConfig
         from photon_ml_tpu.ops.batch import SparseBatch
@@ -262,7 +272,7 @@ class TestTiledMesh:
         from photon_ml_tpu.parallel.distributed import sharded_minimize
         from photon_ml_tpu.types import TaskType
 
-        n, d, k = 4096, 8192, 6  # d >= 4096 satisfies supports_tiling;
+        n, d, k = 2048, 4096, 4  # d >= 4096 satisfies supports_tiling;
         # dense = 128 MB > the CPU fallback budget? force the sparse route
         # by monkeypatching the budget below instead of relying on it
         idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
@@ -325,6 +335,347 @@ class TestTiledMesh:
         np.testing.assert_allclose(
             float(res.value), float(ref.value), rtol=1e-5
         )
+
+
+class TestSlabRunBatching:
+    """Run-length edge conditions for the slab-run-batched phase 1: parity
+    vs the XLA SparseBatch across run shapes (single-group runs, a run
+    crossing the DMA-step boundary, an all-one-slab stream) and under
+    retuned constants — same discipline as the segment-constant
+    regression test below. The edge tests retune GROUPS_PER_STEP/
+    SEGMENTS_PER_DMA down (8/2, the existing regression test's values) so
+    each parity check traces a small kernel — default-constant parity is
+    already covered by every pre-existing test in this file, which now
+    runs the run-batched kernel too."""
+
+    def _small_constants(self, monkeypatch):
+        import photon_ml_tpu.ops.sparse_tiled as st
+
+        monkeypatch.setattr(st, "GROUPS_PER_STEP", 8)
+        monkeypatch.setattr(st, "SEGMENTS_PER_DMA", 2)
+
+    def _make(self, rng, n, d, idx, val):
+        return SparseBatch(
+            indices=jnp.asarray(idx), values=jnp.asarray(val),
+            labels=jnp.zeros(n, jnp.float32),
+            offsets=jnp.zeros(n, jnp.float32),
+            weights=jnp.ones(n, jnp.float32), num_features=d,
+        )
+
+    def _assert_parity(self, batch, rng, rtol=2e-3, atol=2e-3,
+                       squared=False):
+        tb = tile_sparse_batch(batch)
+        w = jnp.asarray(rng.normal(size=batch.num_features).astype(np.float32))
+        r = jnp.asarray(rng.normal(size=batch.num_rows).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(tb.matvec(w)), np.asarray(batch.matvec(w)),
+            rtol=rtol, atol=atol,
+        )
+        np.testing.assert_allclose(
+            np.asarray(tb.rmatvec(r)), np.asarray(batch.rmatvec(r)),
+            rtol=rtol, atol=atol,
+        )
+        if squared:
+            np.testing.assert_allclose(
+                np.asarray(tb.rmatvec_sq(r)), np.asarray(batch.rmatvec_sq(r)),
+                rtol=rtol, atol=atol,
+            )
+        return tb
+
+    def test_single_group_runs(self, rng, monkeypatch):
+        # k=1 over many column slabs: almost every cell holds ONE group,
+        # so runs are minimal and every cell pads up to a whole run
+        self._small_constants(monkeypatch)
+        n, d = 2048, 8192
+        idx = rng.integers(0, d, size=(n, 1)).astype(np.int32)
+        val = rng.normal(size=(n, 1)).astype(np.float32)
+        self._assert_parity(self._make(rng, n, d, idx, val), rng)
+
+    def test_run_crossing_dma_step_boundary(self, rng, monkeypatch):
+        # one hot column slab: a single (write-slab, read-slab) cell holds
+        # more groups than a DMA step — its run crosses segment boundaries
+        # AND the step boundary
+        import photon_ml_tpu.ops.sparse_tiled as st
+
+        self._small_constants(monkeypatch)
+        n, d, k = 1024, 2048, 8
+        idx = rng.integers(0, SLAB, size=(n, k)).astype(np.int32)  # col slab 0
+        val = rng.normal(size=(n, k)).astype(np.float32)
+        batch = self._make(rng, n, d, idx, val)
+        self._assert_parity(batch, rng, squared=True)
+        # the margins layout really does contain a run longer than one DMA
+        # step (the condition under test, not an accident of the shapes)
+        lay = st.build_write_major_layout(
+            np.repeat(np.arange(n, dtype=np.int64), k),
+            idx.reshape(-1).astype(np.int64), val.reshape(-1),
+            SLAB, d,
+        )
+        runs = st.detect_slab_runs(lay.rslab)
+        step_groups = st.GROUPS_PER_STEP * st.SEGMENTS_PER_DMA
+        assert int(runs[:, 1].max()) > step_groups
+
+    def test_all_one_slab_stream(self, rng, monkeypatch):
+        # d and n both one slab: every group of BOTH directions reads
+        # slab 0 — the whole stream is a single maximal run
+        import photon_ml_tpu.ops.sparse_tiled as st
+
+        self._small_constants(monkeypatch)
+        n, d, k = SLAB, SLAB, 6
+        idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        val = rng.normal(size=(n, k)).astype(np.float32)
+        batch = self._make(rng, n, d, idx, val)
+        self._assert_parity(batch, rng)
+        lay = st.build_write_major_layout(
+            np.repeat(np.arange(n, dtype=np.int64), k),
+            idx.reshape(-1).astype(np.int64), val.reshape(-1),
+            SLAB, SLAB,
+        )
+        assert (lay.rslab == 0).all() and (lay.rrun == 0).all()
+
+    def test_retuned_run_constant(self, rng, monkeypatch):
+        # the full retune surface at once, incl. the new runs-per-call
+        # knob — layouts and kernel must agree at CALL-time values
+        import photon_ml_tpu.ops.sparse_tiled as st
+
+        self._small_constants(monkeypatch)
+        monkeypatch.setattr(st, "GROUPS_PER_RUN", 4)
+        n, d, k = 2048, 4096, 4
+        idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        val = rng.normal(size=(n, k)).astype(np.float32)
+        batch = self._make(rng, n, d, idx, val)
+        tb = self._assert_parity(batch, rng, squared=True)
+        for c in tb.chunks:
+            for arrays in (c.m_arrays, c.g_arrays):
+                n_groups = arrays[0].shape[0]
+                assert n_groups % (8 * 2) == 0  # whole DMA steps
+                assert arrays[3].shape[0] == n_groups // 4  # rrun stream
+
+    def test_run_must_divide_segment(self, rng, monkeypatch):
+        import photon_ml_tpu.ops.sparse_tiled as st
+
+        monkeypatch.setattr(st, "GROUPS_PER_RUN", 3)  # does not divide 32
+        with pytest.raises(ValueError, match="divide"):
+            st.build_write_major_layout(
+                np.zeros(4, np.int64), np.zeros(4, np.int64),
+                np.ones(4, np.float32), SLAB, SLAB,
+            )
+
+    def test_run_metadata_invariants(self, rng):
+        """The builder's run invariant, stated directly: every aligned
+        GROUPS_PER_RUN block is single-slab, ``rrun`` is its slab stream,
+        and maximal runs (detect_slab_runs) start and end on run-block
+        boundaries — cells pad to whole runs, so no run straddles one."""
+        import photon_ml_tpu.ops.sparse_tiled as st
+
+        n, d, k = 3072, 6144, 5
+        idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        val = rng.normal(size=(n, k)).astype(np.float32)
+        R = st.GROUPS_PER_RUN
+        for write_pad, read_pad, w_idx, r_idx in (
+            (-(-n // SLAB) * SLAB, -(-d // SLAB) * SLAB,
+             np.repeat(np.arange(n, dtype=np.int64), k),
+             idx.reshape(-1).astype(np.int64)),
+            (-(-d // SLAB) * SLAB, -(-n // SLAB) * SLAB,
+             idx.reshape(-1).astype(np.int64),
+             np.repeat(np.arange(n, dtype=np.int64), k)),
+        ):
+            lay = st.build_write_major_layout(
+                w_idx, r_idx, val.reshape(-1), write_pad, read_pad
+            )
+            blocks = lay.rslab.reshape(-1, R)
+            assert (blocks == blocks[:, :1]).all()
+            np.testing.assert_array_equal(lay.rrun, blocks[:, 0])
+            runs = st.detect_slab_runs(lay.rslab)
+            assert int(runs[:, 1].sum()) == len(lay.rslab)
+            assert (runs[:, 0] % R == 0).all()
+            assert (runs[:, 1] % R == 0).all()
+
+
+class TestTileLayoutCache:
+    """The process-wide layout cache (``ops/tile_cache``): identical
+    sparsity structure never re-packs; anything layout-relevant — values,
+    indices, tuned constants — misses by key."""
+
+    def _batch(self, rng, n=2048, d=4096, k=4, seed_vals=None):
+        idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        val = (seed_vals if seed_vals is not None
+               else rng.normal(size=(n, k))).astype(np.float32)
+        return SparseBatch(
+            indices=jnp.asarray(idx), values=jnp.asarray(val),
+            labels=jnp.asarray(rng.uniform(size=n).astype(np.float32)),
+            offsets=jnp.zeros(n, jnp.float32),
+            weights=jnp.ones(n, jnp.float32), num_features=d,
+        )
+
+    def test_hit_shares_layout_and_carries_callers_rows(self, rng):
+        import dataclasses
+
+        from photon_ml_tpu.ops import tile_cache
+
+        tile_cache.clear()
+        b1 = self._batch(rng)
+        tb1 = tile_cache.tiled_layout_for(b1)
+        # same structure, different labels/offsets (the GAME residual swap)
+        b2 = dataclasses.replace(
+            b1,
+            labels=jnp.ones_like(b1.labels),
+            offsets=jnp.full_like(b1.offsets, 0.5),
+        )
+        tb2 = tile_cache.tiled_layout_for(b2)
+        s = tile_cache.stats()
+        assert (s["hits"], s["misses"]) == (1, 1)
+        assert tb2.chunks is tb1.chunks  # packed streams shared
+        np.testing.assert_array_equal(np.asarray(tb2.labels), 1.0)
+        np.testing.assert_array_equal(np.asarray(tb2.offsets), 0.5)
+
+    def test_structure_change_misses(self, rng):
+        import dataclasses
+
+        from photon_ml_tpu.ops import tile_cache
+
+        tile_cache.clear()
+        b1 = self._batch(rng)
+        tile_cache.tiled_layout_for(b1)
+        b2 = dataclasses.replace(
+            b1, values=b1.values.at[0, 0].add(1.0)
+        )
+        tile_cache.tiled_layout_for(b2)
+        s = tile_cache.stats()
+        assert (s["hits"], s["misses"]) == (0, 2)
+
+    def test_retuned_constants_change_the_key(self, rng, monkeypatch):
+        import photon_ml_tpu.ops.sparse_tiled as st
+        from photon_ml_tpu.ops import tile_cache
+
+        tile_cache.clear()
+        b = self._batch(rng)
+        tile_cache.tiled_layout_for(b)
+        monkeypatch.setattr(st, "GROUPS_PER_RUN", 4)
+        tb = tile_cache.tiled_layout_for(b)
+        s = tile_cache.stats()
+        assert (s["hits"], s["misses"]) == (0, 2)
+        # the rebuilt layout actually reflects the retune (rrun granularity)
+        for c in tb.chunks:
+            assert c.m_arrays[3].shape[0] == c.m_arrays[0].shape[0] // 4
+
+    def test_capacity_bounds_and_clear(self, rng, monkeypatch):
+        from photon_ml_tpu.ops import tile_cache
+
+        tile_cache.clear()
+        old = tile_cache.capacity()
+        old_bytes = tile_cache.byte_budget()
+        try:
+            tile_cache.set_capacity(2)
+            batches = [self._batch(rng) for _ in range(3)]
+            for b in batches:
+                tile_cache.tiled_layout_for(b)
+            assert tile_cache.stats()["entries"] == 2
+            # oldest entry evicted: re-requesting it is a miss
+            tile_cache.tiled_layout_for(batches[0])
+            assert tile_cache.stats()["misses"] == 4
+            # the BYTE budget also evicts (device-resident streams must
+            # never pile up unbounded): one entry's worth keeps one entry
+            one = tile_cache.stats()["bytes"] // 2
+            tile_cache.set_byte_budget(one + 1)
+            assert tile_cache.stats()["entries"] == 1
+            # an over-budget layout still builds, but is never pinned
+            tile_cache.set_byte_budget(1)
+            tb = tile_cache.tiled_layout_for(batches[1])
+            assert tb.chunks and tile_cache.stats()["entries"] == 0
+        finally:
+            tile_cache.set_capacity(old)
+            tile_cache.set_byte_budget(old_bytes)
+            tile_cache.clear()
+        assert tile_cache.stats() == {
+            "hits": 0, "misses": 0, "entries": 0, "bytes": 0
+        }
+
+    def test_streaming_objective_rebuild_hits_cache(self, rng, monkeypatch):
+        """Rebuilding a StreamingGLMObjective over the same sparse chunks
+        (GAME trainers rebuild per fit; drivers per sweep) re-packs
+        nothing."""
+        import photon_ml_tpu.ops.sparse_tiled as st
+        from photon_ml_tpu.ops import tile_cache
+
+        # small segment constants: this test gates the CACHE, not the
+        # default-constant kernel (covered by the parity tests above)
+        monkeypatch.setattr(st, "GROUPS_PER_STEP", 8)
+        monkeypatch.setattr(st, "SEGMENTS_PER_DMA", 2)
+        from photon_ml_tpu.ops.losses import loss_for_task
+        from photon_ml_tpu.ops.streaming import (
+            StreamingGLMObjective,
+            sparse_chunks,
+        )
+        from photon_ml_tpu.types import TaskType
+
+        tile_cache.clear()
+        n, d, k = 1024, 2048, 3
+        idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        val = rng.normal(size=(n, k)).astype(np.float32)
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        chunks = sparse_chunks(idx, val, y, chunk_rows=512)
+        loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+
+        builds = {"n": 0}
+        orig = st.tile_sparse_batch
+
+        def counting(b, **kw):
+            builds["n"] += 1
+            return orig(b, **kw)
+
+        st.tile_sparse_batch = counting
+        try:
+            obj1 = StreamingGLMObjective(
+                chunks, loss, num_features=d, tile_sparse=True
+            )
+            first = builds["n"]
+            obj2 = StreamingGLMObjective(
+                chunks, loss, num_features=d, tile_sparse=True
+            )
+        finally:
+            st.tile_sparse_batch = orig
+        assert first == len(chunks)
+        assert builds["n"] == first, "rebuild re-packed a cached chunk"
+        # and the two objectives agree numerically
+        w = rng.normal(size=d).astype(np.float32)
+        v1, g1 = obj1.value_and_grad(w)
+        v2, g2 = obj2.value_and_grad(w)
+        np.testing.assert_allclose(float(v1), float(v2), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-6)
+
+    def test_cv_ingest_uses_cache(self, rng, monkeypatch):
+        """The CV fold ingest applies the framework's ONE standard rule
+        (optimize_batch_layout): dense-fitting sparse batches densify,
+        over-budget high-dim sparse tiles through the process-wide cache,
+        dense passes through."""
+        import photon_ml_tpu.ops.batch as ob
+        from photon_ml_tpu.ops import tile_cache
+        from photon_ml_tpu.ops.batch import DenseBatch
+        from photon_ml_tpu.supervised.cross_validation import (
+            _ingest_training_batch,
+        )
+
+        tile_cache.clear()
+        big = self._batch(rng, n=SLAB + 11, d=8192, k=4)
+        # simulate an over-budget dense form (a real one needs >6 GB)
+        monkeypatch.setattr(ob, "maybe_densify", lambda b, *a, **k: b)
+        out1 = _ingest_training_batch(big)
+        out2 = _ingest_training_batch(big)
+        assert isinstance(out1, TiledSparseBatch)
+        assert out2.chunks is out1.chunks
+        s = tile_cache.stats()
+        assert (s["hits"], s["misses"]) == (1, 1)
+        monkeypatch.undo()
+        # dense-fitting sparse takes the standard densify path
+        small = self._batch(rng, n=256, d=512, k=4)
+        assert isinstance(_ingest_training_batch(small), DenseBatch)
+        dense = DenseBatch(
+            X=jnp.zeros((8, 4), jnp.float32),
+            labels=jnp.zeros(8, jnp.float32),
+            offsets=jnp.zeros(8, jnp.float32),
+            weights=jnp.ones(8, jnp.float32),
+        )
+        assert _ingest_training_batch(dense) is dense
 
 
 def test_layout_tracks_retuned_segment_constants(rng, monkeypatch):

@@ -797,18 +797,24 @@ class TestStreamedDataValidation:
 
 
 class TestTiledStreamedChunks:
-    def test_tiled_chunks_match_plain_objective(self, rng):
+    def test_tiled_chunks_match_plain_objective(self, rng, monkeypatch):
         """tile_sparse=True: the streamed objective's sparse chunks run the
         tile-COO kernels (device-resident packed streams; slim per-pass
         uploads) and must match the plain XLA chunk path exactly
-        (VERDICT r4 missing #4: the streamed objective's sparse chunks)."""
-        n, d, k = 2048, 4096, 24
+        (VERDICT r4 missing #4: the streamed objective's sparse chunks).
+        Small segment constants: this gates the chunk plumbing (common
+        padding, slim uploads), not the default-constant kernel."""
+        import photon_ml_tpu.ops.sparse_tiled as st_mod
+
+        monkeypatch.setattr(st_mod, "GROUPS_PER_STEP", 8)
+        monkeypatch.setattr(st_mod, "SEGMENTS_PER_DMA", 2)
+        n, d, k = 2048, 4096, 8
         idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
         val = rng.normal(size=(n, k)).astype(np.float32)
         # UNEVEN chunks: zero out most values in the back half so the two
         # chunks tile to different stream lengths — exercising the
         # pad-to-common-groups path, not just the equal-length early return
-        val[n // 2:, 4:] = 0.0
+        val[n // 2:, 2:] = 0.0
         y = (rng.uniform(size=n) < 0.5).astype(np.float32)
         chunks = sparse_chunks(idx, val, y, chunk_rows=1024)
         plain = StreamingGLMObjective(
