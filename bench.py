@@ -63,6 +63,9 @@ RETUNE_ENV = {
     "PHOTON_GROUPS_PER_STEP": "GROUPS_PER_STEP",
     "PHOTON_SEGMENTS_PER_DMA": "SEGMENTS_PER_DMA",
     "PHOTON_GROUPS_PER_RUN": "GROUPS_PER_RUN",
+    # 1 = software-pipelined segment schedule (phase 1 of segment s+1
+    # overlaps phase 2 of segment s), 0 = straight-line reference
+    "PHOTON_PIPELINE_SEGMENTS": "PIPELINE_SEGMENTS",
 }
 # No TPU generation exceeds this HBM bandwidth (v5p ~2.8 TB/s); a
 # measurement implying more is a timing artifact, not a fast solve.
@@ -556,6 +559,7 @@ def _sparse_logistic_bench(jax, jnp, n, d, k, iters, densify_dtype,
             "segments_per_dma": st.SEGMENTS_PER_DMA,
             "groups_per_run": st.GROUPS_PER_RUN,
             "segment_batched": bool(st.SEGMENT_BATCHED),
+            "pipeline_segments": int(st.PIPELINE_SEGMENTS),
         }
         # run-padding overhead of the slab-run lever: padded stream nnz
         # over the raw nonzero count (GROUPS_PER_RUN=1 reproduces the

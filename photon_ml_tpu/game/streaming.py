@@ -1054,6 +1054,63 @@ class StreamedGameTrainer:
 
     # -- validation ---------------------------------------------------------
 
+    # grouped (Multi*) metrics silently drop sentinel rows; beyond this
+    # dropped fraction the remaining groups are a minority sample and the
+    # metric is loudly flagged rather than trusted as a full-validation
+    # score
+    GROUPED_DROPPED_WARN_FRACTION = 0.5
+
+    def _log_grouped_dropped(
+        self, validation: StreamedGameData
+    ) -> dict[str, float]:
+        """Per grouped-evaluator id tag: the fraction of validation rows
+        carrying the ``-1`` unseen-entity sentinel, which every grouped
+        (Multi*) metric DROPS (they form no entity group). Counted and
+        logged once per fit, with a loud warning when the fraction is
+        large — a near-empty grouped metric on a validation-only tag must
+        not be mistaken for a real score (ADVICE r5)."""
+        from photon_ml_tpu.evaluation.evaluators import make_evaluator
+
+        fracs: dict[str, float] = {}
+        for spec in self.evaluators:
+            ev = make_evaluator(spec)
+            tag = ev.group_by
+            # unknown tags raise in _prepare_validation's routing below —
+            # this accounting only covers tags the data actually carries
+            if tag is None or tag in fracs or tag not in validation.id_tags:
+                continue
+            ids = np.asarray(validation.id_tags[tag])
+            counts = np.asarray(
+                [int((ids < 0).sum()), int(len(ids))], np.int64
+            )
+            if self._distributed():
+                from photon_ml_tpu.parallel.multihost import (
+                    allreduce_sum_host,
+                )
+
+                counts = np.asarray(allreduce_sum_host(counts))
+            dropped, total = int(counts[0]), int(counts[1])
+            frac = dropped / total if total else 0.0
+            fracs[tag] = frac
+            self._log(
+                f"grouped metrics on tag {tag!r}: {dropped}/{total} "
+                f"validation rows ({frac:.1%}) carry the -1 unseen-entity "
+                "sentinel and are dropped"
+            )
+            if frac >= self.GROUPED_DROPPED_WARN_FRACTION:
+                import warnings
+
+                warnings.warn(
+                    f"grouped metrics on tag {tag!r} drop {frac:.1%} of "
+                    f"validation rows (unseen-entity sentinel -1): the "
+                    f"reported score covers only the remaining "
+                    f"{total - dropped} rows and is NOT a full-validation "
+                    "metric",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+        return fracs
+
     def _prepare_validation(
         self, validation: StreamedGameData
     ) -> dict[str, Any]:
@@ -1083,6 +1140,7 @@ class StreamedGameTrainer:
                 cid, validation, val_base, val_layout, drop_unseen=True
             )
         state["total"] = state["base_offsets"].copy()
+        state["grouped_dropped"] = self._log_grouped_dropped(validation)
         if self._distributed():
             # grouped evaluators (MULTI_AUC / PRECISION_AT_K) evaluate
             # OWNER-side: for a tag with a random-effect coordinate, the

@@ -28,6 +28,7 @@ path; ``fits_in_memory`` below is the decision rule.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Callable, Iterable, Iterator, Sequence
 
@@ -200,17 +201,13 @@ class StreamingGLMObjective:
         self._tile_layouts = None
         self._tile_meta = None
         self._tile_fingerprints = None
-        from photon_ml_tpu.ops.sparse_tiled import tiling_economical_features
+        from photon_ml_tpu.ops.sparse_tiled import auto_tile_streaming
 
         sparse = bool(self.chunks) and "indices" in self.chunks[0]
         want_tiling = (
             self.tile_sparse
             if self.tile_sparse is not None
-            else (
-                sparse
-                and tiling_economical_features(self.num_features)
-                and jax.default_backend() == "tpu"
-            )
+            else auto_tile_streaming(sparse, self.num_features)
         )
         if want_tiling and sparse:
             self._build_tile_layouts()
@@ -263,7 +260,6 @@ class StreamingGLMObjective:
         self._chunk_hvp = jax.jit(chunk_hvp)
         self._chunk_hd = jax.jit(chunk_hessian_diag)
         self._chunk_h = jax.jit(chunk_hessian)
-        self._chunk_score = jax.jit(lambda b, wi: b.matvec(wi))
 
     def _build_tile_layouts(self):
         """Tile every sparse chunk ONCE (host transform): per-chunk
@@ -542,8 +538,12 @@ class StreamingGLMObjective:
         if not self.chunks:
             return np.zeros(num_rows, np.float32)
         w = jnp.asarray(w)
+        # the one module-level scoring program (shared with the module
+        # scorer below): objectives are rebuilt per GAME fit / per sweep,
+        # and a per-objective jit would re-compile scoring on every
+        # rebuild instead of re-entering the process-wide cache
         outs = [
-            np.asarray(self._chunk_score(self._chunk_batch(c, i), w))
+            np.asarray(_score_matvec(self._chunk_batch(c, i), w))
             for i, c in enumerate(self.chunks)
         ]
         return np.concatenate(outs)[:num_rows]
@@ -565,7 +565,45 @@ class StreamingGLMObjective:
         return v + self._l2_term(w), g
 
 
-_score_matvec = jax.jit(lambda b, wi: b.matvec(wi))
+@functools.partial(jax.jit, static_argnames=("constants",))
+def _score_matvec_keyed(b, wi, constants):
+    return b.matvec(wi)
+
+
+def _score_matvec(b, wi):
+    """The one scoring program, re-entered across objectives/visits. The
+    tuned kernel constants ride along as a STATIC key: a nested jit's
+    statics are resolved at the OUTER trace, so without this a
+    PIPELINE_SEGMENTS / SEGMENT_BATCHED toggle (which reshapes nothing)
+    would silently re-enter the stale executable — the same
+    never-by-luck rule as ``_tiled_apply`` itself."""
+    from photon_ml_tpu.ops import tile_cache
+
+    return _score_matvec_keyed(b, wi, constants=tile_cache.tuned_constants())
+
+
+# bounded storage-identity memo for chunk structure fingerprints: the
+# per-visit GAME scorer passes fresh chunk DICTS over unchanged storage,
+# and re-hashing every chunk's full index/value bytes per visit costs
+# O(data) host sha256 just to look up an already-cached layout. Entries
+# hold references (that is what makes the data-pointer comparison safe —
+# a freed-and-reused address can never alias a live held array).
+_FP_MEMO: list = []
+_FP_MEMO_CAP = 16
+
+
+def _chunk_structure_fingerprint(indices, values) -> tuple:
+    from photon_ml_tpu.ops import tile_cache
+
+    same = StreamingGLMObjective._same_storage
+    for i, (pi, pv, fp) in enumerate(_FP_MEMO):
+        if same(indices, pi) and same(values, pv):
+            _FP_MEMO.append(_FP_MEMO.pop(i))
+            return fp
+    fp = tile_cache.structure_fingerprint(indices, values)
+    _FP_MEMO.append((indices, values, fp))
+    del _FP_MEMO[:-_FP_MEMO_CAP]
+    return fp
 
 
 def stream_scores(
@@ -585,18 +623,13 @@ def stream_scores(
     visit after, instead of re-running XLA's latency-bound gather."""
     if not chunks:
         return np.zeros(num_rows, np.float32)  # 0-row host shard
-    from photon_ml_tpu.ops.sparse_tiled import tiling_economical_features
+    from photon_ml_tpu.ops.sparse_tiled import auto_tile_streaming
 
     sparse = "indices" in chunks[0]
     want_tiling = (
         tile_sparse
         if tile_sparse is not None
-        else (
-            sparse
-            and num_features is not None
-            and tiling_economical_features(num_features)
-            and jax.default_backend() == "tpu"
-        )
+        else auto_tile_streaming(sparse, num_features)
     )
     w = jnp.asarray(w)
     outs = []
@@ -605,6 +638,15 @@ def stream_scores(
         if want_tiling and sparse:
             from photon_ml_tpu.ops import tile_cache
 
-            b = tile_cache.tiled_layout_for(b, keep_empty_chunks=True)
+            # storage-identity memo: per-visit calls pass fresh chunk
+            # dicts over unchanged arrays, and a cache HIT must not cost
+            # a full re-hash of the chunk's index/value bytes
+            shape, h_idx, h_val = _chunk_structure_fingerprint(
+                c["indices"], c["values"]
+            )
+            b = tile_cache.tiled_layout_for(
+                b, keep_empty_chunks=True,
+                fingerprint=(shape, num_features, h_idx, h_val),
+            )
         outs.append(np.asarray(_score_matvec(b, w)))
     return np.concatenate(outs)[:num_rows]

@@ -16,8 +16,9 @@ This module is the one shared answer: a process-wide LRU keyed by
 
 where the fingerprint hashes the nonzero STRUCTURE (indices/values bytes,
 shape, feature count) and the tuned constants are the module-level
-GROUPS_PER_STEP / SEGMENTS_PER_DMA / GROUPS_PER_RUN / SEGMENT_BATCHED
-knobs read at call time — a retune invalidates by key, never by luck.
+GROUPS_PER_STEP / SEGMENTS_PER_DMA / GROUPS_PER_RUN / SEGMENT_BATCHED /
+PIPELINE_SEGMENTS knobs read at call time — a retune invalidates by key,
+never by luck.
 Only the layout (the ``_TileChunk`` tuple + pad metadata) is cached;
 labels/offsets/weights always come from the caller's batch, so GAME
 coordinate visits that only swap residual offsets hit the cache by
@@ -68,6 +69,12 @@ def tuned_constants() -> tuple:
         st.SEGMENTS_PER_DMA,
         st.GROUPS_PER_RUN,
         bool(st.SEGMENT_BATCHED),
+        # the pipeline schedule does not reshape the layout, but it keys
+        # here anyway so a toggle can NEVER reuse a stale entry (the same
+        # never-by-luck rule as the stream-shaping constants; the cost of
+        # a spurious miss is one re-pack, the cost of a stale hit under a
+        # future layout-coupled schedule would be silent garbage)
+        bool(st.PIPELINE_SEGMENTS),
     )
 
 

@@ -354,32 +354,125 @@ def exchange_rows(arrays, dest: np.ndarray):
 _HOST_LINKS: dict | None = None
 
 
+def _reset_host_links() -> None:
+    """Close every cached exchange socket and drop THIS process's mesh so
+    its next exchange rebuilds from scratch. Called on ANY
+    ``_host_p2p_exchange`` error: after a partial send/receive the
+    length-prefix framing on the surviving streams is undefined (a retry
+    would read payload bytes as a prefix and silently mis-frame
+    everything after), so the only safe local state is no mesh at all.
+    The reset is per-process by construction (an error such as a size
+    mismatch may be raised on one host only); peers discover it FAIL-FAST
+    on their next exchange — their sends/receives against the closed
+    sockets error instead of mis-framing — which resets them too, so a
+    caller-level collective retry converges to a full mesh rebuild."""
+    global _HOST_LINKS
+    links, _HOST_LINKS = _HOST_LINKS, None
+    if not links:
+        return
+    for side in ("send", "recv"):
+        for sock in links.get(side, {}).values():
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+def _coordinator_address() -> str:
+    """The ``jax.distributed`` coordinator address: the standard env var
+    when set, else JAX's own distributed global state (the runtime knows
+    its coordinator even when it was wired up by pod auto-detection or
+    explicit ``initialize`` arguments — the env var is absent on exactly
+    those paths)."""
+    target = os.environ.get("JAX_COORDINATOR_ADDRESS", "")
+    if target:
+        return target
+    try:
+        from jax._src import distributed as _distributed
+
+        return getattr(_distributed.global_state, "coordinator_address", None) or ""
+    except Exception:
+        return ""
+
+
+def _is_loopback(ip: str) -> bool:
+    return ip.startswith("127.") or ip in ("0.0.0.0", "localhost", "::1")
+
+
+def _coordinator_is_loopback(host: str) -> bool:
+    """True when the coordinator host is loopback — literally, or through
+    DNS/hosts resolution (the single-machine harness may pass the
+    machine's own hostname, which stock Debian/Ubuntu maps to
+    127.0.1.1)."""
+    if not host:
+        return False
+    if _is_loopback(host):
+        return True
+    import socket
+
+    try:
+        return _is_loopback(socket.gethostbyname(host))
+    except OSError:
+        return False
+
+
 def _local_ip() -> str:
     """This host's address as peers should dial it. Override with
     ``PHOTON_EXCHANGE_HOST`` to pin a specific NIC. Otherwise discover the
     OUTBOUND interface by UDP-connecting toward the ``jax.distributed``
-    coordinator (no packet is sent; the kernel just picks the route) —
+    coordinator (env var or the runtime's own global state; no packet is
+    sent — the kernel just picks the route) —
     ``gethostbyname(gethostname())`` is NOT used because stock
     Debian/Ubuntu ``/etc/hosts`` maps the hostname to 127.0.1.1, which
-    would advertise an undialable loopback to remote peers."""
+    would advertise an undialable loopback to remote peers.
+
+    A discovered LOOPBACK address with ``process_count > 1`` under a
+    non-loopback (or unknown) coordinator fails FAST: advertising it would
+    make every remote peer dial itself and hang the mesh build until the
+    300 s socket timeout. A loopback COORDINATOR means every process lives
+    on this machine (a remote process could not have reached it), so
+    loopback peers are dialable and the single-machine multi-process test
+    harness keeps working."""
     explicit = os.environ.get("PHOTON_EXCHANGE_HOST")
     if explicit:
         return explicit
     import socket
 
-    target = os.environ.get("JAX_COORDINATOR_ADDRESS", "")
+    target = _coordinator_address()
     host = target.rsplit(":", 1)[0] if target else ""
+
+    # any non-loopback discovery returns immediately; one loopback result
+    # only means THAT probe routed locally (e.g. the coordinator hostname
+    # mapped to 127.0.1.1 via /etc/hosts — the later 8.8.8.8 probe still
+    # finds the real NIC), so keep probing and fail fast only once EVERY
+    # source has come up loopback
+    last = "127.0.0.1"
     for probe in filter(None, [host, "8.8.8.8"]):
         try:
             with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
                 s.connect((probe, 53))
-                return s.getsockname()[0]
+                ip = s.getsockname()[0]
         except OSError:
             continue
+        if not _is_loopback(ip):
+            return ip
+        last = ip
     try:
-        return socket.gethostbyname(socket.gethostname())
+        ip = socket.gethostbyname(socket.gethostname())
+        if not _is_loopback(ip):
+            return ip
+        last = ip
     except OSError:
-        return "127.0.0.1"
+        pass
+    if jax.process_count() > 1 and not _coordinator_is_loopback(host):
+        raise RuntimeError(
+            f"host exchange address discovery found only loopback {last!r} "
+            f"with process_count={jax.process_count()}: remote peers "
+            "cannot dial it (the mesh build would hang until the "
+            "300 s timeout). Set PHOTON_EXCHANGE_HOST to this host's "
+            "reachable address."
+        )
+    return last
 
 
 def _recv_exact(sock, n: int) -> bytes:
@@ -468,7 +561,24 @@ def _host_p2p_exchange(arrays, order, starts, counts_matrix):
     send to pid+r, receive from pid−r) so every process's receiver drains
     concurrently — no cyclic wait. Layout of the result matches the
     all_to_all transport exactly (ascending source, stable within source).
+
+    ANY error tears THIS process's socket mesh down
+    (``_reset_host_links``): a partially-drained stream's next bytes are
+    payload, not a length prefix, so reusing a survivor would silently
+    mis-frame every later exchange. Peers fail fast against the closed
+    sockets on their next use and reset themselves, so retries rebuild
+    the mesh instead of corrupting data.
     """
+    try:
+        return _host_p2p_exchange_impl(arrays, order, starts, counts_matrix)
+    except BaseException:
+        # closing the sockets also unblocks a sender thread stuck in
+        # sendall against a stalled peer — it errors out and exits
+        _reset_host_links()
+        raise
+
+
+def _host_p2p_exchange_impl(arrays, order, starts, counts_matrix):
     import struct
     import threading
 

@@ -86,7 +86,10 @@ class TestQuickMode:
             "samples_per_sec": 2.0,
             "quality_ok": True,
             "implied_hbm_fraction": 0.1,
-            "kernel_constants": {"groups_per_run": 2},
+            "kernel_constants": {
+                "groups_per_run": 2,
+                "pipeline_segments": 1,
+            },
         },
         "F_streaming": {"samples_per_sec": 3.0, "quality_ok": True},
     }
@@ -122,6 +125,13 @@ class TestQuickMode:
         assert set(payload["configs"]) == set(bench.QUICK_CONFIGS)
         assert [c for c, _ in calls] == list(bench.QUICK_CONFIGS)
         assert all(q for _, q in calls)
+        # the retune surface round-trips through the contract: A2's
+        # kernel_constants (incl. the pipeline-schedule knob) appear
+        # verbatim in the single JSON line, so a sweep is auditable from
+        # stdout alone
+        constants = payload["configs"]["A2_sparse_highdim"]["kernel_constants"]
+        assert constants["pipeline_segments"] == 1
+        assert constants["groups_per_run"] == 2
         # quick writes NO artifacts (BENCH_DETAIL.json / BASELINE.md)
         assert not baseline_writes and not detail_writes
 
@@ -166,11 +176,14 @@ class TestQuickMode:
 
         monkeypatch.setattr(st, "GROUPS_PER_RUN", 2)
         monkeypatch.setattr(st, "GROUPS_PER_STEP", 32)
+        monkeypatch.setattr(st, "PIPELINE_SEGMENTS", 1)
         monkeypatch.setenv("PHOTON_GROUPS_PER_RUN", "4")
         monkeypatch.setenv("PHOTON_GROUPS_PER_STEP", "16")
+        monkeypatch.setenv("PHOTON_PIPELINE_SEGMENTS", "0")
         bench._apply_retune_env()
         assert st.GROUPS_PER_RUN == 4
         assert st.GROUPS_PER_STEP == 16
+        assert st.PIPELINE_SEGMENTS == 0
 
 
 class TestNarrativeNumberDiscipline:

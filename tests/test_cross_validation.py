@@ -72,3 +72,50 @@ def test_cv_rejects_bad_k(rng):
         cross_validate_glm(batch, TaskType.LOGISTIC_REGRESSION, k=1)
     with pytest.raises(ValueError):
         cross_validate_glm(batch, TaskType.LOGISTIC_REGRESSION, k=11)
+
+
+@pytest.mark.kernel
+def test_cv_fold_ingest_pipelined_bit_identical(rng, monkeypatch):
+    """PIPELINE_SEGMENTS on/off through the CV fold-ingest consumer: a
+    fold ingested onto the tile-COO path (through the process-wide layout
+    cache) must score BIT-IDENTICALLY between the skewed and
+    straight-line kernel schedules (interpret mode, retuned-down
+    constants)."""
+    import photon_ml_tpu.ops.batch as ob
+    import photon_ml_tpu.ops.sparse_tiled as st_mod
+    from photon_ml_tpu.ops import tile_cache
+    from photon_ml_tpu.ops.batch import SparseBatch
+    from photon_ml_tpu.supervised.cross_validation import (
+        _ingest_training_batch,
+    )
+
+    monkeypatch.setattr(st_mod, "GROUPS_PER_STEP", 8)
+    monkeypatch.setattr(st_mod, "SEGMENTS_PER_DMA", 2)
+    # simulate an over-budget dense form so ingest tiles (as in the
+    # layout-cache CV test)
+    monkeypatch.setattr(ob, "maybe_densify", lambda b, *a, **k: b)
+    tile_cache.clear()
+    n, d, k = 2048, 4096, 4
+    idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    val = rng.normal(size=(n, k)).astype(np.float32)
+    batch = SparseBatch(
+        indices=jnp.asarray(idx), values=jnp.asarray(val),
+        labels=jnp.zeros(n, jnp.float32),
+        offsets=jnp.zeros(n, jnp.float32),
+        weights=jnp.ones(n, jnp.float32), num_features=d,
+    )
+    w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    r = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    outs = {}
+    for flag in (1, 0):
+        monkeypatch.setattr(st_mod, "PIPELINE_SEGMENTS", flag)
+        tb = _ingest_training_batch(batch)
+        assert isinstance(tb, st_mod.TiledSparseBatch)
+        outs[flag] = (
+            np.asarray(tb.matvec(w)),
+            np.asarray(tb.rmatvec(r)),
+            np.asarray(tb.rmatvec_sq(r)),
+        )
+    for pipelined, straight in zip(outs[1], outs[0]):
+        np.testing.assert_array_equal(pipelined, straight)
+    tile_cache.clear()

@@ -133,3 +133,49 @@ def test_sparse_mesh_densify_is_sharded(rng, monkeypatch):
     # objective) take different reduction orders — coefficient agreement
     # is convergence-level, not bitwise
     np.testing.assert_allclose(res_d.w, res_s.w, rtol=5e-3, atol=5e-4)
+
+
+@pytest.mark.kernel
+def test_sharded_tiled_solve_pipelined_bit_identical(rng, monkeypatch):
+    """PIPELINE_SEGMENTS on/off through the per-shard MESH consumer: the
+    8-shard tiled solve (``_sharded_tiled_solve`` under ``shard_map``)
+    must be BIT-IDENTICAL between the skewed and straight-line kernel
+    schedules — identical per-step math on every shard means an identical
+    optimizer trajectory (interpret mode, retuned-down constants)."""
+    import photon_ml_tpu.ops.sparse_tiled as st_mod
+    import photon_ml_tpu.ops.streaming as ost
+    from photon_ml_tpu.ops.batch import SparseBatch
+    from photon_ml_tpu.ops.losses import loss_for_task
+    from photon_ml_tpu.parallel.distributed import sharded_minimize
+    from photon_ml_tpu.types import TaskType
+
+    monkeypatch.setattr(st_mod, "GROUPS_PER_STEP", 8)
+    monkeypatch.setattr(st_mod, "SEGMENTS_PER_DMA", 2)
+    # a tiny densify budget forces the sparse batch onto the tiled route
+    monkeypatch.setattr(ost, "device_hbm_budget_bytes", lambda *a, **k: 1.0)
+
+    n, d, k = 2048, 4096, 4
+    idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    val = rng.normal(size=(n, k)).astype(np.float32)
+    w_true = (rng.normal(size=d) * 0.3).astype(np.float32)
+    m = (val * w_true[idx]).sum(axis=1)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-m))).astype(np.float32)
+    batch = SparseBatch(
+        indices=jnp.asarray(idx), values=jnp.asarray(val),
+        labels=jnp.asarray(y),
+        offsets=jnp.zeros(n, jnp.float32),
+        weights=jnp.ones(n, jnp.float32),
+        num_features=d,
+    )
+    loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+    cfg = OptimizerConfig(max_iterations=6, tolerance=0.0)
+    outs = {}
+    for flag in (1, 0):
+        monkeypatch.setattr(st_mod, "PIPELINE_SEGMENTS", flag)
+        res = sharded_minimize(
+            lbfgs_minimize, batch, jnp.zeros(d, jnp.float32), cfg,
+            data_mesh(8), loss, l2_weight=1.0,
+        )
+        outs[flag] = (np.asarray(res.w), float(res.value))
+    np.testing.assert_array_equal(outs[1][0], outs[0][0])
+    assert outs[1][1] == outs[0][1]

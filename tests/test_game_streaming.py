@@ -768,3 +768,91 @@ def test_streamed_game_incremental_prior_matches_in_memory(rng):
         np.asarray(plain_model.models["fixed"].model.coefficients.means),
         atol=1e-4,
     )
+
+
+def test_grouped_metric_dropped_sentinel_fraction_logged(rng):
+    """Grouped (Multi*) metrics drop sentinel -1 rows; the trainer must
+    count and log the dropped fraction and warn LOUDLY when it is large,
+    so a near-empty grouped metric on a validation-only tag cannot be
+    mistaken for a real full-validation score (ADVICE r5)."""
+    import warnings
+
+    X, Xr, ids, y, _ = _data(rng, n=400)
+    Xv, Xrv, idsv, yv, _ = _data(rng, n=200)
+    idsv = np.minimum(idsv, ids.max())
+    # a VALIDATION-ONLY grouped tag where most rows carry the -1 sentinel
+    vtag = rng.integers(0, 4, size=200).astype(np.int64)
+    vtag[: 150] = -1  # 75% dropped
+
+    data = StreamedGameData(labels=y, features={"g": X, "r": Xr},
+                            id_tags={"uid": ids})
+    vdata = StreamedGameData(
+        labels=yv, features={"g": Xv, "r": Xrv},
+        id_tags={"uid": idsv, "vtag": vtag},
+    )
+    logs: list[str] = []
+    tr = StreamedGameTrainer(
+        _config(iters=1), chunk_rows=128,
+        evaluators=("AUC", "MULTI_AUC(vtag)"), logger=logs.append,
+    )
+    with pytest.warns(RuntimeWarning, match="vtag.*75.0%|75.0%.*vtag"):
+        tr.fit(data, validation=vdata)
+    assert any(
+        "vtag" in m and "150/200" in m and "75.0%" in m for m in logs
+    ), logs
+
+    # below the warning threshold: counted and logged, but NO loud warning
+    vtag_ok = rng.integers(0, 4, size=200).astype(np.int64)
+    vtag_ok[:20] = -1  # 10% dropped
+    vdata_ok = StreamedGameData(
+        labels=yv, features={"g": Xv, "r": Xrv},
+        id_tags={"uid": idsv, "vtag": vtag_ok},
+    )
+    logs2: list[str] = []
+    tr2 = StreamedGameTrainer(
+        _config(iters=1), chunk_rows=128,
+        evaluators=("AUC", "MULTI_AUC(vtag)"), logger=logs2.append,
+    )
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        tr2.fit(data, validation=vdata_ok)
+    assert not [
+        w for w in caught if "unseen-entity sentinel" in str(w.message)
+    ]
+    assert any("vtag" in m and "20/200" in m for m in logs2), logs2
+
+
+@pytest.mark.kernel
+def test_game_visit_scoring_pipelined_bit_identical(rng, monkeypatch):
+    """PIPELINE_SEGMENTS on/off through the GAME visit-scoring consumer:
+    ``ops.streaming.stream_scores`` with tile-COO layouts (the per-visit
+    validation/coordinate scorer's kernel path, riding the process-wide
+    layout cache) must be BIT-IDENTICAL between the skewed and
+    straight-line schedules (interpret mode, retuned-down constants)."""
+    import jax.numpy as jnp
+
+    import photon_ml_tpu.ops.sparse_tiled as st_mod
+    from photon_ml_tpu.ops import tile_cache
+    from photon_ml_tpu.ops.streaming import sparse_chunks, stream_scores
+
+    monkeypatch.setattr(st_mod, "GROUPS_PER_STEP", 8)
+    monkeypatch.setattr(st_mod, "SEGMENTS_PER_DMA", 2)
+    tile_cache.clear()
+    n, d, k = 2048, 4096, 4
+    idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    val = rng.normal(size=(n, k)).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    chunks = sparse_chunks(idx, val, y, chunk_rows=1024)
+    w = rng.normal(size=d).astype(np.float32)
+    outs = {}
+    for flag in (1, 0):
+        monkeypatch.setattr(st_mod, "PIPELINE_SEGMENTS", flag)
+        outs[flag] = stream_scores(
+            chunks, w, num_rows=n, num_features=d, tile_sparse=True
+        )
+    np.testing.assert_array_equal(outs[1], outs[0])
+    # the XLA path agrees too (the kernel is correct, not just consistent)
+    ref = stream_scores(chunks, w, num_rows=n, num_features=d,
+                        tile_sparse=False)
+    np.testing.assert_allclose(outs[1], ref, rtol=2e-3, atol=2e-3)
+    tile_cache.clear()

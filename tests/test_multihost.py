@@ -1017,3 +1017,244 @@ def test_four_process_skewed_exchange_is_padding_bounded(tmp_path):
         out, err = p.communicate(timeout=600)
         assert p.returncode == 0, f"worker failed:\n{out}\n{err[-2500:]}"
         assert "SKEW WORKER DONE" in out
+
+
+class TestExchangeHardening:
+    """Single-process unit tests for the exchange transport's failure
+    hygiene (ADVICE r5): a failed point-to-point exchange must tear the
+    socket mesh down (partially-drained streams mis-frame length
+    prefixes), and loopback address discovery must fail fast instead of
+    advertising an undialable address to remote peers."""
+
+    def test_p2p_error_resets_host_links(self, monkeypatch):
+        import jax
+
+        import photon_ml_tpu.parallel.multihost as mh
+
+        class FakeSock:
+            def __init__(self):
+                self.closed = False
+
+            def close(self):
+                self.closed = True
+
+            def sendall(self, *_):
+                if self.closed:
+                    raise OSError("closed")
+
+            def recv(self, *_):
+                raise ConnectionError("peer died mid-stream")
+
+        send_sock, recv_sock = FakeSock(), FakeSock()
+        links = {"send": {1: send_sock}, "recv": {1: recv_sock}}
+        monkeypatch.setattr(mh, "_HOST_LINKS", links)
+        monkeypatch.setattr(mh, "_host_links", lambda: links)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+
+        arrays = {"v": np.arange(4, dtype=np.float32)}
+        order = np.arange(4, dtype=np.int64)
+        starts = np.asarray([0, 2, 4], np.int64)
+        counts_matrix = np.asarray([[2, 2], [2, 2]], np.int64)
+        with pytest.raises(ConnectionError):
+            mh._host_p2p_exchange(arrays, order, starts, counts_matrix)
+        # the mesh is gone and every cached socket is closed: the NEXT
+        # exchange rebuilds from scratch instead of mis-framing a
+        # partially-drained stream
+        assert mh._HOST_LINKS is None
+        assert send_sock.closed and recv_sock.closed
+
+    def test_reset_host_links_tolerates_empty(self):
+        import photon_ml_tpu.parallel.multihost as mh
+
+        before = mh._HOST_LINKS
+        try:
+            mh._HOST_LINKS = None
+            mh._reset_host_links()  # no-op, no raise
+            assert mh._HOST_LINKS is None
+        finally:
+            mh._HOST_LINKS = before
+
+    def test_local_ip_explicit_override_wins(self, monkeypatch):
+        import photon_ml_tpu.parallel.multihost as mh
+
+        monkeypatch.setenv("PHOTON_EXCHANGE_HOST", "10.0.0.7")
+        assert mh._local_ip() == "10.0.0.7"
+
+    def test_local_ip_fails_fast_on_loopback_multiprocess(self, monkeypatch):
+        """EVERY discovery source loopback + process_count > 1 +
+        non-loopback coordinator: raise immediately (the 300 s
+        alternative is every remote peer dialing itself). A single
+        loopback probe result must NOT raise — later probes may still
+        find the real NIC."""
+        import jax
+
+        import photon_ml_tpu.parallel.multihost as mh
+
+        monkeypatch.delenv("PHOTON_EXCHANGE_HOST", raising=False)
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        # coordinator from JAX's distributed global state (no env var set)
+        monkeypatch.setattr(mh, "_coordinator_address",
+                            lambda: "10.1.2.3:1234")
+        import socket as socket_mod
+
+        probes = []
+
+        class FakeUDP:
+            def __init__(self, *a, **k):
+                pass
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+            def connect(self, addr):
+                probes.append(addr[0])
+                # the docstring's own failure case: the hostname maps to
+                # 127.0.1.1, so the probe toward the coordinator routes
+                # locally — but so does everything else on this fake host
+                if addr[0] == "10.1.2.3":
+                    self._ip = "127.0.1.1"
+                else:
+                    self._ip = "127.0.0.1"
+
+            def getsockname(self):
+                return (self._ip, 33333)
+
+        monkeypatch.setattr(socket_mod, "socket", FakeUDP)
+        monkeypatch.setattr(
+            socket_mod, "gethostbyname",
+            lambda *_: (_ for _ in ()).throw(OSError("no resolver")),
+        )
+        with pytest.raises(RuntimeError, match="PHOTON_EXCHANGE_HOST"):
+            mh._local_ip()
+        # the coordinator probe coming up loopback did NOT abort the
+        # sweep: the 8.8.8.8 probe was still tried before failing fast
+        assert probes == ["10.1.2.3", "8.8.8.8"]
+
+    def test_local_ip_allows_loopback_under_loopback_coordinator(
+        self, monkeypatch
+    ):
+        """A loopback COORDINATOR proves a single-machine runtime (the
+        multi-process test harness): loopback peers are dialable, no
+        fail-fast."""
+        import jax
+
+        import photon_ml_tpu.parallel.multihost as mh
+
+        monkeypatch.delenv("PHOTON_EXCHANGE_HOST", raising=False)
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:9999")
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        import socket as socket_mod
+
+        class FakeUDP:
+            def __init__(self, *a, **k):
+                pass
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+            def connect(self, *_):
+                pass
+
+            def getsockname(self):
+                return ("127.0.0.1", 33333)
+
+        monkeypatch.setattr(socket_mod, "socket", FakeUDP)
+        monkeypatch.setattr(
+            socket_mod, "gethostbyname",
+            lambda *_: (_ for _ in ()).throw(OSError("no resolver")),
+        )
+        assert mh._local_ip() == "127.0.0.1"
+
+    def test_local_ip_keeps_probing_past_a_loopback_result(self, monkeypatch):
+        """One loopback probe result is not an error: the 8.8.8.8 probe
+        still runs and its non-loopback discovery wins."""
+        import jax
+
+        import photon_ml_tpu.parallel.multihost as mh
+
+        monkeypatch.delenv("PHOTON_EXCHANGE_HOST", raising=False)
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "badhost:1234")
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        import socket as socket_mod
+
+        class FakeUDP:
+            def __init__(self, *a, **k):
+                pass
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+            def connect(self, addr):
+                self._ip = (
+                    "127.0.1.1" if addr[0] == "badhost" else "10.0.0.5"
+                )
+
+            def getsockname(self):
+                return (self._ip, 33333)
+
+        monkeypatch.setattr(socket_mod, "socket", FakeUDP)
+        assert mh._local_ip() == "10.0.0.5"
+
+    def test_local_ip_allows_hostname_resolving_to_loopback(
+        self, monkeypatch
+    ):
+        """The single-machine carve-out must RESOLVE a hostname
+        coordinator: stock Debian/Ubuntu maps the machine's own hostname
+        to 127.0.1.1, and a harness passing that hostname worked before
+        the fail-fast existed — it must keep working."""
+        import jax
+
+        import photon_ml_tpu.parallel.multihost as mh
+
+        monkeypatch.delenv("PHOTON_EXCHANGE_HOST", raising=False)
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "myhost:9999")
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        import socket as socket_mod
+
+        class FakeUDP:
+            def __init__(self, *a, **k):
+                pass
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+            def connect(self, *_):
+                pass
+
+            def getsockname(self):
+                return ("127.0.1.1", 33333)
+
+        monkeypatch.setattr(socket_mod, "socket", FakeUDP)
+        monkeypatch.setattr(
+            socket_mod, "gethostbyname", lambda h: "127.0.1.1"
+        )
+        assert mh._local_ip() == "127.0.1.1"
+
+    def test_coordinator_address_reads_jax_global_state(self, monkeypatch):
+        import photon_ml_tpu.parallel.multihost as mh
+
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        from jax._src import distributed as jdist
+
+        monkeypatch.setattr(
+            jdist.global_state, "coordinator_address", "10.9.8.7:4321",
+            raising=False,
+        )
+        assert mh._coordinator_address() == "10.9.8.7:4321"
+        # env var wins when set
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1")
+        assert mh._coordinator_address() == "10.0.0.1:1"
