@@ -67,6 +67,13 @@ RETUNE_ENV = {
     # overlaps phase 2 of segment s), 0 = straight-line reference
     "PHOTON_PIPELINE_SEGMENTS": "PIPELINE_SEGMENTS",
 }
+# Host-ingest pipeline knobs: same call-time-read discipline, applied to
+# ops/prefetch (depth 0 = the synchronous pre-prefetch schedule
+# bit-for-bit; the cache budget bounds the device-resident chunk tier).
+RETUNE_ENV_PREFETCH = {
+    "PHOTON_PREFETCH_DEPTH": "PREFETCH_DEPTH",
+    "PHOTON_CHUNK_CACHE_BUDGET": "CHUNK_CACHE_BUDGET",
+}
 # No TPU generation exceeds this HBM bandwidth (v5p ~2.8 TB/s); a
 # measurement implying more is a timing artifact, not a fast solve.
 HBM_ROOFLINE_BYTES_PER_S = 4.0e12
@@ -1105,6 +1112,9 @@ def bench_f_streaming(jax, jnp):
     res = host_lbfgs_minimize(sobj, np.zeros(d, np.float32), cfg)
     dt = time.perf_counter() - t0
     its = max(int(res.iterations), 1)
+    from photon_ml_tpu.ops import prefetch as _prefetch
+
+    _cache_snapshot = _prefetch.cache_stats()  # one coherent snapshot
     return {
         "samples_per_sec": round(n * its / dt, 1),
         "sec_per_iteration": round(dt / its, 4),
@@ -1112,6 +1122,21 @@ def bench_f_streaming(jax, jnp):
         "ingest_gbps_measured": round(ingest_gbps, 4),
         "transfer_limited": bool(ingest_gbps < 1.0),
         **_overlap_microbench(jax, jnp),
+        **_hostpack_overlap_microbench(jax, jnp),
+        # the host-ingest pipeline knobs this run used — the retune
+        # surface (RETUNE_ENV_PREFETCH) round-trips through the JSON
+        # contract exactly like the kernel constants, so a prefetch sweep
+        # is auditable from stdout alone
+        "prefetch": {
+            "prefetch_depth": _prefetch.prefetch_depth(),
+            "chunk_cache_budget_bytes": int(
+                _prefetch.chunk_cache_budget_bytes()
+            ),
+            "chunk_cache": {
+                k: _cache_snapshot[k]
+                for k in ("device_hits", "host_hits", "misses", "evictions")
+            },
+        },
         "quality_ok": bool(np.isfinite(float(res.value))),
         "vs_one_core_proxy": None,
         "shape": {"n": n, "d": d, "iters": its, "chunk_rows": chunk_rows},
@@ -1212,6 +1237,106 @@ def _overlap_microbench(jax, jnp):
     }
 
 
+def _hostpack_overlap_microbench(jax, jnp):
+    """Measures the HOST-PACK overlap claim of the prefetch pipeline
+    (``ops/prefetch``) with a number, the same way ``_overlap_microbench``
+    measures transfer overlap: per chunk, a genuinely heavy host
+    preparation (sort over the chunk — the shape of the tile-COO pack;
+    GIL-releasing numpy) feeds a device kernel sized ADAPTIVELY near the
+    measured pack time, so overlap is resolvable on any backend:
+
+    - prefetch on (depth 2): chunk ``i+k``'s pack+``device_put`` runs on
+      the worker pool while chunk ``i``'s compute is consumed — exactly
+      the schedule every streamed consumer now runs;
+    - prefetch off (depth 0): the synchronous pack→compute loop.
+
+    ``hostpack_overlap_ratio`` = serialized/pipelined — 1.0 means no
+    overlap, ~2.0 is the ceiling when pack ≈ compute. The per-stage wall
+    counters (``utils/profiling`` — host-pack / device-put seconds on the
+    workers, consumer-wait seconds on the main thread) are reported from
+    the SAME pipelined run, so where the critical path went is observable,
+    not asserted."""
+    import functools
+
+    from photon_ml_tpu.ops import prefetch
+    from photon_ml_tpu.utils import profiling
+
+    n_c, d_c, n_chunks = 1 << 11, 256, 6
+    rng = np.random.default_rng(11)
+    raw = [
+        rng.normal(size=(n_c, d_c)).astype(np.float32)
+        for _ in range(n_chunks)
+    ]
+    w_mat = jnp.asarray(rng.normal(size=(d_c, d_c)).astype(np.float32) * 0.01)
+
+    def pack(i):
+        # argsort+gather over every element: the tile-COO pack's shape
+        # (host sort over the nonzero stream), releases the GIL
+        x = raw[i]
+        order = np.argsort(x, axis=0, kind="stable")
+        return np.take_along_axis(x, order, axis=0)
+
+    @functools.partial(jax.jit, static_argnames=("length",))
+    def heavy_n(x, length):
+        def body(c, _):
+            return jnp.tanh(c @ w_mat), None
+        c, _ = jax.lax.scan(body, x, None, length=length)
+        return jnp.sum(c)
+
+    # size the device compute near the measured pack time (fixed sizes
+    # would be unresolvable across the 100x backend speed range)
+    pack(0)
+    t0 = time.perf_counter()
+    for i in range(n_chunks):
+        pack(i)
+    t_pack = (time.perf_counter() - t0) / n_chunks
+    x_dev = jax.device_put(raw[0])
+    float(heavy_n(x_dev, 8)); float(heavy_n(x_dev, 64))
+    t0 = time.perf_counter(); float(heavy_n(x_dev, 8)); t8 = time.perf_counter() - t0
+    t0 = time.perf_counter(); float(heavy_n(x_dev, 64)); t64 = time.perf_counter() - t0
+    per_step = max((t64 - t8) / 56, 1e-7)
+    repeat = int(np.clip(t_pack / per_step, 8, 1 << 16))
+    heavy = lambda x: heavy_n(x, repeat)
+
+    def prepare(i):
+        # timed_device_put keeps the pack/put stage split disjoint (the
+        # put would otherwise double-count inside the worker's pack timer)
+        return prefetch.timed_device_put(pack(i))
+
+    def run(depth):
+        acc = 0.0
+        for x in prefetch.prefetch_iter(n_chunks, prepare, depth):
+            acc += float(heavy(x))
+        return acc
+
+    run(2); run(0)  # compile + warm both schedules
+    ts_on, ts_off = [], []
+    for _ in range(3):  # alternate: link/load drift must not alias in
+        t0 = time.perf_counter(); run(2)
+        ts_on.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); run(0)
+        ts_off.append(time.perf_counter() - t0)
+    # stage split from ONE dedicated pipelined run (the timing loop above
+    # would mix the serialized runs' device_put seconds into the counters
+    # and misattribute where the critical path went)
+    profiling.reset_counters("prefetch.")
+    run(2)
+    stages = {
+        k.split(".", 1)[1]: round(v["seconds"], 4)
+        for k, v in profiling.counter_snapshot("prefetch.").items()
+    }
+    t_on = float(np.median(ts_on))
+    t_off = float(np.median(ts_off))
+    return {
+        "hostpack_sec_pipelined": round(t_on, 4),
+        "hostpack_sec_serialized": round(t_off, 4),
+        "hostpack_overlap_ratio": round(t_off / t_on, 3),
+        "hostpack_chunk_pack_sec": round(t_pack, 4),
+        "hostpack_compute_steps_per_chunk": repeat,
+        "hostpack_stage_seconds": stages,
+    }
+
+
 def bench_g_eval_auc(jax, jnp):
     """Config G: evaluator scale — exact sort-based AUC vs O(n) histogram
     (BUCKETED_AUC) on a 1e8-row synthetic score vector, with the
@@ -1291,8 +1416,10 @@ CONFIGS = {
 
 
 def _apply_retune_env() -> None:
-    """Apply RETUNE_ENV overrides to the sparse-tiled module constants
-    (call-time-read globals, so layout builder and kernel both track)."""
+    """Apply RETUNE_ENV overrides to the sparse-tiled module constants and
+    RETUNE_ENV_PREFETCH overrides to the host-ingest pipeline knobs
+    (call-time-read globals, so layout builder, kernel and prefetch
+    pipeline all track)."""
     pending = {
         attr: int(os.environ[var])
         for var, attr in RETUNE_ENV.items()
@@ -1304,6 +1431,17 @@ def _apply_retune_env() -> None:
         for attr, value in pending.items():
             setattr(st, attr, value)
         _log(f"[bench] retuned kernel constants from env: {pending}")
+    pending_pf = {
+        attr: int(os.environ[var])
+        for var, attr in RETUNE_ENV_PREFETCH.items()
+        if os.environ.get(var)
+    }
+    if pending_pf:
+        import photon_ml_tpu.ops.prefetch as pf
+
+        for attr, value in pending_pf.items():
+            setattr(pf, attr, value)
+        _log(f"[bench] retuned prefetch knobs from env: {pending_pf}")
 
 
 def _run_one(name: str, quick: bool = False) -> None:

@@ -152,6 +152,47 @@ def seq_scores_init(cfg: GameTrainingConfig, model: GameModel) -> list[str]:
     ]
 
 
+def _atomic_savez(directory: str, final_path: str, payload: dict) -> None:
+    """Durably write an ``.npz`` payload: temp file in the SAME directory,
+    fsync BEFORE the atomic rename (``os.replace`` is atomic in the
+    namespace but says nothing about data blocks — a kill between rename
+    and writeback could commit a TRUNCATED file under the final name,
+    which a later ``np.load`` would half-parse instead of reject), then
+    fsync the directory so the rename itself is durable. On any failure
+    the temp file is removed and the final path is untouched."""
+    import os
+    import tempfile
+
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **payload)  # file object: no .npz suffix games
+            f.flush()
+            os.fsync(f.fileno())
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    try:
+        os.replace(tmp, final_path)
+    except BaseException:
+        # a failed rename (final path is a directory, permissions, stale
+        # NFS handle) must not leave a .tmp turd either
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    dfd = os.open(directory, os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
 def _host_digest(labels: np.ndarray, weights: np.ndarray) -> str:
     """Host-side twin of ``checkpoint.batch_digest`` for data that must
     NOT touch the device (the out-of-HBM path — ``jnp.asarray`` on the
@@ -949,14 +990,29 @@ class StreamedGameTrainer:
 
         buckets = shard.buckets
         sub_cols = shard.subspace_cols or (None,) * len(buckets.entity_ids)
-        for ent_ids, rows, cols in zip(
-            buckets.entity_ids, buckets.row_indices, sub_cols
-        ):
-            any_entities = True
-            bucket = gather_bucket(
-                shard.features, shard.labels, offs_re, shard.weights, rows,
-                columns=cols,
+        bucket_args = list(
+            zip(buckets.entity_ids, buckets.row_indices, sub_cols)
+        )
+        from photon_ml_tpu.ops import prefetch
+
+        def gather(i):
+            # bucket INGEST (host row gather + padding + upload) for bucket
+            # i+k runs on prefetch workers while bucket i's device solve is
+            # in flight; it reads only ingest-time state (features, labels,
+            # weights, this visit's offsets) — never W, which the ordered
+            # collect() below writes — so preparation order is free while
+            # solve/collect order (and thus every result) stays identical
+            ent_ids_i, rows_i, cols_i = bucket_args[i]
+            return gather_bucket(
+                shard.features, shard.labels, offs_re, shard.weights,
+                rows_i, columns=cols_i,
             )
+
+        for i, bucket in enumerate(
+            prefetch.prefetch_iter(len(bucket_args), gather)
+        ):
+            ent_ids, rows, cols = bucket_args[i]
+            any_entities = True
             # incremental training: this bucket's rows of the (already
             # solver-space) per-entity prior; subspace projection selects
             # the same columns the solve runs over. Re-sliced per visit —
@@ -1012,7 +1068,12 @@ class StreamedGameTrainer:
         self, shard: _ReShard, W: np.ndarray
     ) -> np.ndarray:
         """Scores w_{e(i)}·x_i for the shard's owned rows, chunk by chunk
-        (one gathered (c, d) coefficient block in HBM at a time)."""
+        (one gathered (c, d) coefficient block in HBM at a time). The
+        host gather + transfer of chunk ``i+k`` runs on prefetch workers
+        while the device scores chunk ``i`` (``ops/prefetch``; depth 0 =
+        the synchronous loop, bit-for-bit). Feature slices ride the
+        device-resident chunk cache — they are the same storage views
+        every visit, so visits 2..N re-upload only the gathered W rows."""
         m = len(shard.grow)
         scores = np.empty(m, np.float32)
         f = shard.features
@@ -1020,14 +1081,43 @@ class StreamedGameTrainer:
         X = np.asarray(f.X) if dense else None
         idx = None if dense else np.asarray(f.indices)
         val = None if dense else np.asarray(f.values)
-        for lo, hi in _chunk_ranges(m, self.chunk_rows):
-            W_rows = jnp.asarray(W[shard.ent_local[lo:hi]])
+        ranges = _chunk_ranges(m, self.chunk_rows)
+        from photon_ml_tpu.ops import prefetch
+
+        depth = prefetch.prefetch_depth()
+        if depth <= 0:
+            for lo, hi in ranges:
+                W_rows = jnp.asarray(W[shard.ent_local[lo:hi]])
+                if dense:
+                    s = _re_chunk_scores_dense(W_rows, jnp.asarray(X[lo:hi]))
+                else:
+                    s = _re_chunk_scores_sparse(
+                        W_rows, jnp.asarray(idx[lo:hi]), jnp.asarray(val[lo:hi])
+                    )
+                scores[lo:hi] = np.asarray(s)
+            return scores
+
+        def prepare(i):
+            lo, hi = ranges[i]
+            # gathered W rows are fresh arrays every visit — transferred
+            # (and stage-accounted) but never cached
+            W_rows = prefetch.timed_device_put(W[shard.ent_local[lo:hi]])
             if dense:
-                s = _re_chunk_scores_dense(W_rows, jnp.asarray(X[lo:hi]))
-            else:
-                s = _re_chunk_scores_sparse(
-                    W_rows, jnp.asarray(idx[lo:hi]), jnp.asarray(val[lo:hi])
-                )
+                feat = prefetch.cached_device_put({"X": X[lo:hi]})
+                return (W_rows, feat["X"])
+            feat = prefetch.cached_device_put(
+                {"indices": idx[lo:hi], "values": val[lo:hi]}
+            )
+            return (W_rows, feat["indices"], feat["values"])
+
+        for i, args in enumerate(
+            prefetch.prefetch_iter(len(ranges), prepare, depth)
+        ):
+            lo, hi = ranges[i]
+            s = (
+                _re_chunk_scores_dense(*args)
+                if dense else _re_chunk_scores_sparse(*args)
+            )
             scores[lo:hi] = np.asarray(s)
         return scores
 
@@ -1422,8 +1512,6 @@ class StreamedGameTrainer:
             # after a barrier) is the commit point — a crash mid-write
             # leaves stale shards that the resume's marker check rejects
             import json
-            import os
-            import tempfile
 
             pid = jax.process_index()
             payload = {
@@ -1440,13 +1528,9 @@ class StreamedGameTrainer:
                     "row_base": int(row_base),
                 }).encode(), dtype=np.uint8,
             )
-            os.makedirs(self.checkpoint_dir, exist_ok=True)
-            fd, tmp = tempfile.mkstemp(
-                dir=self.checkpoint_dir, suffix=".tmp"
-            )
-            with os.fdopen(fd, "wb") as f:
-                np.savez(f, **payload)  # file object: no .npz suffix games
-            os.replace(tmp, self._shard_path(pid))
+            # fsync-and-rename: the metadata commit point below must never
+            # be on disk while this shard's bytes are not
+            _atomic_savez(self.checkpoint_dir, self._shard_path(pid), payload)
             sync_processes("streamed-game-score-shards")
             if writer:
                 save_checkpoint(

@@ -389,19 +389,32 @@ class StreamingGLMObjective:
         return _to_batch(cur, self.num_features)
 
     def _stream(self, params, kernel: Callable, accumulate: Callable, init):
-        """Double-buffered host→device chunk pipeline: the NEXT chunk's
-        transfer is issued before the CURRENT chunk's compute result is
-        consumed, so DMA overlaps compute (async dispatch). ``params`` is
-        passed to ``kernel`` verbatim (an array or a tuple of arrays).
-        Tiled chunks stream only labels/offsets/weights (the packed
-        nonzero streams are device-resident)."""
+        """Host→device chunk pipeline. Default (``PHOTON_PREFETCH_DEPTH``
+        > 0): a bounded-depth background pipeline (``ops/prefetch``)
+        prepares chunk ``i+k`` — host staging + ``device_put`` through the
+        process-wide device-resident chunk cache, so optimizer passes 2..N
+        replay already-resident buffers — on worker threads while the
+        device computes chunk ``i``. Kernel calls and accumulation stay on
+        THIS thread in chunk order, so outputs are bitwise identical to
+        the synchronous schedule. Depth 0 restores the pre-prefetch
+        double-buffered path bit-for-bit: the NEXT chunk's transfer is
+        issued before the CURRENT chunk's compute result is consumed, so
+        DMA overlaps compute (async dispatch). ``params`` is passed to
+        ``kernel`` verbatim (an array or a tuple of arrays). Tiled chunks
+        stream only labels/offsets/weights (the packed nonzero streams are
+        device-resident)."""
         slim = (
             (lambda c: {k: c[k] for k in ("labels", "offsets", "weights")})
             if self._tile_layouts is not None
             else (lambda c: c)
         )
         acc = init
-        if self.chunks:
+        if not self.chunks:
+            return acc
+        from photon_ml_tpu.ops import prefetch
+
+        depth = prefetch.prefetch_depth()
+        if depth <= 0:
             nxt = jax.device_put(slim(self.chunks[0]))
             for i in range(len(self.chunks)):
                 cur = nxt
@@ -409,6 +422,16 @@ class StreamingGLMObjective:
                     nxt = jax.device_put(slim(self.chunks[i + 1]))
                 out = kernel(self._chunk_batch(cur, i), params)
                 acc = accumulate(acc, out)
+            return acc
+
+        def prepare(i):
+            return prefetch.cached_device_put(slim(self.chunks[i]))
+
+        for i, cur in enumerate(
+            prefetch.prefetch_iter(len(self.chunks), prepare, depth)
+        ):
+            out = kernel(self._chunk_batch(cur, i), params)
+            acc = accumulate(acc, out)
         return acc
 
     def _reg_delta(self, w: Array) -> Array:
@@ -538,13 +561,31 @@ class StreamingGLMObjective:
         if not self.chunks:
             return np.zeros(num_rows, np.float32)
         w = jnp.asarray(w)
+        from photon_ml_tpu.ops import prefetch
+
+        depth = prefetch.prefetch_depth()
         # the one module-level scoring program (shared with the module
         # scorer below): objectives are rebuilt per GAME fit / per sweep,
         # and a per-objective jit would re-compile scoring on every
         # rebuild instead of re-entering the process-wide cache
+        if depth <= 0:
+            outs = [
+                np.asarray(_score_matvec(self._chunk_batch(c, i), w))
+                for i, c in enumerate(self.chunks)
+            ]
+            return np.concatenate(outs)[:num_rows]
+
+        def prepare(i):
+            # stage through the device-resident chunk cache: per-visit
+            # GAME scoring re-transfers only the columns that changed
+            c = self.chunks[i]
+            if self._tile_layouts is not None:
+                c = {k: c[k] for k in ("labels", "offsets", "weights")}
+            return self._chunk_batch(prefetch.cached_device_put(c), i)
+
         outs = [
-            np.asarray(_score_matvec(self._chunk_batch(c, i), w))
-            for i, c in enumerate(self.chunks)
+            np.asarray(_score_matvec(b, w))
+            for b in prefetch.prefetch_iter(len(self.chunks), prepare, depth)
         ]
         return np.concatenate(outs)[:num_rows]
 
@@ -588,21 +629,32 @@ def _score_matvec(b, wi):
 # O(data) host sha256 just to look up an already-cached layout. Entries
 # hold references (that is what makes the data-pointer comparison safe —
 # a freed-and-reused address can never alias a live held array).
+# Lock-guarded: prefetch workers fingerprint different chunks concurrently.
+import threading as _threading
+
 _FP_MEMO: list = []
 _FP_MEMO_CAP = 16
+_FP_MEMO_LOCK = _threading.Lock()
 
 
 def _chunk_structure_fingerprint(indices, values) -> tuple:
     from photon_ml_tpu.ops import tile_cache
 
     same = StreamingGLMObjective._same_storage
-    for i, (pi, pv, fp) in enumerate(_FP_MEMO):
-        if same(indices, pi) and same(values, pv):
-            _FP_MEMO.append(_FP_MEMO.pop(i))
-            return fp
-    fp = tile_cache.structure_fingerprint(indices, values)
-    _FP_MEMO.append((indices, values, fp))
-    del _FP_MEMO[:-_FP_MEMO_CAP]
+    with _FP_MEMO_LOCK:
+        for i, (pi, pv, fp) in enumerate(_FP_MEMO):
+            if same(indices, pi) and same(values, pv):
+                _FP_MEMO.append(_FP_MEMO.pop(i))
+                return fp
+    fp = tile_cache.structure_fingerprint(indices, values)  # outside the lock
+    with _FP_MEMO_LOCK:
+        # racing misses for the same chunk both hash; only ONE may insert,
+        # or duplicates would consume memo capacity and evict live entries
+        for pi, pv, _pf in _FP_MEMO:
+            if same(indices, pi) and same(values, pv):
+                return fp
+        _FP_MEMO.append((indices, values, fp))
+        del _FP_MEMO[:-_FP_MEMO_CAP]
     return fp
 
 
@@ -632,8 +684,9 @@ def stream_scores(
         else auto_tile_streaming(sparse, num_features)
     )
     w = jnp.asarray(w)
-    outs = []
-    for c in chunks:
+
+    def prepare(i):
+        c = chunks[i]
         b = _to_batch(c, num_features)
         if want_tiling and sparse:
             from photon_ml_tpu.ops import tile_cache
@@ -648,5 +701,16 @@ def stream_scores(
                 b, keep_empty_chunks=True,
                 fingerprint=(shape, num_features, h_idx, h_val),
             )
-        outs.append(np.asarray(_score_matvec(b, w)))
+        return b
+
+    from photon_ml_tpu.ops import prefetch
+
+    # background prefetch prepares chunk i+k's batch (fingerprint memo +
+    # layout-cache lookup — the host-pack cost) while the device scores
+    # chunk i; depth 0 degenerates to the synchronous per-chunk loop.
+    # Scoring/readback stays on this thread in chunk order.
+    outs = [
+        np.asarray(_score_matvec(b, w))
+        for b in prefetch.prefetch_iter(len(chunks), prepare)
+    ]
     return np.concatenate(outs)[:num_rows]

@@ -26,11 +26,16 @@ construction. Executable reuse is the other half: ``_tiled_apply`` keys
 its jit cache on the same tuned constants, so any two cache entries with
 equal stream shapes re-enter one compiled kernel.
 
-Thread-safe; bounded by BOTH entry count (``capacity()``, LRU) and total
-packed-stream bytes (``byte_budget()``) — the entries pin device-resident
-streams, so an entry cap alone would let a handful of billion-nonzero
-layouts hold multiple GB of HBM for the process lifetime. ``clear()``
-drops everything (tests, or to release device memory eagerly).
+Thread-safe — the ``ops/prefetch`` pipeline's workers hit this cache
+CONCURRENTLY (per-chunk layout lookups race by design; hammer-tested in
+``tests/test_prefetch.py``): every LRU mutation, eviction and hit/miss
+bookkeeping happens under the one module lock, with only the expensive
+pack itself outside it. Bounded by BOTH entry count (``capacity()``, LRU)
+and total packed-stream bytes (``byte_budget()``, maintained as a running
+total so eviction never re-walks the table) — the entries pin
+device-resident streams, so an entry cap alone would let a handful of
+billion-nonzero layouts hold multiple GB of HBM for the process lifetime.
+``clear()`` drops everything (tests, or to release device memory eagerly).
 """
 
 from __future__ import annotations
@@ -51,6 +56,7 @@ _DEFAULT_BYTE_BUDGET = 2 * 1024**3
 _lock = threading.Lock()
 _entries: "OrderedDict[tuple, object]" = OrderedDict()
 _entry_bytes: dict = {}
+_total_bytes = 0
 _stats = {"hits": 0, "misses": 0}
 _capacity = _DEFAULT_CAPACITY
 _byte_budget = _DEFAULT_BYTE_BUDGET
@@ -104,7 +110,7 @@ def stats() -> dict:
         return dict(
             _stats,
             entries=len(_entries),
-            bytes=sum(_entry_bytes.values()),
+            bytes=_total_bytes,
         )
 
 
@@ -117,12 +123,12 @@ def byte_budget() -> int:
 
 
 def _evict_over_limits_locked() -> None:
+    global _total_bytes
     while _entries and (
-        len(_entries) > _capacity
-        or sum(_entry_bytes.values()) > _byte_budget
+        len(_entries) > _capacity or _total_bytes > _byte_budget
     ):
         key, _ = _entries.popitem(last=False)
-        _entry_bytes.pop(key, None)
+        _total_bytes -= _entry_bytes.pop(key, 0)
 
 
 def set_capacity(n: int) -> None:
@@ -140,9 +146,11 @@ def set_byte_budget(n: int) -> None:
 
 
 def clear() -> None:
+    global _total_bytes
     with _lock:
         _entries.clear()
         _entry_bytes.clear()
+        _total_bytes = 0
         _stats["hits"] = 0
         _stats["misses"] = 0
 
@@ -197,13 +205,18 @@ def tiled_layout_for(batch, keep_empty_chunks: bool = False,
     else:
         tb = st.tile_sparse_batch(batch)
     nbytes = _chunks_nbytes(tb.chunks)
+    global _total_bytes
     with _lock:
         _stats["misses"] += 1
         if nbytes <= _byte_budget:  # over-budget layouts are never pinned
+            prev = _entry_bytes.pop(key, None)
+            if prev is not None:  # concurrent miss already inserted this key
+                _total_bytes -= prev
             _entries[key] = (
                 tb.chunks, tb.num_rows_real, tb.n_pad_total, tb.d_pad_total
             )
             _entry_bytes[key] = nbytes
+            _total_bytes += nbytes
             _entries.move_to_end(key)
             _evict_over_limits_locked()
     return tb

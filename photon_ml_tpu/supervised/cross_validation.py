@@ -117,10 +117,31 @@ def cross_validate_glm(
     metric_values: dict[float, list[float]] = {
         float(lam): [] for lam in regularization_weights
     }
-    for held_out in folds:
-        train_rows = np.setdiff1d(perm, held_out, assume_unique=True)
+    from photon_ml_tpu.ops import prefetch
+
+    def ingest_fold(i):
+        # fold INGEST (row gather + layout decision + tile-COO pack through
+        # the process-wide cache) for fold i+k runs on prefetch workers
+        # while fold i's sweep trains; training and evaluation stay on this
+        # thread in fold order, so every metric and the refit are bitwise
+        # identical to the synchronous schedule (depth 0 restores it)
+        train_rows = np.setdiff1d(perm, folds[i], assume_unique=True)
+        return _ingest_training_batch(_row_select(batch, train_rows))
+
+    # depth capped at 1 for THIS consumer: unlike the streaming paths
+    # (whose items are bounded chunks), each prefetched item here is a
+    # near-full ingested training batch — the default depth would hold
+    # three of them live and triple peak memory. One fold ahead overlaps
+    # the whole ingest with the previous fold's sweep already.
+    for i, train_batch in enumerate(
+        prefetch.prefetch_iter(
+            len(folds), ingest_fold,
+            depth=min(prefetch.prefetch_depth(), 1),
+        )
+    ):
+        held_out = folds[i]
         result = train_glm(
-            _ingest_training_batch(_row_select(batch, train_rows)),
+            train_batch,
             task,
             optimizer_config=optimizer_config,
             regularization=regularization,

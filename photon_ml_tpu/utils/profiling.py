@@ -10,6 +10,9 @@ a trace so perf claims are backed by an inspectable timeline.
 from __future__ import annotations
 
 import contextlib
+import threading
+import time
+from collections import defaultdict
 from typing import Iterator
 
 
@@ -40,3 +43,56 @@ def annotate(name: str):
     import jax
 
     return jax.profiler.TraceAnnotation(name)
+
+
+# -- stage counters --------------------------------------------------------
+# Process-wide accumulating wall-second counters for host-side pipeline
+# stages (the prefetch pipeline's host-pack / device-put / consumer-wait
+# split). Device traces answer "what did the chip do"; these answer "where
+# did the HOST critical path go" cheaply enough to stay on in production
+# paths — an overlap claim is then observable from a snapshot, not
+# asserted. Thread-safe: prefetch workers accumulate concurrently.
+
+_counter_lock = threading.Lock()
+_counters: "defaultdict[str, float]" = defaultdict(float)
+_counter_calls: "defaultdict[str, int]" = defaultdict(int)
+
+
+@contextlib.contextmanager
+def stage_timer(name: str) -> Iterator[None]:
+    """Accumulate the enclosed block's wall seconds under ``name``."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        with _counter_lock:
+            _counters[name] += dt
+            _counter_calls[name] += 1
+
+
+def add_seconds(name: str, seconds: float) -> None:
+    with _counter_lock:
+        _counters[name] += float(seconds)
+        _counter_calls[name] += 1
+
+
+def counter_snapshot(prefix: str | None = None) -> dict:
+    """``{name: {"seconds", "calls"}}``, optionally filtered by prefix."""
+    with _counter_lock:
+        return {
+            k: {"seconds": _counters[k], "calls": _counter_calls[k]}
+            for k in _counters
+            if prefix is None or k.startswith(prefix)
+        }
+
+
+def reset_counters(prefix: str | None = None) -> None:
+    with _counter_lock:
+        keys = [
+            k for k in _counters
+            if prefix is None or k.startswith(prefix)
+        ]
+        for k in keys:
+            del _counters[k]
+            del _counter_calls[k]

@@ -91,7 +91,15 @@ class TestQuickMode:
                 "pipeline_segments": 1,
             },
         },
-        "F_streaming": {"samples_per_sec": 3.0, "quality_ok": True},
+        "F_streaming": {
+            "samples_per_sec": 3.0,
+            "quality_ok": True,
+            "hostpack_overlap_ratio": 1.4,
+            "prefetch": {
+                "prefetch_depth": 2,
+                "chunk_cache_budget_bytes": 6_000_000_000,
+            },
+        },
     }
 
     def _run_main(self, monkeypatch, capsys, results, quick=True):
@@ -132,6 +140,13 @@ class TestQuickMode:
         constants = payload["configs"]["A2_sparse_highdim"]["kernel_constants"]
         assert constants["pipeline_segments"] == 1
         assert constants["groups_per_run"] == 2
+        # the host-ingest pipeline knobs round-trip the same way: F's
+        # prefetch depth + chunk-cache budget (and the measured host-pack
+        # overlap ratio) appear verbatim in the single JSON line
+        f_cfg = payload["configs"]["F_streaming"]
+        assert f_cfg["prefetch"]["prefetch_depth"] == 2
+        assert f_cfg["prefetch"]["chunk_cache_budget_bytes"] == 6_000_000_000
+        assert f_cfg["hostpack_overlap_ratio"] == 1.4
         # quick writes NO artifacts (BENCH_DETAIL.json / BASELINE.md)
         assert not baseline_writes and not detail_writes
 
@@ -184,6 +199,21 @@ class TestQuickMode:
         assert st.GROUPS_PER_RUN == 4
         assert st.GROUPS_PER_STEP == 16
         assert st.PIPELINE_SEGMENTS == 0
+
+    def test_retune_env_reaches_prefetch_knobs(self, monkeypatch):
+        import photon_ml_tpu.ops.prefetch as pf
+
+        monkeypatch.setattr(pf, "PREFETCH_DEPTH", 2)
+        monkeypatch.setattr(pf, "CHUNK_CACHE_BUDGET", None)
+        monkeypatch.setenv("PHOTON_PREFETCH_DEPTH", "0")
+        monkeypatch.setenv("PHOTON_CHUNK_CACHE_BUDGET", "123456")
+        bench._apply_retune_env()
+        assert pf.PREFETCH_DEPTH == 0
+        assert pf.CHUNK_CACHE_BUDGET == 123456
+        # the call-time accessors agree (env wins, so child processes
+        # track even without _apply_retune_env)
+        assert pf.prefetch_depth() == 0
+        assert pf.chunk_cache_budget_bytes() == 123456
 
 
 class TestNarrativeNumberDiscipline:

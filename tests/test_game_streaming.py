@@ -856,3 +856,48 @@ def test_game_visit_scoring_pipelined_bit_identical(rng, monkeypatch):
                         tile_sparse=False)
     np.testing.assert_allclose(outs[1], ref, rtol=2e-3, atol=2e-3)
     tile_cache.clear()
+
+
+def test_atomic_savez_fsyncs_before_and_after_rename(tmp_path, monkeypatch):
+    """Per-visit score shards must be DURABLY committed: data fsync'd
+    before the atomic rename (a kill between rename and writeback could
+    otherwise leave a truncated shard under the final name for
+    `_load_resume_state` to half-parse) and the directory fsync'd after,
+    so the shard is on disk before the metadata commit point. A failed
+    write leaves neither the final file nor a temp turd."""
+    import os
+
+    from photon_ml_tpu.game.streaming import _atomic_savez
+
+    events = []
+    real_fsync, real_replace = os.fsync, os.replace
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (events.append("fsync"), real_fsync(fd))[1]
+    )
+    monkeypatch.setattr(
+        os, "replace",
+        lambda a, b: (events.append("replace"), real_replace(a, b))[1],
+    )
+    d = str(tmp_path / "ck")
+    final = os.path.join(d, "scores-shard-00000.npz")
+    _atomic_savez(d, final, {"total": np.arange(5, dtype=np.float32)})
+    # file fsync BEFORE the rename, directory fsync AFTER it
+    assert events == ["fsync", "replace", "fsync"]
+    with np.load(final) as z:
+        np.testing.assert_array_equal(
+            z["total"], np.arange(5, dtype=np.float32)
+        )
+
+    # failure mid-write: no final file, no leftover temp file
+    class Boom(RuntimeError):
+        pass
+
+    def bad_savez(f, **kw):
+        raise Boom()
+
+    monkeypatch.setattr(np, "savez", bad_savez)
+    final2 = os.path.join(d, "scores-shard-00001.npz")
+    with pytest.raises(Boom):
+        _atomic_savez(d, final2, {"total": np.arange(5, dtype=np.float32)})
+    assert not os.path.exists(final2)
+    assert [p for p in os.listdir(d) if p.endswith(".tmp")] == []

@@ -866,6 +866,12 @@ class TestTiledStreamedChunks:
         score_cache_sizes = {}
         from photon_ml_tpu.ops.streaming import _score_matvec_keyed
 
+        # start from an empty scoring-program cache: an EARLIER kernel test
+        # over the same rng-fixture shapes (the GAME visit-scoring parity
+        # test) may already have compiled both schedules, which would make
+        # the cache-growth assertion below vacuously fail (seed state: it
+        # compared 11 > 11) — the assertion must be self-contained
+        _score_matvec_keyed._clear_cache()
         for flag in (1, 0):
             monkeypatch.setattr(st_mod, "PIPELINE_SEGMENTS", flag)
             obj = StreamingGLMObjective(
