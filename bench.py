@@ -1444,6 +1444,24 @@ def _apply_retune_env() -> None:
         _log(f"[bench] retuned prefetch knobs from env: {pending_pf}")
 
 
+def _telemetry_block() -> dict:
+    """The run's telemetry snapshot for the JSON contract: the full
+    metrics-registry snapshot (counters / gauges / histograms / timers —
+    the same dict a ``--telemetry-dir`` run embeds in its ``run_end``
+    record; the legacy stage counters ARE ``metrics["timers"]``, one
+    source of truth) and the knob values the process executed under. One
+    coherent block per config subprocess, so a sweep can diff cache
+    traffic, compile wall and stage seconds from stdout alone."""
+    from photon_ml_tpu.obs.metrics import REGISTRY
+    from photon_ml_tpu.obs.sink import SCHEMA_VERSION, _knob_snapshot
+
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "metrics": REGISTRY.snapshot(),
+        "knobs": _knob_snapshot(),
+    }
+
+
 def _run_one(name: str, quick: bool = False) -> None:
     """Child mode: run one config, print its result JSON on stdout."""
     global QUICK, REPEATS
@@ -1451,10 +1469,17 @@ def _run_one(name: str, quick: bool = False) -> None:
         QUICK = True
         REPEATS = 1
     _apply_retune_env()
+    # installs the jax.monitoring compile listener BEFORE the config's
+    # first compile — configs that never touch an obs-importing module
+    # (pure-ops configs like A) would otherwise report no jax.compile_s
+    import photon_ml_tpu.obs  # noqa: F401
+
     import jax
     import jax.numpy as jnp
 
-    print(json.dumps(CONFIGS[name](jax, jnp)))
+    result = CONFIGS[name](jax, jnp)
+    result["telemetry"] = _telemetry_block()
+    print(json.dumps(result))
 
 
 def _run_config_subprocess(name: str, quick: bool = False) -> dict:

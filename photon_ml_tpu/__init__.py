@@ -26,6 +26,7 @@ Layer map (mirrors SURVEY.md §1, rebuilt TPU-first):
 - ``game``     — coordinates, coordinate descent, scores               (L3)
 - ``evaluation`` — distributed evaluators incl. per-entity multi-evals (L3)
 - ``estimators`` / ``transformers`` — fit/transform API                (L4)
+- ``obs``      — run telemetry: spans, metrics registry, JSONL, report (L6)
 - ``cli``      — training/scoring drivers                              (L6)
 """
 

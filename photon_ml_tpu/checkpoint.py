@@ -140,11 +140,12 @@ def save_checkpoint(
     # mixed-generation checkpoint if preempted between the two renames.
     arrays[_META_KEY] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
 
-    # np.savez appends ".npz" to names lacking the suffix, so the tmp name
-    # must already end in it for os.replace to find the written file
-    tmp_npz = os.path.join(directory, ".ckpt.tmp.npz")
-    np.savez(tmp_npz, **arrays)
-    os.replace(tmp_npz, os.path.join(directory, "ckpt.npz"))
+    # durable commit (fsync → rename → dir fsync): os.replace alone is
+    # atomic only in the namespace; a preemption between rename and
+    # writeback could otherwise leave a truncated npz under the final name
+    from photon_ml_tpu.utils.atomic_io import atomic_savez
+
+    atomic_savez(directory, os.path.join(directory, "ckpt.npz"), arrays)
     # human-readable sidecar, informational only — never read back
     with open(os.path.join(directory, "ckpt.json"), "w") as f:
         json.dump(meta, f)

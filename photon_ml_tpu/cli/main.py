@@ -1,0 +1,44 @@
+"""``photon-ml-tpu`` umbrella entry point (console script).
+
+Subcommand dispatch over the existing drivers — each stays runnable as
+``python -m photon_ml_tpu.cli.<driver>`` too; this wrapper only maps
+``photon-ml-tpu <subcommand> ...`` onto the same ``main(argv)`` hooks.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def _commands() -> dict:
+    # lazy imports: the console script must not pay (or fail on) a jax
+    # backend init just to print usage
+    return {
+        "train": "photon_ml_tpu.cli.train",
+        "score": "photon_ml_tpu.cli.score",
+        "train-glm": "photon_ml_tpu.cli.train_glm",
+        "index-features": "photon_ml_tpu.cli.index_features",
+        "name-term-bags": "photon_ml_tpu.cli.name_term_bags",
+        "report": "photon_ml_tpu.cli.report",
+    }
+
+
+def main(argv: list[str] | None = None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    commands = _commands()
+    if not argv or argv[0] in ("-h", "--help"):
+        names = "  ".join(sorted(commands))
+        print(f"usage: photon-ml-tpu <command> [args...]\ncommands: {names}")
+        raise SystemExit(0 if argv else 2)
+    cmd = argv[0]
+    if cmd not in commands:
+        raise SystemExit(
+            f"unknown command {cmd!r}; one of: {', '.join(sorted(commands))}"
+        )
+    import importlib
+
+    importlib.import_module(commands[cmd]).main(argv[1:])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Telemetry run report driver (``photon-ml-tpu report``).
+
+Renders a run's telemetry JSONL (written by any driver's
+``--telemetry-dir``) into a per-phase wall/compile/transfer summary
+table, diffs two runs (the sweep-readout format), and exports the span
+timeline as Chrome-trace/Perfetto JSON so it opens next to the
+``jax.profiler`` device traces.
+
+Usage:
+    photon-ml-tpu report RUN.jsonl
+    photon-ml-tpu report RUN.jsonl --diff OTHER.jsonl
+    photon-ml-tpu report TELEMETRY_DIR            # newest run in the dir
+    photon-ml-tpu report RUN.jsonl --export-trace trace.json
+    photon-ml-tpu report RUN.jsonl --json         # machine-readable summary
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def _resolve(path: str) -> str:
+    """A run file, or the newest run inside a telemetry directory."""
+    if os.path.isdir(path):
+        from photon_ml_tpu.obs.report import latest_run
+
+        run = latest_run(path)
+        if run is None:
+            raise SystemExit(f"no run-*.jsonl files in {path}")
+        return run
+    return path
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(
+        prog="photon-ml-tpu report",
+        description="summarize / diff / export telemetry runs",
+    )
+    p.add_argument("run", help="run JSONL file, or a --telemetry-dir "
+                               "(newest run is picked)")
+    p.add_argument("--diff", default=None, metavar="OTHER",
+                   help="second run (or telemetry dir) to diff against")
+    p.add_argument("--export-trace", default=None, metavar="OUT_JSON",
+                   help="also write the span timeline as Chrome-trace/"
+                        "Perfetto JSON")
+    p.add_argument("--json", action="store_true",
+                   help="print the machine-readable summary dict instead "
+                        "of the table")
+    args = p.parse_args(argv)
+
+    from photon_ml_tpu.obs.report import (
+        diff_summaries,
+        format_summary,
+        summarize_run,
+    )
+
+    run = _resolve(args.run)
+    summary = summarize_run(run)
+    if args.export_trace:
+        from photon_ml_tpu.obs.export import export_chrome_trace
+
+        export_chrome_trace(run, args.export_trace)
+    if args.diff:
+        other = summarize_run(_resolve(args.diff))
+        if args.json:
+            print(json.dumps({"a": summary, "b": other}))
+        else:
+            print(diff_summaries(summary, other))
+        return
+    print(json.dumps(summary) if args.json else format_summary(summary))
+
+
+if __name__ == "__main__":
+    main()

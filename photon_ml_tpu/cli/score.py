@@ -153,9 +153,13 @@ def run(
                 entity_maps={t: entity_maps[t] for t in id_tags} if entity_maps else None,
             )
 
+    from photon_ml_tpu.obs import span
+
     transformer = GameTransformer(model, logger=logger)
     metrics = None
-    with timed(logger, "score"), profile_trace(profile_dir, "score"):
+    with timed(logger, "score"), profile_trace(profile_dir, "score"), span(
+        "score/pass"
+    ):
         if evaluators and not multihost:
             scores, results = transformer.transform_with_evaluation(
                 ds.batch, evaluators
@@ -323,6 +327,11 @@ def main(argv: list[str] | None = None) -> None:
         help="capture a jax.profiler device trace of the scoring pass",
     )
     p.add_argument(
+        "--telemetry-dir", default=None,
+        help="write the run's telemetry JSONL into this directory; "
+             "render/diff with `photon-ml-tpu report`",
+    )
+    p.add_argument(
         "--multihost", action="store_true",
         help="join the jax.distributed runtime; each host scores its slice "
              "of the input part files and writes its own output partition "
@@ -336,15 +345,21 @@ def main(argv: list[str] | None = None) -> None:
     shards = None
     if args.config:
         shards = dict(load_training_config(args.config).feature_shards)
-    run(
-        args.model_dir,
-        args.data,
-        args.output_dir,
-        evaluators=args.evaluators,
-        feature_shards=shards,
-        profile_dir=args.profile_dir,
-        multihost=args.multihost,
-    )
+    from photon_ml_tpu import obs
+
+    obs.configure(args.telemetry_dir)
+    try:
+        run(
+            args.model_dir,
+            args.data,
+            args.output_dir,
+            evaluators=args.evaluators,
+            feature_shards=shards,
+            profile_dir=args.profile_dir,
+            multihost=args.multihost,
+        )
+    finally:
+        obs.shutdown()
 
 
 if __name__ == "__main__":

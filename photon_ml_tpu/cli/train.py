@@ -25,6 +25,7 @@ from photon_ml_tpu.estimators import GameEstimator, GameResult
 from photon_ml_tpu.game.models import GameModel
 from photon_ml_tpu.io.data_reader import AvroDataReader, GameDataset
 from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+from photon_ml_tpu.obs import span
 from photon_ml_tpu.types import ModelOutputMode
 from photon_ml_tpu.utils import PhotonLogger, profile_trace, timed
 
@@ -79,7 +80,7 @@ def run(
     warm_tag_maps = (
         _load_entity_maps(config.model_input_dir) if config.model_input_dir else None
     )
-    with timed(logger, "read training data"):
+    with timed(logger, "read training data"), span("ingest/train-data"):
         train = reader.read(
             train_data,
             id_tags=id_tags,
@@ -94,7 +95,9 @@ def run(
 
     val: GameDataset | None = None
     if validation_data:
-        with timed(logger, "read validation data"):
+        with timed(logger, "read validation data"), span(
+            "ingest/validation-data"
+        ):
             val = reader.read(
                 validation_data,
                 id_tags=id_tags,
@@ -129,7 +132,7 @@ def run(
     )
     with timed(logger, "estimator grid fit"), profile_trace(
         profile_dir, "grid-fit"
-    ):
+    ), span("train/grid-fit"):
         results = estimator.fit(
             train.batch,
             None if val is None else val.batch,
@@ -357,7 +360,9 @@ def _run_streamed_game(
     warm_tag_maps = (
         _load_entity_maps(config.model_input_dir) if config.model_input_dir else None
     )
-    with timed(logger, "streaming stats pass (all files)"):
+    with timed(logger, "streaming stats pass (all files)"), span(
+        "ingest/stats-pass", files=len(train_paths)
+    ):
         index_maps, max_nnz, entity_maps, n_global = (
             reader.streaming_game_stats(
                 train_paths, id_tags, entity_maps=warm_tag_maps
@@ -373,7 +378,9 @@ def _run_streamed_game(
         local_paths = host_shard_of_paths(train_paths)
         logger.info(f"this host fills {len(local_paths)}/{len(train_paths)} files")
 
-    with timed(logger, "fill pass (this host's files)"):
+    with timed(logger, "fill pass (this host's files)"), span(
+        "ingest/fill-pass", files=len(local_paths)
+    ):
         # allow_empty under multihost: with fewer part files than
         # processes a host's slice is empty, but it MUST still build a
         # 0-row dataset and join every collective in the trainer —
@@ -387,7 +394,9 @@ def _run_streamed_game(
     if validation_data:
         val_paths = _expand_part_files(validation_data)
         local_val = host_shard_of_paths(val_paths) if multihost else val_paths
-        with timed(logger, "fill validation (this host's files)"):
+        with timed(logger, "fill validation (this host's files)"), span(
+            "ingest/fill-validation", files=len(local_val)
+        ):
             vdata = reader.read_streamed_game(
                 local_val, id_tags, index_maps, entity_maps,
                 max_nnz=max_nnz, unseen_entity_ok=True,
@@ -491,9 +500,16 @@ def _run_streamed_game(
             evaluators=specs if vdata is not None else (),
             num_entities=num_entities,
         )
-        m, inf = trainer.fit(
-            data, validation=vdata, initial_model=initial_model
-        )
+        with span(
+            "train/grid-entry", tag=tag,
+            weights={
+                cid: float(o.regularization_weight)
+                for cid, o in configuration.items()
+            },
+        ):
+            m, inf = trainer.fit(
+                data, validation=vdata, initial_model=initial_model
+            )
         primary = None
         if trainer.validation_history:
             (_, last_res), = trainer.validation_history[-1].items()
@@ -516,7 +532,7 @@ def _run_streamed_game(
 
     with timed(logger, "streamed coordinate descent"), profile_trace(
         profile_dir, "streamed-game"
-    ):
+    ), span("train/streamed-descent", grid_entries=len(grid)):
         for i, configuration in enumerate(grid):
             fit_entry(configuration, f"grid-{i:04d}")
         if config.hyperparameter_tuning_iters > 0:
@@ -746,6 +762,12 @@ def main(argv: list[str] | None = None) -> None:
              "into this directory (TensorBoard/Perfetto-loadable)",
     )
     p.add_argument(
+        "--telemetry-dir", default=None,
+        help="write the run's telemetry JSONL (spans, per-iteration "
+             "optimizer records, metrics snapshot) into this directory; "
+             "render/diff with `photon-ml-tpu report`",
+    )
+    p.add_argument(
         "--diagnostics", action="store_true",
         help="write diagnostics.json + a self-contained diagnostics.html "
              "(per-coordinate optimizer traces, metrics, top features)",
@@ -810,19 +832,27 @@ def main(argv: list[str] | None = None) -> None:
         from photon_ml_tpu.parallel import data_mesh
 
         mesh = data_mesh()
-    run(
-        config,
-        train_data,
-        args.output_dir,
-        validation_data=validation_data,
-        index_map_dir=args.index_maps,
-        logger=logger,
-        mesh=mesh,
-        profile_dir=args.profile_dir,
-        diagnostics=args.diagnostics,
-        streaming_chunk_rows=args.streaming_chunk_rows,
-        multihost=args.multihost,
-    )
+    # telemetry AFTER multihost init: only the output process writes (the
+    # sink checks process_index), and `report` renders/diffs the JSONL
+    from photon_ml_tpu import obs
+
+    obs.configure(args.telemetry_dir)
+    try:
+        run(
+            config,
+            train_data,
+            args.output_dir,
+            validation_data=validation_data,
+            index_map_dir=args.index_maps,
+            logger=logger,
+            mesh=mesh,
+            profile_dir=args.profile_dir,
+            diagnostics=args.diagnostics,
+            streaming_chunk_rows=args.streaming_chunk_rows,
+            multihost=args.multihost,
+        )
+    finally:
+        obs.shutdown()
 
 
 if __name__ == "__main__":

@@ -533,6 +533,12 @@ def main(argv: list[str] | None = None) -> None:
         help="capture jax.profiler device traces of the training sweep",
     )
     p.add_argument(
+        "--telemetry-dir", default=None,
+        help="write the run's telemetry JSONL (spans, per-iteration "
+             "optimizer records, metrics snapshot) into this directory; "
+             "render/diff with `photon-ml-tpu report`",
+    )
+    p.add_argument(
         "--diagnostics", action="store_true",
         help="write diagnostics.json + a self-contained diagnostics.html "
              "(optimizer traces, validation metrics, top features)",
@@ -549,27 +555,33 @@ def main(argv: list[str] | None = None) -> None:
         from photon_ml_tpu.parallel.multihost import initialize_multihost
 
         initialize_multihost()
-    run(
-        TaskType(args.task),
-        args.train_data,
-        args.output_dir,
-        data_format=args.format,
-        validation_data=args.validation_data,
-        regularization=RegularizationType(args.regularization),
-        weights=args.weights,
-        optimizer=OptimizerType(args.optimizer),
-        max_iterations=args.max_iterations,
-        tolerance=args.tolerance,
-        normalization=NormalizationType(args.normalization),
-        summarize_features=args.summarize_features,
-        variance_computation=VarianceComputationType(args.variance),
-        validate=DataValidationType(args.validate),
-        prior_model_path=args.prior_model,
-        diagnostics=args.diagnostics,
-        streaming_chunk_rows=args.streaming_chunk_rows,
-        multihost=args.multihost,
-        profile_dir=args.profile_dir,
-    )
+    from photon_ml_tpu import obs
+
+    obs.configure(args.telemetry_dir)
+    try:
+        run(
+            TaskType(args.task),
+            args.train_data,
+            args.output_dir,
+            data_format=args.format,
+            validation_data=args.validation_data,
+            regularization=RegularizationType(args.regularization),
+            weights=args.weights,
+            optimizer=OptimizerType(args.optimizer),
+            max_iterations=args.max_iterations,
+            tolerance=args.tolerance,
+            normalization=NormalizationType(args.normalization),
+            summarize_features=args.summarize_features,
+            variance_computation=VarianceComputationType(args.variance),
+            validate=DataValidationType(args.validate),
+            prior_model_path=args.prior_model,
+            diagnostics=args.diagnostics,
+            streaming_chunk_rows=args.streaming_chunk_rows,
+            multihost=args.multihost,
+            profile_dir=args.profile_dir,
+        )
+    finally:
+        obs.shutdown()
 
 
 if __name__ == "__main__":

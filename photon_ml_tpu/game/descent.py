@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.evaluation import EvaluationResults, evaluate_all
+from photon_ml_tpu.obs import emit_event, span
 from photon_ml_tpu.game.coordinate import Coordinate
 from photon_ml_tpu.game.data import GameBatch
 from photon_ml_tpu.game.models import GameModel
@@ -290,18 +291,20 @@ class CoordinateDescent:
 
         def end_of_iteration(it: int, iter_validation) -> None:
             validation_history.append(iter_validation)
+            emit_event("descent_iteration", iteration=it)
             if checkpoint_dir is not None and _is_output_process():
                 from photon_ml_tpu.checkpoint import save_checkpoint
 
-                save_checkpoint(
-                    checkpoint_dir,
-                    model,
-                    next_iteration=it + 1,
-                    fingerprint=checkpoint_fingerprint,
-                    scores={cid: np.asarray(s) for cid, s in scores.items()},
-                    total=np.asarray(total),
-                    data_digest=digest,
-                )
+                with span("descent/checkpoint", iteration=it):
+                    save_checkpoint(
+                        checkpoint_dir,
+                        model,
+                        next_iteration=it + 1,
+                        fingerprint=checkpoint_fingerprint,
+                        scores={cid: np.asarray(s) for cid, s in scores.items()},
+                        total=np.asarray(total),
+                        data_digest=digest,
+                    )
 
         if fused_outer is not None:
             # iteration chunking: run outer iterations in power-of-two
@@ -316,9 +319,15 @@ class CoordinateDescent:
             it = start_iteration
             while it < num_iterations:
                 r = min(_pow2_floor(num_iterations - it), cap)
-                model, total, scores, trackers_per_iter = fused_outer(
-                    model, total, scores, r
-                )
+                # one span per fused LAUNCH: the per-iteration boundaries
+                # do not exist on the host inside a scanned chunk — the
+                # logical iterations are emitted as events below instead
+                with span(
+                    "descent/fused-outer", first_iteration=it, iterations=r
+                ):
+                    model, total, scores, trackers_per_iter = fused_outer(
+                        model, total, scores, r
+                    )
                 for j in range(r):
                     for cid in update_sequence:
                         append_tracker(cid, trackers_per_iter[j][cid])
@@ -334,42 +343,50 @@ class CoordinateDescent:
 
         for it in range(start_iteration, num_iterations):
             iter_validation: dict[str, EvaluationResults] = {}
-            for cid in update_sequence:
-                coord = self.coordinates[cid]
-                visit = getattr(coord, "visit", None)
-                if visit is not None:
-                    # fused path: offsets → solve → score → total in ONE
-                    # program launch (the coordinate falls back internally
-                    # when its config needs host-side staging per visit)
-                    sub_model, tracker, new_score, total = visit(
-                        total, scores.get(cid), model.models.get(cid)
-                    )
-                else:
-                    offsets = total - scores[cid] if cid in scores else total
-                    sub_model, tracker = coord.train(
-                        offsets, model.models.get(cid)
-                    )
-                    new_score = coord.score(sub_model)
-                    total = offsets + new_score
-                scores[cid] = new_score
-                model = model.updated(cid, sub_model)
-                append_tracker(cid, tracker)
+            with span("descent/iter", iteration=it):
+                for cid in update_sequence:
+                    coord = self.coordinates[cid]
+                    with span("descent/visit", iteration=it, coordinate=cid):
+                        visit = getattr(coord, "visit", None)
+                        if visit is not None:
+                            # fused path: offsets → solve → score → total
+                            # in ONE program launch (the coordinate falls
+                            # back internally when its config needs
+                            # host-side staging per visit)
+                            sub_model, tracker, new_score, total = visit(
+                                total, scores.get(cid), model.models.get(cid)
+                            )
+                        else:
+                            offsets = (
+                                total - scores[cid] if cid in scores else total
+                            )
+                            sub_model, tracker = coord.train(
+                                offsets, model.models.get(cid)
+                            )
+                            new_score = coord.score(sub_model)
+                            total = offsets + new_score
+                        scores[cid] = new_score
+                        model = model.updated(cid, sub_model)
+                        append_tracker(cid, tracker)
 
-                if self.validation_batch is not None and self.evaluators:
-                    vscores = model.score(self.validation_batch)
-                    res = evaluate_all(
-                        self.evaluators,
-                        vscores,
-                        self.validation_batch.labels,
-                        self.validation_batch.weights,
-                        group_ids=self.validation_batch.host_id_tags(),
-                        mesh=self.mesh,
-                    )
-                    iter_validation[cid] = res
-                    self._log(f"iter {it} coordinate {cid}: {res}")
-                else:
-                    self._log(f"iter {it} coordinate {cid}: trained")
-            end_of_iteration(it, iter_validation)
+                    if self.validation_batch is not None and self.evaluators:
+                        with span(
+                            "descent/validation", iteration=it, coordinate=cid
+                        ):
+                            vscores = model.score(self.validation_batch)
+                            res = evaluate_all(
+                                self.evaluators,
+                                vscores,
+                                self.validation_batch.labels,
+                                self.validation_batch.weights,
+                                group_ids=self.validation_batch.host_id_tags(),
+                                mesh=self.mesh,
+                            )
+                        iter_validation[cid] = res
+                        self._log(f"iter {it} coordinate {cid}: {res}")
+                    else:
+                        self._log(f"iter {it} coordinate {cid}: trained")
+                end_of_iteration(it, iter_validation)
 
         return CoordinateDescentResult(
             model=model,

@@ -85,6 +85,7 @@ from photon_ml_tpu.game.data import (
 from photon_ml_tpu.game.models import FixedEffectModel, GameModel, RandomEffectModel
 from photon_ml_tpu.game.random_effect import _solve_bucket
 from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.obs import REGISTRY, emit_event, span
 from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.ops.streaming import (
     StreamingGLMObjective,
@@ -152,45 +153,11 @@ def seq_scores_init(cfg: GameTrainingConfig, model: GameModel) -> list[str]:
     ]
 
 
-def _atomic_savez(directory: str, final_path: str, payload: dict) -> None:
-    """Durably write an ``.npz`` payload: temp file in the SAME directory,
-    fsync BEFORE the atomic rename (``os.replace`` is atomic in the
-    namespace but says nothing about data blocks — a kill between rename
-    and writeback could commit a TRUNCATED file under the final name,
-    which a later ``np.load`` would half-parse instead of reject), then
-    fsync the directory so the rename itself is durable. On any failure
-    the temp file is removed and the final path is untouched."""
-    import os
-    import tempfile
-
-    os.makedirs(directory, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, **payload)  # file object: no .npz suffix games
-            f.flush()
-            os.fsync(f.fileno())
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
-    try:
-        os.replace(tmp, final_path)
-    except BaseException:
-        # a failed rename (final path is a directory, permissions, stale
-        # NFS handle) must not leave a .tmp turd either
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
-    dfd = os.open(directory, os.O_RDONLY)
-    try:
-        os.fsync(dfd)
-    finally:
-        os.close(dfd)
+# Durable npz commit (fsync → atomic rename → dir fsync), now the shared
+# utils helper — the telemetry JSONL sink's rotation and the descent
+# checkpoint reuse the same idiom. The local name stays: it is this
+# module's documented seam (tests patch around it).
+from photon_ml_tpu.utils.atomic_io import atomic_savez as _atomic_savez
 
 
 def _host_digest(labels: np.ndarray, weights: np.ndarray) -> str:
@@ -1182,6 +1149,13 @@ class StreamedGameTrainer:
             dropped, total = int(counts[0]), int(counts[1])
             frac = dropped / total if total else 0.0
             fracs[tag] = frac
+            # registry + structured record, so a run's JSONL carries the
+            # dropped-row accounting the stderr line used to hold alone
+            REGISTRY.gauge_set(f"game.grouped_dropped_frac.{tag}", frac)
+            emit_event(
+                "dropped_rows", tag=tag, dropped=dropped, total=total,
+                fraction=frac,
+            )
             self._log(
                 f"grouped metrics on tag {tag!r}: {dropped}/{total} "
                 f"validation rows ({frac:.1%}) carry the -1 unseen-entity "
@@ -1190,6 +1164,13 @@ class StreamedGameTrainer:
             if frac >= self.GROUPED_DROPPED_WARN_FRACTION:
                 import warnings
 
+                emit_event(
+                    "log", level="WARN", tag=tag, fraction=frac,
+                    message=(
+                        f"grouped metrics on tag {tag!r} drop {frac:.1%} of "
+                        "validation rows (unseen-entity sentinel -1)"
+                    ),
+                )
                 warnings.warn(
                     f"grouped metrics on tag {tag!r} drop {frac:.1%} of "
                     f"validation rows (unseen-entity sentinel -1): the "
@@ -1817,6 +1798,20 @@ class StreamedGameTrainer:
         semantics. Entity rows must already be aligned to this dataset's
         dense entity ids (the driver re-uses the saved run's entity maps
         and pads new entities with zero rows)."""
+        with span(
+            "game/fit",
+            rows=int(data.num_rows),
+            chunk_rows=int(self.chunk_rows),
+            coordinates=list(self.config.coordinate_update_sequence),
+        ):
+            return self._fit_inner(data, validation, initial_model)
+
+    def _fit_inner(
+        self,
+        data: StreamedGameData,
+        validation: StreamedGameData | None,
+        initial_model: GameModel | None,
+    ) -> tuple[GameModel, dict[str, StreamedCoordinateInfo]]:
         cfg = self.config
         n = data.num_rows
         # entity-count floors for THIS fit: caller-declared dictionary sizes,
@@ -1848,9 +1843,10 @@ class StreamedGameTrainer:
         # entity layouts + the multi-host owner exchange, once (the shuffle)
         re_shards: dict[str, _ReShard] = {}
         for cid in cfg.random_effect_coordinates:
-            re_shards[cid] = self._build_re_shard(
-                cid, data, row_base, row_layout
-            )
+            with span("ingest/re-shard", coordinate=cid):
+                re_shards[cid] = self._build_re_shard(
+                    cid, data, row_base, row_layout
+                )
 
         # model state on HOST: fixed vectors + OWNED random-effect rows
         pid, P = _num_processes()
@@ -2111,96 +2107,124 @@ class StreamedGameTrainer:
 
         for it in range(start_it, cfg.coordinate_descent_iterations):
             ci0 = start_ci if it == start_it else 0
-            for ci in range(ci0, len(seq)):
-                cid = seq[ci]
-                offs = total - scores[cid]
-                if cid in cfg.fixed_effect_coordinates:
-                    c = cfg.fixed_effect_coordinates[cid]
-                    feats = data.feature_container(c.feature_shard_id)
-                    w, new_scores, res, var = self._train_fixed(
-                        cid, feats, data, offs, c.optimization, fixed_w[cid],
-                        self.intercept_indices.get(c.feature_shard_id),
-                        norm=self._norm_contexts.get(c.feature_shard_id),
-                        compute_var=(
-                            it == cfg.coordinate_descent_iterations - 1
-                        ),
-                        prior=prior_fixed.get(cid),
-                    )
-                    fixed_w[cid] = w
-                    if var is not None:
-                        fixed_var[cid] = var
-                    info[cid] = StreamedCoordinateInfo(
-                        final_loss=float(res.value),
-                        iterations=int(res.iterations),
-                        converged=bool(res.converged),
-                    )
-                else:
-                    c = cfg.random_effect_coordinates[cid]
-                    shard = re_shards[cid]
-                    offs_re = self._offsets_to_owners(shard, offs, row_base)
-                    loss_sum, max_it, conv = self._solve_re_buckets(
-                        shard, offs_re, c.optimization, re_W[cid],
-                        None if cid in self._projectors
-                        else self.intercept_indices.get(c.feature_shard_id),
-                        norm=self._norm_contexts.get(c.feature_shard_id),
-                        V=re_V[cid],
-                        W_prior=re_W_prior.get(cid),
-                        V_prior=re_V_prior.get(cid),
-                    )
-                    if self._distributed():
-                        # per-owner partial diagnostics → global (sum the
-                        # losses, max the iteration counts, AND the flags)
-                        from jax.experimental import multihost_utils
-
-                        agg = np.asarray(
-                            multihost_utils.process_allgather(
-                                np.asarray(
-                                    [loss_sum, float(max_it), 0.0 if conv else 1.0]
-                                )
+            with span("descent/iter", iteration=it):
+                for ci in range(ci0, len(seq)):
+                    cid = seq[ci]
+                    with span("descent/visit", iteration=it, coordinate=cid):
+                        offs = total - scores[cid]
+                        if cid in cfg.fixed_effect_coordinates:
+                            c = cfg.fixed_effect_coordinates[cid]
+                            feats = data.feature_container(c.feature_shard_id)
+                            w, new_scores, res, var = self._train_fixed(
+                                cid, feats, data, offs, c.optimization,
+                                fixed_w[cid],
+                                self.intercept_indices.get(c.feature_shard_id),
+                                norm=self._norm_contexts.get(
+                                    c.feature_shard_id
+                                ),
+                                compute_var=(
+                                    it == cfg.coordinate_descent_iterations - 1
+                                ),
+                                prior=prior_fixed.get(cid),
                             )
-                        ).reshape(-1, 3)
-                        loss_sum = float(agg[:, 0].sum())
-                        max_it = int(agg[:, 1].max())
-                        conv = bool((agg[:, 2] == 0).all())
-                    s_re = self._score_re_rows(shard, re_W[cid])
-                    new_scores = self._scores_to_origin(
-                        shard, s_re, n, row_base
-                    )
-                    info[cid] = StreamedCoordinateInfo(
-                        final_loss=loss_sum, iterations=max_it, converged=conv
-                    )
-                total = offs + new_scores
-                scores[cid] = new_scores
-                self._log(
-                    f"iter {it} coordinate {cid}: "
-                    f"loss={info[cid].final_loss:.6g} "
-                    f"iterations={info[cid].iterations} "
-                    f"converged={info[cid].converged}"
-                )
+                            fixed_w[cid] = w
+                            if var is not None:
+                                fixed_var[cid] = var
+                            info[cid] = StreamedCoordinateInfo(
+                                final_loss=float(res.value),
+                                iterations=int(res.iterations),
+                                converged=bool(res.converged),
+                            )
+                        else:
+                            c = cfg.random_effect_coordinates[cid]
+                            shard = re_shards[cid]
+                            offs_re = self._offsets_to_owners(
+                                shard, offs, row_base
+                            )
+                            loss_sum, max_it, conv = self._solve_re_buckets(
+                                shard, offs_re, c.optimization, re_W[cid],
+                                None if cid in self._projectors
+                                else self.intercept_indices.get(
+                                    c.feature_shard_id
+                                ),
+                                norm=self._norm_contexts.get(
+                                    c.feature_shard_id
+                                ),
+                                V=re_V[cid],
+                                W_prior=re_W_prior.get(cid),
+                                V_prior=re_V_prior.get(cid),
+                            )
+                            if self._distributed():
+                                # per-owner partial diagnostics → global
+                                # (sum the losses, max the iteration
+                                # counts, AND the flags)
+                                from jax.experimental import multihost_utils
 
-                if vstate is not None:
-                    res_v = self._validate_after_visit(
-                        cid, vstate, validation, fixed_w, re_W
+                                agg = np.asarray(
+                                    multihost_utils.process_allgather(
+                                        np.asarray(
+                                            [loss_sum, float(max_it),
+                                             0.0 if conv else 1.0]
+                                        )
+                                    )
+                                ).reshape(-1, 3)
+                                loss_sum = float(agg[:, 0].sum())
+                                max_it = int(agg[:, 1].max())
+                                conv = bool((agg[:, 2] == 0).all())
+                            s_re = self._score_re_rows(shard, re_W[cid])
+                            new_scores = self._scores_to_origin(
+                                shard, s_re, n, row_base
+                            )
+                            info[cid] = StreamedCoordinateInfo(
+                                final_loss=loss_sum, iterations=max_it,
+                                converged=conv,
+                            )
+                        total = offs + new_scores
+                        scores[cid] = new_scores
+                    emit_event(
+                        "visit_result", iteration=it, coordinate=cid,
+                        loss=info[cid].final_loss,
+                        iterations=info[cid].iterations,
+                        converged=info[cid].converged,
                     )
-                    self.validation_history.append({cid: res_v})
-                    self._log(f"iter {it} coordinate {cid}: validation {res_v}")
+                    self._log(
+                        f"iter {it} coordinate {cid}: "
+                        f"loss={info[cid].final_loss:.6g} "
+                        f"iterations={info[cid].iterations} "
+                        f"converged={info[cid].converged}"
+                    )
 
-                visit_index = it * len(seq) + ci
-                if (
-                    self.checkpoint_dir is not None
-                    and (visit_index + 1) % self.checkpoint_every_n_visits == 0
-                ):
-                    nxt_it, nxt_ci = (
-                        (it, ci + 1) if ci + 1 < len(seq) else (it + 1, 0)
-                    )
-                    model_state = {
-                        "fixed_w": fixed_w, "re_W": re_W, "re_E": re_E,
-                        "fixed_var": fixed_var, "re_V": re_V,
-                    }
-                    self._save_visit_checkpoint(
-                        model_state, scores, total, nxt_it, nxt_ci,
-                        fingerprint, digest, row_base, n_global,
-                    )
+                    if vstate is not None:
+                        with span(
+                            "descent/validation", iteration=it, coordinate=cid
+                        ):
+                            res_v = self._validate_after_visit(
+                                cid, vstate, validation, fixed_w, re_W
+                            )
+                        self.validation_history.append({cid: res_v})
+                        self._log(
+                            f"iter {it} coordinate {cid}: validation {res_v}"
+                        )
+
+                    visit_index = it * len(seq) + ci
+                    if (
+                        self.checkpoint_dir is not None
+                        and (visit_index + 1) % self.checkpoint_every_n_visits
+                        == 0
+                    ):
+                        nxt_it, nxt_ci = (
+                            (it, ci + 1) if ci + 1 < len(seq) else (it + 1, 0)
+                        )
+                        model_state = {
+                            "fixed_w": fixed_w, "re_W": re_W, "re_E": re_E,
+                            "fixed_var": fixed_var, "re_V": re_V,
+                        }
+                        with span("descent/checkpoint", iteration=it,
+                                  coordinate=cid):
+                            self._save_visit_checkpoint(
+                                model_state, scores, total, nxt_it, nxt_ci,
+                                fingerprint, digest, row_base, n_global,
+                            )
 
         model = self._assemble_model(
             {"fixed_w": fixed_w, "re_W": re_W, "re_E": re_E,

@@ -1,0 +1,106 @@
+"""Chrome-trace / Perfetto export of a telemetry run.
+
+Renders a run's JSONL span records as Chrome trace-event JSON (the
+``{"traceEvents": [...]}`` container format), so the host-side span
+timeline opens in Perfetto / ``chrome://tracing`` NEXT TO the
+``jax.profiler`` device traces the drivers already capture — one tool,
+both sides of the host/device boundary.
+
+Mapping: every ``span`` record becomes a complete event (``"ph": "X"``,
+micro-second ``ts``/``dur`` relative to ``run_start``); ``log`` and
+optimizer records become instant events (``"ph": "i"``) so warnings and
+per-iteration markers are visible on the timeline. Thread ids map to
+``tid`` with thread-name metadata events, so the prefetch worker pool
+renders as separate tracks under one process.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+
+def chrome_trace(records: list[dict]) -> dict:
+    """Chrome trace-event JSON (as a dict) for one run's records."""
+    t0 = None
+    pid = 0
+    for r in records:
+        if r.get("event") == "run_start":
+            t0 = float(r["t"])
+            pid = int(r.get("process_index", 0))
+            break
+    if t0 is None and records:
+        t0 = min(float(r["t"]) for r in records if "t" in r)
+    t0 = t0 or 0.0
+
+    events: list[dict[str, Any]] = []
+    thread_names: dict[int, str] = {}
+
+    def us(t: float) -> float:
+        return max((t - t0) * 1e6, 0.0)
+
+    for r in records:
+        kind = r.get("event")
+        if kind == "span":
+            tid = int(r.get("tid") or 0)
+            if r.get("thread") and tid not in thread_names:
+                thread_names[tid] = r["thread"]
+            ev: dict[str, Any] = {
+                "name": r.get("name", "span"),
+                "ph": "X",
+                "ts": us(float(r["t"])),
+                "dur": float(r.get("dur_s", 0.0)) * 1e6,
+                "pid": pid,
+                "tid": tid,
+            }
+            args = dict(r.get("attrs") or {})
+            args["span_id"] = r.get("span_id")
+            if r.get("parent_id") is not None:
+                args["parent_id"] = r["parent_id"]
+            ev["args"] = args
+            events.append(ev)
+        elif kind in ("log", "optim_iter", "optim_result", "jax_event"):
+            name = (
+                r.get("message") if kind == "log" else r.get("name", kind)
+            ) or kind
+            events.append(
+                {
+                    "name": str(name)[:120],
+                    "ph": "i",
+                    "s": "t",
+                    "ts": us(float(r["t"])),
+                    "pid": pid,
+                    "tid": int(r.get("tid") or 0),
+                    "args": {
+                        k: v
+                        for k, v in r.items()
+                        if k not in ("event", "t") and _plain(v)
+                    },
+                }
+            )
+    for tid, name in thread_names.items():
+        events.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": name},
+            }
+        )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _plain(v) -> bool:
+    return isinstance(v, (str, int, float, bool)) or v is None
+
+
+def export_chrome_trace(jsonl_path: str, out_path: str | None = None) -> dict:
+    """Read a run JSONL and return (optionally write) its Chrome trace."""
+    from photon_ml_tpu.obs.report import load_run
+
+    trace = chrome_trace(load_run(jsonl_path))
+    if out_path is not None:
+        with open(out_path, "w") as f:
+            json.dump(trace, f)
+    return trace

@@ -1,0 +1,259 @@
+"""Run-report rendering: load, validate, summarize and diff telemetry runs.
+
+The ``photon-ml-tpu report`` CLI's engine. A summary answers the question
+every on-chip sweep needs answered per run — where did the wall go
+(per-phase span seconds), how much was XLA compile, how much was
+host→device transfer, what did the optimizers do — and ``diff`` lines two
+runs up so a knob sweep (``PHOTON_PREFETCH_DEPTH``,
+``PHOTON_PIPELINE_SEGMENTS``, …) reads as a table instead of two log
+greps. Phases are the first ``/`` segment of span names (``descent/iter``
+→ ``descent``); a phase's wall is the UNION of its phase-entry spans'
+time intervals (entry = parent outside the phase), so neither nesting
+nor concurrent worker-thread spans double-count. Phases may still
+overlap EACH OTHER in wall time — a prefetch worker's ``ingest`` span
+running under a consumer's ``cv`` span is real pipelining, so the phase
+column can legitimately sum past the run's wall.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from photon_ml_tpu.obs.sink import SCHEMA_VERSION
+
+_SPAN_REQUIRED = ("name", "span_id", "dur_s", "t")
+
+
+def load_run(path: str) -> list[dict]:
+    """Parse one run's JSONL into records (raises on unparseable lines —
+    the atomic-rotate sink never commits a torn tail, so a parse failure
+    means the file is not a telemetry run)."""
+    records = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{i + 1}: not JSONL: {e}") from e
+    return records
+
+
+def validate_run(records: list[dict]) -> list[str]:
+    """Schema check; returns a list of problems (empty = valid)."""
+    errors = []
+    if not records:
+        return ["empty run (no records)"]
+    head = records[0]
+    if head.get("event") != "run_start":
+        errors.append("first record is not run_start")
+    elif head.get("schema_version") != SCHEMA_VERSION:
+        errors.append(
+            f"schema_version {head.get('schema_version')!r} != "
+            f"{SCHEMA_VERSION} (this reader)"
+        )
+    for i, r in enumerate(records):
+        if "event" not in r or "t" not in r:
+            errors.append(f"record {i}: missing 'event'/'t'")
+            continue
+        if r["event"] == "span":
+            missing = [k for k in _SPAN_REQUIRED if k not in r]
+            if missing:
+                errors.append(f"record {i}: span missing {missing}")
+    return errors
+
+
+def _phase(name: str) -> str:
+    return name.split("/", 1)[0]
+
+
+def _union_seconds(intervals: list[tuple[float, float]]) -> float:
+    """Total seconds covered by a set of (start, end) intervals."""
+    total = 0.0
+    end = -float("inf")
+    for lo, hi in sorted(intervals):
+        if hi <= end:
+            continue
+        total += hi - max(lo, end)
+        end = hi
+    return total
+
+
+def summarize_run(path: str) -> dict:
+    """One run's JSONL → a JSON-plain summary dict."""
+    records = load_run(path)
+    errors = validate_run(records)
+    if errors:
+        raise ValueError(f"{path}: invalid telemetry run: {errors}")
+
+    spans = [r for r in records if r["event"] == "span"]
+    by_id = {r["span_id"]: r for r in spans}
+    run_start = records[0]
+    run_end = next(
+        (r for r in records if r["event"] == "run_end"), None
+    )
+    t_last = max(float(r["t"]) for r in records)
+
+    phases: dict[str, dict] = {}
+    entry_intervals: dict[str, list[tuple[float, float]]] = {}
+    for s in spans:
+        ph = _phase(s["name"])
+        agg = phases.setdefault(ph, {"wall_s": 0.0, "spans": 0})
+        agg["spans"] += 1
+        parent = by_id.get(s.get("parent_id"))
+        # only phase-entry spans contribute wall (children re-cover the
+        # same seconds), and entry intervals are UNIONED so concurrent
+        # worker-thread spans of one phase don't double-count either
+        if parent is None or _phase(parent["name"]) != ph:
+            t0 = float(s["t"])
+            entry_intervals.setdefault(ph, []).append(
+                (t0, t0 + float(s["dur_s"]))
+            )
+    for ph, intervals in entry_intervals.items():
+        phases[ph]["wall_s"] = _union_seconds(intervals)
+
+    events: dict[str, int] = {}
+    for r in records:
+        events[r["event"]] = events.get(r["event"], 0) + 1
+
+    # leaf XLA compiles only (jax nests backend_compile inside broader
+    # "compile" events — summing every match would double-count)
+    compile_s = sum(
+        float(r.get("dur_s", 0.0))
+        for r in records
+        if r["event"] == "jax_event"
+        and "backend_compile" in str(r.get("name", ""))
+    )
+    metrics = (run_end or {}).get("metrics", {})
+    timers = metrics.get("timers", {})
+    base_timers = run_start.get("metrics_baseline", {}).get("timers", {})
+
+    def timer_s(name: str) -> float:
+        # delta against the run_start baseline: the registry is process-
+        # cumulative, and a second run in the same process must not
+        # inherit the first run's seconds
+        end = float(timers.get(name, {}).get("seconds", 0.0))
+        base = float(base_timers.get(name, {}).get("seconds", 0.0))
+        return max(end - base, 0.0)
+
+    optim = [r for r in records if r["event"] == "optim_result"]
+    reasons: dict[str, int] = {}
+    for r in optim:
+        reasons[str(r.get("reason"))] = reasons.get(str(r.get("reason")), 0) + 1
+
+    return {
+        "path": os.path.abspath(path),
+        "run_id": run_start.get("run_id"),
+        "schema_version": run_start.get("schema_version"),
+        "knobs": run_start.get("knobs", {}),
+        "wall_s": t_last - float(run_start["t"]),
+        "complete": run_end is not None,
+        "phases": phases,
+        "compile_s": compile_s or timer_s("jax.compile_s"),
+        "transfer_s": timer_s("prefetch.device_put_s"),
+        "host_pack_s": timer_s("prefetch.host_pack_s"),
+        "consumer_wait_s": timer_s("prefetch.consumer_wait_s"),
+        "events": events,
+        "optim": {
+            "solves": len(optim),
+            "iterations": sum(int(r.get("iterations", 0)) for r in optim),
+            "reasons": reasons,
+        },
+        "warnings": sum(
+            1 for r in records
+            if r["event"] == "log" and r.get("level") in ("WARN", "ERROR")
+        ),
+        "metrics": metrics,
+    }
+
+
+# -- rendering --------------------------------------------------------------
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v:.3f}s"
+
+
+def format_summary(s: dict) -> str:
+    lines = [
+        f"run {s['run_id']}  (schema v{s['schema_version']}, "
+        f"{'complete' if s['complete'] else 'NO run_end — truncated?'})",
+        f"  wall {_fmt_s(s['wall_s'])}   compile {_fmt_s(s['compile_s'])}   "
+        f"transfer {_fmt_s(s['transfer_s'])}   "
+        f"host-pack {_fmt_s(s['host_pack_s'])}   "
+        f"consumer-wait {_fmt_s(s['consumer_wait_s'])}",
+        "",
+        f"  {'phase':<16} {'wall':>10} {'spans':>7}",
+    ]
+    for ph, agg in sorted(
+        s["phases"].items(), key=lambda kv: -kv[1]["wall_s"]
+    ):
+        lines.append(
+            f"  {ph:<16} {_fmt_s(agg['wall_s']):>10} {agg['spans']:>7}"
+        )
+    o = s["optim"]
+    if o["solves"]:
+        reasons = ", ".join(f"{k}×{v}" for k, v in sorted(o["reasons"].items()))
+        lines.append(
+            f"  optimizer: {o['solves']} solves, {o['iterations']} "
+            f"iterations ({reasons})"
+        )
+    if s["warnings"]:
+        lines.append(f"  warnings: {s['warnings']}")
+    if s["knobs"]:
+        lines.append(f"  knobs: {json.dumps(s['knobs'], sort_keys=True)}")
+    return "\n".join(lines)
+
+
+def diff_summaries(a: dict, b: dict) -> str:
+    """Two runs side by side: per-phase wall, compile/transfer split, knob
+    deltas — the sweep-readout format."""
+    lines = [
+        f"A: {a['run_id']}  ({os.path.basename(a['path'])})",
+        f"B: {b['run_id']}  ({os.path.basename(b['path'])})",
+        "",
+        f"  {'':<16} {'A':>10} {'B':>10} {'B/A':>7}",
+    ]
+
+    def row(label: str, va: float, vb: float):
+        ratio = (vb / va) if va > 0 else float("inf") if vb > 0 else 1.0
+        lines.append(
+            f"  {label:<16} {_fmt_s(va):>10} {_fmt_s(vb):>10} {ratio:>7.2f}"
+        )
+
+    row("wall", a["wall_s"], b["wall_s"])
+    for ph in sorted(set(a["phases"]) | set(b["phases"])):
+        row(
+            ph,
+            a["phases"].get(ph, {}).get("wall_s", 0.0),
+            b["phases"].get(ph, {}).get("wall_s", 0.0),
+        )
+    row("compile", a["compile_s"], b["compile_s"])
+    row("transfer", a["transfer_s"], b["transfer_s"])
+    row("host-pack", a["host_pack_s"], b["host_pack_s"])
+    row("consumer-wait", a["consumer_wait_s"], b["consumer_wait_s"])
+    ka, kb = a.get("knobs", {}), b.get("knobs", {})
+    knob_diffs = {
+        k: (ka.get(k), kb.get(k))
+        for k in sorted(set(ka) | set(kb))
+        if ka.get(k) != kb.get(k)
+    }
+    if knob_diffs:
+        lines.append("  knob deltas:")
+        for k, (va, vb) in knob_diffs.items():
+            lines.append(f"    {k}: {va!r} -> {vb!r}")
+    return "\n".join(lines)
+
+
+def latest_run(directory: str) -> str | None:
+    """Newest ``run-*.jsonl`` in a telemetry directory (mtime order)."""
+    runs = [
+        os.path.join(directory, f)
+        for f in os.listdir(directory)
+        if f.startswith("run-") and f.endswith(".jsonl")
+    ]
+    return max(runs, key=os.path.getmtime) if runs else None

@@ -1,0 +1,281 @@
+"""Buffered JSONL event sink — one run, one schema-versioned file.
+
+Reference parity: the reference leans on Spark's event log / UI timeline
+for run observability (SURVEY.md §5.1); this sink is the TPU-native
+equivalent: every span, optimizer record, structured warning and metric
+snapshot of a run lands as one JSON line in one file that a human or a
+sweep script can diff across runs without grepping stderr.
+
+Durability contract: the file on disk is ALWAYS a complete, parseable
+run prefix. Buffered records are committed by **atomic rotation** — the
+full accumulated content is written to a same-directory temp file,
+fsync'd, and renamed over the run file (``utils/atomic_io``, the same
+fsync-rename idiom the visit-checkpoint shards use) — so a reader never
+observes a torn tail and a crash never shadows a complete file with a
+partial one. The rotation threshold grows with the file (bounded at
+``_MAX_ROTATE_EVERY``) so total write amplification stays O(n·log n)
+rather than O(n²) on long runs. The tradeoff of full-rewrite atomicity
+is that the sink holds the run's serialized records in memory and each
+commit rewrites the whole file — sized for this framework's runs (span +
+per-iteration record volume is a few hundred bytes each; even a
+day-long sweep stays in the tens of MB). A workload emitting orders of
+magnitude more should thin its per-iteration records, not the spans.
+
+Multihost: only the output process (``jax.process_index() == 0``) writes
+by default — ``configure`` returns a disabled subsystem elsewhere, the
+same single-writer discipline the drivers use for models and metrics.
+
+Disabled fast path: when no sink is configured, ``emit`` is a single
+attribute check and every ``span()`` returns a shared no-op context
+manager — telemetry can stay wired through production paths.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any
+
+from photon_ml_tpu.obs import metrics as _metrics
+
+SCHEMA_VERSION = 1
+
+# rotation cadence: first commit after this many buffered records, then
+# proportional to what's already written (amortized near-linear total IO)
+_FIRST_ROTATE_EVERY = 128
+_MAX_ROTATE_EVERY = 65536
+
+
+def _json_default(o: Any) -> str:
+    return str(o)
+
+
+def _sanitize(v: Any) -> Any:
+    """Strict-JSON-safe record values: Python's json module would happily
+    write bare ``NaN``/``Infinity`` (a diverged solve's loss, say), which
+    strict parsers — the Perfetto UI, any non-Python consumer — reject
+    for the WHOLE file. Non-finite floats become strings, keeping the
+    information without breaking the document."""
+    if isinstance(v, float):
+        if v != v:
+            return "NaN"
+        if v == float("inf"):
+            return "Infinity"
+        if v == float("-inf"):
+            return "-Infinity"
+        return v
+    if isinstance(v, dict):
+        return {k: _sanitize(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_sanitize(x) for x in v]
+    return v
+
+
+class TelemetrySink:
+    """One run's JSONL file. Thread-safe; records are buffered and
+    committed by atomic rotation (never an append a crash could tear)."""
+
+    _seq = itertools.count()  # same-second same-process runs stay distinct
+
+    def __init__(self, directory: str, run_id: str | None = None):
+        os.makedirs(directory, exist_ok=True)
+        self.run_id = run_id or (
+            time.strftime("%Y%m%dT%H%M%S")
+            + f"-{os.getpid()}-{next(self._seq)}"
+        )
+        self.directory = directory
+        self.path = os.path.join(directory, f"run-{self.run_id}.jsonl")
+        self._lock = threading.Lock()
+        self._lines: list[str] = []
+        self._pending = 0
+        self._rotate_every = _FIRST_ROTATE_EVERY
+        self._closed = False
+
+    def emit(self, record: dict) -> None:
+        """Buffer one event record (a plain dict; non-JSON values are
+        stringified rather than raised — telemetry must never take down
+        the run it observes)."""
+        line = json.dumps(_sanitize(record), default=_json_default)
+        with self._lock:
+            if self._closed:
+                return
+            self._lines.append(line)
+            self._pending += 1
+            if self._pending >= self._rotate_every:
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        from photon_ml_tpu.utils.atomic_io import atomic_replace_bytes
+
+        data = ("\n".join(self._lines) + "\n").encode()
+        atomic_replace_bytes(self.directory, self.path, data)
+        self._pending = 0
+        self._rotate_every = min(
+            max(_FIRST_ROTATE_EVERY, len(self._lines)), _MAX_ROTATE_EVERY
+        )
+
+    def flush(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._rotate_locked()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._rotate_locked()
+            self._closed = True
+
+
+# -- the process-wide active sink ------------------------------------------
+
+_ACTIVE: TelemetrySink | None = None
+_state_lock = threading.Lock()
+
+
+def active_sink() -> TelemetrySink | None:
+    return _ACTIVE
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def configure(
+    telemetry_dir: str | None,
+    run_id: str | None = None,
+    force_writer: bool | None = None,
+) -> str | None:
+    """Enable telemetry into ``telemetry_dir`` and return the run file's
+    path. ``None`` leaves telemetry disabled (the CLI drivers call this
+    unconditionally with their ``--telemetry-dir`` value). Multihost: only
+    the output process writes unless ``force_writer=True``. Re-configuring
+    closes any previous run's sink first."""
+    global _ACTIVE
+    with _state_lock:
+        if _ACTIVE is not None:
+            _shutdown_locked()
+        if telemetry_dir is None:
+            return None
+        writer = force_writer if force_writer is not None \
+            else _process_index() == 0
+        if not writer:
+            return None
+        sink = TelemetrySink(telemetry_dir, run_id=run_id)
+        sink.emit(
+            {
+                "event": "run_start",
+                "t": time.time(),
+                "schema_version": SCHEMA_VERSION,
+                "run_id": sink.run_id,
+                "pid": os.getpid(),
+                "process_index": _process_index(),
+                "knobs": _knob_snapshot(),
+                # the registry is PROCESS-cumulative; the baseline lets a
+                # reader (obs/report) delta run_end down to THIS run's
+                # share when several runs live in one process
+                "metrics_baseline": _metrics.REGISTRY.snapshot(),
+            }
+        )
+        _ACTIVE = sink
+        _install_jax_monitoring()
+        return sink.path
+
+
+def shutdown() -> None:
+    """Emit the ``run_end`` record (with the full metrics snapshot), flush
+    durably, and disable the sink. Safe to call when already disabled."""
+    with _state_lock:
+        _shutdown_locked()
+
+
+def _shutdown_locked() -> None:
+    global _ACTIVE
+    sink = _ACTIVE
+    _ACTIVE = None  # disable emission first: close must not race new spans
+    if sink is None:
+        return
+    record = {
+        "event": "run_end",
+        "t": time.time(),
+        "run_id": sink.run_id,
+        "metrics": _metrics.REGISTRY.snapshot(),
+    }
+    try:
+        from photon_ml_tpu.ops import prefetch
+
+        record["chunk_cache"] = prefetch.cache_stats()
+    except Exception:
+        pass
+    sink.emit(record)
+    sink.close()
+
+
+def _knob_snapshot() -> dict:
+    """The retune surface a run executed under (same knobs the bench
+    round-trips), so two JSONLs are diffable AS CONFIGURATIONS too."""
+    knobs: dict = {}
+    try:
+        from photon_ml_tpu.ops import prefetch
+
+        knobs["prefetch_depth"] = prefetch.prefetch_depth()
+        knobs["chunk_cache_budget_bytes"] = int(
+            prefetch.chunk_cache_budget_bytes()
+        )
+    except Exception:
+        pass
+    try:
+        from photon_ml_tpu.ops import sparse_tiled as st
+
+        knobs["groups_per_run"] = int(st.GROUPS_PER_RUN)
+        knobs["pipeline_segments"] = int(st.PIPELINE_SEGMENTS)
+    except Exception:
+        pass
+    return knobs
+
+
+# -- XLA compile visibility via jax.monitoring ------------------------------
+# Registered ONCE per process at obs import (and defensively re-checked in
+# configure), never unregistered (jax offers no targeted removal); the
+# callbacks consult the active sink so they are cheap no-ops between runs.
+# Durations also land in the registry, so compile wall is in every
+# snapshot — bench telemetry blocks included — even without a sink.
+
+_jax_monitoring_installed = False
+
+
+def _on_jax_duration(name: str, secs: float, **kw) -> None:
+    try:
+        if "backend_compile" in name:
+            # the leaf XLA compile phase only: jax nests it inside broader
+            # "compile" events, and summing every match double-counts
+            _metrics.REGISTRY.timer_add("jax.compile_s", secs)
+        sink = _ACTIVE
+        if sink is not None:
+            sink.emit(
+                {"event": "jax_event", "t": time.time(), "name": name,
+                 "dur_s": secs}
+            )
+    except Exception:
+        pass  # monitoring must never break compilation
+
+
+def _install_jax_monitoring() -> None:
+    global _jax_monitoring_installed
+    if _jax_monitoring_installed:
+        return
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(_on_jax_duration)
+        _jax_monitoring_installed = True
+    except Exception:
+        pass  # older jax without monitoring: compile events just absent

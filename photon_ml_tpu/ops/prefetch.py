@@ -66,6 +66,7 @@ from typing import Any, Callable, Iterator
 
 import numpy as np
 
+from photon_ml_tpu.obs.metrics import REGISTRY as _REGISTRY
 from photon_ml_tpu.utils import profiling
 
 # -- knobs (module globals read at CALL time; env override wins) ----------
@@ -256,6 +257,7 @@ def _evict_over_budget_locked() -> None:
         key, (host_ref, _dev, nb) = _device_tier.popitem(last=False)
         _device_bytes -= nb
         _cache_stats["evictions"] += 1
+        _REGISTRY.counter_inc("prefetch.cache.evictions")
         # spill: keep the host array so re-entry is one device_put, never
         # a re-slice/re-pack upstream
         if key not in _host_tier:
@@ -278,13 +280,18 @@ def _cached_put_one(a):
         if hit is not None:
             _device_tier.move_to_end(key)
             _cache_stats["device_hits"] += 1
+            # registry twins of the stats (hit/miss BYTES: the transfer
+            # traffic the cache saved/paid — what a sweep actually diffs)
+            _REGISTRY.counter_inc("prefetch.cache.hit_bytes", hit[2])
             return hit[1]
         spilled = _host_tier.pop(key, None)
         if spilled is not None:
             _host_bytes -= spilled[1]
             _cache_stats["host_hits"] += 1
+            _REGISTRY.counter_inc("prefetch.cache.host_hit_bytes", spilled[1])
         else:
             _cache_stats["misses"] += 1
+            _REGISTRY.counter_inc("prefetch.cache.miss_bytes", int(a.nbytes))
     # transfer OUTSIDE the lock (the expensive part; concurrent misses for
     # the same key both transfer — last insert wins, both correct)
     dev = timed_device_put(a)

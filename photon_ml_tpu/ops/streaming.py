@@ -411,7 +411,13 @@ class StreamingGLMObjective:
         acc = init
         if not self.chunks:
             return acc
+        from photon_ml_tpu.obs.metrics import REGISTRY
         from photon_ml_tpu.ops import prefetch
+
+        # registry counters (one update per PASS, not per chunk: the
+        # telemetry write must never show up on the chunk critical path)
+        REGISTRY.counter_inc("stream.passes")
+        REGISTRY.counter_inc("stream.chunks", len(self.chunks))
 
         depth = prefetch.prefetch_depth()
         if depth <= 0:

@@ -68,11 +68,32 @@ class OptimizationResult:
     def converged(self) -> Array:
         return self.reason != ConvergenceReason.MAX_ITERATIONS
 
+    def telemetry_record(self, **extra) -> dict:
+        """The solve as one JSON-plain telemetry record. The
+        ``ConvergenceReason`` is the enum NAME (the raw int in logs is
+        easy to misread) and the iteration count is verbatim; ``extra``
+        tags the record (coordinate id, λ, fold)."""
+        rec = {
+            "reason": ConvergenceReason(int(self.reason)).name,
+            "iterations": int(self.iterations),
+            "value": float(self.value),
+            "grad_norm": float(self.grad_norm),
+        }
+        if self.objective_passes is not None:
+            rec["objective_passes"] = int(self.objective_passes)
+        rec.update(extra)
+        return rec
+
     def summary(self) -> str:
-        """Host-side, human-readable run summary (PhotonLogger parity)."""
-        n = int(self.iterations)
+        """Host-side, human-readable run summary (PhotonLogger parity).
+        Renders the same ``telemetry_record`` fields; it does NOT emit —
+        the solver that produced the result already emitted the run's one
+        ``optim_result`` record, and a second here would double-count
+        solves in the report."""
+        rec = self.telemetry_record()
+        n = rec["iterations"]
         lines = [
-            f"iterations={n} reason={ConvergenceReason(int(self.reason)).name} "
+            f"iterations={n} reason={rec['reason']} "
             f"value={float(self.value):.6g} grad_norm={float(self.grad_norm):.3e}"
         ]
         losses = jax.device_get(self.loss_history)

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.config import OptimizerConfig
+from photon_ml_tpu.obs import REGISTRY, emit_event
 from photon_ml_tpu.optim.common import ConvergenceReason, OptimizationResult
 
 _ARMIJO_C1 = 1e-4
@@ -180,6 +181,11 @@ def host_lbfgs_minimize(
         it += 1
         gn = float(np.linalg.norm(pg))
         loss_hist[it], gnorm_hist[it] = f, gn
+        # per-iteration telemetry record (run JSONL; no-op without a sink)
+        emit_event(
+            "optim_iter", algorithm="owlqn" if use_l1 else "lbfgs",
+            it=it, loss=f, grad_norm=gn,
+        )
         if iteration_callback is not None:
             iteration_callback(it, w, f)
         if converged_grad(gn):
@@ -189,7 +195,7 @@ def host_lbfgs_minimize(
             reason = ConvergenceReason.OBJECTIVE_CONVERGED
             break
 
-    return OptimizationResult(
+    result = OptimizationResult(
         w=jnp.asarray(w, jnp.float32),
         value=jnp.asarray(f, jnp.float32),
         grad_norm=jnp.asarray(np.linalg.norm(pg), jnp.float32),
@@ -198,6 +204,11 @@ def host_lbfgs_minimize(
         loss_history=jnp.asarray(loss_hist, jnp.float32),
         grad_norm_history=jnp.asarray(gnorm_hist, jnp.float32),
     )
+    algo = "owlqn" if use_l1 else "lbfgs"
+    REGISTRY.histogram_observe("optim.iterations", it)
+    REGISTRY.counter_inc(f"optim.reason.{reason.name}")
+    emit_event("optim_result", algorithm=algo, **result.telemetry_record())
+    return result
 
 
 def host_owlqn_minimize(

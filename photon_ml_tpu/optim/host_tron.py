@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.config import OptimizerConfig
+from photon_ml_tpu.obs import REGISTRY, emit_event
 from photon_ml_tpu.optim.common import ConvergenceReason, OptimizationResult
 
 # LIBLINEAR tron.cpp constants (identical to optim/tron.py)
@@ -128,6 +129,11 @@ def host_tron_minimize(
         gn = float(np.linalg.norm(g))
         it += 1
         loss_hist[it], gnorm_hist[it] = f, gn
+        # per-iteration telemetry record (run JSONL; no-op without a sink)
+        emit_event(
+            "optim_iter", algorithm="tron", it=it, loss=f, grad_norm=gn,
+            accepted=bool(accept),
+        )
         if iteration_callback is not None:
             iteration_callback(it, w, f)
 
@@ -142,7 +148,7 @@ def host_tron_minimize(
             reason = ConvergenceReason.OBJECTIVE_CONVERGED
             break
 
-    return OptimizationResult(
+    result = OptimizationResult(
         w=jnp.asarray(w, jnp.float32),
         value=jnp.asarray(f, jnp.float32),
         grad_norm=jnp.asarray(np.linalg.norm(g), jnp.float32),
@@ -151,3 +157,7 @@ def host_tron_minimize(
         loss_history=jnp.asarray(loss_hist, jnp.float32),
         grad_norm_history=jnp.asarray(gnorm_hist, jnp.float32),
     )
+    REGISTRY.histogram_observe("optim.iterations", it)
+    REGISTRY.counter_inc(f"optim.reason.{reason.name}")
+    emit_event("optim_result", algorithm="tron", **result.telemetry_record())
+    return result

@@ -119,14 +119,20 @@ def cross_validate_glm(
     }
     from photon_ml_tpu.ops import prefetch
 
+    from photon_ml_tpu.obs import span
+
     def ingest_fold(i):
         # fold INGEST (row gather + layout decision + tile-COO pack through
         # the process-wide cache) for fold i+k runs on prefetch workers
         # while fold i's sweep trains; training and evaluation stay on this
         # thread in fold order, so every metric and the refit are bitwise
-        # identical to the synchronous schedule (depth 0 restores it)
-        train_rows = np.setdiff1d(perm, folds[i], assume_unique=True)
-        return _ingest_training_batch(_row_select(batch, train_rows))
+        # identical to the synchronous schedule (depth 0 restores it).
+        # The ingest span roots on the WORKER thread (spans are
+        # thread-local by design — it must not adopt whatever fold the
+        # consumer thread currently has open).
+        with span("ingest/cv-fold", fold=i):
+            train_rows = np.setdiff1d(perm, folds[i], assume_unique=True)
+            return _ingest_training_batch(_row_select(batch, train_rows))
 
     # depth capped at 1 for THIS consumer: unlike the streaming paths
     # (whose items are bounded chunks), each prefetched item here is a
@@ -140,21 +146,22 @@ def cross_validate_glm(
         )
     ):
         held_out = folds[i]
-        result = train_glm(
-            train_batch,
-            task,
-            optimizer_config=optimizer_config,
-            regularization=regularization,
-            regularization_weights=regularization_weights,
-            normalization=normalization,
-            intercept_index=intercept_index,
-        )
-        val = _row_select(batch, held_out)
-        for lam, model in result.models.items():
-            scores = model.score(val)
-            metric_values[float(lam)].append(
-                float(ev(scores, val.labels, val.weights))
+        with span("cv/fold", fold=i, k=k):
+            result = train_glm(
+                train_batch,
+                task,
+                optimizer_config=optimizer_config,
+                regularization=regularization,
+                regularization_weights=regularization_weights,
+                normalization=normalization,
+                intercept_index=intercept_index,
             )
+            val = _row_select(batch, held_out)
+            for lam, model in result.models.items():
+                scores = model.score(val)
+                metric_values[float(lam)].append(
+                    float(ev(scores, val.labels, val.weights))
+                )
 
     best_weight = None
     best_mean = float("nan")
@@ -163,16 +170,17 @@ def cross_validate_glm(
         if best_weight is None or ev.better(m, best_mean):
             best_weight, best_mean = lam, m
 
-    final = train_glm(
-        _ingest_training_batch(batch),
-        task,
-        optimizer_config=optimizer_config,
-        regularization=regularization,
-        regularization_weights=[best_weight],
-        normalization=normalization,
-        intercept_index=intercept_index,
-        variance_computation=variance_computation,
-    )
+    with span("cv/refit", weight=float(best_weight), k=k):
+        final = train_glm(
+            _ingest_training_batch(batch),
+            task,
+            optimizer_config=optimizer_config,
+            regularization=regularization,
+            regularization_weights=[best_weight],
+            normalization=normalization,
+            intercept_index=intercept_index,
+            variance_computation=variance_computation,
+        )
     return CrossValidationResult(
         metric_values=metric_values,
         metric_name=ev.name,

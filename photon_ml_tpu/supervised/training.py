@@ -146,22 +146,31 @@ def train_glm(
     best_weight: float | None = None
     best_value = float("nan")
 
+    from photon_ml_tpu.obs import emit_event, enabled, span
+
     # ascending λ with warm start (reference sweeps the same way)
     for lam in sorted(regularization_weights):
         l1 = regularization.l1_weight(lam)
         l2 = regularization.l2_weight(lam)
-        obj = make_objective(
-            batch,
-            loss,
-            l2_weight=l2,
-            norm=normalization,
-            intercept_index=intercept_index,
-            axis_name=axis_name,
-            prior=prior,
-        )
-        minimize_fn, extra = select_minimize_fn(optimizer_config, l1)
-        result = minimize_fn(obj, w, optimizer_config, **extra)
+        with span("glm/lambda", weight=float(lam)):
+            obj = make_objective(
+                batch,
+                loss,
+                l2_weight=l2,
+                norm=normalization,
+                intercept_index=intercept_index,
+                axis_name=axis_name,
+                prior=prior,
+            )
+            minimize_fn, extra = select_minimize_fn(optimizer_config, l1)
+            result = minimize_fn(obj, w, optimizer_config, **extra)
         w = result.w  # warm start the next λ (normalized space)
+        if enabled():
+            # device solvers return lazily; pull the record only when a
+            # sink is live (a host sync per λ is fine, but not for free)
+            emit_event(
+                "optim_result", weight=float(lam), **result.telemetry_record()
+            )
 
         variances = compute_variances(obj, result.w, variance_computation)
         w_model = result.w

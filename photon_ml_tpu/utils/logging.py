@@ -30,21 +30,40 @@ class PhotonLogger:
         level: str = "INFO",
         stream: TextIO | None = None,
         filename: str = "photon.log",
+        event_hook=None,
     ):
         self.level = self.LEVELS[level.upper()]
         self.stream = stream if stream is not None else sys.stderr
+        # structured-event hook: WARN/ERROR lines also land in the run's
+        # telemetry JSONL with their tag payload (not just stderr), so a
+        # post-hoc report sees every loud condition the run hit. ``None``
+        # selects the telemetry sink's default (a no-op when telemetry is
+        # disabled); pass an explicit ``hook(level, msg, fields)`` to
+        # redirect, or ``False`` to opt out entirely.
+        self._event_hook = event_hook
         self._file = None
         if output_dir is not None:
             os.makedirs(output_dir, exist_ok=True)
             self._file = open(os.path.join(output_dir, filename), "a")
 
-    def log(self, level: str, msg: str) -> None:
+    def log(self, level: str, msg: str, **fields) -> None:
         if self.LEVELS[level] < self.level:
             return
         line = f"[{time.strftime('%Y-%m-%d %H:%M:%S')}] {level:5s} {msg}"
         print(line, file=self.stream)
         if self._file is not None:
             print(line, file=self._file, flush=True)
+        if self.LEVELS[level] >= self.LEVELS["WARN"]:
+            hook = self._event_hook
+            if hook is None:
+                from photon_ml_tpu.obs import emit_log
+
+                hook = emit_log
+            if hook:
+                try:
+                    hook(level, msg, fields or None)
+                except Exception:
+                    pass  # telemetry must never take down the run it logs
 
     def debug(self, msg: str) -> None:
         self.log("DEBUG", msg)
@@ -52,11 +71,11 @@ class PhotonLogger:
     def info(self, msg: str) -> None:
         self.log("INFO", msg)
 
-    def warn(self, msg: str) -> None:
-        self.log("WARN", msg)
+    def warn(self, msg: str, **fields) -> None:
+        self.log("WARN", msg, **fields)
 
-    def error(self, msg: str) -> None:
-        self.log("ERROR", msg)
+    def error(self, msg: str, **fields) -> None:
+        self.log("ERROR", msg, **fields)
 
     def __call__(self, msg: str) -> None:
         self.info(msg)

@@ -10,10 +10,10 @@ a trace so perf claims are backed by an inspectable timeline.
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
-from collections import defaultdict
 from typing import Iterator
+
+from photon_ml_tpu.obs.metrics import REGISTRY as _REGISTRY
 
 
 @contextlib.contextmanager
@@ -46,16 +46,15 @@ def annotate(name: str):
 
 
 # -- stage counters --------------------------------------------------------
-# Process-wide accumulating wall-second counters for host-side pipeline
-# stages (the prefetch pipeline's host-pack / device-put / consumer-wait
-# split). Device traces answer "what did the chip do"; these answer "where
-# did the HOST critical path go" cheaply enough to stay on in production
-# paths — an overlap claim is then observable from a snapshot, not
-# asserted. Thread-safe: prefetch workers accumulate concurrently.
-
-_counter_lock = threading.Lock()
-_counters: "defaultdict[str, float]" = defaultdict(float)
-_counter_calls: "defaultdict[str, int]" = defaultdict(int)
+# COMPATIBILITY SHIM over the run-telemetry metrics registry
+# (``photon_ml_tpu.obs.metrics.REGISTRY``): the process-wide wall-second
+# stage counters (the prefetch pipeline's host-pack / device-put /
+# consumer-wait split) now live in the registry's timer kind, so the same
+# numbers appear in a run's JSONL ``run_end`` record, the bench telemetry
+# block, and these legacy accessors. Every pre-telemetry call site and
+# test keeps working unchanged: the snapshot shape
+# (``{name: {"seconds", "calls"}}``) and reset semantics are identical.
+# Thread-safe: prefetch workers accumulate concurrently.
 
 
 @contextlib.contextmanager
@@ -65,34 +64,17 @@ def stage_timer(name: str) -> Iterator[None]:
     try:
         yield
     finally:
-        dt = time.perf_counter() - t0
-        with _counter_lock:
-            _counters[name] += dt
-            _counter_calls[name] += 1
+        _REGISTRY.timer_add(name, time.perf_counter() - t0)
 
 
 def add_seconds(name: str, seconds: float) -> None:
-    with _counter_lock:
-        _counters[name] += float(seconds)
-        _counter_calls[name] += 1
+    _REGISTRY.timer_add(name, float(seconds))
 
 
 def counter_snapshot(prefix: str | None = None) -> dict:
     """``{name: {"seconds", "calls"}}``, optionally filtered by prefix."""
-    with _counter_lock:
-        return {
-            k: {"seconds": _counters[k], "calls": _counter_calls[k]}
-            for k in _counters
-            if prefix is None or k.startswith(prefix)
-        }
+    return _REGISTRY.timer_snapshot(prefix)
 
 
 def reset_counters(prefix: str | None = None) -> None:
-    with _counter_lock:
-        keys = [
-            k for k in _counters
-            if prefix is None or k.startswith(prefix)
-        ]
-        for k in keys:
-            del _counters[k]
-            del _counter_calls[k]
+    _REGISTRY.reset_timers(prefix)

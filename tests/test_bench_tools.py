@@ -99,6 +99,21 @@ class TestQuickMode:
                 "prefetch_depth": 2,
                 "chunk_cache_budget_bytes": 6_000_000_000,
             },
+            "telemetry": {
+                "schema_version": 1,
+                "metrics": {
+                    "counters": {
+                        "prefetch.cache.miss_bytes": {
+                            "value": 123.0, "calls": 3,
+                        }
+                    },
+                    "gauges": {}, "histograms": {},
+                    "timers": {
+                        "prefetch.host_pack_s": {"seconds": 0.5, "calls": 6},
+                    },
+                },
+                "knobs": {"prefetch_depth": 2},
+            },
         },
     }
 
@@ -147,6 +162,18 @@ class TestQuickMode:
         assert f_cfg["prefetch"]["prefetch_depth"] == 2
         assert f_cfg["prefetch"]["chunk_cache_budget_bytes"] == 6_000_000_000
         assert f_cfg["hostpack_overlap_ratio"] == 1.4
+        # the telemetry block (registry snapshot incl. the stage counters
+        # as metrics.timers + knob values, the same dict a --telemetry-dir
+        # run_end embeds) round-trips the contract verbatim
+        tel = f_cfg["telemetry"]
+        assert tel == self.FAKE["F_streaming"]["telemetry"]
+        assert (
+            tel["metrics"]["timers"]["prefetch.host_pack_s"]["calls"] == 6
+        )
+        assert (
+            tel["metrics"]["counters"]["prefetch.cache.miss_bytes"]["value"]
+            == 123.0
+        )
         # quick writes NO artifacts (BENCH_DETAIL.json / BASELINE.md)
         assert not baseline_writes and not detail_writes
 
@@ -199,6 +226,30 @@ class TestQuickMode:
         assert st.GROUPS_PER_RUN == 4
         assert st.GROUPS_PER_STEP == 16
         assert st.PIPELINE_SEGMENTS == 0
+
+    def test_telemetry_block_shape(self, monkeypatch):
+        """The block every config subprocess attaches: the typed registry
+        snapshot (stage counters = metrics.timers, one source of truth)
+        and the knob values — coherent and JSON-serializable."""
+        from photon_ml_tpu.obs.metrics import REGISTRY
+        from photon_ml_tpu.utils import profiling
+
+        profiling.add_seconds("benchtest.stage_s", 0.25)
+        REGISTRY.counter_inc("benchtest.bytes", 42)
+        monkeypatch.setenv("PHOTON_PREFETCH_DEPTH", "3")
+        block = bench._telemetry_block()
+        json.dumps(block)
+        assert block["schema_version"] == 1
+        # the legacy stage-counter view and the block's timers agree
+        assert (
+            block["metrics"]["timers"]["benchtest.stage_s"]
+            == profiling.counter_snapshot("benchtest.")["benchtest.stage_s"]
+        )
+        assert block["metrics"]["counters"]["benchtest.bytes"]["value"] == 42
+        # knobs read at call time (env wins), same as the prefetch block
+        assert block["knobs"]["prefetch_depth"] == 3
+        assert "groups_per_run" in block["knobs"]
+        REGISTRY.reset("benchtest.")
 
     def test_retune_env_reaches_prefetch_knobs(self, monkeypatch):
         import photon_ml_tpu.ops.prefetch as pf
