@@ -54,7 +54,9 @@ REPEATS = 3
 # changes. Quick runs never touch BENCH_DETAIL.json or BASELINE.md (toy
 # numbers must not overwrite the real artifact).
 QUICK = False
-QUICK_CONFIGS = ("A_sparse_logistic", "A2_sparse_highdim", "F_streaming")
+QUICK_CONFIGS = (
+    "A_sparse_logistic", "A2_sparse_highdim", "F_streaming", "R_re_skew",
+)
 # Kernel retune knobs: the sparse-tiled constants are module globals read
 # at call time (layout builder AND kernel), so a child process can retune
 # them from the environment — the bench-side lever for the
@@ -73,6 +75,13 @@ RETUNE_ENV = {
 RETUNE_ENV_PREFETCH = {
     "PHOTON_PREFETCH_DEPTH": "PREFETCH_DEPTH",
     "PHOTON_CHUNK_CACHE_BUDGET": "CHUNK_CACHE_BUDGET",
+}
+# Random-effect bucket-solve knobs (game/random_effect): compact_every 0 =
+# today's single-launch schedule bit-for-bit; fuse_buckets 0 = one launch
+# per bucket. The R_re_skew config is the sweep surface for both.
+RETUNE_ENV_RE = {
+    "PHOTON_RE_COMPACT_EVERY": "COMPACT_EVERY",
+    "PHOTON_RE_FUSE_BUCKETS": "FUSE_BUCKETS",
 }
 # No TPU generation exceeds this HBM bandwidth (v5p ~2.8 TB/s); a
 # measurement implying more is a timing artifact, not a fast solve.
@@ -1401,6 +1410,115 @@ def bench_dense_logistic_f32(jax, jnp):
     return bench_dense_logistic(jax, jnp, dtype=jnp.float32)
 
 
+def bench_r_re_skew(jax, jnp):
+    """Config R_re_skew: iteration-skewed random-effect bucket solves —
+    the lane-compaction/launch-fusion testbed. A synthetic bucket set
+    where a minority of entities (ill-conditioned features) need ~10× the
+    L-BFGS iterations of the rest, so the single-launch vmapped solve
+    burns most of its lane-iterations on already-converged entities.
+    Reports the ``re_solve.*`` registry accounting (executed vs useful
+    entity-iterations, launches, wasted-lane fraction) next to the wall —
+    sweep ``PHOTON_RE_COMPACT_EVERY`` ∈ {0, 1, 4, 16} ×
+    ``PHOTON_RE_FUSE_BUCKETS`` ∈ {0, 1}: results are BITWISE knob-
+    invariant (tests assert it), only the schedule and counters move."""
+    # the off-knob path counts executed/useful only when accounting is on
+    # (it costs one tiny per-bucket readback the deferred-diagnostics
+    # design otherwise skips)
+    prev_accounting = os.environ.get("PHOTON_RE_ITER_ACCOUNTING")
+    os.environ["PHOTON_RE_ITER_ACCOUNTING"] = "1"
+    try:
+        from photon_ml_tpu.config import OptimizerConfig
+        from photon_ml_tpu.game import (
+            DenseFeatures,
+            bucket_entities,
+            group_by_entity,
+            train_random_effects,
+        )
+        from photon_ml_tpu.game import random_effect as re_mod
+        from photon_ml_tpu.obs.metrics import REGISTRY
+        from photon_ml_tpu.ops.losses import loss_for_task
+        from photon_ml_tpu.types import TaskType
+
+        E, C, d = (48, 16, 6) if QUICK else (1024, 32, 8)
+        rng = np.random.default_rng(7)
+        ids = np.repeat(np.arange(E), C).astype(np.int32)
+        n = E * C
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        # every 16th entity is SLOW: anisotropically scaled features make its
+        # L-BFGS grind ~10× the iterations of the easy lanes
+        slow = np.arange(0, E, 16)
+        X[np.isin(ids, slow)] *= np.geomspace(1.0, 60.0, d).astype(np.float32)
+        W_true = (rng.normal(size=(E, d)) * 0.5).astype(np.float32)
+        margin = np.sum(W_true[ids] * X, axis=1)
+        y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-margin))).astype(np.float32)
+        loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+        cfg = OptimizerConfig(max_iterations=200, tolerance=1e-7)
+        grouping = group_by_entity(ids, num_entities=E)
+        buckets = bucket_entities(grouping)
+        feats = DenseFeatures(X=jnp.asarray(X))
+        offsets = np.zeros(n, np.float32)
+        weights = np.ones(n, np.float32)
+
+        def solve(seed):
+            # run-unique warm start (coefficient-scale noise would skip real
+            # work; 1e-3 noise keeps the full solve while defeating the
+            # relay's identical-(program, args) dedup cache)
+            prng = np.random.default_rng(seed)
+            w0 = jnp.asarray(
+                prng.normal(size=(E, d)).astype(np.float32) * 1e-3
+            )
+            res = train_random_effects(
+                feats, y, offsets, weights, buckets, E, loss, cfg,
+                l2_weight=1.0, initial_coefficients=w0,
+            )
+            W = np.asarray(res.coefficients)  # fence: materialize the result
+            return W, res
+
+        solve(1)  # compile warm-up (off- and on-knob paths alike)
+        REGISTRY.reset("re_solve.")
+        t0 = time.perf_counter()
+        _, res = solve(2)
+        dt = time.perf_counter() - t0
+        snap = REGISTRY.snapshot("re_solve.")
+
+        def counter(name):
+            return float(snap["counters"].get(name, {}).get("value", 0.0))
+
+        executed = counter("re_solve.executed_entity_iterations")
+        useful = counter("re_solve.useful_entity_iterations")
+        iters = res.iterations
+        conv_frac = float(np.mean(res.converged))
+        return {
+            "sec_solve": round(dt, 4),
+            "entity_iterations_per_sec": (
+                None if dt <= 0 else round(float(iters.sum()) / dt, 1)
+            ),
+            "iterations_max": int(iters.max()),
+            "iterations_median": float(np.median(iters)),
+            "re_executed_entity_iterations": executed,
+            "re_useful_entity_iterations": useful,
+            "re_wasted_lane_fraction": (
+                round(1.0 - useful / executed, 4) if executed > 0 else None
+            ),
+            "re_launches": counter("re_solve.launches"),
+            "re_knobs": {
+                "compact_every": int(re_mod.compact_every()),
+                "fuse_buckets": int(bool(re_mod.fuse_buckets())),
+            },
+            "converged_fraction": conv_frac,
+            "quality_ok": bool(conv_frac == 1.0),
+            "vs_one_core_proxy": None,
+            "shape": {"entities": E, "capacity": C, "d": d},
+        }
+    finally:
+        # restore: the flag must not leak into later in-process
+        # configs or tests (it flips a host-sync readback globally)
+        if prev_accounting is None:
+            os.environ.pop("PHOTON_RE_ITER_ACCOUNTING", None)
+        else:
+            os.environ["PHOTON_RE_ITER_ACCOUNTING"] = prev_accounting
+
+
 CONFIGS = {
     "headline_dense_logistic": bench_dense_logistic,
     "dense_logistic_f32": bench_dense_logistic_f32,
@@ -1412,36 +1530,35 @@ CONFIGS = {
     "E_game_glmm": bench_e_game_glmm,
     "F_streaming": bench_f_streaming,
     "G_eval_auc_scale": bench_g_eval_auc,
+    "R_re_skew": bench_r_re_skew,
 }
 
 
 def _apply_retune_env() -> None:
-    """Apply RETUNE_ENV overrides to the sparse-tiled module constants and
-    RETUNE_ENV_PREFETCH overrides to the host-ingest pipeline knobs
-    (call-time-read globals, so layout builder, kernel and prefetch
-    pipeline all track)."""
-    pending = {
-        attr: int(os.environ[var])
-        for var, attr in RETUNE_ENV.items()
-        if os.environ.get(var)
-    }
-    if pending:
-        import photon_ml_tpu.ops.sparse_tiled as st
+    """Apply the env-var retune surfaces to their module globals
+    (call-time-read, so layout builder, kernel, prefetch pipeline and
+    random-effect solve loop all track): RETUNE_ENV → sparse-tiled kernel
+    constants, RETUNE_ENV_PREFETCH → host-ingest pipeline knobs,
+    RETUNE_ENV_RE → random-effect solve knobs."""
+    import importlib
 
-        for attr, value in pending.items():
-            setattr(st, attr, value)
-        _log(f"[bench] retuned kernel constants from env: {pending}")
-    pending_pf = {
-        attr: int(os.environ[var])
-        for var, attr in RETUNE_ENV_PREFETCH.items()
-        if os.environ.get(var)
-    }
-    if pending_pf:
-        import photon_ml_tpu.ops.prefetch as pf
-
-        for attr, value in pending_pf.items():
-            setattr(pf, attr, value)
-        _log(f"[bench] retuned prefetch knobs from env: {pending_pf}")
+    surfaces = (
+        (RETUNE_ENV, "photon_ml_tpu.ops.sparse_tiled", "kernel constants"),
+        (RETUNE_ENV_PREFETCH, "photon_ml_tpu.ops.prefetch", "prefetch knobs"),
+        (RETUNE_ENV_RE, "photon_ml_tpu.game.random_effect",
+         "random-effect knobs"),
+    )
+    for env_map, module_name, label in surfaces:
+        pending = {
+            attr: int(os.environ[var])
+            for var, attr in env_map.items()
+            if os.environ.get(var)
+        }
+        if pending:
+            mod = importlib.import_module(module_name)
+            for attr, value in pending.items():
+                setattr(mod, attr, value)
+            _log(f"[bench] retuned {label} from env: {pending}")
 
 
 def _telemetry_block() -> dict:

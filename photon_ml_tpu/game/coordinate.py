@@ -528,6 +528,7 @@ class RandomEffectCoordinate:
             norm=self.normalization,
             prior_coefficients=prior_W,
             prior_variances=prior_V,
+            fusion_units=self._staged_fusion_units(),
         )
         coefficients = result.coefficients
         variances = result.variances
@@ -547,15 +548,45 @@ class RandomEffectCoordinate:
     def score(self, model: RandomEffectModel) -> Array:
         return model.score(self.batch)
 
+    def _staged_fusion_units(self):
+        """Fused launch units for this coordinate's (cached) prepared
+        buckets, staged ONCE: the eager visit loop calls ``train`` per
+        descent visit, and rebuilding the fused concatenation each time
+        would copy every static bucket tensor per visit. ``None`` when
+        fusion doesn't apply (knob off, mesh-sharded, single bucket)."""
+        from photon_ml_tpu.game.random_effect import _fusion_units, fuse_buckets
+
+        if self.mesh is not None or not fuse_buckets() or len(self._prepared) < 2:
+            return None
+        units = self.__dict__.get("_fusion_units_cache")
+        if units is None:
+            units = _fusion_units(self._prepared)
+            object.__setattr__(self, "_fusion_units_cache", units)
+        return units
+
     def _fused_visit_parts(self):
         """See ``FixedEffectCoordinate._fused_visit_parts``."""
         if self.mesh is not None:
             return None
+        from photon_ml_tpu.game.random_effect import compact_every, fuse_buckets
+
+        if compact_every() > 0:
+            # convergence-aware lane compaction (PHOTON_RE_COMPACT_EVERY)
+            # snapshots per-lane done masks on host between chunks —
+            # incompatible with tracing the whole visit into one launch;
+            # fall back to the host bucket loop where compaction applies
+            # (knob 0, the default, keeps the fused-visit path untouched)
+            return None
         _ = self._prepared  # stage bucket tensors OUTSIDE the trace
-        fn = self.__dict__.get("_visit_fn")
+        # the launch-fusion knob is baked into the visit trace — key the
+        # cached fn on it so a toggle rebuilds instead of silently reusing
+        # the old schedule (same discipline as the kernel-constant caches)
+        fuse_key = bool(fuse_buckets())
+        cached = self.__dict__.get("_visit_fn")
+        fn = cached[1] if cached is not None and cached[0] == fuse_key else None
         if fn is None:
             fn = self._build_visit_fn()
-            object.__setattr__(self, "_visit_fn", fn)
+            object.__setattr__(self, "_visit_fn", (fuse_key, fn))
         bucket_args = tuple(
             (pb.static, pb.row_idx, pb.mask, pb.ids, pb.columns)
             for pb in self._prepared

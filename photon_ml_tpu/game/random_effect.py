@@ -19,6 +19,7 @@ a sharding annotation).
 from __future__ import annotations
 
 import dataclasses
+import os
 from dataclasses import dataclass
 from functools import partial
 from typing import Any
@@ -42,6 +43,119 @@ from photon_ml_tpu.optim.common import select_minimize_fn
 from photon_ml_tpu.types import VarianceComputationType
 
 Array = jnp.ndarray
+
+# Convergence-aware bucket-solve knobs (bench RETUNE idiom: the env var
+# wins over the module global, both read at CALL time so bench child
+# processes and tests retune without import-order games).
+#
+# COMPACT_EVERY > 0 runs each bucket's batched while_loop in chunks of
+# that many outer iterations; between chunks the per-lane done mask is
+# snapshotted on host and the still-active entities are gathered into a
+# dense front (pow2-rounded so the recompile count stays O(log k)), so
+# retired lanes stop burning device iterations. 0 (default) = today's
+# single-launch schedule bit-for-bit. FUSE_BUCKETS = 1 concatenates
+# same-(C, d)-geometry buckets into one launch (amortized dispatch, and
+# a wider front for compaction to keep MXU-shaped as lanes retire).
+# Both transforms leave per-entity math untouched: results are BITWISE
+# identical to the knob-off run (asserted in tests/test_re_compaction.py).
+COMPACT_EVERY = 0  # outer iterations per chunk; 0 = single launch
+FUSE_BUCKETS = 0  # 1 = fuse same-geometry buckets into one launch
+
+
+def compact_every() -> int:
+    """``PHOTON_RE_COMPACT_EVERY`` (env > module global), 0 = off."""
+    env = os.environ.get("PHOTON_RE_COMPACT_EVERY")
+    if env is not None and env != "":
+        return max(int(env), 0)
+    return max(int(COMPACT_EVERY), 0)
+
+
+def fuse_buckets() -> bool:
+    """``PHOTON_RE_FUSE_BUCKETS`` (env > module global)."""
+    env = os.environ.get("PHOTON_RE_FUSE_BUCKETS")
+    if env is not None and env != "":
+        return int(env) != 0
+    return int(FUSE_BUCKETS) != 0
+
+
+def _iter_accounting_enabled() -> bool:
+    """Whether single-launch solves read back per-lane iteration counts
+    for the ``re_solve.*`` executed/useful counters. That readback is a
+    host sync the deferred-diagnostics design otherwise avoids, so it is
+    opt-in: on when a telemetry sink is active (observability runs accept
+    the sync) or when ``PHOTON_RE_ITER_ACCOUNTING=1`` (bench R_re_skew);
+    ``=0`` forces it off. The compacted path always counts — it syncs
+    the done mask between chunks anyway."""
+    env = os.environ.get("PHOTON_RE_ITER_ACCOUNTING")
+    if env is not None and env != "":
+        return int(env) != 0  # same strict parse as the sibling knobs
+    from photon_ml_tpu.obs import sink
+
+    return sink.is_active()
+
+
+def _account_single_launch_host(it: np.ndarray, lanes: int) -> None:
+    """Registry update for one single-launch bucket solve from already-
+    materialized per-lane iteration counts: every lane executes the batched
+    loop until the SLOWEST lane converges, so executed = lanes × max(it)
+    and useful = Σ it."""
+    from photon_ml_tpu.obs.metrics import REGISTRY
+
+    it = np.asarray(it).astype(np.int64)
+    trips = int(it.max()) if it.size else 0
+    executed = trips * int(lanes)
+    REGISTRY.counter_inc("re_solve.executed_entity_iterations", float(executed))
+    REGISTRY.counter_inc("re_solve.useful_entity_iterations", float(it.sum()))
+    if executed:
+        REGISTRY.gauge_set(
+            "re_solve.active_lane_fraction", float(it.sum()) / float(executed)
+        )
+
+
+def _account_single_launch(it_lane: Array, lanes: int) -> None:
+    """Inline (blocking) accounting for one single-launch bucket solve —
+    a one-shot defer-and-flush so the gating rules (launch counter,
+    opt-in check, multihost-addressability skip) live in exactly one
+    place, ``_DeferredLaunchAccounting.add``."""
+    acct = _DeferredLaunchAccounting()
+    acct.add(it_lane, lanes)
+    acct.flush()
+
+
+class _DeferredLaunchAccounting:
+    """Single-launch accounting that never syncs inside an enqueue loop.
+
+    ``add`` bumps the launch counter immediately (no readback) and stashes
+    the per-lane iteration array; ``flush`` fetches every stashed array in
+    ONE ``jax.device_get`` — by flush time the caller has already blocked
+    on the final solve, so the fetch costs one round-trip of tiny arrays
+    instead of a per-bucket pipeline stall (the dispatch loops' no-host-
+    sync-between-buckets invariant holds even with a telemetry sink on)."""
+
+    def __init__(self) -> None:
+        self._pending: list[tuple[Array, int]] = []
+
+    def add(self, it_lane: Array, lanes: int) -> None:
+        from photon_ml_tpu.obs.metrics import REGISTRY
+
+        REGISTRY.counter_inc("re_solve.launches")
+        if not _iter_accounting_enabled():
+            return
+        if isinstance(it_lane, jax.Array) and not it_lane.is_fully_addressable:
+            return  # multihost shard: per-process accounting double counts
+        self._pending.append((it_lane, int(lanes)))
+
+    def flush(self) -> None:
+        if not self._pending:
+            return
+        its = jax.device_get([it for it, _ in self._pending])
+        for it, (_, lanes) in zip(its, self._pending):
+            _account_single_launch_host(it, lanes)
+        self._pending.clear()
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
 
 
 @dataclass(frozen=True)
@@ -81,10 +195,22 @@ class RandomEffectTrainingResult:
             loss_values = np.full((self.num_entities,), np.nan, np.float64)
             iterations = np.zeros((self.num_entities,), np.int64)
             converged = np.zeros((self.num_entities,), bool)
-            for ent_ids, f_b, it_b, reason_b in self.diag_refs:
-                loss_values[ent_ids] = _to_host(f_b).astype(np.float64)
-                iterations[ent_ids] = _to_host(it_b)
-                converged[ent_ids] = _to_host(reason_b) != 0  # != MAX_ITERATIONS
+            # ALL buckets' refs fetch in ONE jax.device_get of the nested
+            # list (one transfer round-trip instead of 3 serial pulls per
+            # bucket); only non-fully-addressable (multihost) arrays fall
+            # back to the per-array allgather path
+            refs = [(f_b, it_b, r_b) for _, f_b, it_b, r_b in self.diag_refs]
+            if any(
+                isinstance(x, jax.Array) and not x.is_fully_addressable
+                for t in refs for x in t
+            ):
+                host = [tuple(_to_host(x) for x in t) for t in refs]
+            else:
+                host = jax.device_get(refs)
+            for (ent_ids, *_), (f_h, it_h, reason_h) in zip(self.diag_refs, host):
+                loss_values[ent_ids] = np.asarray(f_h).astype(np.float64)
+                iterations[ent_ids] = np.asarray(it_h)
+                converged[ent_ids] = np.asarray(reason_h) != 0  # != MAX_ITERATIONS
             cached = (loss_values, iterations, converged)
             object.__setattr__(self, "_diag_cache", cached)
         return cached
@@ -280,6 +406,404 @@ def _solve_bucket(
     )
 
 
+# ---------------------------------------------------------------------------
+# Convergence-aware lane compaction (PHOTON_RE_COMPACT_EVERY)
+# ---------------------------------------------------------------------------
+# The single-launch ``_solve_bucket`` runs every lane until the SLOWEST
+# entity converges. The compacted twin runs the same batched loop in
+# host-driven chunks through the solvers' chunked entry points
+# (``optim.common.select_chunked_solver``): after each chunk the per-lane
+# done mask is read back, converged lanes' solver state is committed to a
+# full-size accumulator in original lane order, and the still-active
+# entities (batch tensors, priors, solver state) are gathered into a
+# dense pow2-rounded front for the next chunk. Per-lane math is
+# untouched — a vmapped while_loop freezes done lanes via select either
+# way — so final weights and diagnostics are BITWISE identical to the
+# single launch; only the wasted lockstep iterations disappear.
+
+
+def _lane_objective(batch, loss, l2_weight, norm, intercept_index, mu_e, var_e):
+    """One entity lane's objective — EXACTLY ``_solve_bucket.solve_one``'s
+    construction, shared by the chunked init/run/finalize programs."""
+    from photon_ml_tpu.ops.glm import GaussianPrior
+
+    prior = None
+    if mu_e is not None:
+        prior = GaussianPrior(means=mu_e, variances=var_e)
+    return make_objective(
+        batch, loss, l2_weight=l2_weight, norm=norm,
+        intercept_index=intercept_index, prior=prior,
+    )
+
+
+def _prior_axes(prior_mu, prior_var):
+    return (None if prior_mu is None else 0, None if prior_var is None else 0)
+
+
+@partial(jax.jit, static_argnames=("init_fn", "loss", "config", "intercept_index"))
+def _lanes_init(
+    bucket_batch, w0, l2_weight, norm, prior_mu, prior_var, *,
+    init_fn, loss, config, intercept_index, **extra,
+):
+    def one(batch, w0_e, mu_e, var_e):
+        obj = _lane_objective(
+            batch, loss, l2_weight, norm, intercept_index, mu_e, var_e
+        )
+        return init_fn(obj, w0_e, config, **extra)
+
+    in_axes = (0, 0) + _prior_axes(prior_mu, prior_var)
+    return jax.vmap(one, in_axes=in_axes)(bucket_batch, w0, prior_mu, prior_var)
+
+
+@partial(jax.jit, static_argnames=("run_fn", "loss", "config", "intercept_index"))
+def _lanes_run(
+    bucket_batch, state, it_bound, l2_weight, norm, prior_mu, prior_var, *,
+    run_fn, loss, config, intercept_index, **extra,
+):
+    def one(batch, st, mu_e, var_e):
+        obj = _lane_objective(
+            batch, loss, l2_weight, norm, intercept_index, mu_e, var_e
+        )
+        return run_fn(obj, st, config, it_bound, **extra)
+
+    in_axes = (0, 0) + _prior_axes(prior_mu, prior_var)
+    return jax.vmap(one, in_axes=in_axes)(
+        bucket_batch, state, prior_mu, prior_var
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "fin_fn", "loss", "config", "intercept_index", "variance_computation"
+    ),
+)
+def _lanes_finalize(
+    bucket_batch, state, l2_weight, norm, prior_mu, prior_var, *,
+    fin_fn, loss, config, intercept_index, variance_computation, **extra,
+):
+    from photon_ml_tpu.ops.glm import compute_variances
+
+    def one(batch, st, mu_e, var_e):
+        obj = _lane_objective(
+            batch, loss, l2_weight, norm, intercept_index, mu_e, var_e
+        )
+        res = fin_fn(st)
+        var = compute_variances(obj, res.w, variance_computation)
+        if var is None:
+            var = jnp.zeros_like(res.w)
+        return res.w, res.value, res.iterations, res.reason, var
+
+    in_axes = (0, 0) + _prior_axes(prior_mu, prior_var)
+    return jax.vmap(one, in_axes=in_axes)(
+        bucket_batch, state, prior_mu, prior_var
+    )
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (int(n) - 1).bit_length()
+
+
+def _solve_bucket_compacted(
+    bucket_batch: Batch,
+    w0: Array,
+    l2_weight: Array,
+    norm: Any,
+    prior_mu: Array | None,
+    prior_var: Array | None,
+    *,
+    chunked: Any,  # optim.common.ChunkedSolver
+    loss: PointwiseLoss,
+    config: OptimizerConfig,
+    intercept_index: int | None,
+    variance_computation: VarianceComputationType,
+    compact_every_n: int,
+    **minimize_kwargs,
+):
+    """Host-driven compacted twin of ``_solve_bucket``: same argument
+    shapes, same ``(w, f, it, reason, var)`` output, BITWISE-identical
+    values — only the launch schedule differs (init + one launch per
+    chunk on a shrinking dense front + finalize, instead of one launch
+    total). Requires fully-addressable lanes (no mesh sharding — callers
+    gate on ``sharding is None``)."""
+    from photon_ml_tpu.obs.metrics import REGISTRY
+
+    k = int(bucket_batch.labels.shape[0])
+    T = int(config.max_iterations)
+    step = max(int(compact_every_n), 1)
+    common = dict(loss=loss, config=config, intercept_index=intercept_index)
+
+    full_state = _lanes_init(
+        bucket_batch, w0, l2_weight, norm, prior_mu, prior_var,
+        init_fn=chunked.init, **common, **minimize_kwargs,
+    )
+    REGISTRY.counter_inc("re_solve.launches")
+
+    state = full_state
+    front_batch, front_mu, front_var = bucket_batch, prior_mu, prior_var
+    slots = np.arange(k, dtype=np.int64)  # original slot of each REAL front lane
+    n_real = k
+    compacted = False
+    it_prev = np.zeros(k, np.int64)
+    executed_total = 0
+    useful_total = 0
+    bound = 0
+    while True:
+        bound = min(bound + step, T)
+        state = _lanes_run(
+            front_batch, state, jnp.int32(bound), l2_weight, norm,
+            front_mu, front_var, run_fn=chunked.run, **common,
+            **minimize_kwargs,
+        )
+        REGISTRY.counter_inc("re_solve.launches")
+        # the between-chunk host sync IS the design: the done snapshot
+        # buys dropping retired lanes from every later chunk
+        done_f, it_f = jax.device_get((state.done, state.it))
+        front_lanes = int(np.asarray(done_f).shape[0])  # incl. pow2 padding
+        done_f = np.asarray(done_f)[:n_real]
+        it_f = np.asarray(it_f)[:n_real].astype(np.int64)
+        delta = it_f - it_prev[slots]
+        trips = int(delta.max()) if delta.size else 0
+        executed_total += trips * front_lanes
+        useful_total += int(delta.sum())
+        REGISTRY.counter_inc(
+            "re_solve.executed_entity_iterations", float(trips * front_lanes)
+        )
+        REGISTRY.counter_inc(
+            "re_solve.useful_entity_iterations", float(delta.sum())
+        )
+        it_prev[slots] = it_f
+        active = np.flatnonzero(~done_f)
+        exit_loop = active.size == 0 or bound >= T
+        if not exit_loop:
+            # prospective packed-front size: pow2 bounds the distinct
+            # front shapes — and thus recompiles — at O(log k), capped at
+            # the current front so compaction never runs more lanes than
+            # the schedule it replaces; never 1 lane for a multi-lane
+            # bucket — XLA lowers batch-1 programs down a different
+            # (squeezed) path whose per-lane arithmetic is NOT bitwise-
+            # stable against the batched lowering (measured on CPU,
+            # tests/test_re_compaction.py)
+            front_n = _next_pow2(int(active.size))
+            if k > 1:
+                front_n = max(front_n, 2)
+            front_n = min(front_n, front_lanes)
+            if front_n == front_lanes:
+                # the front cannot shrink (nothing retired, or the pow2
+                # rounding lands on the same size): keep it — a re-gather
+                # would copy every batch/state tensor just to run the
+                # same lane count
+                continue
+        # commit the front's real lanes back into original slot order —
+        # deferred to the chunks that actually read full_state (a shrink
+        # gathers from it, the exit finalizes it); done lanes are frozen
+        # by the while_loop select, so the deferred scatter commits the
+        # same values every intermediate commit would have
+        if not compacted:
+            full_state = state
+        else:
+            slot_dev = jnp.asarray(slots, jnp.int32)
+            full_state = jax.tree.map(
+                lambda A, B: A.at[slot_dev].set(B[:n_real]), full_state, state
+            )
+        if exit_loop:
+            break
+        # gather the still-active entities into the smaller dense front;
+        # padding lanes replay lane 0's data but are marked done, so the
+        # while_loop select freezes them at zero extra trips
+        orig_active = slots[active]
+        n_real = int(orig_active.size)
+        pad = front_n - n_real
+        gather = (
+            np.concatenate([orig_active, np.repeat(orig_active[:1], pad)])
+            if pad else orig_active
+        )
+        gidx = jnp.asarray(gather, jnp.int32)
+        state = jax.tree.map(lambda a: a[gidx], full_state)
+        if pad:
+            state = state._replace(done=state.done.at[n_real:].set(True))
+        front_batch = jax.tree.map(lambda a: a[gidx], bucket_batch)
+        front_mu = None if prior_mu is None else prior_mu[gidx]
+        front_var = None if prior_var is None else prior_var[gidx]
+        slots = orig_active
+        compacted = True
+
+    # gauge contract (shared with _account_single_launch): the solve's
+    # whole-run useful/executed average, so knob-on and knob-off JSONL
+    # snapshots compare like for like
+    if executed_total:
+        REGISTRY.gauge_set(
+            "re_solve.active_lane_fraction",
+            float(useful_total) / float(executed_total),
+        )
+    REGISTRY.counter_inc("re_solve.launches")
+    return _lanes_finalize(
+        bucket_batch, full_state, l2_weight, norm, prior_mu, prior_var,
+        fin_fn=chunked.finalize, variance_computation=variance_computation,
+        **common, **minimize_kwargs,
+    )
+
+
+def solve_bucket_lanes(
+    bucket_batch: Batch,
+    w0: Array,
+    l2_weight: Array,
+    norm: Any,
+    prior_mu: Array | None,
+    prior_var: Array | None,
+    *,
+    minimize_fn: Any,
+    loss: PointwiseLoss,
+    config: OptimizerConfig,
+    intercept_index: int | None,
+    variance_computation: VarianceComputationType,
+    accounting: "_DeferredLaunchAccounting | None" = None,
+    **minimize_kwargs,
+):
+    """THE bucket-solve entry point for eager (host-driven) callers — the
+    streamed trainer and any direct consumer. ``PHOTON_RE_COMPACT_EVERY=0``
+    (default) dispatches to ``_solve_bucket`` with identical arguments:
+    today's single-launch schedule bit-for-bit. A positive knob routes
+    through the compacted chunk schedule (bitwise-identical results).
+
+    ``accounting`` defers the single-launch iteration readback (a pipeline
+    stall for callers that overlap bucket dispatches) to the caller's
+    ``flush()``; the compacted schedule ignores it — its accounting rides
+    the between-chunk syncs it performs anyway."""
+    ce = compact_every()
+    chunked = None
+    if ce > 0:
+        from photon_ml_tpu.optim.common import select_chunked_solver
+
+        chunked, _ = select_chunked_solver(
+            config, minimize_kwargs.get("l1_weight", 0.0)
+        )
+    if chunked is None:
+        out = _solve_bucket(
+            bucket_batch,
+            w0,
+            l2_weight,
+            norm,
+            prior_mu,
+            prior_var,
+            minimize_fn=minimize_fn,
+            loss=loss,
+            config=config,
+            intercept_index=intercept_index,
+            variance_computation=variance_computation,
+            **minimize_kwargs,
+        )
+        lanes = int(bucket_batch.labels.shape[0])
+        if accounting is not None:
+            accounting.add(out[2], lanes)
+        else:
+            _account_single_launch(out[2], lanes)
+        return out
+    return _solve_bucket_compacted(
+        bucket_batch,
+        w0,
+        l2_weight,
+        norm,
+        prior_mu,
+        prior_var,
+        chunked=chunked,
+        loss=loss,
+        config=config,
+        intercept_index=intercept_index,
+        variance_computation=variance_computation,
+        compact_every_n=ce,
+        **minimize_kwargs,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Same-geometry launch fusion (PHOTON_RE_FUSE_BUCKETS)
+# ---------------------------------------------------------------------------
+
+
+def _bucket_geometry(pb: PreparedBucket):
+    """The (C, d) compile key ``_solve_bucket`` already specializes on:
+    buckets with equal keys share an executable, so concatenating their
+    entity lanes into one launch changes dispatch count, not math."""
+    static_leaves = tuple(
+        (a.shape[1:], str(a.dtype)) for a in jax.tree.leaves(pb.static)
+    )
+    return (
+        jax.tree.structure(pb.static),
+        static_leaves,
+        pb.row_idx.shape[1:],
+        None if pb.columns is None else pb.columns.shape[1],
+    )
+
+
+def plan_fusion_groups(
+    keys: list, lanes: list[int]
+) -> list[tuple[list[int], list[tuple[int, int, int]]]]:
+    """Shared fusion bookkeeping for BOTH fusion sites (the in-memory
+    ``_fusion_units`` and the streamed ``_solve_re_buckets`` grouping):
+    ordered group-by-key with per-member ``(index, lo, hi)`` lane ranges.
+    Returns ``(idxs, members)`` per launch unit, first-seen key order.
+
+    Buckets with fewer than 2 lanes NEVER fuse — they stay standalone
+    units: XLA lowers batch-1 programs down a different (squeezed) path
+    whose per-lane arithmetic is not bitwise-stable against the batched
+    lowering (the same measured caveat the compaction path guards with
+    its min-2 front), so merging a 1-lane bucket into a batched launch
+    would change its results vs the knob-off schedule."""
+    groups: dict[Any, list[int]] = {}
+    for i, key in enumerate(keys):
+        if lanes[i] < 2:
+            key = ("__solo__", i)
+        groups.setdefault(key, []).append(i)
+    plan: list[tuple[list[int], list[tuple[int, int, int]]]] = []
+    for idxs in groups.values():
+        members: list[tuple[int, int, int]] = []
+        lo = 0
+        for i in idxs:
+            members.append((i, lo, lo + lanes[i]))
+            lo = members[-1][2]
+        plan.append((idxs, members))
+    return plan
+
+
+def _fusion_units(
+    prepared: list[PreparedBucket],
+) -> list[tuple[PreparedBucket, list[tuple[int, int, int]]]]:
+    """Group same-geometry buckets into fused launch units. Returns
+    ``(fused_bucket, members)`` pairs where ``members`` lists each
+    original bucket's ``(index, lo, hi)`` lane range in the fused order —
+    the diag-refs bookkeeping is remapped through exactly this
+    permutation. Entity ids partition across buckets, so the fused
+    scatter into the (E, d) matrix touches the same disjoint rows in any
+    order; single-member units pass through untouched. Callers gate on
+    ``sharding is None`` (concatenation would break mesh lane padding)."""
+    plan = plan_fusion_groups(
+        [_bucket_geometry(pb) for pb in prepared],
+        [pb.num_real for pb in prepared],
+    )
+    units: list[tuple[PreparedBucket, list[tuple[int, int, int]]]] = []
+    for idxs, members in plan:
+        if len(idxs) == 1:
+            units.append((prepared[idxs[0]], members))
+            continue
+        lo = members[-1][2]
+        cat = lambda *xs: jnp.concatenate(xs, axis=0)
+        fused = PreparedBucket(
+            entity_ids=np.concatenate([prepared[i].entity_ids for i in idxs]),
+            ids=cat(*(prepared[i].ids for i in idxs)),
+            static=jax.tree.map(cat, *(prepared[i].static for i in idxs)),
+            row_idx=cat(*(prepared[i].row_idx for i in idxs)),
+            mask=cat(*(prepared[i].mask for i in idxs)),
+            num_real=lo,
+            columns=(
+                None if prepared[idxs[0]].columns is None
+                else cat(*(prepared[i].columns for i in idxs))
+            ),
+        )
+        units.append((fused, members))
+    return units
+
+
 def train_random_effects(
     features: Features,
     labels: np.ndarray,
@@ -345,10 +869,17 @@ def train_prepared(
     norm: Any = None,  # NormalizationContext | None (shared by all entities)
     prior_coefficients: Array | None = None,  # (E, d) per-entity MAP prior means
     prior_variances: Array | None = None,  # (E, d) per-entity prior variances
+    fusion_units: list | None = None,  # precomputed _fusion_units(prepared)
 ) -> RandomEffectTrainingResult:
     """Solve every prepared bucket against the current offsets. Only the
     offsets are gathered per call (on device); everything else was staged by
     ``prepare_buckets``.
+
+    ``fusion_units`` lets a caller that solves the SAME prepared list
+    repeatedly (the eager coordinate-descent visit loop) stage the fused
+    concatenation once instead of re-concatenating every bucket tensor
+    per call; it must be ``_fusion_units(prepared)`` for this exact list
+    and is only consulted when the fuse knob is on.
 
     ``norm`` applies the shard's normalization inside every entity's
     objective (coefficients are mapped back to the original feature space
@@ -373,6 +904,7 @@ def train_prepared(
         norm=norm,
         prior_coefficients=prior_coefficients,
         prior_variances=prior_variances,
+        fusion_units=fusion_units,
     )
     diag_refs = tuple(
         (pb.entity_ids, f_k, it_k, reason_k)
@@ -403,6 +935,7 @@ def _train_prepared_core(
     norm: Any = None,
     prior_coefficients: Array | None = None,
     prior_variances: Array | None = None,
+    fusion_units: list | None = None,
 ) -> tuple[Array, Array | None, list[tuple]]:
     """Pure computational core of ``train_prepared``: jax ops only (also
     traceable inside a caller's fused-visit jit), returning the coefficient
@@ -447,33 +980,90 @@ def _train_prepared_core(
     # per-bucket diagnostics stay ON DEVICE — materialized lazily by the
     # result object on first access, so a descent visit that nobody
     # inspects costs ZERO host syncs (VERDICT weak #2)
-    diag: list[tuple[Array, Array, Array]] = []
+    #
+    # Launch planning: same-geometry buckets fuse into one launch under
+    # PHOTON_RE_FUSE_BUCKETS (traceable — works inside the fused-visit
+    # jit too), and PHOTON_RE_COMPACT_EVERY > 0 routes each launch
+    # through the host-driven compacted chunk schedule (eager callers
+    # only: compaction snapshots the done mask between chunks). Both
+    # knobs off ⇒ the classic one-``_bucket_step``-per-bucket loop,
+    # bit-for-bit. Mesh-sharded lanes keep the classic schedule (both
+    # transforms would break the even lane partition).
+    eager = not _is_tracer(offsets)
+    chunked = None
+    ce = compact_every()
+    if ce > 0 and eager and sharding is None:
+        from photon_ml_tpu.optim.common import select_chunked_solver
 
-    for pb in prepared:
-        W, V, f_k, it_k, reason_k = _bucket_step(
-            W,
-            V,
-            offsets,
-            pb.static,
-            pb.row_idx,
-            pb.mask,
-            pb.ids,
-            pb.columns,
-            l2,
-            norm,
-            prior_mu,
-            prior_var,
-            minimize_fn=minimize_fn,
-            loss=loss,
-            config=config,
-            intercept_index=intercept_index,
-            variance_computation=variance_computation,
-            k=pb.num_real,
-            sharding=sharding,
-            **extra,
-        )
-        diag.append((f_k, it_k, reason_k))
+        chunked, _ = select_chunked_solver(config, l1_weight)
+    fused = fuse_buckets() and sharding is None and len(prepared) > 1
+    if fused:
+        units = fusion_units if fusion_units is not None else _fusion_units(prepared)
+    else:
+        units = [(pb, [(i, 0, pb.num_real)]) for i, pb in enumerate(prepared)]
+    diag: list[tuple[Array, Array, Array]] = [None] * len(prepared)
+    accounting = _DeferredLaunchAccounting()
 
+    for pb, members in units:
+        if chunked is not None:
+            W, V, f_k, it_k, reason_k = _bucket_step_compacted(
+                W,
+                V,
+                offsets,
+                pb.static,
+                pb.row_idx,
+                pb.mask,
+                pb.ids,
+                pb.columns,
+                l2,
+                norm,
+                prior_mu,
+                prior_var,
+                chunked=chunked,
+                loss=loss,
+                config=config,
+                intercept_index=intercept_index,
+                variance_computation=variance_computation,
+                k=pb.num_real,
+                compact_every_n=ce,
+                **extra,
+            )
+        else:
+            W, V, f_k, it_k, reason_k = _bucket_step(
+                W,
+                V,
+                offsets,
+                pb.static,
+                pb.row_idx,
+                pb.mask,
+                pb.ids,
+                pb.columns,
+                l2,
+                norm,
+                prior_mu,
+                prior_var,
+                minimize_fn=minimize_fn,
+                loss=loss,
+                config=config,
+                intercept_index=intercept_index,
+                variance_computation=variance_computation,
+                k=pb.num_real,
+                sharding=sharding,
+                **extra,
+            )
+            if eager:
+                # deferred: the loop's no-host-sync-between-buckets
+                # invariant (the donate comment on _bucket_step) must
+                # survive an active telemetry sink
+                accounting.add(it_k, lanes=int(pb.static.labels.shape[0]))
+        total = pb.num_real
+        for orig_i, lo, hi in members:
+            if lo == 0 and hi == total:
+                diag[orig_i] = (f_k, it_k, reason_k)  # unfused: no re-slice
+            else:
+                diag[orig_i] = (f_k[lo:hi], it_k[lo:hi], reason_k[lo:hi])
+
+    accounting.flush()  # one batched readback, after every bucket enqueued
     if norm is not None:
         # back to the ORIGINAL feature space (W was held in normalized space
         # throughout so per-bucket warm starts stayed consistent)
@@ -483,6 +1073,46 @@ def _train_prepared_core(
             V = norm.factors**2 * V
 
     return W, V, diag
+
+
+def _extract_lanes(M, ids, columns, k, k_pad, d, pad_value=0.0, sharding=None):
+    """Extract, pad, project, and (optionally) shard one bucket's rows of
+    an (E, d) matrix — the warm-start/prior lane convention. SHARED by the
+    fused ``_bucket_step`` and the chunked-compaction twin ``_lane_prologue``
+    so the pad/project rules (including the unit prior-variance pad) cannot
+    drift between the schedules and break their bitwise-parity contract."""
+    if M is None:
+        return None
+    rows = M[ids]
+    if k_pad != k:
+        rows = jnp.concatenate(
+            [rows, jnp.full((k_pad - k, d), pad_value, rows.dtype)]
+        )
+    if columns is not None:
+        rows = jnp.take_along_axis(rows, columns, axis=1)
+    if sharding is not None:
+        rows = jax.lax.with_sharding_constraint(rows, sharding)
+    return rows
+
+
+def _scatter_lanes(W, V, ids, columns, w_b, var_b, k):
+    """Scatter a solved bucket's lanes back into the (E, d) matrices —
+    the zero-then-scatter subspace epilogue, SHARED by ``_bucket_step``
+    and ``_lane_scatter`` (same drift guard as ``_extract_lanes``)."""
+    if columns is not None:
+        cols = columns[:k]
+        # coefficients outside an entity's subspace are 0 (reference:
+        # projected training never touches them)
+        W = W.at[ids].set(0.0)
+        W = W.at[ids[:, None], cols].set(w_b[:k])
+        if V is not None:
+            V = V.at[ids].set(0.0)
+            V = V.at[ids[:, None], cols].set(var_b[:k])
+    else:
+        W = W.at[ids].set(w_b[:k])
+        if V is not None:
+            V = V.at[ids].set(var_b[:k])
+    return W, V
 
 
 @partial(
@@ -530,20 +1160,7 @@ def _bucket_step(
     k_pad = static_batch.labels.shape[0]
 
     def lane(M, pad_value=0.0):
-        """Extract, pad, project, and shard this bucket's rows of an (E, d)
-        matrix the same way as the warm-start lane."""
-        if M is None:
-            return None
-        rows = M[ids]
-        if k_pad != k:
-            rows = jnp.concatenate(
-                [rows, jnp.full((k_pad - k, d), pad_value, rows.dtype)]
-            )
-        if columns is not None:
-            rows = jnp.take_along_axis(rows, columns, axis=1)
-        if sharding is not None:
-            rows = jax.lax.with_sharding_constraint(rows, sharding)
-        return rows
+        return _extract_lanes(M, ids, columns, k, k_pad, d, pad_value, sharding)
 
     w0 = lane(W)
     solve_intercept = intercept_index
@@ -568,19 +1185,89 @@ def _bucket_step(
         variance_computation=variance_computation,
         **minimize_kwargs,
     )
-    if columns is not None:
-        cols = columns[:k]
-        # coefficients outside an entity's subspace are 0 (reference:
-        # projected training never touches them)
-        W = W.at[ids].set(0.0)
-        W = W.at[ids[:, None], cols].set(w_b[:k])
-        if V is not None:
-            V = V.at[ids].set(0.0)
-            V = V.at[ids[:, None], cols].set(var_b[:k])
-    else:
-        W = W.at[ids].set(w_b[:k])
-        if V is not None:
-            V = V.at[ids].set(var_b[:k])
+    W, V = _scatter_lanes(W, V, ids, columns, w_b, var_b, k)
+    return W, V, f_b[:k], it_b[:k], reason_b[:k]
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _lane_prologue(
+    W, offsets, static_batch, row_idx, mask, ids, columns, prior_mu, prior_var,
+    *, k,
+):
+    """Eager-path twin of ``_bucket_step``'s prologue (offset gather +
+    warm-start/prior lane extraction), as its own compiled program so the
+    host-driven compaction loop pays one dispatch, not ~6. Same ops as
+    the fused prologue with ``sharding=None`` — identical values."""
+    d = W.shape[1]
+    off_b = offsets[row_idx] * mask
+    bucket_batch = dataclasses.replace(static_batch, offsets=off_b)
+    k_pad = static_batch.labels.shape[0]
+
+    def lane(M, pad_value=0.0):
+        return _extract_lanes(M, ids, columns, k, k_pad, d, pad_value)
+
+    return bucket_batch, lane(W), lane(prior_mu), lane(prior_var, pad_value=1.0)
+
+
+# W/V donation: same O(1)-coefficient-copies HBM discipline as _bucket_step —
+# the compacted caller rebinds both, so holding the old (E, d) buffers alive
+# through the scatter would double peak coefficient memory versus knob-off
+@partial(jax.jit, static_argnames=("k",), donate_argnums=(0, 1))
+def _lane_scatter(W, V, ids, columns, w_b, var_b, *, k):
+    """Eager-path twin of ``_bucket_step``'s (E, d) scatter epilogue."""
+    return _scatter_lanes(W, V, ids, columns, w_b, var_b, k)
+
+
+def _bucket_step_compacted(
+    W: Array,
+    V: Array | None,
+    offsets: Array,
+    static_batch: Batch,
+    row_idx: Array,
+    mask: Array,
+    ids: Array,
+    columns: Array | None,
+    l2_weight: Array,
+    norm: Any,
+    prior_mu: Array | None,
+    prior_var: Array | None,
+    *,
+    chunked: Any,
+    loss: PointwiseLoss,
+    config: OptimizerConfig,
+    intercept_index: int | None,
+    variance_computation: VarianceComputationType,
+    k: int,
+    compact_every_n: int,
+    **minimize_kwargs,
+):
+    """``_bucket_step``'s host-driven compacted twin: identical math and
+    outputs, but the solve runs through ``_solve_bucket_compacted``'s
+    chunked schedule (which needs the host between launches, so the whole
+    step cannot live inside one jit). Eager, unsharded callers only."""
+    bucket_batch, w0, mu_l, var_l = _lane_prologue(
+        W, offsets, static_batch, row_idx, mask, ids, columns,
+        prior_mu, prior_var, k=k,
+    )
+    solve_intercept = intercept_index
+    if columns is not None and intercept_index is not None:
+        solve_intercept = columns.shape[1] - 1
+    w_b, f_b, it_b, reason_b, var_b = _solve_bucket_compacted(
+        bucket_batch,
+        w0,
+        l2_weight,
+        norm,
+        mu_l,
+        var_l,
+        chunked=chunked,
+        loss=loss,
+        config=config,
+        intercept_index=solve_intercept,
+        variance_computation=variance_computation,
+        compact_every_n=compact_every_n,
+        **minimize_kwargs,
+    )
+    W, V = _lane_scatter(W, V, ids, columns, w_b, var_b, k=k)
     return W, V, f_b[:k], it_b[:k], reason_b[:k]
 
 

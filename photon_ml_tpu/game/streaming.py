@@ -83,7 +83,11 @@ from photon_ml_tpu.game.data import (
     group_by_entity,
 )
 from photon_ml_tpu.game.models import FixedEffectModel, GameModel, RandomEffectModel
-from photon_ml_tpu.game.random_effect import _solve_bucket
+from photon_ml_tpu.game.random_effect import (
+    _DeferredLaunchAccounting,
+    fuse_buckets as _re_fuse_buckets,
+    solve_bucket_lanes,
+)
 from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_ml_tpu.obs import REGISTRY, emit_event, span
 from photon_ml_tpu.ops.losses import loss_for_task
@@ -912,7 +916,15 @@ class StreamedGameTrainer:
         uploads only width-p features, and solved rows scatter back to
         full width with unselected columns ZERO — matching the in-memory
         scatter into a fresh matrix. Returns honest aggregates (loss sum,
-        max iterations, all converged)."""
+        max iterations, all converged).
+
+        ``PHOTON_RE_FUSE_BUCKETS`` concatenates same-geometry buckets
+        into one launch unit, and each unit's solve dispatches through
+        ``solve_bucket_lanes`` (``PHOTON_RE_COMPACT_EVERY`` routes it
+        through the convergence-aware compacted chunk schedule). Both
+        knobs change the launch schedule only — W/V, the aggregates and
+        the per-bucket loss accumulation order are bitwise identical to
+        the knob-off run (asserted in tests/test_re_compaction.py)."""
         loss = loss_for_task(self.config.task_type)
         l1 = opt.regularization.l1_weight(opt.regularization_weight)
         l2 = jnp.asarray(
@@ -923,14 +935,15 @@ class StreamedGameTrainer:
             self.config.variance_computation if V is not None
             else VarianceComputationType.NONE
         )
-        loss_sum = 0.0
         max_iters = 0
         all_converged = True
         any_entities = False
-        pending: tuple[np.ndarray, tuple] | None = None
+        bucket_loss: dict[int, float] = {}
+        pending: tuple[list, np.ndarray, tuple, tuple] | None = None
+        accounting = _DeferredLaunchAccounting()
 
-        def collect(ent_ids, cols, out):
-            nonlocal loss_sum, max_iters, all_converged
+        def collect(members, ent_ids, cols, out):
+            nonlocal max_iters, all_converged
             w_b, f_b, it_b, reason_b, var_b = out
             if norm is not None:
                 w_b = jax.vmap(lambda w: norm.model_to_original_space(w)[0])(w_b)
@@ -950,7 +963,12 @@ class StreamedGameTrainer:
                 W[ent_ids] = np.asarray(w_b, np.float32)
                 if V is not None:
                     V[ent_ids] = np.asarray(var_b, np.float32)
-            loss_sum += float(jnp.sum(f_b))
+            # per-ORIGINAL-bucket loss pieces, summed at the end in original
+            # bucket order — launch fusion must not perturb the float
+            # accumulation order of the returned aggregate
+            for orig_i, lo, hi in members:
+                piece = f_b if (lo == 0 and hi == len(ent_ids)) else f_b[lo:hi]
+                bucket_loss[orig_i] = float(jnp.sum(piece))
             max_iters = max(max_iters, int(jnp.max(it_b)))
             # reason 0 == MAX_ITERATIONS (not converged)
             all_converged = all_converged and bool(jnp.all(reason_b != 0))
@@ -960,6 +978,45 @@ class StreamedGameTrainer:
         bucket_args = list(
             zip(buckets.entity_ids, buckets.row_indices, sub_cols)
         )
+        # PHOTON_RE_FUSE_BUCKETS: same-(C, p)-geometry buckets concatenate
+        # along the entity lane into ONE launch unit (the gather below then
+        # uploads one fused batch); results split back per original bucket
+        # in collect(). Knob off (default): one unit per bucket, the
+        # classic schedule bit-for-bit.
+        units: list[tuple[list[tuple[int, int, int]], tuple]] = []
+        if _re_fuse_buckets() and len(bucket_args) > 1:
+            from photon_ml_tpu.game.random_effect import plan_fusion_groups
+
+            plan = plan_fusion_groups(
+                [
+                    (
+                        rows_i.shape[1],
+                        None if cols_i is None else cols_i.shape[1],
+                    )
+                    for _, rows_i, cols_i in bucket_args
+                ],
+                [len(ent) for ent, _, _ in bucket_args],
+            )
+            for idxs, members in plan:
+                if len(idxs) == 1:
+                    units.append((members, bucket_args[idxs[0]]))
+                    continue
+                ent = np.concatenate([bucket_args[i][0] for i in idxs])
+                rows = np.concatenate(
+                    [bucket_args[i][1] for i in idxs], axis=0
+                )
+                cols = (
+                    None if bucket_args[idxs[0]][2] is None
+                    else np.concatenate(
+                        [bucket_args[i][2] for i in idxs], axis=0
+                    )
+                )
+                units.append((members, (ent, rows, cols)))
+        else:
+            units = [
+                ([(i, 0, len(args[0]))], args)
+                for i, args in enumerate(bucket_args)
+            ]
         from photon_ml_tpu.ops import prefetch
 
         def gather(i):
@@ -969,16 +1026,16 @@ class StreamedGameTrainer:
             # weights, this visit's offsets) — never W, which the ordered
             # collect() below writes — so preparation order is free while
             # solve/collect order (and thus every result) stays identical
-            ent_ids_i, rows_i, cols_i = bucket_args[i]
+            _, rows_i, cols_i = units[i][1]
             return gather_bucket(
                 shard.features, shard.labels, offs_re, shard.weights,
                 rows_i, columns=cols_i,
             )
 
         for i, bucket in enumerate(
-            prefetch.prefetch_iter(len(bucket_args), gather)
+            prefetch.prefetch_iter(len(units), gather)
         ):
-            ent_ids, rows, cols = bucket_args[i]
+            members, (ent_ids, rows, cols) = units[i]
             any_entities = True
             # incremental training: this bucket's rows of the (already
             # solver-space) per-entity prior; subspace projection selects
@@ -1008,7 +1065,7 @@ class StreamedGameTrainer:
             w0 = jnp.asarray(w0_rows, jnp.float32)
             if norm is not None:
                 w0 = jax.vmap(norm.model_from_original_space)(w0)
-            out = _solve_bucket(
+            out = solve_bucket_lanes(
                 bucket,
                 w0,
                 l2,
@@ -1020,15 +1077,22 @@ class StreamedGameTrainer:
                 config=opt.optimizer,
                 intercept_index=b_intercept,
                 variance_computation=variance_computation,
+                # deferred: an inline iteration readback would block on the
+                # CURRENT bucket and serialize the solve/collect pipeline
+                accounting=accounting,
                 **extra,
             )
             if pending is not None:
                 collect(*pending)  # blocks on the PREVIOUS bucket only
-            pending = (ent_ids, cols, out)
+            pending = (members, ent_ids, cols, out)
         if pending is not None:
             collect(*pending)
+        accounting.flush()  # one batched readback, all solves now complete
         if not any_entities:
-            loss_sum, max_iters, all_converged = 0.0, 0, True
+            return 0.0, 0, True
+        loss_sum = 0.0
+        for i in range(len(bucket_args)):
+            loss_sum += bucket_loss[i]
         return loss_sum, max_iters, all_converged
 
     def _score_re_rows(

@@ -140,6 +140,31 @@ def summarize_run(path: str) -> dict:
         base = float(base_timers.get(name, {}).get("seconds", 0.0))
         return max(end - base, 0.0)
 
+    counters = metrics.get("counters", {})
+    base_counters = run_start.get("metrics_baseline", {}).get("counters", {})
+
+    def counter_v(name: str) -> float:
+        # same run_start-baseline delta as timer_s: the registry is
+        # process-cumulative, this run's share only
+        end = float(counters.get(name, {}).get("value", 0.0))
+        base = float(base_counters.get(name, {}).get("value", 0.0))
+        return max(end - base, 0.0)
+
+    # random-effect bucket-solve lane accounting (re_solve.* counters,
+    # game/random_effect): executed = lane-iterations the launches ran,
+    # useful = lane-iterations before each lane converged; their gap is
+    # the wasted lockstep work the compaction knob exists to remove
+    executed = counter_v("re_solve.executed_entity_iterations")
+    useful = counter_v("re_solve.useful_entity_iterations")
+    re_solve = {
+        "launches": counter_v("re_solve.launches"),
+        "executed_entity_iterations": executed,
+        "useful_entity_iterations": useful,
+        "wasted_lane_fraction": (
+            1.0 - useful / executed if executed > 0 else None
+        ),
+    }
+
     optim = [r for r in records if r["event"] == "optim_result"]
     reasons: dict[str, int] = {}
     for r in optim:
@@ -163,6 +188,7 @@ def summarize_run(path: str) -> dict:
             "iterations": sum(int(r.get("iterations", 0)) for r in optim),
             "reasons": reasons,
         },
+        "re_solve": re_solve,
         "warnings": sum(
             1 for r in records
             if r["event"] == "log" and r.get("level") in ("WARN", "ERROR")
@@ -202,6 +228,14 @@ def format_summary(s: dict) -> str:
             f"  optimizer: {o['solves']} solves, {o['iterations']} "
             f"iterations ({reasons})"
         )
+    rs = s.get("re_solve") or {}
+    if rs.get("executed_entity_iterations"):
+        lines.append(
+            f"  re-solve: {int(rs['launches'])} launches, "
+            f"{int(rs['executed_entity_iterations'])} executed entity-iters "
+            f"({int(rs['useful_entity_iterations'])} useful), "
+            f"wasted-lane {rs['wasted_lane_fraction']:.1%}"
+        )
     if s["warnings"]:
         lines.append(f"  warnings: {s['warnings']}")
     if s["knobs"]:
@@ -236,6 +270,23 @@ def diff_summaries(a: dict, b: dict) -> str:
     row("transfer", a["transfer_s"], b["transfer_s"])
     row("host-pack", a["host_pack_s"], b["host_pack_s"])
     row("consumer-wait", a["consumer_wait_s"], b["consumer_wait_s"])
+    ra, rb = a.get("re_solve") or {}, b.get("re_solve") or {}
+    if ra.get("executed_entity_iterations") or rb.get("executed_entity_iterations"):
+        # the wasted-lane column: the knob-sweep readout for
+        # PHOTON_RE_COMPACT_EVERY / PHOTON_RE_FUSE_BUCKETS
+        def pct(v):
+            return "-" if v is None else f"{v:.1%}"
+
+        lines.append(
+            f"  {'wasted-lane':<16} "
+            f"{pct(ra.get('wasted_lane_fraction')):>10} "
+            f"{pct(rb.get('wasted_lane_fraction')):>10}"
+        )
+        lines.append(
+            f"  {'exec-entity-it':<16} "
+            f"{int(ra.get('executed_entity_iterations') or 0):>10} "
+            f"{int(rb.get('executed_entity_iterations') or 0):>10}"
+        )
     ka, kb = a.get("knobs", {}), b.get("knobs", {})
     knob_diffs = {
         k: (ka.get(k), kb.get(k))

@@ -140,6 +140,12 @@ def active_sink() -> TelemetrySink | None:
     return _ACTIVE
 
 
+def is_active() -> bool:
+    """Whether a telemetry sink is currently configured (cheap, lock-free
+    — consumers use it to gate observability-only host syncs)."""
+    return _ACTIVE is not None
+
+
 def _process_index() -> int:
     try:
         import jax
@@ -237,6 +243,13 @@ def _knob_snapshot() -> dict:
 
         knobs["groups_per_run"] = int(st.GROUPS_PER_RUN)
         knobs["pipeline_segments"] = int(st.PIPELINE_SEGMENTS)
+    except Exception:
+        pass
+    try:
+        from photon_ml_tpu.game import random_effect as re_mod
+
+        knobs["re_compact_every"] = int(re_mod.compact_every())
+        knobs["re_fuse_buckets"] = int(bool(re_mod.fuse_buckets()))
     except Exception:
         pass
     return knobs

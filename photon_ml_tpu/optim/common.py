@@ -11,7 +11,7 @@ from __future__ import annotations
 import enum
 from dataclasses import dataclass
 from functools import partial
-from typing import Callable
+from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -155,6 +155,67 @@ def select_minimize_fn(
     if l1_weight > 0.0:
         return owlqn_fn, {"l1_weight": l1_weight}
     return lbfgs_fn, {}
+
+
+class ChunkedSolver(NamedTuple):
+    """Chunked-run twins of a device solver (``select_chunked_solver``).
+
+    Contract (implemented by lbfgs/owlqn/tron): ``init(objective, w0,
+    config, **extra)`` builds the solver-state pytree at ``w0`` (paying
+    the initial objective pass); ``run(objective, state, config,
+    it_bound, **extra)`` advances the loop until convergence or
+    ``state.it >= it_bound`` (ABSOLUTE iteration bound — callers pass
+    c, 2c, 3c, …); ``finalize(state)`` wraps the state as an
+    ``OptimizationResult``. Every state leaf is a fixed-shape array and
+    the state exposes ``.it`` (int32) and ``.done`` (bool), so a vmapped
+    caller can snapshot per-lane convergence between chunks and
+    gather/scatter still-active lanes (convergence-aware lane compaction,
+    ``game/random_effect``). Running the chunks to exhaustion then
+    finalizing reproduces the one-shot ``*_minimize`` result bitwise."""
+
+    init: Callable
+    run: Callable
+    finalize: Callable
+
+
+def select_chunked_solver(
+    config: OptimizerConfig, l1_weight: float = 0.0
+) -> tuple[ChunkedSolver | None, dict]:
+    """Chunked twins of ``select_minimize_fn``'s DEVICE solvers — the same
+    selection rule, returning ``(solver, extra_kwargs)``. Returns
+    ``(None, {})`` when the configured solver has no chunked entry point
+    (NEWTON_CHOLESKY's fixed-ladder loop) — callers fall back to the
+    single-launch schedule."""
+    if config.optimizer_type is OptimizerType.NEWTON_CHOLESKY:
+        return None, {}
+    if config.optimizer_type is OptimizerType.TRON:
+        if l1_weight > 0.0:
+            raise ValueError("TRON does not support L1 regularization (reference parity)")
+        from photon_ml_tpu.optim.tron import (
+            tron_chunk_finalize,
+            tron_chunk_init,
+            tron_chunk_run,
+        )
+
+        return ChunkedSolver(tron_chunk_init, tron_chunk_run, tron_chunk_finalize), {}
+    if l1_weight > 0.0:
+        from photon_ml_tpu.optim.lbfgs import (
+            owlqn_chunk_finalize,
+            owlqn_chunk_init,
+            owlqn_chunk_run,
+        )
+
+        return (
+            ChunkedSolver(owlqn_chunk_init, owlqn_chunk_run, owlqn_chunk_finalize),
+            {"l1_weight": l1_weight},
+        )
+    from photon_ml_tpu.optim.lbfgs import (
+        lbfgs_chunk_finalize,
+        lbfgs_chunk_init,
+        lbfgs_chunk_run,
+    )
+
+    return ChunkedSolver(lbfgs_chunk_init, lbfgs_chunk_run, lbfgs_chunk_finalize), {}
 
 
 def make_optimizer(config: OptimizerConfig, l1_weight: float = 0.0) -> Callable:

@@ -97,14 +97,19 @@ def _two_loop(pg: Array, S: Array, Y: Array, rho: Array, count: Array, m: int) -
     return lax.fori_loop(0, m, fwd, r)
 
 
-def _lbfgs_impl(
-    objective: Any,
-    w0: Array,
-    config: OptimizerConfig,
-    l1w: Array | None,
-) -> OptimizationResult:
-    """Shared L-BFGS / OWL-QN loop. ``l1w`` is None (static) for plain
-    L-BFGS, else the per-coordinate L1 weight vector (λ₁ · reg_mask)."""
+def _lbfgs_funcs(objective: Any, config: OptimizerConfig, l1w: Array | None):
+    """The shared L-BFGS / OWL-QN loop, split into ``(init, cond, body)``
+    closures. ``l1w`` is None (static) for plain L-BFGS, else the
+    per-coordinate L1 weight vector (λ₁ · reg_mask).
+
+    ``_lbfgs_impl`` composes them into the classic single
+    ``lax.while_loop`` program; the chunked entry points below run the
+    SAME cond/body bounded to ``it < it_bound`` so a caller can snapshot
+    per-lane convergence between chunks (convergence-aware lane
+    compaction, ``game/random_effect``). Because ``body`` is applied to a
+    lane's state in the same order either way (a vmapped while_loop
+    freezes done lanes via select), chunked and single-launch runs are
+    bitwise identical per lane."""
     m = config.history_length
     T = config.max_iterations
     use_l1 = l1w is not None
@@ -112,8 +117,6 @@ def _lbfgs_impl(
         getattr(objective, "one_pass_value_grad",
                 getattr(objective, "fused", False))
     )
-    d = w0.shape[0]
-    dtype = w0.dtype
 
     def full_value(w: Array) -> Array:
         v = objective.value(w)
@@ -130,31 +133,34 @@ def _lbfgs_impl(
             pg = g
         return f, g, pg
 
-    f0, g0, pg0 = value_and_grads(w0)
-    g0_norm = jnp.linalg.norm(pg0)
+    def init(w0: Array) -> _LbfgsState:
+        d = w0.shape[0]
+        dtype = w0.dtype
+        f0, g0, pg0 = value_and_grads(w0)
+        g0_norm = jnp.linalg.norm(pg0)
 
-    loss_hist = jnp.full((T + 1,), jnp.nan, dtype)
-    gnorm_hist = jnp.full((T + 1,), jnp.nan, dtype)
-    loss_hist = loss_hist.at[0].set(f0)
-    gnorm_hist = gnorm_hist.at[0].set(g0_norm)
+        loss_hist = jnp.full((T + 1,), jnp.nan, dtype)
+        gnorm_hist = jnp.full((T + 1,), jnp.nan, dtype)
+        loss_hist = loss_hist.at[0].set(f0)
+        gnorm_hist = gnorm_hist.at[0].set(g0_norm)
 
-    init = _LbfgsState(
-        w=w0,
-        f=f0,
-        g=g0,
-        pg=pg0,
-        S=jnp.zeros((m, d), dtype),
-        Y=jnp.zeros((m, d), dtype),
-        rho=jnp.zeros((m,), dtype),
-        count=jnp.int32(0),
-        it=jnp.int32(0),
-        evals=jnp.int32(1),  # the initial value_and_grads
-        reason=jnp.int32(ConvergenceReason.MAX_ITERATIONS),
-        done=grad_converged(g0_norm, g0_norm, config.tolerance),
-        g0_norm=g0_norm,
-        loss_hist=loss_hist,
-        gnorm_hist=gnorm_hist,
-    )
+        return _LbfgsState(
+            w=w0,
+            f=f0,
+            g=g0,
+            pg=pg0,
+            S=jnp.zeros((m, d), dtype),
+            Y=jnp.zeros((m, d), dtype),
+            rho=jnp.zeros((m,), dtype),
+            count=jnp.int32(0),
+            it=jnp.int32(0),
+            evals=jnp.int32(1),  # the initial value_and_grads
+            reason=jnp.int32(ConvergenceReason.MAX_ITERATIONS),
+            done=grad_converged(g0_norm, g0_norm, config.tolerance),
+            g0_norm=g0_norm,
+            loss_hist=loss_hist,
+            gnorm_hist=gnorm_hist,
+        )
 
     def cond(st: _LbfgsState):
         return jnp.logical_and(st.it < T, jnp.logical_not(st.done))
@@ -326,7 +332,10 @@ def _lbfgs_impl(
             gnorm_hist=gnorm_hist,
         )
 
-    final = lax.while_loop(cond, body, init)
+    return init, cond, body
+
+
+def _lbfgs_result(final: _LbfgsState) -> OptimizationResult:
     # If we stopped because the initial point already satisfied the test:
     reason = jnp.where(
         jnp.logical_and(final.it == 0, final.done),
@@ -343,6 +352,95 @@ def _lbfgs_impl(
         grad_norm_history=final.gnorm_hist,
         objective_passes=final.evals,
     )
+
+
+def _lbfgs_impl(
+    objective: Any,
+    w0: Array,
+    config: OptimizerConfig,
+    l1w: Array | None,
+) -> OptimizationResult:
+    init, cond, body = _lbfgs_funcs(objective, config, l1w)
+    final = lax.while_loop(cond, body, init(w0))
+    return _lbfgs_result(final)
+
+
+# -- chunked-run entry points (convergence-aware lane compaction) -----------
+# The solver state is a pytree of fixed-shape arrays, so a batched caller
+# can gather/scatter still-active lanes between chunks. Contract shared
+# with tron.py: the state exposes ``.it`` (int32 iteration counter,
+# incremented once per body application) and ``.done`` (bool); running
+# ``chunk_run`` to increasing absolute bounds until every lane is done,
+# then ``chunk_finalize``, reproduces ``*_minimize`` bitwise.
+#
+# Each entry point is @jit LIKE the one-shot minimize functions — the
+# nested-jit call boundary is load-bearing for the bitwise claim: XLA
+# compiles a while body differently when the loop is inlined into a
+# larger computation than when it sits behind its own pjit boundary
+# (measured on CPU: OWL-QN diverged by 1 ulp/iteration when the chunk
+# pieces were inlined), and ``_solve_bucket`` calls the minimize twins
+# through exactly this kind of boundary.
+
+
+@partial(jax.jit, static_argnames=("config",))
+def lbfgs_chunk_init(objective: Any, w0: Array, config: OptimizerConfig) -> _LbfgsState:
+    """Solver state at ``w0`` (costs the initial value_and_grad pass)."""
+    init, _, _ = _lbfgs_funcs(objective, config, None)
+    return init(w0)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def lbfgs_chunk_run(
+    objective: Any, state: _LbfgsState, config: OptimizerConfig, it_bound: Array
+) -> _LbfgsState:
+    """Advance the loop until converged or ``state.it >= it_bound``
+    (absolute iteration count — chunked callers pass c, 2c, 3c, …)."""
+    _, cond, body = _lbfgs_funcs(objective, config, None)
+    bound = jnp.asarray(it_bound, jnp.int32)
+    return lax.while_loop(
+        lambda st: jnp.logical_and(cond(st), st.it < bound), body, state
+    )
+
+
+@jax.jit
+def lbfgs_chunk_finalize(state: _LbfgsState) -> OptimizationResult:
+    return _lbfgs_result(state)
+
+
+def _owlqn_l1w(objective: Any, state_dtype, l1_weight) -> Array:
+    return jnp.asarray(l1_weight, state_dtype) * objective.reg_mask
+
+
+@partial(jax.jit, static_argnames=("config",))
+def owlqn_chunk_init(
+    objective: Any, w0: Array, config: OptimizerConfig, l1_weight
+) -> _LbfgsState:
+    init, _, _ = _lbfgs_funcs(
+        objective, config, _owlqn_l1w(objective, w0.dtype, l1_weight)
+    )
+    return init(w0)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def owlqn_chunk_run(
+    objective: Any,
+    state: _LbfgsState,
+    config: OptimizerConfig,
+    it_bound: Array,
+    l1_weight,
+) -> _LbfgsState:
+    _, cond, body = _lbfgs_funcs(
+        objective, config, _owlqn_l1w(objective, state.w.dtype, l1_weight)
+    )
+    bound = jnp.asarray(it_bound, jnp.int32)
+    return lax.while_loop(
+        lambda st: jnp.logical_and(cond(st), st.it < bound), body, state
+    )
+
+
+@jax.jit
+def owlqn_chunk_finalize(state: _LbfgsState) -> OptimizationResult:
+    return _lbfgs_result(state)
 
 
 @partial(jax.jit, static_argnames=("config",))

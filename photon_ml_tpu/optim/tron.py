@@ -114,35 +114,34 @@ class _TronState(NamedTuple):
     gnorm_hist: Array
 
 
-@partial(jax.jit, static_argnames=("config",))
-def tron_minimize(objective: Any, w0: Array, config: OptimizerConfig) -> OptimizationResult:
-    """Minimize a twice-differentiable objective with TRON.
-
-    ``objective`` must expose ``value(w)``, ``value_and_grad(w)`` and
-    ``hvp(w, v)`` (e.g. ``GLMObjective``).
-    """
+def _tron_funcs(objective: Any, config: OptimizerConfig):
+    """The TRON loop split into ``(init, cond, body)`` closures — same
+    structure as ``lbfgs._lbfgs_funcs`` and the same chunked-run contract
+    (state exposes ``.it``/``.done``; body order per lane is unchanged by
+    chunking, so chunked and single-launch runs are bitwise identical)."""
     T = config.max_iterations
-    dtype = w0.dtype
 
-    f0, g0 = objective.value_and_grad(w0)
-    g0_norm = jnp.linalg.norm(g0)
+    def init(w0: Array) -> _TronState:
+        dtype = w0.dtype
+        f0, g0 = objective.value_and_grad(w0)
+        g0_norm = jnp.linalg.norm(g0)
 
-    loss_hist = jnp.full((T + 1,), jnp.nan, dtype).at[0].set(f0)
-    gnorm_hist = jnp.full((T + 1,), jnp.nan, dtype).at[0].set(g0_norm)
+        loss_hist = jnp.full((T + 1,), jnp.nan, dtype).at[0].set(f0)
+        gnorm_hist = jnp.full((T + 1,), jnp.nan, dtype).at[0].set(g0_norm)
 
-    init = _TronState(
-        w=w0,
-        f=f0,
-        g=g0,
-        delta=g0_norm,
-        it=jnp.int32(0),
-        passes=jnp.int32(1),  # the initial value_and_grad
-        reason=jnp.int32(ConvergenceReason.MAX_ITERATIONS),
-        done=grad_converged(g0_norm, g0_norm, config.tolerance),
-        g0_norm=g0_norm,
-        loss_hist=loss_hist,
-        gnorm_hist=gnorm_hist,
-    )
+        return _TronState(
+            w=w0,
+            f=f0,
+            g=g0,
+            delta=g0_norm,
+            it=jnp.int32(0),
+            passes=jnp.int32(1),  # the initial value_and_grad
+            reason=jnp.int32(ConvergenceReason.MAX_ITERATIONS),
+            done=grad_converged(g0_norm, g0_norm, config.tolerance),
+            g0_norm=g0_norm,
+            loss_hist=loss_hist,
+            gnorm_hist=gnorm_hist,
+        )
 
     def cond(st: _TronState):
         return jnp.logical_and(st.it < T, jnp.logical_not(st.done))
@@ -227,7 +226,10 @@ def tron_minimize(objective: Any, w0: Array, config: OptimizerConfig) -> Optimiz
             gnorm_hist=st.gnorm_hist.at[it].set(g_norm),
         )
 
-    final = lax.while_loop(cond, body, init)
+    return init, cond, body
+
+
+def _tron_result(final: _TronState) -> OptimizationResult:
     reason = jnp.where(
         jnp.logical_and(final.it == 0, final.done),
         jnp.int32(ConvergenceReason.GRADIENT_CONVERGED),
@@ -243,3 +245,41 @@ def tron_minimize(objective: Any, w0: Array, config: OptimizerConfig) -> Optimiz
         grad_norm_history=final.gnorm_hist,
         objective_passes=final.passes,
     )
+
+
+@partial(jax.jit, static_argnames=("config",))
+def tron_minimize(objective: Any, w0: Array, config: OptimizerConfig) -> OptimizationResult:
+    """Minimize a twice-differentiable objective with TRON.
+
+    ``objective`` must expose ``value(w)``, ``value_and_grad(w)`` and
+    ``hvp(w, v)`` (e.g. ``GLMObjective``).
+    """
+    init, cond, body = _tron_funcs(objective, config)
+    final = lax.while_loop(cond, body, init(w0))
+    return _tron_result(final)
+
+
+# -- chunked-run entry points (see lbfgs.py for the shared contract; the
+# @jit boundary on each piece is load-bearing for the bitwise claim) --------
+
+
+@partial(jax.jit, static_argnames=("config",))
+def tron_chunk_init(objective: Any, w0: Array, config: OptimizerConfig) -> _TronState:
+    init, _, _ = _tron_funcs(objective, config)
+    return init(w0)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def tron_chunk_run(
+    objective: Any, state: _TronState, config: OptimizerConfig, it_bound: Array
+) -> _TronState:
+    _, cond, body = _tron_funcs(objective, config)
+    bound = jnp.asarray(it_bound, jnp.int32)
+    return lax.while_loop(
+        lambda st: jnp.logical_and(cond(st), st.it < bound), body, state
+    )
+
+
+@jax.jit
+def tron_chunk_finalize(state: _TronState) -> OptimizationResult:
+    return _tron_result(state)

@@ -475,6 +475,34 @@ def _local_ip() -> str:
     return last
 
 
+def _p2p_timeout_s() -> float | None:
+    """Socket timeout for the host P2P exchange mesh, knob
+    ``PHOTON_P2P_TIMEOUT_S`` (seconds; generous default — exchanges move
+    real payload over slow DCN links, and a false-positive timeout tears
+    the mesh down; ``0`` or negative disables the timeout entirely, the
+    usual knob convention, restoring blocking sockets). Applied to EVERY
+    socket operation of the mesh — accept, connect, send, recv — so a
+    dead or silent peer raises ``socket.timeout`` instead of hanging the
+    exchange forever; the error then reaches the existing
+    ``_reset_host_links`` teardown and the caller's retry rebuilds the
+    mesh."""
+    env = os.environ.get("PHOTON_P2P_TIMEOUT_S")
+    if env is not None and env != "":
+        v = float(env)
+        return v if v > 0 else None
+    return 300.0
+
+
+def _configure_link_socket(sock) -> None:
+    """Apply the exchange-mesh socket policy: the knob timeout (no socket
+    in the mesh may block forever) and TCP_NODELAY (length-prefixed small
+    frames must not wait on Nagle)."""
+    import socket
+
+    sock.settimeout(_p2p_timeout_s())
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+
 def _recv_exact(sock, n: int) -> bytes:
     chunks = []
     while n:
@@ -502,10 +530,12 @@ def _host_links() -> dict:
 
     from jax.experimental import multihost_utils as mhu
 
+    timeout_s = _p2p_timeout_s()
     P_ = jax.process_count()
     pid = jax.process_index()
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.settimeout(timeout_s)  # accept() must not hang on a dead peer
     srv.bind(("0.0.0.0", 0))
     srv.listen(P_)
     port = srv.getsockname()[1]
@@ -521,8 +551,7 @@ def _host_links() -> dict:
     def accept_all():
         for _ in range(P_ - 1):
             conn, _ = srv.accept()
-            conn.settimeout(300.0)
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _configure_link_socket(conn)
             src = struct.unpack("!i", _recv_exact(conn, 4))[0]
             recv_socks[src] = conn
 
@@ -535,13 +564,12 @@ def _host_links() -> dict:
             addrs[peer, :4].astype(np.uint8).tobytes()
         )
         s = socket.create_connection(
-            (peer_ip, int(addrs[peer, 4])), timeout=300.0
+            (peer_ip, int(addrs[peer, 4])), timeout=timeout_s
         )
-        s.settimeout(300.0)
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _configure_link_socket(s)
         s.sendall(struct.pack("!i", pid))
         send_socks[peer] = s
-    acceptor.join(timeout=300.0)
+    acceptor.join(timeout=timeout_s)
     if len(recv_socks) != P_ - 1:
         raise RuntimeError(
             f"host exchange mesh incomplete: accepted {len(recv_socks)} "

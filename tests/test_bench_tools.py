@@ -91,6 +91,32 @@ class TestQuickMode:
                 "pipeline_segments": 1,
             },
         },
+        "R_re_skew": {
+            "sec_solve": 0.5,
+            "quality_ok": True,
+            "re_executed_entity_iterations": 1200.0,
+            "re_useful_entity_iterations": 450.0,
+            "re_wasted_lane_fraction": 0.625,
+            "re_launches": 1.0,
+            "re_knobs": {"compact_every": 0, "fuse_buckets": 0},
+            "telemetry": {
+                "schema_version": 1,
+                "metrics": {
+                    "counters": {
+                        "re_solve.executed_entity_iterations": {
+                            "value": 1200.0, "calls": 1,
+                        },
+                        "re_solve.useful_entity_iterations": {
+                            "value": 450.0, "calls": 1,
+                        },
+                        "re_solve.launches": {"value": 1.0, "calls": 1},
+                    },
+                    "gauges": {"re_solve.active_lane_fraction": 0.375},
+                    "histograms": {}, "timers": {},
+                },
+                "knobs": {"re_compact_every": 0, "re_fuse_buckets": 0},
+            },
+        },
         "F_streaming": {
             "samples_per_sec": 3.0,
             "quality_ok": True,
@@ -174,6 +200,24 @@ class TestQuickMode:
             tel["metrics"]["counters"]["prefetch.cache.miss_bytes"]["value"]
             == 123.0
         )
+        # the random-effect bucket-solve knobs + lane accounting round-trip
+        # the same way: R_re_skew's knob block and its re_solve.* registry
+        # counters appear verbatim in the single JSON line, so the
+        # compaction/fusion sweep is auditable from stdout alone
+        r_cfg = payload["configs"]["R_re_skew"]
+        assert r_cfg["re_knobs"] == {"compact_every": 0, "fuse_buckets": 0}
+        r_tel = r_cfg["telemetry"]
+        assert (
+            r_tel["metrics"]["counters"][
+                "re_solve.executed_entity_iterations"
+            ]["value"] == 1200.0
+        )
+        assert (
+            r_tel["metrics"]["counters"][
+                "re_solve.useful_entity_iterations"
+            ]["value"] == 450.0
+        )
+        assert r_tel["knobs"]["re_compact_every"] == 0
         # quick writes NO artifacts (BENCH_DETAIL.json / BASELINE.md)
         assert not baseline_writes and not detail_writes
 
@@ -250,6 +294,26 @@ class TestQuickMode:
         assert block["knobs"]["prefetch_depth"] == 3
         assert "groups_per_run" in block["knobs"]
         REGISTRY.reset("benchtest.")
+
+    def test_retune_env_reaches_re_knobs(self, monkeypatch):
+        import photon_ml_tpu.game.random_effect as re_mod
+
+        monkeypatch.setattr(re_mod, "COMPACT_EVERY", 0)
+        monkeypatch.setattr(re_mod, "FUSE_BUCKETS", 0)
+        monkeypatch.setenv("PHOTON_RE_COMPACT_EVERY", "4")
+        monkeypatch.setenv("PHOTON_RE_FUSE_BUCKETS", "1")
+        bench._apply_retune_env()
+        assert re_mod.COMPACT_EVERY == 4
+        assert re_mod.FUSE_BUCKETS == 1
+        # the call-time readers agree (env wins either way)
+        assert re_mod.compact_every() == 4
+        assert re_mod.fuse_buckets() is True
+        # knob snapshot (telemetry block / run_start) reflects them
+        from photon_ml_tpu.obs.sink import _knob_snapshot
+
+        knobs = _knob_snapshot()
+        assert knobs["re_compact_every"] == 4
+        assert knobs["re_fuse_buckets"] == 1
 
     def test_retune_env_reaches_prefetch_knobs(self, monkeypatch):
         import photon_ml_tpu.ops.prefetch as pf

@@ -1064,6 +1064,80 @@ class TestExchangeHardening:
         assert mh._HOST_LINKS is None
         assert send_sock.closed and recv_sock.closed
 
+    def test_p2p_timeout_knob_reads_env(self, monkeypatch):
+        import photon_ml_tpu.parallel.multihost as mh
+
+        monkeypatch.delenv("PHOTON_P2P_TIMEOUT_S", raising=False)
+        assert mh._p2p_timeout_s() == 300.0  # generous default
+        monkeypatch.setenv("PHOTON_P2P_TIMEOUT_S", "7.5")
+        assert mh._p2p_timeout_s() == 7.5
+        # 0 (or negative) = disable: blocking sockets, the knob convention
+        monkeypatch.setenv("PHOTON_P2P_TIMEOUT_S", "0")
+        assert mh._p2p_timeout_s() is None
+        monkeypatch.setenv("PHOTON_P2P_TIMEOUT_S", "-1")
+        assert mh._p2p_timeout_s() is None
+
+    def test_silent_peer_times_out_and_reaches_reset_path(self, monkeypatch):
+        """A DELIBERATELY SILENT server (accepts, never sends a byte): the
+        exchange's recv must raise ``socket.timeout`` within the knob
+        budget instead of hanging forever, and — raised from inside
+        ``_host_p2p_exchange`` — the error must reach the existing
+        ``_reset_host_links`` teardown."""
+        import socket
+        import threading
+        import time as _time
+
+        import jax
+
+        import photon_ml_tpu.parallel.multihost as mh
+
+        monkeypatch.setenv("PHOTON_P2P_TIMEOUT_S", "0.3")
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        accepted = []
+
+        def accept_and_go_silent():
+            conn, _ = srv.accept()
+            accepted.append(conn)  # hold open, never send
+
+        t = threading.Thread(target=accept_and_go_silent, daemon=True)
+        t.start()
+        recv_sock = socket.create_connection(srv.getsockname(), timeout=5.0)
+        mh._configure_link_socket(recv_sock)  # the mesh's socket policy
+        assert recv_sock.gettimeout() == 0.3
+
+        class SendSock:
+            closed = False
+
+            def sendall(self, *_):
+                pass
+
+            def close(self):
+                self.closed = True
+
+        send_sock = SendSock()
+        links = {"send": {1: send_sock}, "recv": {1: recv_sock}}
+        monkeypatch.setattr(mh, "_HOST_LINKS", links)
+        monkeypatch.setattr(mh, "_host_links", lambda: links)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        arrays = {"v": np.arange(4, dtype=np.float32)}
+        order = np.arange(4, dtype=np.int64)
+        starts = np.asarray([0, 2, 4], np.int64)
+        counts_matrix = np.asarray([[2, 2], [2, 2]], np.int64)
+        t0 = _time.perf_counter()
+        with pytest.raises((socket.timeout, TimeoutError)):
+            mh._host_p2p_exchange(arrays, order, starts, counts_matrix)
+        elapsed = _time.perf_counter() - t0
+        assert elapsed < 30.0  # timed out, did not hang on the dead peer
+        # the failure reached the reset path: mesh gone, sockets closed
+        assert mh._HOST_LINKS is None
+        assert send_sock.closed
+        srv.close()
+        for c in accepted:
+            c.close()
+
     def test_reset_host_links_tolerates_empty(self):
         import photon_ml_tpu.parallel.multihost as mh
 

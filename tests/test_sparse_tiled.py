@@ -23,7 +23,10 @@ from photon_ml_tpu.ops.sparse_tiled import (
 )
 
 
-def _sparse_problem(rng, n=1500, d=5000, k=7):
+def _sparse_problem(rng, n=1100, d=4608, k=5):
+    # defaults retuned DOWN for the tier-1 budget (interpret-mode cost
+    # scales with nnz = n*k): n must stay >= SLAB (1024) and d >= 4096
+    # for supports_tiling; n > SLAB keeps the multi-row-slab path covered
     idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
     val = rng.normal(size=(n, k)).astype(np.float32)
     # some explicit padding slots, like the ingest layer produces
@@ -111,10 +114,14 @@ class TestTiledSparse:
         from photon_ml_tpu.optim import lbfgs_minimize
         from photon_ml_tpu.types import TaskType
 
-        batch = _sparse_problem(rng, n=1200, d=4500, k=6)
+        batch = _sparse_problem(rng, n=1100, d=4608, k=5)
         tiled = tile_sparse_batch(batch)
         loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
-        cfg = OptimizerConfig(max_iterations=40, tolerance=1e-8)
+        # both paths run the SAME iteration count, so the parity holds at
+        # any bound — 15 keeps the interpret-mode solve inside the tier-1
+        # budget (each extra iteration is two more interpreted kernel
+        # sweeps through the line search)
+        cfg = OptimizerConfig(max_iterations=15, tolerance=1e-8)
         w0 = jnp.zeros((batch.num_features,), jnp.float32)
         obj_a = make_objective(batch, loss, l2_weight=1.0)
         obj_b = make_objective(tiled, loss, l2_weight=1.0)
@@ -198,6 +205,8 @@ def test_game_fixed_effect_rides_tiled_kernel(rng):
     idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
     val = rng.normal(size=(n, k)).astype(np.float32)
     y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    # (both fits run the same iteration count; 10 keeps two interpret-mode
+    # estimator fits inside the tier-1 budget)
     batch = make_game_batch(
         y,
         {"s": SparseFeatures(
@@ -213,7 +222,7 @@ def test_game_fixed_effect_rides_tiled_kernel(rng):
             "fixed": FixedEffectCoordinateConfig(
                 feature_shard_id="s",
                 optimization=OptimizationConfig(
-                    optimizer=OptimizerConfig(max_iterations=25),
+                    optimizer=OptimizerConfig(max_iterations=10),
                     regularization=RegularizationContext(RegularizationType.L2),
                     regularization_weight=1.0,
                 ),
@@ -299,7 +308,10 @@ class TestTiledMesh:
             num_features=d,
         )
         loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
-        cfg = OptimizerConfig(max_iterations=40, tolerance=1e-8)
+        # ref and mesh solves run the same bound, so the agreement check
+        # compares the same trajectory point — 15 keeps two interpreted
+        # solves inside the tier-1 budget
+        cfg = OptimizerConfig(max_iterations=15, tolerance=1e-8)
 
         # single-device tiled reference
         from photon_ml_tpu.ops.sparse_tiled import tile_sparse_batch

@@ -401,6 +401,43 @@ class TestExportAndReport:
         # summed durations ≈ 2× the unioned wall (the spans fully overlap)
         assert s["phases"]["ingest"]["wall_s"] < 0.75 * spans_total
 
+    def test_report_wasted_lane_accounting(self, tmp_path):
+        """The re_solve.* lane counters surface as a wasted-lane readout:
+        run_start-baselined deltas in summarize, a rendered line in
+        format_summary, and the wasted-lane column in diff — the sweep
+        readout for PHOTON_RE_COMPACT_EVERY / PHOTON_RE_FUSE_BUCKETS."""
+        path_a = obs.configure(str(tmp_path / "a"), run_id="runOFF")
+        obs_metrics.REGISTRY.counter_inc("re_solve.launches", 2)
+        obs_metrics.REGISTRY.counter_inc(
+            "re_solve.executed_entity_iterations", 1000.0
+        )
+        obs_metrics.REGISTRY.counter_inc(
+            "re_solve.useful_entity_iterations", 600.0
+        )
+        obs.shutdown()
+        path_b = obs.configure(str(tmp_path / "b"), run_id="runON")
+        obs_metrics.REGISTRY.counter_inc("re_solve.launches", 9)
+        obs_metrics.REGISTRY.counter_inc(
+            "re_solve.executed_entity_iterations", 660.0
+        )
+        obs_metrics.REGISTRY.counter_inc(
+            "re_solve.useful_entity_iterations", 600.0
+        )
+        obs.shutdown()
+        a, b = summarize_run(path_a), summarize_run(path_b)
+        # deltas against the run_start baseline (the registry is process-
+        # cumulative: run B must NOT inherit run A's 1000)
+        assert a["re_solve"]["executed_entity_iterations"] == 1000.0
+        assert a["re_solve"]["useful_entity_iterations"] == 600.0
+        assert abs(a["re_solve"]["wasted_lane_fraction"] - 0.4) < 1e-9
+        assert b["re_solve"]["executed_entity_iterations"] == 660.0
+        assert b["re_solve"]["wasted_lane_fraction"] == 1.0 - 600.0 / 660.0
+        text = format_summary(a)
+        assert "wasted-lane 40.0%" in text
+        d = diff_summaries(a, b)
+        assert "wasted-lane" in d and "exec-entity-it" in d
+        assert "1000" in d and "660" in d
+
     def test_report_diffs_two_synthetic_runs(self, tmp_path, monkeypatch):
         run_a = self._make_run(tmp_path / "a", "runA")
         monkeypatch.setenv("PHOTON_PREFETCH_DEPTH", "0")
