@@ -68,6 +68,11 @@ RETUNE_ENV = {
     # 1 = software-pipelined segment schedule (phase 1 of segment s+1
     # overlaps phase 2 of segment s), 0 = straight-line reference
     "PHOTON_PIPELINE_SEGMENTS": "PIPELINE_SEGMENTS",
+    # storage precision rung for the packed slabs + gathered operands
+    # (f32 = bitwise anchor | bf16 | int8 with per-tile scales); the ONE
+    # string-valued knob — parsed strictly by validate_kernel_dtype, so a
+    # typo fails the run instead of silently benching f32
+    "PHOTON_KERNEL_DTYPE": "KERNEL_DTYPE",
 }
 # Host-ingest pipeline knobs: same call-time-read discipline, applied to
 # ops/prefetch (depth 0 = the synchronous pre-prefetch schedule
@@ -490,6 +495,74 @@ def _make_sparse_problem(jax, jnp, n, d, k, seed):
     return batch, w_true
 
 
+def _dtype_quality_parity(jnp, sparse_batch, iters, *,
+                          auc_model, final_loss, w_model):
+    """The precision ladder's model-quality gate: re-run the identical
+    train-to-convergence fit on the f32 anchor rung and report AUC/loss
+    deltas (plus RMSE of the margins against the anchor's — the
+    regression-flavored delta the protocol names). Forces the env knob
+    (env wins over the module global, so a sweep's child env is the only
+    thing to override) and restores it afterwards; the tile caches key on
+    the rung, so the rebuild can never reuse the reduced-precision
+    layouts. The same dict is emitted as a ``quality_parity`` telemetry
+    event so ``photon-ml-tpu report``/``--diff`` renders the gate next to
+    the wall numbers."""
+    from photon_ml_tpu.config import OptimizerConfig
+    from photon_ml_tpu.evaluation.evaluators import auc_roc
+    from photon_ml_tpu.ops.glm import make_objective
+    from photon_ml_tpu.ops.losses import loss_for_task
+    from photon_ml_tpu.ops.sparse_tiled import kernel_dtype, tile_sparse_batch
+    from photon_ml_tpu.optim import lbfgs_minimize
+    from photon_ml_tpu.types import TaskType
+
+    rung = kernel_dtype()
+    prev = os.environ.get("PHOTON_KERNEL_DTYPE")
+    os.environ["PHOTON_KERNEL_DTYPE"] = "f32"
+    try:
+        batch32 = tile_sparse_batch(sparse_batch)
+        obj32 = make_objective(
+            batch32, loss_for_task(TaskType.LOGISTIC_REGRESSION),
+            l2_weight=1.0, data_hints=(True, True),
+        )
+        d = sparse_batch.num_features
+        res32 = lbfgs_minimize(
+            obj32, jnp.zeros((d,), jnp.float32),
+            OptimizerConfig(max_iterations=iters, tolerance=0.0),
+        )
+        auc32 = float(auc_roc(
+            sparse_batch.matvec(res32.w), sparse_batch.labels
+        ))
+        loss32 = float(res32.value)
+        m32 = np.asarray(sparse_batch.matvec(res32.w))
+    finally:
+        if prev is None:
+            os.environ.pop("PHOTON_KERNEL_DTYPE", None)
+        else:
+            os.environ["PHOTON_KERNEL_DTYPE"] = prev
+    # margins RMSE at the reduced rung's solution vs the anchor's —
+    # computed on the XLA reference matvec so kernel error and model
+    # drift are not conflated
+    m_rung = np.asarray(sparse_batch.matvec(w_model))
+    qp = {
+        "kernel_dtype": rung,
+        "auc": round(auc_model, 6),
+        "auc_f32": round(auc32, 6),
+        "auc_delta": round(auc_model - auc32, 6),
+        "final_loss": round(final_loss, 6),
+        "final_loss_f32": round(loss32, 6),
+        "loss_rel_delta": round(
+            (final_loss - loss32) / max(abs(loss32), 1e-12), 6
+        ),
+        "margins_rmse_vs_f32": round(
+            float(np.sqrt(np.mean((m_rung - m32) ** 2))), 6
+        ),
+    }
+    from photon_ml_tpu.obs.spans import emit_event
+
+    emit_event("quality_parity", **qp)
+    return qp
+
+
 def _sparse_logistic_bench(jax, jnp, n, d, k, iters, densify_dtype,
                            tiled=False):
     from photon_ml_tpu.config import OptimizerConfig
@@ -524,10 +597,13 @@ def _sparse_logistic_bench(jax, jnp, n, d, k, iters, densify_dtype,
     itemsize = 2 if densified and densify_dtype == jnp.bfloat16 else 4
     if tiled:
         # one value+grad pass streams BOTH write-major layouts (margins +
-        # gradient): the packed (M/128, 3, 128) i32 arrays are the traffic
+        # gradient): the packed streams are the traffic, at their ACTUAL
+        # storage width (nbytes) — the precision ladder's bytes-moved win
+        # is auditable straight from this number (f32: 12 B/nnz, bf16: 6,
+        # int8: 4)
         bytes_per_pass = float(
             sum(
-                int(c.m_arrays[0].size + c.g_arrays[0].size) * 4
+                int(c.m_arrays[0].nbytes + c.g_arrays[0].nbytes)
                 for c in batch.chunks
             )
         )
@@ -576,7 +652,10 @@ def _sparse_logistic_bench(jax, jnp, n, d, k, iters, densify_dtype,
             "groups_per_run": st.GROUPS_PER_RUN,
             "segment_batched": bool(st.SEGMENT_BATCHED),
             "pipeline_segments": int(st.PIPELINE_SEGMENTS),
+            "kernel_dtype": st.kernel_dtype(),
         }
+        # the streamed bytes at the active rung: what a dtype sweep diffs
+        constants["packed_stream_bytes_per_pass"] = int(bytes_per_pass)
         # run-padding overhead of the slab-run lever: padded stream nnz
         # over the raw nonzero count (GROUPS_PER_RUN=1 reproduces the
         # pre-run-batching padding exactly)
@@ -586,6 +665,19 @@ def _sparse_logistic_bench(jax, jnp, n, d, k, iters, densify_dtype,
             for c in batch.chunks
         ) // 2
         constants["stream_padding_ratio"] = round(packed_nnz / raw_nnz, 4)
+        if st.kernel_dtype() != "f32":
+            # quality-parity gate (BASELINE: never report speed without a
+            # parity check): reduced rungs cannot be bitwise, so the SAME
+            # train-to-convergence fit re-runs on the f32 anchor and the
+            # AUC/loss deltas ride the result + telemetry block
+            # cfg.max_iterations, NOT the local ``iters`` (rebound above
+            # to the REALIZED count): an early-terminating reduced-rung
+            # solve must not shrink the anchor's iteration budget, or the
+            # anchor underfits and the gate reads falsely favorable
+            constants["quality_parity"] = _dtype_quality_parity(
+                jnp, sparse_batch, cfg.max_iterations,
+                auc_model=auc_model, final_loss=float(value), w_model=res.w,
+            )
     return {
         "samples_per_sec": round(sps, 1),
         "sec_per_solve": round(dt, 6),
@@ -1548,9 +1640,18 @@ def _apply_retune_env() -> None:
         (RETUNE_ENV_RE, "photon_ml_tpu.game.random_effect",
          "random-effect knobs"),
     )
+    def _parse(var: str, raw: str):
+        if var == "PHOTON_KERNEL_DTYPE":
+            # the one string knob: strict-parse (reject unknown rungs
+            # loudly) exactly like the strict-int parse of its siblings
+            from photon_ml_tpu.ops.sparse_tiled import validate_kernel_dtype
+
+            return validate_kernel_dtype(raw)
+        return int(raw)
+
     for env_map, module_name, label in surfaces:
         pending = {
-            attr: int(os.environ[var])
+            attr: _parse(var, os.environ[var])
             for var, attr in env_map.items()
             if os.environ.get(var)
         }
@@ -1596,6 +1697,11 @@ def _run_one(name: str, quick: bool = False) -> None:
 
     result = CONFIGS[name](jax, jnp)
     result["telemetry"] = _telemetry_block()
+    if "quality_parity" in result:
+        # the quality gate rides the telemetry block too (the protocol's
+        # "never report speed without a parity check" — a dtype sweep
+        # diffs quality from the same block it diffs cache traffic from)
+        result["telemetry"]["quality_parity"] = result["quality_parity"]
     print(json.dumps(result))
 
 

@@ -170,6 +170,18 @@ def summarize_run(path: str) -> dict:
     for r in optim:
         reasons[str(r.get("reason"))] = reasons.get(str(r.get("reason")), 0) + 1
 
+    # precision-ladder quality parity (BASELINE protocol: speed is never
+    # reported without a parity check): a reduced-precision bench run
+    # emits a quality_parity event with its AUC/RMSE/loss deltas against
+    # the f32 anchor — surfaced here so a dtype sweep reads its quality
+    # gate from the same report as its wall numbers
+    quality_parity = None
+    for r in records:
+        if r["event"] == "quality_parity":
+            quality_parity = {
+                k: v for k, v in r.items() if k not in ("event", "t")
+            }
+
     return {
         "path": os.path.abspath(path),
         "run_id": run_start.get("run_id"),
@@ -189,6 +201,7 @@ def summarize_run(path: str) -> dict:
             "reasons": reasons,
         },
         "re_solve": re_solve,
+        "quality_parity": quality_parity,
         "warnings": sum(
             1 for r in records
             if r["event"] == "log" and r.get("level") in ("WARN", "ERROR")
@@ -199,9 +212,22 @@ def summarize_run(path: str) -> dict:
 
 # -- rendering --------------------------------------------------------------
 
+_UNRECORDED = "(unrecorded)"
+
 
 def _fmt_s(v: float) -> str:
     return f"{v:.3f}s"
+
+
+def _fmt_quality_parity(qp: dict) -> str:
+    # every delta/RMSE metric renders — the gate's whole point is that a
+    # bad number is impossible to miss next to the wall numbers
+    parts = [f"kernel_dtype={qp.get('kernel_dtype')}"]
+    for k in sorted(qp):
+        if k.endswith("_delta") or "rmse" in k:
+            v = qp[k]
+            parts.append(f"{k}={v:+.6f}" if isinstance(v, float) else f"{k}={v}")
+    return ", ".join(parts)
 
 
 def format_summary(s: dict) -> str:
@@ -235,6 +261,10 @@ def format_summary(s: dict) -> str:
             f"{int(rs['executed_entity_iterations'])} executed entity-iters "
             f"({int(rs['useful_entity_iterations'])} useful), "
             f"wasted-lane {rs['wasted_lane_fraction']:.1%}"
+        )
+    if s.get("quality_parity"):
+        lines.append(
+            f"  quality-parity: {_fmt_quality_parity(s['quality_parity'])}"
         )
     if s["warnings"]:
         lines.append(f"  warnings: {s['warnings']}")
@@ -287,11 +317,29 @@ def diff_summaries(a: dict, b: dict) -> str:
             f"{int(ra.get('executed_entity_iterations') or 0):>10} "
             f"{int(rb.get('executed_entity_iterations') or 0):>10}"
         )
+    qa, qb = a.get("quality_parity"), b.get("quality_parity")
+    if qa or qb:
+        lines.append("  quality-parity:")
+        lines.append(
+            f"    A: {_fmt_quality_parity(qa) if qa else _UNRECORDED}"
+        )
+        lines.append(
+            f"    B: {_fmt_quality_parity(qb) if qb else _UNRECORDED}"
+        )
     ka, kb = a.get("knobs", {}), b.get("knobs", {})
+    # a knob only one run recorded (an older-schema run, or a pre-knob
+    # baseline) renders as "(unrecorded)" instead of being dropped — an
+    # asymmetric PHOTON_KERNEL_DTYPE is a real config delta, and the
+    # `(k in ka) != (k in kb)` term keeps it even when .get() values
+    # would coincide (e.g. a knob legitimately recorded as None)
+    knob_keys = set(ka) | set(kb)
     knob_diffs = {
-        k: (ka.get(k), kb.get(k))
-        for k in sorted(set(ka) | set(kb))
-        if ka.get(k) != kb.get(k)
+        k: (
+            ka[k] if k in ka else _UNRECORDED,
+            kb[k] if k in kb else _UNRECORDED,
+        )
+        for k in sorted(knob_keys)
+        if (k in ka) != (k in kb) or ka.get(k) != kb.get(k)
     }
     if knob_diffs:
         lines.append("  knob deltas:")

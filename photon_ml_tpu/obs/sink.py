@@ -243,6 +243,7 @@ def _knob_snapshot() -> dict:
 
         knobs["groups_per_run"] = int(st.GROUPS_PER_RUN)
         knobs["pipeline_segments"] = int(st.PIPELINE_SEGMENTS)
+        knobs["kernel_dtype"] = st.kernel_dtype()
     except Exception:
         pass
     try:

@@ -233,16 +233,65 @@ def prefetch_iter(
 # changed column while labels/weights/features replay resident buffers.
 
 _cache_lock = threading.Lock()
-# key -> (host_array_ref, device_array, nbytes); insertion order = LRU
+# key -> (host_ref, staged_ref, device_array, dev_nbytes, host_nbytes);
+# insertion order = LRU. ``host_ref`` is the caller's original array (it
+# OWNS the data-pointer key: holding it makes the key safe); ``staged_ref``
+# is the transfer-dtype twin actually shipped (identical to host_ref on the
+# f32 rung).
 _device_tier: "OrderedDict[tuple, tuple]" = OrderedDict()
 _device_bytes = 0
-# key -> (host_array_ref, nbytes): spilled entries (host ref retained so a
-# re-entry pays one device_put — and so the data-pointer key stays safe)
+# aggregate HOST RAM pinned by device-resident entries (each entry's
+# host_ref keeps a view's whole base alive): bounded against the host
+# spill budget, so many small device entries can never pin unbounded
+# host memory between them — the pre-ladder guarantee, kept in aggregate
+_device_host_bytes = 0
+# key -> (host_ref, staged_ref, host_nbytes): spilled entries (refs
+# retained so a re-entry pays one device_put — never a re-slice/re-pack —
+# and so the data-pointer key stays safe)
 _host_tier: "OrderedDict[tuple, tuple]" = OrderedDict()
 _host_bytes = 0
 _cache_stats = {
     "device_hits": 0, "host_hits": 0, "misses": 0, "evictions": 0,
 }
+
+# Raw (un-tiled) streamed feature arrays packed at the transfer dtype:
+# under the PHOTON_KERNEL_DTYPE precision ladder (ops/sparse_tiled), the
+# tile-COO consumers already move their packed slabs at the storage dtype;
+# these are the remaining fat columns of raw chunk dicts. Both reduced
+# rungs transfer bf16 here (int8's symmetric scales exist only inside the
+# packed tile layouts; a raw operand has no tile to carry them on) —
+# labels/offsets/weights stay f32, so the f32 rung is byte-identical to
+# the pre-ladder path.
+_PACK_KEYS = ("values", "X")
+
+
+def transfer_dtype() -> str:
+    """The raw-chunk transfer rung derived from the kernel-dtype knob at
+    CALL time: 'f32' (identity) or 'bf16'."""
+    from photon_ml_tpu.ops.sparse_tiled import kernel_dtype
+
+    return "f32" if kernel_dtype() == "f32" else "bf16"
+
+
+def _pack_for_transfer(a: np.ndarray):
+    """One feature array → its bf16 transfer twin (f32 inputs only; other
+    dtypes pass through untouched)."""
+    import ml_dtypes
+
+    return a.astype(ml_dtypes.bfloat16) if a.dtype == np.float32 else a
+
+
+def pack_host_chunk(host_tree: dict) -> dict:
+    """Pack a prepared host chunk's feature arrays at the ladder's
+    transfer dtype (no-op on the f32 rung). The synchronous depth-0
+    streamed path uses this directly; the cached path packs per-array on
+    cache miss so repeat passes key on the caller's ORIGINAL storage."""
+    if transfer_dtype() == "f32":
+        return host_tree
+    return {
+        k: _pack_for_transfer(np.asarray(v)) if k in _PACK_KEYS else v
+        for k, v in host_tree.items()
+    }
 
 
 def _storage_key(a: np.ndarray) -> tuple:
@@ -251,70 +300,102 @@ def _storage_key(a: np.ndarray) -> tuple:
 
 
 def _evict_over_budget_locked() -> None:
-    global _device_bytes, _host_bytes
+    global _device_bytes, _device_host_bytes, _host_bytes
     budget = chunk_cache_budget_bytes()
-    while _device_tier and _device_bytes > budget:
-        key, (host_ref, _dev, nb) = _device_tier.popitem(last=False)
-        _device_bytes -= nb
+    host_budget = host_spill_budget_bytes()
+    while _device_tier and (
+        _device_bytes > budget or _device_host_bytes > host_budget
+    ):
+        key, (host_ref, staged, _dev, nb_dev, nb_host) = (
+            _device_tier.popitem(last=False)
+        )
+        _device_bytes -= nb_dev
+        _device_host_bytes -= nb_host
         _cache_stats["evictions"] += 1
         _REGISTRY.counter_inc("prefetch.cache.evictions")
-        # spill: keep the host array so re-entry is one device_put, never
-        # a re-slice/re-pack upstream
+        # spill: keep the staged host twin so re-entry is one device_put,
+        # never a re-slice/re-pack upstream
         if key not in _host_tier:
-            _host_bytes += nb
-        _host_tier[key] = (host_ref, nb)
+            _host_bytes += nb_host
+        _host_tier[key] = (host_ref, staged, nb_host)
         _host_tier.move_to_end(key)
-    host_budget = host_spill_budget_bytes()
     while _host_tier and _host_bytes > host_budget:
-        _, (_ref, nb) = _host_tier.popitem(last=False)
+        _, (_ref, _staged, nb) = _host_tier.popitem(last=False)
         _host_bytes -= nb
 
 
-def _cached_put_one(a):
+def _cached_put_one(name, a):
     """One host array → its device-resident twin, through the LRU."""
-    global _device_bytes, _host_bytes
+    global _device_bytes, _device_host_bytes, _host_bytes
     a = np.asarray(a)
-    key = _storage_key(a)
+    tdt = transfer_dtype()
+    packs = tdt != "f32" and name in _PACK_KEYS and a.dtype == np.float32
+    # the transfer dtype is part of the key for packed arrays: a bf16-rung
+    # entry must never serve an f32 pass (or vice versa) after the knob
+    # toggles mid-process — same never-by-luck rule as the kernel caches
+    key = _storage_key(a) + ((tdt,) if packs else ())
+    staged = None
     with _cache_lock:
         hit = _device_tier.get(key)
         if hit is not None:
             _device_tier.move_to_end(key)
             _cache_stats["device_hits"] += 1
             # registry twins of the stats (hit/miss BYTES: the transfer
-            # traffic the cache saved/paid — what a sweep actually diffs)
-            _REGISTRY.counter_inc("prefetch.cache.hit_bytes", hit[2])
-            return hit[1]
+            # traffic the cache saved/paid — what a sweep actually diffs;
+            # counted at the DEVICE size, i.e. post-pack dtype)
+            _REGISTRY.counter_inc("prefetch.cache.hit_bytes", hit[3])
+            return hit[2]
         spilled = _host_tier.pop(key, None)
         if spilled is not None:
-            _host_bytes -= spilled[1]
+            _host_bytes -= spilled[2]
             _cache_stats["host_hits"] += 1
-            _REGISTRY.counter_inc("prefetch.cache.host_hit_bytes", spilled[1])
+            _REGISTRY.counter_inc(
+                "prefetch.cache.host_hit_bytes", int(spilled[1].nbytes)
+            )
+            staged = spilled[1]
         else:
             _cache_stats["misses"] += 1
-            _REGISTRY.counter_inc("prefetch.cache.miss_bytes", int(a.nbytes))
+    if staged is None:
+        staged = _pack_for_transfer(a) if packs else a
+        # registry counters take their own lock — no cache state touched
+        _REGISTRY.counter_inc("prefetch.cache.miss_bytes", int(staged.nbytes))
     # transfer OUTSIDE the lock (the expensive part; concurrent misses for
     # the same key both transfer — last insert wins, both correct)
-    dev = timed_device_put(a)
-    nb = _pinned_nbytes(a)
+    dev = timed_device_put(staged)
+    # the DEVICE tier charges what the entry actually holds in HBM — the
+    # post-pack device array's nbytes (a bf16 pass fits ~2x the chunks of
+    # an f32 pass under the same budget, and a view's device copy is just
+    # the slice). What the entry pins in HOST RAM (a view's whole base —
+    # see _pinned_nbytes) is bounded separately against the HOST budget:
+    # a few-KB slice of a base larger than the spill budget never caches,
+    # so holding its ref can never pin unbounded host RAM past both
+    # budgets (the pre-ladder guarantee, kept).
+    nb_dev = int(dev.nbytes)
+    nb_host = _pinned_nbytes(a) + (int(staged.nbytes) if staged is not a else 0)
     with _cache_lock:
-        if nb <= chunk_cache_budget_bytes():  # over-budget: never pinned
+        if (
+            nb_dev <= chunk_cache_budget_bytes()
+            and nb_host <= host_spill_budget_bytes()
+        ):  # over-budget on either axis: never pinned
             prev = _device_tier.pop(key, None)
             if prev is not None:
-                _device_bytes -= prev[2]
-            _device_tier[key] = (a, dev, nb)
-            _device_bytes += nb
+                _device_bytes -= prev[3]
+                _device_host_bytes -= prev[4]
+            _device_tier[key] = (a, staged, dev, nb_dev, nb_host)
+            _device_bytes += nb_dev
+            _device_host_bytes += nb_host
             _device_tier.move_to_end(key)
             _evict_over_budget_locked()
     return dev
 
 
 def _pinned_nbytes(a: np.ndarray) -> int:
-    """An entry's budget charge: what holding the reference actually PINS.
-    A numpy VIEW keeps its whole base array alive, so charging the slice's
-    own nbytes would let a few-KB entry pin a multi-GB dataset past both
-    budgets; views are charged at their base's size (conservative — a base
-    larger than the budget simply never caches, degrading to plain
-    per-pass transfers, which is the pre-cache behavior)."""
+    """A HOST-tier entry's budget charge: what holding the reference
+    actually PINS. A numpy VIEW keeps its whole base array alive, so
+    charging the slice's own nbytes would let a few-KB entry pin a
+    multi-GB dataset past the spill budget; views are charged at their
+    base's size (conservative — a base larger than the budget simply
+    never spills, degrading to plain per-pass transfers)."""
     base = a.base
     if isinstance(base, np.ndarray):
         return int(base.nbytes)
@@ -326,11 +407,15 @@ def cached_device_put(host_tree: dict) -> dict:
     arrays) through the process-wide per-array cache: a repeat pass over
     the SAME host storage returns already-resident device buffers
     (optimizer passes 2..N skip the transfer entirely), and a per-visit
-    offsets swap re-transfers only the offsets column. Thread-safe —
-    prefetch workers for different chunks race here by design. Keyed by
-    storage identity, so cached arrays must not be mutated in place (the
-    framework never does; fresh arrays per visit get fresh keys)."""
-    return {k: _cached_put_one(v) for k, v in host_tree.items()}
+    offsets swap re-transfers only the offsets column. Feature arrays
+    (``values``/``X``) transfer at the precision ladder's storage dtype
+    (``pack_host_chunk``), so a bf16 pass halves both the HBM footprint
+    and the host→device traffic of raw chunks. Thread-safe — prefetch
+    workers for different chunks race here by design. Keyed by the
+    CALLER's storage identity (+ transfer dtype for packed arrays), so
+    cached arrays must not be mutated in place (the framework never does;
+    fresh arrays per visit get fresh keys)."""
+    return {k: _cached_put_one(k, v) for k, v in host_tree.items()}
 
 
 def cache_stats() -> dict:
@@ -339,17 +424,19 @@ def cache_stats() -> dict:
             _cache_stats,
             device_entries=len(_device_tier),
             device_bytes=_device_bytes,
+            device_host_pinned_bytes=_device_host_bytes,
             host_entries=len(_host_tier),
             host_bytes=_host_bytes,
         )
 
 
 def clear_cache() -> None:
-    global _device_bytes, _host_bytes
+    global _device_bytes, _device_host_bytes, _host_bytes
     with _cache_lock:
         _device_tier.clear()
         _host_tier.clear()
         _device_bytes = 0
+        _device_host_bytes = 0
         _host_bytes = 0
         for k in _cache_stats:
             _cache_stats[k] = 0

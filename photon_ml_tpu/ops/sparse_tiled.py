@@ -71,6 +71,7 @@ objective's psum handles the reduction).
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass, field
 
 import jax
@@ -115,6 +116,62 @@ GROUPS_PER_RUN = 2  # groups per slab RUN: all read ONE source slab
 # from the environment via PHOTON_PIPELINE_SEGMENTS (bench.py RETUNE_ENV).
 PIPELINE_SEGMENTS = 1  # 1 = skewed segment schedule, 0 = straight-line
 SLAB = 1024  # outputs/inputs per slab: an (8, 128) block of a table
+# Precision ladder for the PACKED SLAB STORAGE and the gathered source
+# operand (ROADMAP "Mixed-precision sparse-tiled kernels"): A2 is
+# HBM-bound, so after pipelining/prefetch/caching hid latency, the next
+# raw-speed lever is to move fewer bytes. The rungs change STORAGE only —
+# the MXU contraction always accumulates in f32 through the existing
+# 3-term Dekker split, and ``p_scratch``/``acc_scratch`` stay f32:
+#
+#   f32  — today's layout bit-for-bit (12 B/nnz: full i32 write/read
+#          indices + f32 value bits). The BITWISE-parity anchor: knob
+#          unset and knob=f32 reproduce the pre-ladder kernels exactly
+#          (asserted with assert_array_equal across all four streamed
+#          consumers).
+#   bf16 — the same three streams at HALF width (6 B/nnz): the kernel
+#          only ever consumes the low 10 bits of each index (lane +
+#          sublane; the slab id rides the SMEM wslab/rslab/rrun streams),
+#          so indices narrow to within-slab i16 offsets and values store
+#          as bf16 bits in i16. Gathered source slabs are cast to bf16
+#          too; products upcast to f32 before accumulation.
+#   int8 — ONE i32 stream (4 B/nnz): write-offset(10) | read-offset(10)
+#          | symmetric-int8 value(8), with per-CELL scale factors (one
+#          (write-slab, read-slab) tile shares one scale, carried per
+#          aligned RUN in the scalar-prefetched ``srun`` stream so the
+#          kernel pays one SMEM read per run). Dequantized to f32 at
+#          gather time; accumulation unchanged.
+#
+# bf16/int8 are NOT bitwise rungs — they gate on model-quality parity
+# (AUC/RMSE deltas in the bench ``telemetry`` block, per BASELINE's
+# "never report speed without a parity check" protocol). The dtype is a
+# static key of the ``_tiled_apply`` jit cache, the tile-layout cache and
+# the shared scoring program: toggling recompiles, never reuses. Retune
+# from the environment via PHOTON_KERNEL_DTYPE (bench.py RETUNE_ENV).
+KERNEL_DTYPE = "f32"  # storage rung: "f32" (parity anchor) | "bf16" | "int8"
+KERNEL_DTYPES = ("f32", "bf16", "int8")
+
+
+def validate_kernel_dtype(value) -> str:
+    """Strict knob parse (the sibling PHOTON_RE_* knobs parse strict ints;
+    a typo'd dtype must fail loudly, not fall back to f32 and silently
+    bench the wrong rung)."""
+    v = str(value).strip().lower()
+    if v not in KERNEL_DTYPES:
+        raise ValueError(
+            f"PHOTON_KERNEL_DTYPE={value!r} is not a known precision rung; "
+            f"valid rungs: {', '.join(KERNEL_DTYPES)}"
+        )
+    return v
+
+
+def kernel_dtype() -> str:
+    """The active storage rung, read at CALL time (env wins over the
+    module global — the same discipline as the layout-shaping constants:
+    an import-time capture would let layouts and kernel disagree)."""
+    env = os.environ.get("PHOTON_KERNEL_DTYPE")
+    if env is not None and env != "":
+        return validate_kernel_dtype(env)
+    return validate_kernel_dtype(KERNEL_DTYPE)
 
 
 def _interpret() -> bool:
@@ -133,10 +190,16 @@ class _Layout:
     single-DMA layout with 128-group steps is what made the stream cheap
     enough for the compute to be the limit again."""
 
-    packed: np.ndarray  # (M/GROUP, 3, GROUP) int32: [write, read, val bits]
+    packed: np.ndarray  # (M/GROUP, S, GROUP) storage-dtype streams; f32:
+    # S=3 int32 [write, read, val bits] (the pre-ladder layout verbatim),
+    # bf16: S=3 int16 [write off10, read off10, bf16 bits], int8: S=1
+    # int32 [write off10 | read off10 << 10 | symmetric q8 << 20]
     wslab: np.ndarray  # (M/(GROUP*GROUPS_PER_STEP),) int32: per-segment slab
     rslab: np.ndarray  # (M/GROUP,) int32 read slab id per group
     rrun: np.ndarray  # (M/(GROUP*GROUPS_PER_RUN),) int32: per-RUN read slab
+    srun: np.ndarray  # (M/(GROUP*GROUPS_PER_RUN),) f32: per-RUN dequant
+    # scale (each run is single-cell, so this carries the per-CELL int8
+    # symmetric scale; all-ones for the f32/bf16 rungs, never read there)
 
 
 def detect_slab_runs(rslab: np.ndarray) -> np.ndarray:
@@ -162,6 +225,7 @@ def build_write_major_layout(
     read_pad: int,
     groups_per_step: int | None = None,
     groups_per_run: int | None = None,
+    storage: str | None = None,
 ) -> _Layout:
     """Sort nonzeros by (write-slab, read-slab) cell, pad each cell to a
     whole number of ``groups_per_run``-group RUNS (every group of a cell
@@ -170,15 +234,23 @@ def build_write_major_layout(
     of ``groups_per_step`` (all vectorized — no Python per-cell loop).
     Fillers carry value 0 (they contribute exactly 0 through any slab).
 
-    ``groups_per_step=None``/``groups_per_run=None`` read the module's
-    GROUPS_PER_STEP / GROUPS_PER_RUN at CALL time — a default-arg capture
-    froze the import-time value, so layouts built after retuning the
-    constant silently disagreed with the kernel consuming them (garbage
-    outputs, caught by a parity probe)."""
+    ``groups_per_step=None``/``groups_per_run=None``/``storage=None``
+    read the module's GROUPS_PER_STEP / GROUPS_PER_RUN / kernel_dtype()
+    at CALL time — a default-arg capture froze the import-time value, so
+    layouts built after retuning the constant silently disagreed with
+    the kernel consuming them (garbage outputs, caught by a parity
+    probe). ``storage`` selects the packed-stream precision rung (see
+    KERNEL_DTYPE): the f32 layout is the pre-ladder layout verbatim;
+    bf16/int8 narrow the streams and must be consumed by a kernel
+    compiled for the same rung (the jit/layout caches key on it)."""
     if groups_per_step is None:
         groups_per_step = GROUPS_PER_STEP
     if groups_per_run is None:
         groups_per_run = GROUPS_PER_RUN
+    if storage is None:
+        storage = kernel_dtype()
+    else:
+        storage = validate_kernel_dtype(storage)
     if groups_per_step % groups_per_run:
         raise ValueError(
             f"GROUPS_PER_RUN={groups_per_run} must divide "
@@ -255,15 +327,64 @@ def build_write_major_layout(
     blocks = rslab.reshape(-1, groups_per_run)
     assert (blocks == blocks[:, :1]).all(), "slab run crosses a run block"
     rrun = np.ascontiguousarray(blocks[:, 0])
-    packed = np.stack(
-        [
-            out_w.reshape(n_groups, GROUP),
-            out_r.reshape(n_groups, GROUP),
-            out_v.view(np.int32).reshape(n_groups, GROUP),
-        ],
-        axis=1,
+    n_runs = n_groups // groups_per_run
+    srun = np.ones(n_runs, np.float32)
+    if storage == "f32":
+        packed = np.stack(
+            [
+                out_w.reshape(n_groups, GROUP),
+                out_r.reshape(n_groups, GROUP),
+                out_v.view(np.int32).reshape(n_groups, GROUP),
+            ],
+            axis=1,
+        )
+    elif storage == "bf16":
+        import ml_dtypes
+
+        # the kernel consumes only the within-slab offset (lane + sublane
+        # = low 10 bits; slab ids ride the SMEM streams), so both index
+        # streams narrow to i16 and the value stream stores bf16 bits —
+        # the same three streams at exactly half width
+        packed = np.stack(
+            [
+                (out_w % SLAB).astype(np.int16).reshape(n_groups, GROUP),
+                (out_r % SLAB).astype(np.int16).reshape(n_groups, GROUP),
+                out_v.astype(ml_dtypes.bfloat16).view(np.int16).reshape(
+                    n_groups, GROUP
+                ),
+            ],
+            axis=1,
+        )
+    else:  # int8: one i32 stream [w off10 | r off10 << 10 | q8 << 20]
+        out_q = np.zeros(M_total, np.int64)
+        if len(uniq):
+            # symmetric per-CELL scale: every nonzero of a (write-slab,
+            # read-slab) tile quantizes against the tile's |v| max, and
+            # every aligned run of the cell carries that scale in srun
+            # (fillers are q=0, inert under any scale)
+            amax = np.maximum.reduceat(np.abs(v), start)
+            cell_scale = (amax / 127.0).astype(np.float32)
+            cell_scale[cell_scale == 0.0] = 1.0
+            q = np.clip(
+                np.rint(v / np.repeat(cell_scale, counts)), -127, 127
+            ).astype(np.int64)
+            out_q[pos] = q
+            runs_per_cell = (pc // run_nnz).astype(np.int64)
+            rpc_excl = np.cumsum(runs_per_cell) - runs_per_cell
+            rpos = (
+                np.repeat(cell_out // run_nnz, runs_per_cell)
+                + np.arange(int(runs_per_cell.sum()), dtype=np.int64)
+                - np.repeat(rpc_excl, runs_per_cell)
+            )
+            srun[rpos] = np.repeat(cell_scale, runs_per_cell)
+        packed = (
+            (out_w.astype(np.int64) % SLAB)
+            | ((out_r.astype(np.int64) % SLAB) << 10)
+            | ((out_q & 0xFF) << 20)
+        ).astype(np.int32).reshape(n_groups, 1, GROUP)
+    return _Layout(
+        packed=packed, wslab=wslab, rslab=rslab, rrun=rrun, srun=srun
     )
-    return _Layout(packed=packed, wslab=wslab, rslab=rslab, rrun=rrun)
 
 
 # r5 ablation on the A2 shapes (n=2^19, d=2^17, k=32; one chunk,
@@ -282,6 +403,38 @@ def build_write_major_layout(
 # skeleton loads/bitcast hoist per segment and the source slab loads once
 # per GROUPS_PER_RUN-group run — see _tile_kernel_seg.
 SEGMENT_BATCHED = True
+
+
+def _decode_packed(load, storage):
+    """Phase 1's packed-stream decode, shared by BOTH kernels (one copy
+    of the per-rung bit layout — a drifted duplicate would let phase 1
+    and phase 2 disagree on offsets and produce silent garbage).
+    ``load(stream)`` returns one packed stream's 2-D block, so each rung
+    loads ONLY the streams it consumes; returns ``(rd, vals)`` — i32
+    within-slab read offsets and f32 values (RAW q for int8: the per-run
+    scale is applied by the caller, after the optional square
+    decision)."""
+    if storage == "int8":
+        pk = load(0)
+        rd = (pk >> 10) & 1023
+        q = (pk >> 20) & 255
+        return rd, (q - ((q & 128) << 1)).astype(jnp.float32)
+    rd = load(1)
+    if storage == "bf16":
+        return rd.astype(jnp.int32), pltpu.bitcast(
+            load(2), jnp.bfloat16
+        ).astype(jnp.float32)
+    return rd, pltpu.bitcast(load(2), jnp.float32)
+
+
+def _decode_write_offsets(wr, storage):
+    """Phase 2's write-stream decode, shared by both kernels: normalize
+    the per-rung storage to i32 within-slab write offsets."""
+    if storage == "bf16":
+        return wr.astype(jnp.int32)
+    if storage == "int8":
+        return wr & 1023  # low 10 bits of the single packed stream
+    return wr
 
 
 def _run_segment_schedule(dma, phase1, phase2, *, n_steps, segs, pipeline):
@@ -354,9 +507,9 @@ def _run_segment_schedule(dma, phase1, phase2, *, n_steps, segs, pipeline):
 
 
 def _tile_kernel_seg(
-    wslab_ref, rslab_ref, rrun_ref, packed_hbm, src_ref, out_ref,
+    wslab_ref, rslab_ref, rrun_ref, srun_ref, packed_hbm, src_ref, out_ref,
     acc_scratch, p_scratch, pk_buf, dma_sem,
-    *, n_steps, groups, segs, run_groups, square_vals, pipeline,
+    *, n_steps, groups, segs, run_groups, square_vals, pipeline, storage,
 ):
     """Segment-batched kernel with slab-RUN phase 1 (see SEGMENT_BATCHED
     note): the per-group skeleton the r5 retuned-state ablation measured
@@ -376,7 +529,15 @@ def _tile_kernel_seg(
     the NEXT step's DMA is waited mid-step so its first segment's phase 1
     overlaps the last segment's phase 2. Both schedules run identical
     per-phase math in identical accumulation order, so outputs are
-    BIT-IDENTICAL (asserted by the parity tests)."""
+    BIT-IDENTICAL (asserted by the parity tests).
+
+    ``storage`` selects the packed-stream precision rung (KERNEL_DTYPE):
+    only phase 1's stream decode changes — f32 reproduces the pre-ladder
+    decode verbatim (the bitwise anchor), bf16 widens i16 offsets and
+    bitcasts bf16 value bits, int8 unpacks the single i32 stream and
+    dequantizes by the per-run SMEM scale (``srun_ref``). Products land
+    in f32 ``p_scratch`` either way, and phase 2's Dekker-split f32 MXU
+    accumulation is IDENTICAL across rungs."""
     step_groups = segs * groups
     seg_nnz = groups * GROUP
     run_nnz = run_groups * GROUP
@@ -401,14 +562,15 @@ def _tile_kernel_seg(
         ``pk_buf[buf_slot]`` into ``p_scratch[p_slot]``."""
         g0 = s2 * groups
         # per-group skeleton, hoisted: one packed-buffer load per
-        # stream and one value bitcast for the WHOLE segment
-        rd_all = pk_buf[buf_slot, g0:g0 + groups, 1, :]  # (groups, GROUP)
+        # stream and one value decode for the WHOLE segment
+        rd_all, vals_all = _decode_packed(
+            lambda s: pk_buf[buf_slot, g0:g0 + groups, s, :], storage
+        )  # (groups, GROUP) each
         lane_all = rd_all & 127
         sub_all = (rd_all >> 7) & 7
-        vals_all = pltpu.bitcast(
-            pk_buf[buf_slot, g0:g0 + groups, 2, :], jnp.float32
-        )
-        if square_vals:
+        if square_vals and storage != "int8":
+            # int8 squares AFTER dequantization (below): (q·s)² needs the
+            # per-run scale, and scale² must not leak into the raw q
             vals_all = vals_all * vals_all
         for b in range(seg_runs):
             gb = b * run_groups
@@ -420,14 +582,23 @@ def _tile_kernel_seg(
             gathered = jnp.take_along_axis(
                 slab, jnp.broadcast_to(lanes, (8, run_nnz)), axis=1
             )
+            if storage != "f32":
+                # the gathered operand is stored bf16 (the other half of
+                # the bytes-moved win); upcast BEFORE the product so the
+                # accumulation chain is f32 end to end
+                gathered = gathered.astype(jnp.float32)
             sub_r = sub_all[gb:gb + run_groups, :].reshape(1, run_nnz)
             sel = (
                 iota8_run == jnp.broadcast_to(sub_r, (8, run_nnz))
             ).astype(jnp.float32)
             src_vals = jnp.sum(gathered * sel, axis=0)  # (run_nnz,)
+            v = vals_all[gb:gb + run_groups, :]
+            if storage == "int8":
+                v = v * srun_ref[t * step_runs + s2 * seg_runs + b]
+                if square_vals:
+                    v = v * v
             p_scratch[p_slot, gb:gb + run_groups, :] = (
-                vals_all[gb:gb + run_groups, :]
-                * src_vals.reshape(run_groups, GROUP)
+                v * src_vals.reshape(run_groups, GROUP)
             )
 
     def phase2(buf_slot, t, s2, p_slot):
@@ -436,7 +607,9 @@ def _tile_kernel_seg(
         one relayout per stream, int8 one-hot compares, operands as
         values."""
         g0 = s2 * groups
-        wr = pk_buf[buf_slot, g0:g0 + groups, 0, :]  # (groups, GROUP) i32
+        wr = _decode_write_offsets(
+            pk_buf[buf_slot, g0:g0 + groups, 0, :], storage
+        )  # (groups, GROUP)
         wr_row = wr.reshape(1, seg_nnz)
         lane_w = wr_row & 127
         sub_w = (wr_row >> 7) & 7
@@ -479,9 +652,9 @@ def _tile_kernel_seg(
 
 
 def _tile_kernel(
-    wslab_ref, rslab_ref, rrun_ref, packed_hbm, src_ref, out_ref,
+    wslab_ref, rslab_ref, rrun_ref, srun_ref, packed_hbm, src_ref, out_ref,
     acc_scratch, a_scratch, bt_scratch, p_scratch, pk_buf, dma_sem,
-    *, n_steps, groups, segs, square_vals, pipeline,
+    *, n_steps, groups, segs, run_groups, square_vals, pipeline, storage,
 ):
     """Single-launch kernel: a ``fori_loop`` over DMA steps, each step
     fetching ``segs * groups`` groups in ONE double-buffered DMA and
@@ -494,8 +667,11 @@ def _tile_kernel(
     gather/select/product into ``p_scratch`` (two slots under
     ``pipeline`` — see PIPELINE_SEGMENTS), phase 2 the per-group one-hot
     staging + per-segment MXU contraction, so the same skewed schedule
-    overlaps adjacent segments' VPU and MXU streams here too."""
+    overlaps adjacent segments' VPU and MXU streams here too. ``storage``
+    (KERNEL_DTYPE) changes only the per-group stream decode, exactly as in
+    the segment-batched kernel; accumulation stays f32 on every rung."""
     step_groups = segs * groups
+    step_runs = step_groups // run_groups
     iota8 = jax.lax.broadcasted_iota(jnp.int32, (8, GROUP), 0)
     iota_sub = jax.lax.broadcasted_iota(jnp.int32, (GROUP, GROUP), 0)
     acc_scratch[...] = jnp.zeros_like(acc_scratch)
@@ -512,7 +688,12 @@ def _tile_kernel(
         into ``p_scratch[p_slot]``."""
         for gi in range(groups):
             g = s2 * groups + gi
-            rd = pk_buf[buf_slot, g, 1, :]
+            # (1, GROUP) 2-D blocks (Mosaic's bitcast scope), squeezed
+            # after the shared decode
+            rd, vals = _decode_packed(
+                lambda s: pk_buf[buf_slot, g:g + 1, s, :], storage
+            )
+            rd, vals = rd[0, :], vals[0, :]
             lane_r = rd & 127
             sub_r = (rd >> 7) & 7
             rslab = rslab_ref[t * step_groups + g]
@@ -520,12 +701,16 @@ def _tile_kernel(
             gathered = jnp.take_along_axis(
                 slab, jnp.broadcast_to(lane_r[None, :], (8, GROUP)), axis=1
             )
+            if storage != "f32":
+                gathered = gathered.astype(jnp.float32)
             sel = (iota8 == sub_r[None, :]).astype(jnp.float32)
             src_vals = jnp.sum(gathered * sel, axis=0)  # (GROUP,)
-            vals = pltpu.bitcast(pk_buf[buf_slot, g, 2:3, :], jnp.float32)[0, :]
+            if storage == "int8":
+                vals = vals * srun_ref[t * step_runs + g // run_groups]
             if square_vals:
                 # Hessian-diagonal contraction (rmatvec_sq) squares the
                 # values in-register — no second packed stream needed
+                # (int8: after dequantization, so the square carries s²)
                 vals = vals * vals
             p_scratch[p_slot, gi, :] = vals * src_vals
 
@@ -535,7 +720,7 @@ def _tile_kernel(
         for gi in range(groups):
             g = s2 * groups + gi
             p = p_scratch[p_slot, gi, :]
-            wr = pk_buf[buf_slot, g, 0, :]
+            wr = _decode_write_offsets(pk_buf[buf_slot, g, 0, :], storage)
             lane_w = wr & 127
             sub_w = (wr >> 7) & 7
             cols = pl.ds(g * GROUP, GROUP)
@@ -586,18 +771,31 @@ def _tile_kernel(
     static_argnames=(
         "out_pad", "src_pad", "square_vals",
         "groups", "segs", "run_groups", "seg_batched", "pipeline",
-        "interpret",
+        "storage", "interpret",
     ),
 )
 def _tiled_apply_jit(
     layout_arrays, src, out_pad, src_pad, square_vals,
-    groups, segs, run_groups, seg_batched, pipeline, interpret,
+    groups, segs, run_groups, seg_batched, pipeline, storage, interpret,
 ):
-    packed, wslab, rslab, rrun = layout_arrays
+    packed, wslab, rslab, rrun, srun = layout_arrays
     step_groups = segs * groups
     n_steps = int(packed.shape[0]) // step_groups
     src_shape = (src_pad // 128, 128)
     out_shape = (out_pad // 128, 128)
+    src_mat = src.reshape(src_shape)
+    if storage != "f32":
+        # the gathered operand stores bf16 under both reduced rungs (the
+        # source vector changes per call, so per-call int8 quantization
+        # would buy nothing); products upcast to f32 inside phase 1
+        src_mat = src_mat.astype(jnp.bfloat16)
+    # packed-stream shape/dtype per rung (must match the layout builder):
+    # f32 (.., 3, GROUP) i32 | bf16 (.., 3, GROUP) i16 | int8 (.., 1,
+    # GROUP) i32 — a layout built under one rung fails loudly under a
+    # kernel compiled for another (the caches key on the rung, so the
+    # only way there is hand-assembling mismatched pieces)
+    n_streams = 1 if storage == "int8" else 3
+    buf_dtype = jnp.int16 if storage == "bf16" else jnp.int32
     # p_scratch: phase 1's per-segment products. The pipelined schedule
     # double-buffers it (segment s+1's phase 1 writes one slot while
     # segment s's phase 2 drains the other); straight-line needs one slot.
@@ -606,31 +804,32 @@ def _tiled_apply_jit(
         kernel = functools.partial(
             _tile_kernel_seg, n_steps=n_steps, groups=groups, segs=segs,
             run_groups=run_groups, square_vals=square_vals,
-            pipeline=pipeline,
+            pipeline=pipeline, storage=storage,
         )
         scratch = [
             pltpu.VMEM(out_shape, jnp.float32),
             pltpu.VMEM((p_slots, groups, GROUP), jnp.float32),  # p_scratch
-            pltpu.VMEM((2, step_groups, 3, GROUP), jnp.int32),
+            pltpu.VMEM((2, step_groups, n_streams, GROUP), buf_dtype),
             pltpu.SemaphoreType.DMA((2,)),
         ]
     else:
         kernel = functools.partial(
             _tile_kernel, n_steps=n_steps, groups=groups, segs=segs,
-            square_vals=square_vals, pipeline=pipeline,
+            run_groups=run_groups, square_vals=square_vals,
+            pipeline=pipeline, storage=storage,
         )
         scratch = [
             pltpu.VMEM(out_shape, jnp.float32),
             pltpu.VMEM((8, step_groups * GROUP), jnp.float32),
             pltpu.VMEM((GROUP, step_groups * GROUP), jnp.bfloat16),
             pltpu.VMEM((p_slots, groups, GROUP), jnp.float32),  # p_scratch
-            pltpu.VMEM((2, step_groups, 3, GROUP), jnp.int32),
+            pltpu.VMEM((2, step_groups, n_streams, GROUP), buf_dtype),
             pltpu.SemaphoreType.DMA((2,)),
         ]
     f = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,
+            num_scalar_prefetch=4,
             grid=(1,),
             in_specs=[
                 pl.BlockSpec(memory_space=_pallas_compat.ANY),
@@ -646,7 +845,7 @@ def _tiled_apply_jit(
         ),
         interpret=interpret,
     )
-    return f(wslab, rslab, rrun, packed, src.reshape(src_shape)).reshape(-1)
+    return f(wslab, rslab, rrun, srun, packed, src_mat).reshape(-1)
 
 
 def _tiled_apply(layout_arrays, src, out_pad, src_pad, square_vals=False):
@@ -661,12 +860,13 @@ def _tiled_apply(layout_arrays, src, out_pad, src_pad, square_vals=False):
     also what makes the compiled kernel a PROCESS-WIDE executable cache:
     any layout with the same stream shapes and constants — across
     streaming chunks, GAME visits and CV folds — re-enters the same
-    compiled program. PIPELINE_SEGMENTS is part of the same static key:
-    toggling the schedule mid-process recompiles, never reuses."""
+    compiled program. PIPELINE_SEGMENTS and the KERNEL_DTYPE storage rung
+    are part of the same static key: toggling either mid-process
+    recompiles, never reuses."""
     return _tiled_apply_jit(
         layout_arrays, src, out_pad, src_pad, square_vals,
         GROUPS_PER_STEP, SEGMENTS_PER_DMA, GROUPS_PER_RUN, SEGMENT_BATCHED,
-        bool(PIPELINE_SEGMENTS), _interpret(),
+        bool(PIPELINE_SEGMENTS), kernel_dtype(), _interpret(),
     )
 
 
@@ -679,8 +879,8 @@ def _tiled_apply(layout_arrays, src, out_pad, src_pad, square_vals=False):
 class _TileChunk:
     """One (row-range x col-range) kernel chunk: both direction layouts."""
 
-    m_arrays: tuple  # margins: (packed, wslab, rslab), write=row
-    g_arrays: tuple  # gradient: (packed, wslab, rslab), write=col
+    m_arrays: tuple  # margins: (packed, wslab, rslab, rrun, srun), write=row
+    g_arrays: tuple  # gradient: same five streams, write=col
     row_start: int = field(metadata=dict(static=True))
     col_start: int = field(metadata=dict(static=True))
     n_pad: int = field(metadata=dict(static=True))
@@ -767,10 +967,14 @@ def _build_chunk(
     rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
     row_start: int, col_start: int, n_pad: int, d_pad: int,
 ) -> _TileChunk:
-    m = build_write_major_layout(rows, cols, vals, n_pad, d_pad)
-    g = build_write_major_layout(cols, rows, vals, d_pad, n_pad)
+    storage = kernel_dtype()  # ONE call-time read for both directions
+    m = build_write_major_layout(rows, cols, vals, n_pad, d_pad,
+                                 storage=storage)
+    g = build_write_major_layout(cols, rows, vals, d_pad, n_pad,
+                                 storage=storage)
     as_j = lambda lay: tuple(
-        jnp.asarray(a) for a in (lay.packed, lay.wslab, lay.rslab, lay.rrun)
+        jnp.asarray(a)
+        for a in (lay.packed, lay.wslab, lay.rslab, lay.rrun, lay.srun)
     )
     return _TileChunk(
         m_arrays=as_j(m),
@@ -886,14 +1090,14 @@ def supports_tiling(batch) -> bool:
 
 
 def _pad_layout_groups(arrays: tuple, target_groups: int) -> tuple:
-    """Extend one direction's (packed, wslab, rslab, rrun) stream with
-    filler segments up to ``target_groups`` groups. Fillers use the
-    builder's tail convention — write slab 0, read slab 0, value 0 — and
-    contribute exactly 0; ``target_groups`` must be a whole-DMA-step
-    multiple (every built stream already is, so the max over shards is
-    too), and a DMA step is a whole number of runs."""
-    packed, wslab, rslab, rrun = arrays
-    n_groups = packed.shape[0]  # packed is (n_groups, 3, GROUP)
+    """Extend one direction's (packed, wslab, rslab, rrun, srun) stream
+    with filler segments up to ``target_groups`` groups. Fillers use the
+    builder's tail convention — write slab 0, read slab 0, value 0, scale
+    1 — and contribute exactly 0; ``target_groups`` must be a
+    whole-DMA-step multiple (every built stream already is, so the max
+    over shards is too), and a DMA step is a whole number of runs."""
+    packed, wslab, rslab, rrun, srun = arrays
+    n_groups = packed.shape[0]  # packed is (n_groups, S, GROUP)
     if n_groups == target_groups:
         return arrays
     add = target_groups - n_groups
@@ -905,7 +1109,8 @@ def _pad_layout_groups(arrays: tuple, target_groups: int) -> tuple:
     wslab = jnp.concatenate([wslab, jnp.zeros((segs,), wslab.dtype)])
     runs = add // GROUPS_PER_RUN
     rrun = jnp.concatenate([rrun, jnp.zeros((runs,), rrun.dtype)])
-    return (packed, wslab, rslab, rrun)
+    srun = jnp.concatenate([srun, jnp.ones((runs,), srun.dtype)])
+    return (packed, wslab, rslab, rrun, srun)
 
 
 def pad_chunks_to_common_groups(tbs: list) -> list[list]:
@@ -982,11 +1187,11 @@ def tile_sparse_batch_sharded(batch, n_dev: int):
             _TileChunk(
                 m_arrays=tuple(
                     jnp.stack([c.m_arrays[i] for c in padded[j]])
-                    for i in range(4)
+                    for i in range(5)
                 ),
                 g_arrays=tuple(
                     jnp.stack([c.g_arrays[i] for c in padded[j]])
-                    for i in range(4)
+                    for i in range(5)
                 ),
                 row_start=ref.chunks[j].row_start,
                 col_start=ref.chunks[j].col_start,

@@ -421,11 +421,16 @@ class StreamingGLMObjective:
 
         depth = prefetch.prefetch_depth()
         if depth <= 0:
-            nxt = jax.device_put(slim(self.chunks[0]))
+            # pack_host_chunk: raw feature columns transfer at the
+            # precision ladder's storage dtype here too (identity on the
+            # f32 rung, so depth 0 stays the pre-prefetch path bit-for-bit)
+            nxt = jax.device_put(prefetch.pack_host_chunk(slim(self.chunks[0])))
             for i in range(len(self.chunks)):
                 cur = nxt
                 if i + 1 < len(self.chunks):
-                    nxt = jax.device_put(slim(self.chunks[i + 1]))
+                    nxt = jax.device_put(
+                        prefetch.pack_host_chunk(slim(self.chunks[i + 1]))
+                    )
                 out = kernel(self._chunk_batch(cur, i), params)
                 acc = accumulate(acc, out)
             return acc
@@ -575,8 +580,16 @@ class StreamingGLMObjective:
         # and a per-objective jit would re-compile scoring on every
         # rebuild instead of re-entering the process-wide cache
         if depth <= 0:
+            # raw (un-tiled) chunks score at the ladder's transfer dtype,
+            # like the streamed objective's depth-0 path; tiled chunks
+            # only consume labels/offsets/weights here (identity pack)
+            pack = (
+                (lambda c: c)
+                if self._tile_layouts is not None
+                else prefetch.pack_host_chunk
+            )
             outs = [
-                np.asarray(_score_matvec(self._chunk_batch(c, i), w))
+                np.asarray(_score_matvec(self._chunk_batch(pack(c), i), w))
                 for i, c in enumerate(self.chunks)
             ]
             return np.concatenate(outs)[:num_rows]
@@ -693,6 +706,11 @@ def stream_scores(
 
     def prepare(i):
         c = chunks[i]
+        if not (want_tiling and sparse):
+            # raw chunks score at the ladder's transfer dtype (identity
+            # on the f32 rung); tiled chunks keep their f32 values — the
+            # layout builder owns their storage-precision conversion
+            c = prefetch.pack_host_chunk(c)
         b = _to_batch(c, num_features)
         if want_tiling and sparse:
             from photon_ml_tpu.ops import tile_cache

@@ -81,6 +81,10 @@ def tuned_constants() -> tuple:
         # a spurious miss is one re-pack, the cost of a stale hit under a
         # future layout-coupled schedule would be silent garbage)
         bool(st.PIPELINE_SEGMENTS),
+        # the precision rung RESHAPES the packed streams (f32 i32x3 /
+        # bf16 i16x3 / int8 i32x1 + scales): a stale hit across a toggle
+        # would hand the kernel streams of the wrong width
+        st.kernel_dtype(),
     )
 
 

@@ -42,6 +42,63 @@ def pytest_collection_modifyitems(config, items):
     items.sort(key=lambda it: it.get_closest_marker("kernel") is not None)
 
 
+# Tier-1 runtime guard (the suite sits NEAR the 870 s budget): every
+# kernel-marked test must trace its Pallas kernels at retuned-DOWN
+# constants — interpret-mode cost scales with the DMA-step carve, and one
+# test silently instantiating default-size tiles (GROUPS_PER_STEP=32 x
+# SEGMENTS_PER_DMA=4 = 16K-nnz steps) costs ~an order of magnitude more
+# than the 8x2 test discipline. Collection cannot see what a test will
+# build, so the fixture below (a) RETUNES kernel-marked tests down to the
+# 8x2 carve by default (tests may monkeypatch further; the layout builder
+# and kernel read the constants at call time, so both sides track), and
+# (b) wraps the layout builder to fail AT THE BUILD, with an actionable
+# message, if a test restores a default-size carve. Run the tier-1
+# command with ``--durations=15`` (see ROADMAP) to spot runtime creep.
+_KERNEL_TEST_MAX_STEP_NNZ = 8 * 2 * 128  # the retuned-down 8x2 carve
+
+
+@pytest.fixture(autouse=True)
+def _kernel_test_constants_guard(request):
+    if request.node.get_closest_marker("kernel") is None:
+        yield
+        return
+    import photon_ml_tpu.ops.sparse_tiled as st
+
+    orig_build = st.build_write_major_layout
+    orig_constants = (st.GROUPS_PER_STEP, st.SEGMENTS_PER_DMA)
+    st.GROUPS_PER_STEP, st.SEGMENTS_PER_DMA = 8, 2
+
+    def guarded(*args, **kwargs):
+        # groups_per_step is parameter #6 of build_write_major_layout —
+        # resolve positional and keyword spellings alike, or a positional
+        # call would silently bypass the guard
+        gps = kwargs.get("groups_per_step")
+        if gps is None and len(args) > 5:
+            gps = args[5]
+        if gps is None:
+            gps = st.GROUPS_PER_STEP
+        step_nnz = gps * st.SEGMENTS_PER_DMA * st.GROUP
+        if step_nnz > _KERNEL_TEST_MAX_STEP_NNZ:
+            pytest.fail(
+                f"kernel-marked test built a tile layout at default-size "
+                f"constants (GROUPS_PER_STEP={gps} x SEGMENTS_PER_DMA="
+                f"{st.SEGMENTS_PER_DMA} = {step_nnz}-nnz DMA steps > "
+                f"{_KERNEL_TEST_MAX_STEP_NNZ}). Interpret-mode kernel cost "
+                f"scales with the step carve and the tier-1 suite sits "
+                f"near its 870 s budget: keep the retuned-down constants "
+                f"this fixture installs (or monkeypatch smaller), or drop "
+                f"the kernel marker if no kernel is traced."
+            )
+        return orig_build(*args, **kwargs)
+
+    st.build_write_major_layout = guarded
+    try:
+        yield
+    finally:
+        st.build_write_major_layout = orig_build
+        st.GROUPS_PER_STEP, st.SEGMENTS_PER_DMA = orig_constants
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -89,6 +89,30 @@ class TestQuickMode:
             "kernel_constants": {
                 "groups_per_run": 2,
                 "pipeline_segments": 1,
+                "kernel_dtype": "bf16",
+            },
+            "packed_stream_bytes_per_pass": 196608,
+            "quality_parity": {
+                "kernel_dtype": "bf16",
+                "auc": 0.995066,
+                "auc_f32": 0.995074,
+                "auc_delta": -9e-06,
+                "final_loss": 983.320618,
+                "final_loss_f32": 983.277466,
+                "loss_rel_delta": 4.4e-05,
+                "margins_rmse_vs_f32": 0.003478,
+            },
+            "telemetry": {
+                "schema_version": 1,
+                "metrics": {
+                    "counters": {}, "gauges": {}, "histograms": {},
+                    "timers": {},
+                },
+                "knobs": {"kernel_dtype": "bf16", "groups_per_run": 2},
+                "quality_parity": {
+                    "kernel_dtype": "bf16",
+                    "auc_delta": -9e-06,
+                },
             },
         },
         "R_re_skew": {
@@ -181,6 +205,18 @@ class TestQuickMode:
         constants = payload["configs"]["A2_sparse_highdim"]["kernel_constants"]
         assert constants["pipeline_segments"] == 1
         assert constants["groups_per_run"] == 2
+        # the precision-ladder knob rides the same contract: kernel_dtype
+        # in kernel_constants, the per-rung streamed bytes, and the
+        # quality-parity block (AUC/loss deltas vs the f32 anchor) both
+        # at top level and inside the telemetry block — a dtype sweep is
+        # auditable (speed AND quality gate) from stdout alone
+        assert constants["kernel_dtype"] == "bf16"
+        a2 = payload["configs"]["A2_sparse_highdim"]
+        assert a2["packed_stream_bytes_per_pass"] == 196608
+        assert a2["quality_parity"]["auc_delta"] == -9e-06
+        assert a2["quality_parity"]["kernel_dtype"] == "bf16"
+        assert a2["telemetry"]["knobs"]["kernel_dtype"] == "bf16"
+        assert a2["telemetry"]["quality_parity"]["auc_delta"] == -9e-06
         # the host-ingest pipeline knobs round-trip the same way: F's
         # prefetch depth + chunk-cache budget (and the measured host-pack
         # overlap ratio) appear verbatim in the single JSON line
@@ -263,13 +299,21 @@ class TestQuickMode:
         monkeypatch.setattr(st, "GROUPS_PER_RUN", 2)
         monkeypatch.setattr(st, "GROUPS_PER_STEP", 32)
         monkeypatch.setattr(st, "PIPELINE_SEGMENTS", 1)
+        monkeypatch.setattr(st, "KERNEL_DTYPE", "f32")
         monkeypatch.setenv("PHOTON_GROUPS_PER_RUN", "4")
         monkeypatch.setenv("PHOTON_GROUPS_PER_STEP", "16")
         monkeypatch.setenv("PHOTON_PIPELINE_SEGMENTS", "0")
+        monkeypatch.setenv("PHOTON_KERNEL_DTYPE", "int8")
         bench._apply_retune_env()
         assert st.GROUPS_PER_RUN == 4
         assert st.GROUPS_PER_STEP == 16
         assert st.PIPELINE_SEGMENTS == 0
+        # the one string knob parses as a validated string, not an int
+        assert st.KERNEL_DTYPE == "int8"
+        # knob snapshot (telemetry block / run_start) reflects it
+        from photon_ml_tpu.obs.sink import _knob_snapshot
+
+        assert _knob_snapshot()["kernel_dtype"] == "int8"
 
     def test_telemetry_block_shape(self, monkeypatch):
         """The block every config subprocess attaches: the typed registry

@@ -169,6 +169,84 @@ class TestDeviceChunkCache:
         monkeypatch.setattr(prefetch, "CHUNK_CACHE_BUDGET", None)
         assert prefetch.chunk_cache_budget_bytes() > 0  # device query
 
+    def test_device_tier_charges_post_pack_nbytes(self, monkeypatch):
+        """The device budget charges the ACTUAL device array (post-pack
+        dtype), not the host f32: a bf16 pass fits ~2x the chunks under
+        the same PHOTON_CHUNK_CACHE_BUDGET."""
+        arrays = [np.full(256, i, np.float32) for i in range(2)]  # 1 KiB each
+        monkeypatch.setattr(prefetch, "CHUNK_CACHE_BUDGET", 1024)
+        # ample HOST budget: this test isolates the DEVICE-tier charge
+        # (the host-pinning bound has its own admission check)
+        monkeypatch.setattr(prefetch, "HOST_SPILL_BUDGET", 1 << 20)
+        monkeypatch.setenv("PHOTON_KERNEL_DTYPE", "f32")
+        for a in arrays:
+            prefetch.cached_device_put({"values": a})
+        s = prefetch.cache_stats()
+        assert s["device_entries"] == 1 and s["evictions"] == 1
+        prefetch.clear_cache()
+        monkeypatch.setenv("PHOTON_KERNEL_DTYPE", "bf16")
+        for a in arrays:
+            prefetch.cached_device_put({"values": a})
+        s = prefetch.cache_stats()
+        # both bf16 twins (512 B each) fit where one f32 array did
+        assert s["device_entries"] == 2 and s["evictions"] == 0
+        assert s["device_bytes"] == 1024
+
+    def test_aggregate_view_pinning_bounded_by_host_budget(self, monkeypatch):
+        """Many small views of DISTINCT large bases: each admits alone,
+        but the AGGREGATE host RAM their refs pin is bounded by the host
+        budget — device entries evict on host-pin pressure, not just on
+        their (tiny) device bytes."""
+        monkeypatch.setattr(prefetch, "CHUNK_CACHE_BUDGET", 1 << 20)
+        monkeypatch.setattr(prefetch, "HOST_SPILL_BUDGET", 8192)
+        bases = [np.zeros(1024, np.float32) for _ in range(8)]  # 4 KiB each
+        for b in bases:
+            prefetch.cached_device_put({"x": b[:16]})  # 64 B on device
+        s = prefetch.cache_stats()
+        assert s["device_host_pinned_bytes"] <= 8192  # two bases' worth
+        assert s["device_entries"] <= 2 and s["evictions"] >= 6
+
+    def test_small_view_of_huge_base_never_pinned(self, monkeypatch):
+        """A few-KB slice VIEW of a base larger than the host budget must
+        not cache: its device copy is tiny, but holding the ref would pin
+        the whole base in host RAM past both budgets (the pre-ladder
+        guarantee, kept alongside the post-pack device-tier charge)."""
+        monkeypatch.setattr(prefetch, "CHUNK_CACHE_BUDGET", 1 << 20)
+        monkeypatch.setattr(prefetch, "HOST_SPILL_BUDGET", 4096)
+        base = np.zeros(4096, np.float32)  # 16 KiB > host budget
+        out = prefetch.cached_device_put({"x": base[:64]})
+        assert out["x"].shape == (64,)
+        assert prefetch.cache_stats()["device_entries"] == 0
+
+    def test_eviction_at_mixed_dtypes(self, monkeypatch):
+        """Eviction with packed (values → bf16) and unpacked (labels, f32)
+        entries interleaved: byte totals stay coherent, and a spilled
+        packed entry re-enters from the host tier with its PACKED twin —
+        one device_put, no re-pack, correct values."""
+        import ml_dtypes
+
+        monkeypatch.setenv("PHOTON_KERNEL_DTYPE", "bf16")
+        vals = [np.full(256, i, np.float32) for i in range(3)]  # 512 B bf16
+        labs = [np.full(128, i, np.float32) for i in range(3)]  # 512 B f32
+        # fits exactly one (values, labels) pair on the device tier
+        monkeypatch.setattr(prefetch, "CHUNK_CACHE_BUDGET", 1024)
+        monkeypatch.setattr(prefetch, "HOST_SPILL_BUDGET", 1 << 20)
+        for v, l in zip(vals, labs):
+            out = prefetch.cached_device_put({"values": v, "labels": l})
+            assert out["values"].dtype == jnp.bfloat16
+            assert out["labels"].dtype == np.float32
+        s = prefetch.cache_stats()
+        assert s["device_bytes"] <= 1024
+        assert s["evictions"] == 4  # two pairs pushed out
+        # re-entry of the oldest pair: HOST hits (staged bf16 retained)
+        out = prefetch.cached_device_put({"values": vals[0], "labels": labs[0]})
+        assert prefetch.cache_stats()["host_hits"] == 2
+        np.testing.assert_array_equal(
+            np.asarray(out["values"]).astype(np.float32),
+            vals[0].astype(ml_dtypes.bfloat16).astype(np.float32),
+        )
+        np.testing.assert_array_equal(np.asarray(out["labels"]), labs[0])
+
     def test_concurrent_mixed_puts_stay_coherent(self, monkeypatch):
         from concurrent.futures import ThreadPoolExecutor
 

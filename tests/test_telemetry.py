@@ -438,6 +438,56 @@ class TestExportAndReport:
         assert "wasted-lane" in d and "exec-entity-it" in d
         assert "1000" in d and "660" in d
 
+    def test_report_quality_parity_section(self, tmp_path, monkeypatch):
+        """A quality_parity event (emitted by a reduced-precision bench
+        run) surfaces in summarize, format_summary and diff — the
+        precision ladder's quality gate reads from the same report as the
+        wall numbers."""
+        monkeypatch.setenv("PHOTON_KERNEL_DTYPE", "bf16")
+        path_b = obs.configure(str(tmp_path / "b"), run_id="runBF16")
+        obs.emit_event(
+            "quality_parity", kernel_dtype="bf16",
+            auc=0.9951, auc_f32=0.9950, auc_delta=0.0001,
+            final_loss=983.32, final_loss_f32=983.28,
+            loss_rel_delta=4.4e-05, margins_rmse_vs_f32=0.0035,
+        )
+        obs.shutdown()
+        monkeypatch.delenv("PHOTON_KERNEL_DTYPE")
+        path_a = obs.configure(str(tmp_path / "a"), run_id="runF32")
+        obs.shutdown()
+        b = summarize_run(path_b)
+        assert b["quality_parity"]["kernel_dtype"] == "bf16"
+        assert b["quality_parity"]["auc_delta"] == 0.0001
+        assert b["knobs"]["kernel_dtype"] == "bf16"
+        text = format_summary(b)
+        assert "quality-parity" in text and "kernel_dtype=bf16" in text
+        assert "auc_delta=+0.000100" in text
+        a = summarize_run(path_a)
+        assert a["quality_parity"] is None
+        d = diff_summaries(a, b)
+        assert "quality-parity" in d
+        assert "(unrecorded)" in d  # run A recorded no parity block
+        assert "kernel_dtype: 'f32' -> 'bf16'" in d  # the knob delta too
+
+    def test_report_diff_renders_asymmetric_retune_knobs(self, tmp_path):
+        """A RETUNE knob recorded by only ONE run (an older-schema run,
+        or a pre-knob baseline) must still render in the knob-delta table
+        as '(unrecorded)' instead of being silently dropped."""
+        path_a = obs.configure(str(tmp_path / "a"), run_id="oldRun")
+        obs.shutdown()
+        path_b = obs.configure(str(tmp_path / "b"), run_id="newRun")
+        obs.shutdown()
+        a, b = summarize_run(path_a), summarize_run(path_b)
+        # simulate an old run that predates the kernel_dtype knob (and
+        # one knob recorded nowhere at all — absent from the table)
+        a["knobs"] = {k: v for k, v in a["knobs"].items()
+                      if k not in ("kernel_dtype", "re_compact_every")}
+        b["knobs"] = {k: v for k, v in b["knobs"].items()
+                      if k != "re_compact_every"}
+        d = diff_summaries(a, b)
+        assert "kernel_dtype: '(unrecorded)' -> 'f32'" in d
+        assert "re_compact_every" not in d
+
     def test_report_diffs_two_synthetic_runs(self, tmp_path, monkeypatch):
         run_a = self._make_run(tmp_path / "a", "runA")
         monkeypatch.setenv("PHOTON_PREFETCH_DEPTH", "0")
