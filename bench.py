@@ -570,7 +570,7 @@ def _sparse_logistic_bench(jax, jnp, n, d, k, iters, densify_dtype,
     from photon_ml_tpu.ops.batch import maybe_densify
     from photon_ml_tpu.ops.glm import make_objective
     from photon_ml_tpu.ops.losses import loss_for_task
-    from photon_ml_tpu.optim import lbfgs_minimize
+    from photon_ml_tpu.optim.common import select_minimize_fn
     from photon_ml_tpu.types import TaskType
 
     sparse_batch, w_true = _make_sparse_problem(jax, jnp, n, d, k, seed=1)
@@ -593,6 +593,11 @@ def _sparse_logistic_bench(jax, jnp, n, d, k, iters, densify_dtype,
     )
     cfg = OptimizerConfig(max_iterations=iters, tolerance=0.0)
     w0 = jnp.zeros((d,), jnp.float32)
+    # the library's own selection boundary (optim/common): the returned
+    # solver carries the obs/devcost capture twin, so the warm-up solve's
+    # fresh executable lands its analytic flops/bytes in telemetry —
+    # keyed by the active knob tuple (dtype rung, segments, groups/run)
+    lbfgs_minimize, _ = select_minimize_fn(cfg)
 
     itemsize = 2 if densified and densify_dtype == jnp.bfloat16 else 4
     if tiled:
@@ -1680,32 +1685,55 @@ def _telemetry_block() -> dict:
     }
 
 
-def _run_one(name: str, quick: bool = False) -> None:
-    """Child mode: run one config, print its result JSON on stdout."""
+def _run_one(name: str, quick: bool = False,
+             telemetry_dir: str | None = None) -> None:
+    """Child mode: run one config, print its result JSON on stdout.
+
+    ``telemetry_dir`` archives this config's full telemetry JSONL next to
+    the bench artifact (one run file per config, run_id = config name —
+    the ROADMAP sweep-backlog format); quick and telemetry runs also
+    enable analytic device-cost capture (``PHOTON_DEVCOST``, overridable
+    from the environment) so ``devcost.*`` gauges ride the JSON contract
+    and ``photon-ml-tpu report gate`` can tripwire byte/flop regressions
+    from a ``--quick`` capture alone."""
     global QUICK, REPEATS
     if quick:
         QUICK = True
         REPEATS = 1
+    if quick or telemetry_dir:
+        os.environ.setdefault("PHOTON_DEVCOST", "1")
     _apply_retune_env()
     # installs the jax.monitoring compile listener BEFORE the config's
     # first compile — configs that never touch an obs-importing module
     # (pure-ops configs like A) would otherwise report no jax.compile_s
-    import photon_ml_tpu.obs  # noqa: F401
+    import photon_ml_tpu.obs as obs
+
+    run_path = None
+    if telemetry_dir:
+        run_path = obs.configure(telemetry_dir, run_id=name)
 
     import jax
     import jax.numpy as jnp
 
-    result = CONFIGS[name](jax, jnp)
-    result["telemetry"] = _telemetry_block()
-    if "quality_parity" in result:
-        # the quality gate rides the telemetry block too (the protocol's
-        # "never report speed without a parity check" — a dtype sweep
-        # diffs quality from the same block it diffs cache traffic from)
-        result["telemetry"]["quality_parity"] = result["quality_parity"]
+    try:
+        result = CONFIGS[name](jax, jnp)
+        result["telemetry"] = _telemetry_block()
+        if "quality_parity" in result:
+            # the quality gate rides the telemetry block too (the protocol's
+            # "never report speed without a parity check" — a dtype sweep
+            # diffs quality from the same block it diffs cache traffic from)
+            result["telemetry"]["quality_parity"] = result["quality_parity"]
+        if telemetry_dir:
+            # round-trip the archive location through the JSON contract
+            result["telemetry"]["telemetry_dir"] = telemetry_dir
+            result["telemetry"]["run_path"] = run_path
+    finally:
+        obs.shutdown()  # emit run_end + flush durably (no-op when disabled)
     print(json.dumps(result))
 
 
-def _run_config_subprocess(name: str, quick: bool = False) -> dict:
+def _run_config_subprocess(name: str, quick: bool = False,
+                           telemetry_dir: str | None = None) -> dict:
     """Run one config in a fresh subprocess; return its result dict (or an
     {"error": ...} dict — an impossible number or a crash is reported,
     never faked). Factored out so the contract test can stub the child."""
@@ -1714,7 +1742,7 @@ def _run_config_subprocess(name: str, quick: bool = False) -> dict:
     here = os.path.abspath(__file__)
     argv = [sys.executable, here, "--config", name] + (
         ["--quick"] if quick else []
-    )
+    ) + (["--telemetry-dir", telemetry_dir] if telemetry_dir else [])
     try:
         proc = subprocess.run(
             argv, capture_output=True, text=True, timeout=900,
@@ -1728,7 +1756,7 @@ def _run_config_subprocess(name: str, quick: bool = False) -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
-def main(quick: bool = False) -> None:
+def main(quick: bool = False, telemetry_dir: str | None = None) -> None:
     # Each config runs in its OWN subprocess, sequentially (two concurrent
     # TPU processes deadlock on this platform's relay): device memory is
     # fully released between configs — closure-captured batches baked into
@@ -1738,7 +1766,14 @@ def main(quick: bool = False) -> None:
     names = QUICK_CONFIGS if quick else tuple(CONFIGS)
     for name in names:
         _log(f"[bench] {name} ...")
-        results[name] = _run_config_subprocess(name, quick=quick)
+        if telemetry_dir:
+            results[name] = _run_config_subprocess(
+                name, quick=quick, telemetry_dir=telemetry_dir
+            )
+        else:
+            # keyword shape kept stable: the contract test stubs this
+            # callable with a (name, quick=...) lambda
+            results[name] = _run_config_subprocess(name, quick=quick)
         _log(f"[bench] {name}: {json.dumps(results[name])[:300]}")
 
     head = results.get("headline_dense_logistic", {})
@@ -1764,6 +1799,7 @@ def main(quick: bool = False) -> None:
                 "unit": "samples/s",
                 "vs_baseline": head.get("vs_one_core_proxy"),
                 "quick": quick,
+                "telemetry_dir": telemetry_dir,
                 "quality": {
                     "auc": head.get("auc"),
                     "auc_generating_model": head.get("auc_generating_model"),
@@ -1861,15 +1897,24 @@ def update_baseline(results: dict | None = None) -> None:
 
 if __name__ == "__main__":
     args = sys.argv[1:]
+    telemetry_dir = None
+    if "--telemetry-dir" in args:
+        i = args.index("--telemetry-dir")
+        if i + 1 >= len(args):
+            _log("usage: --telemetry-dir requires a directory argument")
+            sys.exit(2)
+        telemetry_dir = args[i + 1]
+        del args[i:i + 2]
     if len(args) >= 2 and args[0] == "--config":
-        _run_one(args[1], quick="--quick" in args[2:])
+        _run_one(args[1], quick="--quick" in args[2:],
+                 telemetry_dir=telemetry_dir)
     elif args == ["--update-baseline"]:
         update_baseline()
     elif args == ["--quick"]:
-        main(quick=True)
+        main(quick=True, telemetry_dir=telemetry_dir)
     elif not args:
-        main()
+        main(telemetry_dir=telemetry_dir)
     else:
         _log(f"usage: bench.py [--quick | --update-baseline | "
-             f"--config NAME [--quick]]; got {args}")
+             f"--config NAME [--quick]] [--telemetry-dir DIR]; got {args}")
         sys.exit(2)

@@ -44,6 +44,19 @@ from photon_ml_tpu.types import VarianceComputationType
 
 Array = jnp.ndarray
 
+
+def _captured_jit_call(label, fn, *args, **kwargs):
+    """Invoke a jitted bucket-solve boundary with analytic cost capture
+    (``obs/devcost``). The solver entry points themselves only ever run
+    INSIDE these jits (vmapped over the entity lane), where capture's
+    tracer check skips — so THIS is where the RE solve's executable cost
+    is captured, once per (knob tuple, bucket geometry). Under a
+    fused-visit trace the args are tracers and capture skips itself."""
+    from photon_ml_tpu.obs import devcost
+
+    devcost.capture(label, fn, args, kwargs)
+    return fn(*args, **kwargs)
+
 # Convergence-aware bucket-solve knobs (bench RETUNE idiom: the env var
 # wins over the module global, both read at CALL time so bench child
 # processes and tests retune without import-order games).
@@ -533,7 +546,9 @@ def _solve_bucket_compacted(
     step = max(int(compact_every_n), 1)
     common = dict(loss=loss, config=config, intercept_index=intercept_index)
 
-    full_state = _lanes_init(
+    full_state = _captured_jit_call(
+        "re_solve.lanes_init",
+        _lanes_init,
         bucket_batch, w0, l2_weight, norm, prior_mu, prior_var,
         init_fn=chunked.init, **common, **minimize_kwargs,
     )
@@ -550,7 +565,9 @@ def _solve_bucket_compacted(
     bound = 0
     while True:
         bound = min(bound + step, T)
-        state = _lanes_run(
+        state = _captured_jit_call(
+            "re_solve.lanes_run",
+            _lanes_run,
             front_batch, state, jnp.int32(bound), l2_weight, norm,
             front_mu, front_var, run_fn=chunked.run, **common,
             **minimize_kwargs,
@@ -637,7 +654,9 @@ def _solve_bucket_compacted(
             float(useful_total) / float(executed_total),
         )
     REGISTRY.counter_inc("re_solve.launches")
-    return _lanes_finalize(
+    return _captured_jit_call(
+        "re_solve.lanes_finalize",
+        _lanes_finalize,
         bucket_batch, full_state, l2_weight, norm, prior_mu, prior_var,
         fin_fn=chunked.finalize, variance_computation=variance_computation,
         **common, **minimize_kwargs,
@@ -679,7 +698,9 @@ def solve_bucket_lanes(
             config, minimize_kwargs.get("l1_weight", 0.0)
         )
     if chunked is None:
-        out = _solve_bucket(
+        out = _captured_jit_call(
+            "re_solve.bucket",
+            _solve_bucket,
             bucket_batch,
             w0,
             l2_weight,
@@ -1029,7 +1050,9 @@ def _train_prepared_core(
                 **extra,
             )
         else:
-            W, V, f_k, it_k, reason_k = _bucket_step(
+            W, V, f_k, it_k, reason_k = _captured_jit_call(
+                "re_solve.bucket_step",
+                _bucket_step,
                 W,
                 V,
                 offsets,

@@ -1,7 +1,7 @@
 """Run-telemetry subsystem: spans, metrics registry, JSONL sink, exporters.
 
 The TPU-native replacement for the observability the reference got from
-Spark's UI/event timeline (SURVEY.md §5.1). Four pieces:
+Spark's UI/event timeline (SURVEY.md §5.1). Five pieces:
 
 - **spans** (``span("descent/iter", coordinate=cid)``) — nested host-side
   wall-clock spans, thread-correct across the prefetch worker pool;
@@ -12,15 +12,21 @@ Spark's UI/event timeline (SURVEY.md §5.1). Four pieces:
   run, one schema-versioned file, atomically rotated, single-writer
   under multihost;
 - **exporters** — ``obs.export`` renders a run as a Chrome-trace/Perfetto
-  JSON next to ``jax.profiler`` device traces; ``obs.report`` summarizes
-  and diffs runs (surfaced as ``photon-ml-tpu report``).
+  JSON next to ``jax.profiler`` device traces; ``obs.report`` summarizes,
+  diffs, validates and GATES runs (surfaced as ``photon-ml-tpu report``);
+- **analytic device cost** (``obs.devcost``) — per-executable XLA
+  ``cost_analysis``/``memory_analysis`` capture on fresh compiles plus
+  HBM budget/watermark sampling, feeding the report's roofline table and
+  the ``report gate`` regression tripwire.
 
 Everything here is host-side and cheap: with no sink configured, spans
 return a shared no-op and event emission is one attribute check, so the
 instrumentation stays wired through production paths unconditionally.
 """
 
+from photon_ml_tpu.obs import devcost  # noqa: F401
 from photon_ml_tpu.obs import metrics  # noqa: F401
+from photon_ml_tpu.obs.devcost import capture as capture_executable_cost  # noqa: F401
 from photon_ml_tpu.obs.metrics import REGISTRY  # noqa: F401
 from photon_ml_tpu.obs.sink import (  # noqa: F401
     SCHEMA_VERSION,

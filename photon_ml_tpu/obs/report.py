@@ -182,6 +182,121 @@ def summarize_run(path: str) -> dict:
                 k: v for k, v in r.items() if k not in ("event", "t")
             }
 
+    # analytic device cost (obs/devcost executable_cost records): one
+    # roofline row per (capture label, knob tuple) — flops,
+    # bytes-accessed, arithmetic intensity, peak memory. Sums are over
+    # fresh executables only (the capture layer dedups cache hits).
+    # Aggregating across knob tuples would merge precision rungs (a
+    # reduced-rung run can capture the same label under both rungs), so
+    # a label that appears under several knob tuples gets one row per
+    # tuple, suffixed with the knobs that differ.
+    by_label_knobs: dict[tuple, dict] = {}
+    for r in records:
+        if r["event"] != "executable_cost":
+            continue
+        knobs = r.get("knobs") or {}
+        k = (str(r.get("label")), tuple(sorted(knobs.items())))
+        agg = by_label_knobs.setdefault(
+            k,
+            {
+                "captures": 0, "flops": 0.0, "bytes_accessed": 0.0,
+                "peak_bytes": 0, "peak_is_estimate": False,
+                "capture_s": 0.0, "knobs": knobs,
+            },
+        )
+        agg["captures"] += 1
+        agg["flops"] += float(r.get("flops") or 0.0)
+        agg["bytes_accessed"] += float(r.get("bytes_accessed") or 0.0)
+        agg["peak_bytes"] = max(
+            agg["peak_bytes"], int(r.get("peak_bytes") or 0)
+        )
+        agg["peak_is_estimate"] = agg["peak_is_estimate"] or bool(
+            r.get("peak_is_estimate")
+        )
+        agg["capture_s"] += float(r.get("capture_s") or 0.0)
+    label_variants: dict[str, list] = {}
+    for (lab, _), agg in by_label_knobs.items():
+        label_variants.setdefault(lab, []).append(agg)
+    run_knobs = run_start.get("knobs", {})
+    devcost: dict[str, dict] = {}
+    for lab, variants in label_variants.items():
+        if len(variants) == 1:
+            devcost[lab] = variants[0]
+            continue
+        # naming must be STABLE for gating: the variant matching the
+        # RUN'S OWN knobs keeps the bare label (the name a single-variant
+        # baseline run produced), and off-run variants (e.g. the f32
+        # quality-parity anchor captured inside a bf16 run) are suffixed
+        # by their delta vs the run knobs — so adding an anchor capture
+        # never renames the run's native metrics out from under a
+        # committed baseline
+        all_keys = set().union(*(v["knobs"] for v in variants))
+        differing_between = sorted(
+            kk for kk in all_keys
+            if len({repr(v["knobs"].get(kk)) for v in variants}) > 1
+        )
+        for v in variants:
+            diff_vs_run = sorted(
+                kk for kk in v["knobs"]
+                if repr(v["knobs"][kk]) != repr(run_knobs.get(kk))
+            )
+            if not diff_vs_run and lab not in devcost:
+                # `lab not in devcost`: two variants can BOTH be
+                # consistent with the run knobs (one captured with a
+                # partial knob dict) — the second must fall through to a
+                # suffixed name instead of overwriting the first
+                devcost[lab] = v
+                continue
+            suffix = ",".join(f"{kk}={v['knobs'][kk]}" for kk in diff_vs_run)
+            name = f"{lab}[{suffix}]" if diff_vs_run else lab
+            if name in devcost:  # disambiguate fully
+                suffix = ",".join(
+                    f"{kk}={v['knobs'].get(kk)}" for kk in differing_between
+                )
+                name = f"{lab}[{suffix}]"
+            devcost[name] = v
+    for agg in devcost.values():
+        b = agg["bytes_accessed"]
+        agg["arith_intensity"] = (agg["flops"] / b) if b else None
+
+    # runtime HBM axis: budget source (queried vs fallback) + watermark
+    # samples from root-span exits; explicit unavailability on backends
+    # without memory stats, so "no pressure" and "no instrument" read
+    # differently
+    gauges = metrics.get("gauges", {})
+    budget_ev = [r for r in records if r["event"] == "hbm_budget"]
+    wm = [r for r in records if r["event"] == "hbm_watermark"]
+    wm_avail = [r for r in wm if r.get("available")]
+    # source: the hbm_budget event when one landed, else the persistent
+    # hbm.budget_queried gauge (the FIRST budget query of a run can
+    # precede sink activation — run_start's own knob snapshot triggers
+    # it — and later calls are memoized, so the gauge is the durable
+    # record of which source won)
+    if budget_ev:
+        budget_source = budget_ev[-1].get("source")
+    elif gauges.get("hbm.budget_bytes") is not None:
+        budget_source = (
+            "device_memory_stats"
+            if gauges.get("hbm.budget_queried") else "fallback_default"
+        )
+    else:
+        budget_source = None
+    hbm = {
+        "budget_bytes": (
+            budget_ev[-1].get("budget_bytes") if budget_ev
+            else gauges.get("hbm.budget_bytes")
+        ),
+        "budget_source": budget_source,
+        "memory_stats_available": (
+            bool(wm_avail) if wm else None  # None = never sampled
+        ),
+        "watermark_samples": len(wm_avail),
+        "peak_bytes_in_use": (
+            max(int(r.get("peak_bytes_in_use") or 0) for r in wm_avail)
+            if wm_avail else None
+        ),
+    }
+
     return {
         "path": os.path.abspath(path),
         "run_id": run_start.get("run_id"),
@@ -202,6 +317,8 @@ def summarize_run(path: str) -> dict:
         },
         "re_solve": re_solve,
         "quality_parity": quality_parity,
+        "devcost": devcost,
+        "hbm": hbm,
         "warnings": sum(
             1 for r in records
             if r["event"] == "log" and r.get("level") in ("WARN", "ERROR")
@@ -217,6 +334,17 @@ _UNRECORDED = "(unrecorded)"
 
 def _fmt_s(v: float) -> str:
     return f"{v:.3f}s"
+
+
+def _fmt_qty(v: float | None) -> str:
+    """Compact engineering format for flops/bytes (roofline cells)."""
+    if v is None:
+        return "-"
+    v = float(v)
+    for div, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{suffix}"
+    return f"{v:.0f}"
 
 
 def _fmt_quality_parity(qp: dict) -> str:
@@ -265,6 +393,54 @@ def format_summary(s: dict) -> str:
     if s.get("quality_parity"):
         lines.append(
             f"  quality-parity: {_fmt_quality_parity(s['quality_parity'])}"
+        )
+    dc = s.get("devcost") or {}
+    if dc:
+        est = any(a.get("peak_is_estimate") for a in dc.values())
+        lines.append("")
+        lines.append(
+            "  analytic device cost (XLA estimates"
+            + ("; peak = arg+out+temp estimate" if est else "")
+            + "):"
+        )
+        lines.append(
+            f"  {'label':<34} {'flops':>9} {'bytes':>9} {'fl/B':>6} "
+            f"{'peak':>9} {'caps':>5}"
+        )
+        for lab, a in sorted(
+            dc.items(), key=lambda kv: -kv[1]["bytes_accessed"]
+        ):
+            ai = a.get("arith_intensity")
+            lines.append(
+                f"  {lab:<34} {_fmt_qty(a['flops']):>9} "
+                f"{_fmt_qty(a['bytes_accessed']):>9} "
+                f"{'-' if ai is None else f'{ai:.1f}':>6} "
+                f"{_fmt_qty(a['peak_bytes']):>9} {a['captures']:>5}"
+            )
+        kd = next(
+            (a["knobs"].get("kernel_dtype") for a in dc.values()
+             if a.get("knobs", {}).get("kernel_dtype")), None,
+        )
+        if kd:
+            lines.append(f"  (captured under kernel_dtype={kd})")
+    hbm = s.get("hbm") or {}
+    if hbm.get("budget_bytes") is not None or hbm.get(
+        "memory_stats_available"
+    ) is not None:
+        avail = hbm.get("memory_stats_available")
+        wm_txt = (
+            f"peak in-use {_fmt_qty(hbm['peak_bytes_in_use'])}B over "
+            f"{hbm['watermark_samples']} samples"
+            if avail
+            else "memory_stats unavailable on this backend"
+            if avail is False
+            else "no watermark samples"
+        )
+        src = hbm.get("budget_source")
+        lines.append(
+            f"  hbm: budget {_fmt_qty(hbm.get('budget_bytes'))}B"
+            + (f" ({src})" if src else "")
+            + f"; {wm_txt}"
         )
     if s["warnings"]:
         lines.append(f"  warnings: {s['warnings']}")
@@ -317,6 +493,21 @@ def diff_summaries(a: dict, b: dict) -> str:
             f"{int(ra.get('executed_entity_iterations') or 0):>10} "
             f"{int(rb.get('executed_entity_iterations') or 0):>10}"
         )
+    da, db = a.get("devcost") or {}, b.get("devcost") or {}
+    if da or db:
+        # the knob-keyed byte-delta readout: the dtype-ladder /
+        # groups-per-run sweeps read their analytic traffic change here
+        lines.append("  analytic bytes-accessed (per executable label):")
+        for lab in sorted(set(da) | set(db)):
+            va = (da.get(lab) or {}).get("bytes_accessed", 0.0)
+            vb = (db.get(lab) or {}).get("bytes_accessed", 0.0)
+            ratio = (
+                f"{vb / va:.2f}" if va else ("inf" if vb else "1.00")
+            )
+            lines.append(
+                f"    {lab:<32} {_fmt_qty(va):>9} {_fmt_qty(vb):>9} "
+                f"{ratio:>7}"
+            )
     qa, qb = a.get("quality_parity"), b.get("quality_parity")
     if qa or qb:
         lines.append("  quality-parity:")
@@ -356,3 +547,232 @@ def latest_run(directory: str) -> str | None:
         if f.startswith("run-") and f.endswith(".jsonl")
     ]
     return max(runs, key=os.path.getmtime) if runs else None
+
+
+# -- regression gate --------------------------------------------------------
+#
+# ``photon-ml-tpu report gate RUN --baseline BASE`` turns the telemetry
+# artifact from a passive record into an active tripwire: a flat metric
+# dict is extracted from each side (telemetry run JSONL, bench JSON doc,
+# or a saved gate-baseline file), every baseline metric is compared
+# against the current run under a per-metric threshold, and any breach
+# exits nonzero. Thresholds are tiered by what the metric IS: analytic
+# cost numbers (devcost flops/bytes) are deterministic for a given
+# compiler, so they gate TIGHT; wall-clock metrics are noisy, so they
+# gate loose. Regressions are one-sided — fewer bytes/flops/seconds is
+# never a failure.
+
+GATE_SCHEMA_VERSION = 1
+
+# pattern -> {"rel": fractional headroom, "abs": additive headroom};
+# longest matching substring wins, "" is the default tier
+DEFAULT_GATE_THRESHOLDS: dict[str, dict] = {
+    "": {"rel": 0.25},
+    # wall-clock tiers: real time on shared CI boxes jitters hard
+    "wall_s": {"rel": 1.0, "abs": 10.0},
+    "compile_s": {"rel": 2.0, "abs": 10.0},
+    "transfer_s": {"rel": 1.0, "abs": 5.0},
+    "host_pack_s": {"rel": 1.0, "abs": 5.0},
+    "consumer_wait_s": {"rel": 2.0, "abs": 5.0},
+    "capture_s": {"rel": 4.0, "abs": 10.0},
+    # analytic tiers: byte/flop counts move only when code or knobs move
+    "devcost/": {"rel": 0.02},
+    "packed_stream_bytes": {"rel": 0.01},
+    "hbm/": {"rel": 0.10},
+    # quality tiers: deltas vs the f32 anchor, absolute headroom at the
+    # parity-gate scale (|ΔAUC| ≤ 0.005 is the ladder's own bf16 gate)
+    "quality/": {"rel": 0.0, "abs": 0.005},
+    "optim/iterations": {"rel": 0.25, "abs": 2.0},
+    "warnings": {"rel": 0.0, "abs": 0.0},
+}
+
+
+def _fmt_gate(v: float | None) -> str:
+    """Gate-table cell format: engineering suffixes for big counts, but
+    full precision below 1 — the quality/* tier lives at 1e-3..1e-6 and
+    ``_fmt_qty`` would render every such value (and its limit) as '0',
+    hiding by how much a parity gate was breached."""
+    if v is None:
+        return "-"
+    v = float(v)
+    if abs(v) >= 1000:
+        return _fmt_qty(v)
+    return f"{v:.6g}"
+
+
+def resolve_threshold(metric: str, thresholds: dict) -> dict:
+    """Longest substring-matching pattern wins; ``""`` is the default.
+    An explicitly-empty rule (``{}``) is a valid exact gate (no
+    headroom), so resolution checks for None, never truthiness."""
+    best = ""
+    for p in thresholds:
+        if p and p in metric and len(p) > len(best):
+            best = p
+    rule = thresholds.get(best)
+    if rule is None:
+        rule = thresholds.get("")
+    return rule if rule is not None else {"rel": 0.25}
+
+
+def _qp_metrics(qp: dict, prefix: str = "") -> dict:
+    m = {}
+    if not qp:
+        return m
+    for k in ("auc_delta", "loss_rel_delta"):
+        if isinstance(qp.get(k), (int, float)):
+            m[f"{prefix}quality/{k}_abs"] = abs(float(qp[k]))
+    if isinstance(qp.get("margins_rmse_vs_f32"), (int, float)):
+        m[f"{prefix}quality/margins_rmse_vs_f32"] = float(
+            qp["margins_rmse_vs_f32"]
+        )
+    return m
+
+
+def gate_metrics_from_summary(s: dict) -> dict[str, float]:
+    """Flatten one telemetry-run summary into gateable metrics."""
+    m: dict[str, float] = {}
+    for k in ("wall_s", "compile_s", "transfer_s", "host_pack_s",
+              "consumer_wait_s"):
+        if isinstance(s.get(k), (int, float)):
+            m[k] = float(s[k])
+    for lab, agg in (s.get("devcost") or {}).items():
+        m[f"devcost/{lab}/flops"] = float(agg.get("flops") or 0.0)
+        m[f"devcost/{lab}/bytes_accessed"] = float(
+            agg.get("bytes_accessed") or 0.0
+        )
+        if agg.get("peak_bytes"):
+            m[f"devcost/{lab}/peak_bytes"] = float(agg["peak_bytes"])
+    m.update(_qp_metrics(s.get("quality_parity") or {}))
+    o = s.get("optim") or {}
+    if o.get("solves"):
+        m["optim/iterations"] = float(o.get("iterations") or 0)
+    m["warnings"] = float(s.get("warnings") or 0)
+    hbm = s.get("hbm") or {}
+    if hbm.get("peak_bytes_in_use"):
+        m["hbm/peak_bytes_in_use"] = float(hbm["peak_bytes_in_use"])
+    return m
+
+
+def gate_metrics_from_bench(doc: dict) -> dict[str, float]:
+    """Flatten a ``bench.py`` JSON document (the ``--quick`` single-line
+    contract, or one ``--config`` child's result) into gateable metrics,
+    namespaced per config. Reads the telemetry block's ``devcost.*`` /
+    ``hbm.*`` gauges, the compile timer, the quality-parity gate and the
+    per-rung packed-stream bytes — everything a dtype or schedule sweep
+    would want tripwired."""
+    configs = doc.get("configs")
+    if configs is None:
+        configs = {"config": doc}
+    m: dict[str, float] = {}
+    for cfg, r in configs.items():
+        if not isinstance(r, dict) or "error" in r:
+            continue  # its baseline metrics then read as MISSING -> fail
+        tel = r.get("telemetry") or {}
+        tmetrics = tel.get("metrics") or {}
+        for g, v in (tmetrics.get("gauges") or {}).items():
+            if g.startswith("devcost."):
+                m[f"{cfg}/devcost/{g[len('devcost.'):]}"] = float(v)
+            elif g.startswith("hbm.") and g != "hbm.budget_queried":
+                m[f"{cfg}/hbm/{g[len('hbm.'):]}"] = float(v)
+        timers = tmetrics.get("timers") or {}
+        if "jax.compile_s" in timers:
+            m[f"{cfg}/compile_s"] = float(
+                timers["jax.compile_s"].get("seconds") or 0.0
+            )
+        m.update(
+            _qp_metrics(
+                tel.get("quality_parity") or r.get("quality_parity") or {},
+                prefix=f"{cfg}/",
+            )
+        )
+        if isinstance(r.get("packed_stream_bytes_per_pass"), (int, float)):
+            m[f"{cfg}/packed_stream_bytes_per_pass"] = float(
+                r["packed_stream_bytes_per_pass"]
+            )
+        if isinstance(r.get("sec_per_solve"), (int, float)):
+            m[f"{cfg}/wall_s"] = float(r["sec_per_solve"])
+    return m
+
+
+def load_gate_metrics(path: str) -> tuple[str, dict[str, float]]:
+    """(kind, metrics) from any gate-readable artifact: a telemetry run
+    JSONL (or a telemetry DIR — newest run wins), a ``bench.py`` JSON
+    document, or a gate-baseline file written by ``report gate
+    --write-baseline``."""
+    if os.path.isdir(path):
+        run = latest_run(path)
+        if run is None:
+            raise ValueError(f"no run-*.jsonl files in {path}")
+        path = run
+    doc = None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except json.JSONDecodeError:
+        doc = None  # multi-record JSONL -> telemetry run
+    if isinstance(doc, dict) and doc.get("gate_baseline"):
+        return "baseline", {
+            k: float(v) for k, v in (doc.get("metrics") or {}).items()
+            if isinstance(v, (int, float))
+        }
+    if isinstance(doc, dict) and (
+        "configs" in doc or "telemetry" in doc
+    ) and doc.get("event") != "run_start":
+        return "bench", gate_metrics_from_bench(doc)
+    return "telemetry", gate_metrics_from_summary(summarize_run(path))
+
+
+def gate_run(
+    current: dict[str, float],
+    baseline: dict[str, float],
+    thresholds: dict | None = None,
+    allow_missing: bool = False,
+) -> tuple[list[dict], list[str]]:
+    """Compare ``current`` against every ``baseline`` metric. Returns
+    ``(failures, report_lines)``; empty failures = gate passes. A metric
+    present in the baseline but absent from the run is itself a failure
+    (lost instrumentation reads as "covered" otherwise) unless
+    ``allow_missing``; metrics only the current run has are informational
+    (new instrumentation is not a regression)."""
+    th = dict(DEFAULT_GATE_THRESHOLDS)
+    th.update(thresholds or {})
+    if not baseline:
+        raise ValueError("baseline contains no gateable metrics")
+    failures: list[dict] = []
+    lines = [
+        f"  {'metric':<58} {'baseline':>11} {'current':>11} "
+        f"{'limit':>11}  ok",
+    ]
+    for name in sorted(baseline):
+        base = baseline[name]
+        rule = resolve_threshold(name, th)
+        limit = base * (1.0 + float(rule.get("rel", 0.0))) + float(
+            rule.get("abs", 0.0)
+        )
+        cur = current.get(name)
+        if cur is None:
+            if not allow_missing:
+                failures.append(
+                    {"metric": name, "problem": "missing",
+                     "baseline": base, "limit": limit}
+                )
+            lines.append(
+                f"  {name:<58} {_fmt_gate(base):>11} {'(missing)':>11} "
+                f"{_fmt_gate(limit):>11}  "
+                + ("SKIP" if allow_missing else "FAIL")
+            )
+            continue
+        ok = cur <= limit
+        if not ok:
+            failures.append(
+                {"metric": name, "problem": "regression",
+                 "baseline": base, "current": cur, "limit": limit}
+            )
+        lines.append(
+            f"  {name:<58} {_fmt_gate(base):>11} {_fmt_gate(cur):>11} "
+            f"{_fmt_gate(limit):>11}  " + ("ok" if ok else "FAIL")
+        )
+    new = sorted(set(current) - set(baseline))
+    if new:
+        lines.append(f"  (+{len(new)} metrics not in baseline — ignored)")
+    return failures, lines

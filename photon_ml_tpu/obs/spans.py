@@ -92,6 +92,16 @@ class _Span:
             if exc_type is not None:
                 rec["error"] = exc_type.__name__
             s.emit(rec)
+            if self.parent_id is None:
+                # ROOT-span exit: sample device HBM watermarks (per-fit /
+                # per-driver cadence — never per-iteration; the sampler is
+                # itself sink-gated and never raises)
+                try:
+                    from photon_ml_tpu.obs import devcost
+
+                    devcost.sample_hbm_watermarks(root_span=self.name)
+                except Exception:
+                    pass
         return False
 
 

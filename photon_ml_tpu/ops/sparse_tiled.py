@@ -862,12 +862,22 @@ def _tiled_apply(layout_arrays, src, out_pad, src_pad, square_vals=False):
     streaming chunks, GAME visits and CV folds — re-enters the same
     compiled program. PIPELINE_SEGMENTS and the KERNEL_DTYPE storage rung
     are part of the same static key: toggling either mid-process
-    recompiles, never reuses."""
-    return _tiled_apply_jit(
+    recompiles, never reuses.
+
+    Analytic cost capture (``obs/devcost``) shadows the same key: an
+    eager call whose (knob tuple, stream signature) is fresh captures the
+    kernel executable's XLA flops/bytes once — calls under an outer
+    trace (the optimizer/scoring jits) skip, and THAT enclosing
+    executable is captured at its own boundary instead."""
+    args = (
         layout_arrays, src, out_pad, src_pad, square_vals,
         GROUPS_PER_STEP, SEGMENTS_PER_DMA, GROUPS_PER_RUN, SEGMENT_BATCHED,
         bool(PIPELINE_SEGMENTS), kernel_dtype(), _interpret(),
     )
+    from photon_ml_tpu.obs import devcost
+
+    devcost.capture("sparse_tiled.tiled_apply", _tiled_apply_jit, args)
+    return _tiled_apply_jit(*args)
 
 
 @functools.partial(

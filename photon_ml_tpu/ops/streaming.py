@@ -123,17 +123,27 @@ def device_hbm_budget_bytes(
     """The HBM budget for dataset residency, QUERIED from the device
     (``memory_stats()['bytes_limit']`` scaled by ``fraction`` to leave room
     for coefficients, optimizer state and XLA scratch). Falls back to
-    ``default`` on backends that expose no memory stats (e.g. CPU)."""
+    ``default`` on backends that expose no memory stats (e.g. CPU).
+
+    Which source won is recorded (``hbm.budget_bytes`` /
+    ``hbm.budget_queried`` gauges + a one-per-run ``hbm_budget`` event):
+    a fallback-budget run on a memory-stats-less backend is
+    distinguishable from a device-quoted one in ``report`` output."""
+    queried = None
     try:
         if device is None:
             device = jax.local_devices()[0]
         stats = device.memory_stats() or {}
         limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
         if limit:
-            return fraction * float(limit)
+            queried = fraction * float(limit)
     except Exception:
         pass
-    return default
+    budget = default if queried is None else queried
+    from photon_ml_tpu.obs import devcost
+
+    devcost.record_hbm_budget(budget, queried is not None)
+    return budget
 
 
 def fits_in_memory(num_rows: int, num_features: int, itemsize: int = 4,
@@ -388,7 +398,8 @@ class StreamingGLMObjective:
             )
         return _to_batch(cur, self.num_features)
 
-    def _stream(self, params, kernel: Callable, accumulate: Callable, init):
+    def _stream(self, params, kernel: Callable, accumulate: Callable, init,
+                devcost_fn=None, devcost_label: str | None = None):
         """Host→device chunk pipeline. Default (``PHOTON_PREFETCH_DEPTH``
         > 0): a bounded-depth background pipeline (``ops/prefetch``)
         prepares chunk ``i+k`` — host staging + ``device_put`` through the
@@ -402,7 +413,13 @@ class StreamingGLMObjective:
         DMA overlaps compute (async dispatch). ``params`` is passed to
         ``kernel`` verbatim (an array or a tuple of arrays). Tiled chunks
         stream only labels/offsets/weights (the packed nonzero streams are
-        device-resident)."""
+        device-resident).
+
+        ``devcost_fn``/``devcost_label`` name the jitted per-chunk program
+        for analytic cost capture (``obs/devcost``) — chunks are
+        uniform-shape, so the FIRST chunk's signature covers every chunk
+        of every pass, and the capture dedup means passes 2..N emit
+        nothing."""
         slim = (
             (lambda c: {k: c[k] for k in ("labels", "offsets", "weights")})
             if self._tile_layouts is not None
@@ -411,6 +428,7 @@ class StreamingGLMObjective:
         acc = init
         if not self.chunks:
             return acc
+        from photon_ml_tpu.obs import devcost
         from photon_ml_tpu.obs.metrics import REGISTRY
         from photon_ml_tpu.ops import prefetch
 
@@ -431,7 +449,10 @@ class StreamingGLMObjective:
                     nxt = jax.device_put(
                         prefetch.pack_host_chunk(slim(self.chunks[i + 1]))
                     )
-                out = kernel(self._chunk_batch(cur, i), params)
+                b = self._chunk_batch(cur, i)
+                if i == 0 and devcost_fn is not None:
+                    devcost.capture(devcost_label, devcost_fn, (b, params))
+                out = kernel(b, params)
                 acc = accumulate(acc, out)
             return acc
 
@@ -441,7 +462,10 @@ class StreamingGLMObjective:
         for i, cur in enumerate(
             prefetch.prefetch_iter(len(self.chunks), prepare, depth)
         ):
-            out = kernel(self._chunk_batch(cur, i), params)
+            b = self._chunk_batch(cur, i)
+            if i == 0 and devcost_fn is not None:
+                devcost.capture(devcost_label, devcost_fn, (b, params))
+            out = kernel(b, params)
             acc = accumulate(acc, out)
         return acc
 
@@ -465,7 +489,9 @@ class StreamingGLMObjective:
 
     def value(self, w: Array) -> Array:
         total = self._stream(
-            jnp.asarray(w), self._chunk_v, lambda acc, v: acc + v, jnp.float32(0.0)
+            jnp.asarray(w), self._chunk_v, lambda acc, v: acc + v,
+            jnp.float32(0.0),
+            devcost_fn=self._chunk_v, devcost_label="streaming.chunk_value",
         )
         if self.cross_process:
             from photon_ml_tpu.parallel.multihost import allreduce_sum_host
@@ -485,6 +511,7 @@ class StreamingGLMObjective:
             lambda batch, wv: self._chunk_hvp(batch, wv),
             lambda acc, out: acc + out,
             init,
+            devcost_fn=self._chunk_hvp, devcost_label="streaming.chunk_hvp",
         )
         if self.cross_process:
             from photon_ml_tpu.parallel.multihost import allreduce_sum_host
@@ -507,6 +534,8 @@ class StreamingGLMObjective:
             lambda batch, wi: self._chunk_hd(batch, wi),
             lambda acc, out: acc + out,
             init,
+            devcost_fn=self._chunk_hd,
+            devcost_label="streaming.chunk_hessian_diag",
         )
         if self.cross_process:
             from photon_ml_tpu.parallel.multihost import allreduce_sum_host
@@ -552,6 +581,8 @@ class StreamingGLMObjective:
             lambda batch, wi: self._chunk_h(batch, wi),
             lambda acc, out: acc + out,
             init,
+            devcost_fn=self._chunk_h,
+            devcost_label="streaming.chunk_hessian",
         )
         if self.cross_process:
             from photon_ml_tpu.parallel.multihost import allreduce_sum_host
@@ -615,6 +646,8 @@ class StreamingGLMObjective:
             w, self._chunk_vg,
             lambda acc, out: (acc[0] + out[0], acc[1] + out[1]),
             init,
+            devcost_fn=self._chunk_vg,
+            devcost_label="streaming.chunk_value_grad",
         )
         if self.cross_process:
             from photon_ml_tpu.parallel.multihost import allreduce_sum_host
@@ -636,10 +669,18 @@ def _score_matvec(b, wi):
     statics are resolved at the OUTER trace, so without this a
     PIPELINE_SEGMENTS / SEGMENT_BATCHED toggle (which reshapes nothing)
     would silently re-enter the stale executable — the same
-    never-by-luck rule as ``_tiled_apply`` itself."""
+    never-by-luck rule as ``_tiled_apply`` itself. Analytic cost capture
+    shadows the same key (constants are part of the signature), so a
+    fresh scoring executable's flops/bytes land in telemetry once."""
+    from photon_ml_tpu.obs import devcost
     from photon_ml_tpu.ops import tile_cache
 
-    return _score_matvec_keyed(b, wi, constants=tile_cache.tuned_constants())
+    constants = tile_cache.tuned_constants()
+    devcost.capture(
+        "streaming.score_matvec", _score_matvec_keyed, (b, wi),
+        {"constants": constants},
+    )
+    return _score_matvec_keyed(b, wi, constants=constants)
 
 
 # bounded storage-identity memo for chunk structure fingerprints: the

@@ -212,8 +212,16 @@ def tiled_layout_for(batch, keep_empty_chunks: bool = False,
     global _total_bytes
     with _lock:
         _stats["misses"] += 1
+        # devcost accounting: once per PACK that produced a NEW resident
+        # entry (concurrent misses on one key both pack, but only the
+        # first insert records — a doubled packed-bytes total would
+        # inflate the analytic bytes-moved record the dtype ladder's
+        # claim rests on). Over-budget layouts are never pinned, so each
+        # re-request genuinely re-packs and records again — that repeat
+        # IS the real host work/traffic of running over budget.
+        prev = _entry_bytes.pop(key, None)
+        record_pack = prev is None
         if nbytes <= _byte_budget:  # over-budget layouts are never pinned
-            prev = _entry_bytes.pop(key, None)
             if prev is not None:  # concurrent miss already inserted this key
                 _total_bytes -= prev
             _entries[key] = (
@@ -223,4 +231,13 @@ def tiled_layout_for(batch, keep_empty_chunks: bool = False,
             _total_bytes += nbytes
             _entries.move_to_end(key)
             _evict_over_limits_locked()
+        elif prev is not None:
+            # key was resident but the REBUILT layout is over budget
+            # (budget shrank): drop the stale entry
+            _total_bytes -= prev
+            _entries.pop(key, None)
+    if record_pack:
+        from photon_ml_tpu.obs import devcost
+
+        devcost.record_layout_pack(nbytes=nbytes, chunks=len(tb.chunks))
     return tb

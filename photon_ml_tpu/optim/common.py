@@ -117,7 +117,13 @@ def select_minimize_fn(
     ``fn(objective, w0, config, **extra_kwargs)`` runs the solve.
 
     ``host=True`` selects the host-driven twins (streaming/out-of-core
-    objectives) — same rule, same rejection, same call shape."""
+    objectives) — same rule, same rejection, same call shape.
+
+    Device solvers come back wrapped in ``obs/devcost``'s MEMOIZED
+    capture twin (identity-stable — these functions are jit static keys
+    downstream): an eager solve captures the whole solver executable's
+    analytic XLA cost once per (knob tuple, shape signature); traced
+    calls and the host twins pass through untouched."""
     if host:
         from photon_ml_tpu.optim.host_lbfgs import (
             host_lbfgs_minimize,
@@ -129,10 +135,15 @@ def select_minimize_fn(
             host_lbfgs_minimize, host_owlqn_minimize, host_tron_minimize,
         )
     else:
+        from photon_ml_tpu.obs.devcost import captured
         from photon_ml_tpu.optim.lbfgs import lbfgs_minimize, owlqn_minimize
         from photon_ml_tpu.optim.tron import tron_minimize
 
-        lbfgs_fn, owlqn_fn, tron_fn = lbfgs_minimize, owlqn_minimize, tron_minimize
+        lbfgs_fn, owlqn_fn, tron_fn = (
+            captured("optim", lbfgs_minimize),
+            captured("optim", owlqn_minimize),
+            captured("optim", tron_minimize),
+        )
 
     if config.optimizer_type is OptimizerType.NEWTON_CHOLESKY:
         if l1_weight > 0.0:
@@ -145,9 +156,10 @@ def select_minimize_fn(
                 "NEWTON_CHOLESKY is a device-resident small-d solver; the "
                 "streamed/out-of-core objectives use LBFGS or TRON"
             )
+        from photon_ml_tpu.obs.devcost import captured
         from photon_ml_tpu.optim.newton import newton_minimize
 
-        return newton_minimize, {}
+        return captured("optim", newton_minimize), {}
     if config.optimizer_type is OptimizerType.TRON:
         if l1_weight > 0.0:
             raise ValueError("TRON does not support L1 regularization (reference parity)")
@@ -185,7 +197,20 @@ def select_chunked_solver(
     selection rule, returning ``(solver, extra_kwargs)``. Returns
     ``(None, {})`` when the configured solver has no chunked entry point
     (NEWTON_CHOLESKY's fixed-ladder loop) — callers fall back to the
-    single-launch schedule."""
+    single-launch schedule.
+
+    Like the one-shot selectors, each entry point comes back wrapped in
+    the memoized ``obs/devcost`` capture twin (identity-stable: callers
+    pass these as the ``init_fn``/``run_fn``/``fin_fn`` jit static keys).
+    """
+    from photon_ml_tpu.obs.devcost import captured
+
+    def _chunked(init, run, fin):
+        return ChunkedSolver(
+            captured("optim", init), captured("optim", run),
+            captured("optim", fin),
+        )
+
     if config.optimizer_type is OptimizerType.NEWTON_CHOLESKY:
         return None, {}
     if config.optimizer_type is OptimizerType.TRON:
@@ -197,7 +222,7 @@ def select_chunked_solver(
             tron_chunk_run,
         )
 
-        return ChunkedSolver(tron_chunk_init, tron_chunk_run, tron_chunk_finalize), {}
+        return _chunked(tron_chunk_init, tron_chunk_run, tron_chunk_finalize), {}
     if l1_weight > 0.0:
         from photon_ml_tpu.optim.lbfgs import (
             owlqn_chunk_finalize,
@@ -206,7 +231,7 @@ def select_chunked_solver(
         )
 
         return (
-            ChunkedSolver(owlqn_chunk_init, owlqn_chunk_run, owlqn_chunk_finalize),
+            _chunked(owlqn_chunk_init, owlqn_chunk_run, owlqn_chunk_finalize),
             {"l1_weight": l1_weight},
         )
     from photon_ml_tpu.optim.lbfgs import (
@@ -215,7 +240,7 @@ def select_chunked_solver(
         lbfgs_chunk_run,
     )
 
-    return ChunkedSolver(lbfgs_chunk_init, lbfgs_chunk_run, lbfgs_chunk_finalize), {}
+    return _chunked(lbfgs_chunk_init, lbfgs_chunk_run, lbfgs_chunk_finalize), {}
 
 
 def make_optimizer(config: OptimizerConfig, l1_weight: float = 0.0) -> Callable:

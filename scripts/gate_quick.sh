@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# One-command perf/cost self-check: run the smoke bench and gate its
+# analytic cost / wall / quality metrics against the committed CPU
+# baseline. Exits nonzero on any byte/flop/quality regression (see
+# DEFAULT_GATE_THRESHOLDS in photon_ml_tpu/obs/report.py for the tiers).
+#
+# Usage:
+#   scripts/gate_quick.sh                      # gate vs BASELINE_cost_cpu.json
+#   scripts/gate_quick.sh MY_BASELINE.json     # gate vs another baseline
+#   UPDATE_BASELINE=1 scripts/gate_quick.sh    # re-capture the baseline
+#
+# The baseline is a verbatim `bench.py --quick` stdout capture (the
+# single-JSON-line contract); re-capture it whenever an INTENTIONAL cost
+# change lands, and commit the diff with the change that caused it.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+baseline="${1:-BASELINE_cost_cpu.json}"
+out="$(mktemp)"
+trap 'rm -f "$out"' EXIT
+
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --quick > "$out"
+
+if [[ "${UPDATE_BASELINE:-0}" == "1" ]]; then
+    # refuse to bless a capture with errored configs: gate_metrics skips
+    # them, so committing one would silently DROP that config's metrics
+    # from all future gate coverage
+    python - "$out" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+bad = [k for k, v in doc.get("configs", {}).items()
+       if not isinstance(v, dict) or "error" in v]
+if bad:
+    sys.exit(f"gate_quick: NOT updating baseline — configs errored: {bad}")
+PY
+    cp "$out" "$baseline"
+    echo "gate_quick: baseline re-captured to $baseline"
+    exit 0
+fi
+
+python -m photon_ml_tpu.cli.main report gate "$out" --baseline "$baseline"

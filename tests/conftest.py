@@ -10,6 +10,12 @@ before the first ``import jax`` anywhere in the test process.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Analytic device-cost capture (obs/devcost) AOT-compiles every fresh
+# executable a second time while a telemetry sink is active. The tier-1
+# suite sits NEAR its 870 s budget, so the suite pins capture OFF and
+# tests that exercise it (tests/test_devcost.py) opt back in by clearing
+# or overriding this variable.
+os.environ.setdefault("PHOTON_DEVCOST", "0")
 # Double precision in tests: finite-difference derivative checks need it.
 os.environ["JAX_ENABLE_X64"] = "1"
 _flags = os.environ.get("XLA_FLAGS", "")

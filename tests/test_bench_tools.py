@@ -270,6 +270,62 @@ class TestQuickMode:
         lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
         assert len(lines) == 1 and json.loads(lines[0])["quick"] is True
 
+    def test_quick_telemetry_dir_round_trips_contract(
+        self, monkeypatch, capsys, tmp_path
+    ):
+        """--telemetry-dir reaches every config child and rides the
+        single-JSON-line contract (top-level telemetry_dir + the child's
+        archived run path inside its telemetry block)."""
+        tdir = str(tmp_path / "tel")
+        calls = []
+
+        def fake_child(name, quick=False, telemetry_dir=None):
+            calls.append((name, quick, telemetry_dir))
+            r = {k: dict(v) for k, v in self.FAKE.items()}[name]
+            r = dict(r)
+            tel = dict(r.get("telemetry") or {"schema_version": 1})
+            tel["telemetry_dir"] = telemetry_dir
+            tel["run_path"] = os.path.join(
+                telemetry_dir, f"run-{name}.jsonl"
+            )
+            r["telemetry"] = tel
+            return r
+
+        orig_child = bench._run_config_subprocess
+        monkeypatch.setattr(bench, "_run_config_subprocess", fake_child)
+        monkeypatch.setattr(bench, "update_baseline", lambda *a, **k: None)
+        bench.main(quick=True, telemetry_dir=tdir)
+        lines = [l for l in capsys.readouterr().out.splitlines()
+                 if l.strip()]
+        assert len(lines) == 1
+        payload = json.loads(lines[0])
+        assert payload["telemetry_dir"] == tdir
+        assert all(td == tdir for _, _, td in calls)
+        a2_tel = payload["configs"]["A2_sparse_highdim"]["telemetry"]
+        assert a2_tel["telemetry_dir"] == tdir
+        assert a2_tel["run_path"].endswith("run-A2_sparse_highdim.jsonl")
+        # the child argv carries the flag (subprocess contract)
+        import subprocess as sp
+
+        seen_argv = {}
+
+        def fake_run(argv, **kw):
+            seen_argv["argv"] = argv
+
+            class P:
+                returncode = 0
+                stdout = json.dumps({"ok": True})
+                stderr = ""
+
+            return P()
+
+        monkeypatch.setattr(sp, "run", fake_run)
+        orig_child("A2_sparse_highdim", quick=True, telemetry_dir=tdir)
+        assert "--telemetry-dir" in seen_argv["argv"]
+        assert seen_argv["argv"][
+            seen_argv["argv"].index("--telemetry-dir") + 1
+        ] == tdir
+
     def test_full_mode_still_writes_artifacts(self, monkeypatch, capsys):
         results = {
             name: {"samples_per_sec": 1.0, "quality_ok": True}
