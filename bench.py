@@ -88,6 +88,12 @@ RETUNE_ENV_RE = {
     "PHOTON_RE_COMPACT_EVERY": "COMPACT_EVERY",
     "PHOTON_RE_FUSE_BUCKETS": "FUSE_BUCKETS",
 }
+# Entity-sharded placement + overlapped exchange (parallel/placement):
+# 0 = the pre-sharding schedule bit-for-bit (modular owners, blocking
+# exchanges), 1 = skew-aware placement + overlapped P2P exchange.
+RETUNE_ENV_SHARD = {
+    "PHOTON_RE_SHARD": "RE_SHARD",
+}
 # No TPU generation exceeds this HBM bandwidth (v5p ~2.8 TB/s); a
 # measurement implying more is a timing artifact, not a fast solve.
 HBM_ROOFLINE_BYTES_PER_S = 4.0e12
@@ -1585,7 +1591,44 @@ def bench_r_re_skew(jax, jnp):
         useful = counter("re_solve.useful_entity_iterations")
         iters = res.iterations
         conv_frac = float(np.mean(res.converged))
+
+        # Entity-shard placement readout (deterministic host arithmetic —
+        # gate-stable): the skew-aware plan vs naive round-robin over 4
+        # virtual shards of the bench's Zipf entity distribution (this
+        # config's own rows are uniform — its skew is in ITERATIONS —
+        # so the Zipf ladder from the MULTICHIP_r06 capture is the
+        # meaningful placement surface). The multi-process wall/overlap
+        # numbers live in MULTICHIP_r06.json; here the planner's balance
+        # advantage and the exchange-overlap instrument ride the --quick
+        # JSON contract so `report gate` tripwires them from a smoke run
+        # alone.
+        from photon_ml_tpu.parallel.multihost import exchange_rows_async
+        from photon_ml_tpu.parallel.placement import (
+            plan_entity_placement,
+            re_shard_enabled,
+            record_placement_metrics,
+        )
+
+        entity_rows = _multichip_r06_sizes()
+        shard_plan = plan_entity_placement(entity_rows, 4)
+        rr_plan = plan_entity_placement(entity_rows, 4, skew_aware=False)
+        record_placement_metrics(shard_plan)
+        REGISTRY.gauge_set(
+            "re_shard.round_robin_balance", rr_plan.balance
+        )
+        # exercise the issue→join path of the overlapped exchange once
+        # (identity on one process) so the overlap-ratio gauge is present
+        # in every capture — a missing instrument must trip the gate
+        exchange_rows_async(
+            {"probe": np.zeros(4, np.float32)},
+            np.zeros(4, np.int64),
+        ).result()
+
         return {
+            "re_shard_balance": round(shard_plan.balance, 6),
+            "re_shard_round_robin_balance": round(rr_plan.balance, 6),
+            "re_shard_rows_max": float(shard_plan.loads.max()),
+            "re_shard_rows_mean": float(shard_plan.loads.mean()),
             "sec_solve": round(dt, 4),
             "entity_iterations_per_sec": (
                 None if dt <= 0 else round(float(iters.sum()) / dt, 1)
@@ -1601,6 +1644,7 @@ def bench_r_re_skew(jax, jnp):
             "re_knobs": {
                 "compact_every": int(re_mod.compact_every()),
                 "fuse_buckets": int(bool(re_mod.fuse_buckets())),
+                "re_shard": int(bool(re_shard_enabled())),
             },
             "converged_fraction": conv_frac,
             "quality_ok": bool(conv_frac == 1.0),
@@ -1644,6 +1688,8 @@ def _apply_retune_env() -> None:
         (RETUNE_ENV_PREFETCH, "photon_ml_tpu.ops.prefetch", "prefetch knobs"),
         (RETUNE_ENV_RE, "photon_ml_tpu.game.random_effect",
          "random-effect knobs"),
+        (RETUNE_ENV_SHARD, "photon_ml_tpu.parallel.placement",
+         "entity-shard knobs"),
     )
     def _parse(var: str, raw: str):
         if var == "PHOTON_KERNEL_DTYPE":
@@ -1815,6 +1861,293 @@ def main(quick: bool = False, telemetry_dir: str | None = None) -> None:
         sys.exit(1)
 
 
+# -- MULTICHIP_r06: entity-sharded multi-process random-effect capture ------
+#
+# `python bench.py --multichip-r06` spawns a loopback multi-process CPU
+# harness (gloo collectives, one process per virtual chip — the same
+# recipe as tests/test_multihost.py) running the streamed GAME
+# random-effect coordinate on a Zipf-skewed entity distribution, once
+# per arm: PHOTON_RE_SHARD=0 (today's modular owners, blocking
+# exchanges) and PHOTON_RE_SHARD=1 (skew-aware placement + overlapped
+# P2P exchange). Writes MULTICHIP_r06.json and archives each arm's
+# telemetry JSONL (process 0's sink) under --telemetry-dir. Also records
+# the pure-planner balance table (skew-aware vs round-robin over
+# P ∈ {2, 4, 8} shards of the same distribution) — the ≤1.15×-vs-≥1.5×
+# acceptance numbers, deterministic on any host.
+
+MULTICHIP_R06_ENTITIES = 64
+MULTICHIP_R06_D = 3
+
+
+def _multichip_r06_sizes() -> "np.ndarray":
+    """Zipf-ish per-entity row counts (head entity ~300 rows, tail 2):
+    skewed enough that round-robin loses a full shard to the head
+    (balance ≥ 1.5× at 4 shards) while LPT stays ≤ 1.15×."""
+    E = MULTICHIP_R06_ENTITIES
+    return np.maximum(
+        (300.0 / (1 + np.arange(E)) ** 1.1).astype(np.int64), 2
+    )
+
+
+def _multichip_r06_dataset():
+    rng = np.random.default_rng(606)
+    sizes = _multichip_r06_sizes()
+    ids = np.repeat(np.arange(len(sizes)), sizes).astype(np.int64)
+    ids = ids[rng.permutation(len(ids))]
+    n = len(ids)
+    X = rng.normal(size=(n, MULTICHIP_R06_D)).astype(np.float32)
+    W_true = (rng.normal(size=(len(sizes), MULTICHIP_R06_D)) * 0.5).astype(
+        np.float32
+    )
+    margin = np.sum(W_true[ids] * X, axis=1)
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-margin))).astype(
+        np.float32
+    )
+    return ids, X, y
+
+
+def _multichip_r06_worker(
+    coordinator: str, pid: int, nproc: int, arm: str,
+    telemetry_dir: str | None,
+) -> None:
+    """One harness process of the MULTICHIP_r06 capture (child mode)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["PHOTON_RE_SHARD"] = "1" if arm == "skew_aware" else "0"
+    import hashlib
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    from photon_ml_tpu.parallel.multihost import initialize_multihost
+
+    initialize_multihost(coordinator, num_processes=nproc, process_id=pid)
+    import photon_ml_tpu.obs as obs
+
+    run_path = None
+    if telemetry_dir:
+        run_path = obs.configure(
+            telemetry_dir, run_id=f"MULTICHIP_r06_{arm}_P{nproc}"
+        )
+    try:
+        from photon_ml_tpu.config import (
+            GameTrainingConfig,
+            OptimizationConfig,
+            OptimizerConfig,
+            RandomEffectCoordinateConfig,
+            RegularizationContext,
+        )
+        from photon_ml_tpu.game.streaming import (
+            StreamedGameData,
+            StreamedGameTrainer,
+        )
+        from photon_ml_tpu.types import RegularizationType, TaskType
+
+        ids, X, y = _multichip_r06_dataset()
+        n = len(ids)
+        bounds = np.linspace(0, n, nproc + 1).astype(int)
+        lo, hi = bounds[pid], bounds[pid + 1]
+        opt = OptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=25, tolerance=1e-8),
+            regularization=RegularizationContext(RegularizationType.L2),
+            regularization_weight=1.0,
+        )
+        cfg = GameTrainingConfig(
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            coordinate_update_sequence=("per_entity",),
+            coordinate_descent_iterations=2,
+            fixed_effect_coordinates={},
+            random_effect_coordinates={
+                "per_entity": RandomEffectCoordinateConfig(
+                    random_effect_type="eid", feature_shard_id="r",
+                    optimization=opt,
+                )
+            },
+        )
+        data = StreamedGameData(
+            labels=y[lo:hi],
+            features={"r": X[lo:hi]},
+            id_tags={"eid": ids[lo:hi]},
+        )
+        trainer = StreamedGameTrainer(
+            cfg, chunk_rows=1 << 16, multihost=nproc > 1
+        )
+        t0 = time.perf_counter()
+        model, info = trainer.fit(data)
+        wall = time.perf_counter() - t0
+        W = np.asarray(model.models["per_entity"].coefficients, np.float32)
+
+        from photon_ml_tpu.obs.metrics import REGISTRY
+        from photon_ml_tpu.parallel.multihost import LAST_EXCHANGE_STATS
+
+        snap = REGISTRY.snapshot()
+        gauges = {
+            k: v for k, v in snap.get("gauges", {}).items()
+            if k.startswith("re_shard.")
+        }
+        timers = {
+            k: v.get("seconds")
+            for k, v in snap.get("timers", {}).items()
+            if k.startswith("re_exchange.")
+        }
+        print("RESULT " + json.dumps({
+            "pid": pid,
+            "arm": arm,
+            "wall_s": round(wall, 4),
+            "W_sha256": hashlib.sha256(
+                np.ascontiguousarray(W).tobytes()
+            ).hexdigest(),
+            "loss": info["per_entity"].final_loss,
+            "converged": bool(info["per_entity"].converged),
+            "gauges": gauges,
+            "exchange_timers": timers,
+            "last_exchange_transport": LAST_EXCHANGE_STATS.get("transport"),
+            "run_path": run_path,
+        }))
+    finally:
+        if telemetry_dir:
+            obs.shutdown()
+
+
+def run_multichip_r06(
+    out_path: str = "MULTICHIP_r06.json",
+    telemetry_dir: str | None = "telemetry_r06",
+    nproc: int = 2,
+) -> dict:
+    """Drive the multi-process capture (parent mode) and write the
+    MULTICHIP_r06.json artifact."""
+    import socket
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    arms: dict[str, dict] = {}
+    for arm in ("baseline_modulo", "skew_aware"):
+        coordinator = f"127.0.0.1:{free_port()}"
+        env = {
+            k: v for k, v in os.environ.items()
+            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+        }
+        # worker output goes to FILES, not pipes: the parent drains the
+        # workers sequentially, and a worker that fills an unread 64 KB
+        # stderr pipe (chatty XLA/gloo logging) would stall inside a
+        # collective and deadlock the whole arm
+        import tempfile
+
+        tmpdir = tempfile.mkdtemp(prefix="multichip_r06_")
+        logs = []
+        procs = []
+        for pid in range(nproc):
+            out_f = open(os.path.join(tmpdir, f"{arm}-{pid}.out"), "w+")
+            err_f = open(os.path.join(tmpdir, f"{arm}-{pid}.err"), "w+")
+            logs.append((out_f, err_f))
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.join(here, "bench.py"),
+                 "--multichip-r06-worker", coordinator, str(pid),
+                 str(nproc), arm] + (
+                     ["--telemetry-dir", telemetry_dir]
+                     if telemetry_dir else []
+                 ),
+                stdout=out_f, stderr=err_f, text=True, env=env, cwd=here,
+            ))
+        outs = []
+        try:
+            for p, (out_f, err_f) in zip(procs, logs):
+                p.wait(timeout=900)
+                out_f.seek(0)
+                err_f.seek(0)
+                out = out_f.read()
+                if p.returncode != 0:
+                    raise RuntimeError(
+                        f"MULTICHIP_r06 {arm} worker failed "
+                        f"(rc={p.returncode}):\n{out[-2000:]}\n"
+                        f"{err_f.read()[-4000:]}"
+                    )
+                outs.append(out)
+        finally:
+            # one dead/deadlocked worker must not orphan its peers —
+            # they block forever on the missing process's collectives
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for out_f, err_f in logs:
+                out_f.close()
+                err_f.close()
+        per_pid = {}
+        for out in outs:
+            for line in out.splitlines():
+                if line.startswith("RESULT "):
+                    r = json.loads(line[len("RESULT "):])
+                    per_pid[r["pid"]] = r
+        arms[arm] = {
+            "per_process": per_pid,
+            "bitwise_identical_across_processes": (
+                len({r["W_sha256"] for r in per_pid.values()}) == 1
+            ),
+        }
+
+    # pure-planner balance table on the same distribution: the
+    # ≤1.15×-vs-≥1.5× acceptance readout, deterministic on any host
+    from photon_ml_tpu.parallel.placement import plan_entity_placement
+
+    sizes = _multichip_r06_sizes()
+    table = {}
+    for P_ in (2, 4, 8):
+        sk = plan_entity_placement(sizes, P_)
+        rr = plan_entity_placement(sizes, P_, skew_aware=False)
+        table[str(P_)] = {
+            "skew_aware_balance": round(sk.balance, 4),
+            "round_robin_balance": round(rr.balance, 4),
+            "skew_aware_rows_max": float(sk.loads.max()),
+            "round_robin_rows_max": float(rr.loads.max()),
+        }
+    doc = {
+        "round": 6,
+        "what": (
+            "entity-sharded multi-process random-effect solves: "
+            "skew-aware bucket placement + overlapped P2P exchange "
+            f"(streamed GAME, Zipf E config, {nproc}-process loopback "
+            "CPU harness, gloo collectives)"
+        ),
+        "entities": MULTICHIP_R06_ENTITIES,
+        "rows_total": int(_multichip_r06_sizes().sum()),
+        "nproc": nproc,
+        "arms": arms,
+        "planner_balance_by_shards": table,
+        "acceptance": {
+            "skew_balance_4_shards": table["4"]["skew_aware_balance"],
+            "round_robin_balance_4_shards": table["4"]["round_robin_balance"],
+            "skew_le_1.15": table["4"]["skew_aware_balance"] <= 1.15,
+            "round_robin_ge_1.5": table["4"]["round_robin_balance"] >= 1.5,
+        },
+        "telemetry_dir": telemetry_dir,
+        "note": (
+            "CPU wall at toy scale is dispatch/exchange-latency bound — "
+            "recorded per the BASELINE protocol either way; the on-chip "
+            "sweep decides defaults (ROADMAP backlog)"
+        ),
+    }
+    with open(os.path.join(here, out_path), "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    _log(f"[bench] MULTICHIP_r06 capture written to {out_path}")
+    return doc
+
+
 _BASELINE_BEGIN = "<!-- BEGIN MEASURED (generated by `python bench.py --update-baseline` from BENCH_DETAIL.json; do not hand-edit) -->"
 _BASELINE_END = "<!-- END MEASURED -->"
 
@@ -1912,9 +2245,20 @@ if __name__ == "__main__":
         update_baseline()
     elif args == ["--quick"]:
         main(quick=True, telemetry_dir=telemetry_dir)
+    elif args and args[0] == "--multichip-r06-worker":
+        _multichip_r06_worker(
+            args[1], int(args[2]), int(args[3]), args[4],
+            telemetry_dir,
+        )
+    elif args and args[0] == "--multichip-r06":
+        run_multichip_r06(
+            telemetry_dir=telemetry_dir or "telemetry_r06",
+            nproc=int(args[1]) if len(args) > 1 else 2,
+        )
     elif not args:
         main(telemetry_dir=telemetry_dir)
     else:
         _log(f"usage: bench.py [--quick | --update-baseline | "
-             f"--config NAME [--quick]] [--telemetry-dir DIR]; got {args}")
+             f"--config NAME [--quick] | --multichip-r06 [NPROC]] "
+             f"[--telemetry-dir DIR]; got {args}")
         sys.exit(2)

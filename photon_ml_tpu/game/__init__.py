@@ -23,6 +23,7 @@ from photon_ml_tpu.game.data import (  # noqa: F401
     GameBatch,
     SparseFeatures,
     bucket_entities,
+    capacity_classes,
     group_by_entity,
     make_game_batch,
 )

@@ -553,10 +553,22 @@ class RandomEffectCoordinate:
         buckets, staged ONCE: the eager visit loop calls ``train`` per
         descent visit, and rebuilding the fused concatenation each time
         would copy every static bucket tensor per visit. ``None`` when
-        fusion doesn't apply (knob off, mesh-sharded, single bucket)."""
+        fusion doesn't apply (knob off, lane-sharded mesh, single
+        bucket). Under entity-sharded owned-bucket mode
+        (``PHOTON_RE_SHARD=1``) a mesh no longer disables fusion: lanes
+        are fully addressable per owned bucket, and placement is
+        fusion-group-atomic, so every fusable set is co-owned."""
         from photon_ml_tpu.game.random_effect import _fusion_units, fuse_buckets
 
-        if self.mesh is not None or not fuse_buckets() or len(self._prepared) < 2:
+        # gate on the PREPARED STATE, not a re-read of the knob: the
+        # buckets were either staged owned (owner set, fully addressable
+        # — fusable) or lane-sharded (concatenation would break the mesh
+        # lane padding), and a knob flip after staging must not change
+        # which schedule the cached tensors support
+        lane_sharded = self.mesh is not None and not any(
+            pb.owner is not None for pb in self._prepared
+        )
+        if lane_sharded or not fuse_buckets() or len(self._prepared) < 2:
             return None
         units = self.__dict__.get("_fusion_units_cache")
         if units is None:

@@ -283,6 +283,40 @@ def default_capacities(max_count: int, smallest: int = 8, growth: int = 2) -> tu
     return tuple(caps)
 
 
+def _capacity_slots(
+    active_counts: np.ndarray,
+    capacities: tuple[int, ...] | None,
+    target_buckets: int,
+    max_padded_ratio: float,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """The class-assignment front half SHARED by ``bucket_entities`` and
+    ``capacity_classes``: (active entity indices, per-active-entity class
+    slot, capacity ladder). One implementation on purpose — the sharded
+    solve's bitwise-parity guarantee rests on every shard assigning each
+    entity the capacity the whole-population bucketing would, so the two
+    call sites must never drift."""
+    counts = np.asarray(active_counts)
+    active = np.flatnonzero(counts > 0)
+    if len(active) == 0:
+        return active, np.zeros(0, np.int64), np.zeros(0, np.int64)
+    max_count = int(counts[active].max())
+    explicit = capacities is not None
+    if capacities is None:
+        capacities = default_capacities(max_count)
+    caps = np.asarray(sorted(capacities))
+    if caps[-1] < max_count:
+        raise ValueError(
+            f"largest bucket capacity {caps[-1]} < max active entity size {max_count}"
+        )
+    # smallest capacity >= count, per entity
+    slot = np.searchsorted(caps, counts[active])
+    if not explicit:
+        slot, caps = _merge_bucket_classes(
+            slot, caps, counts[active], target_buckets, max_padded_ratio
+        )
+    return active, slot, caps
+
+
 def bucket_entities(
     grouping: EntityGrouping,
     capacities: tuple[int, ...] | None = None,
@@ -301,25 +335,11 @@ def bucket_entities(
     — so the budget is deliberately tight (0.5×) and the target loose (8):
     on bench config E this keeps total padding ≈2× active samples where the
     old launch-count-minimizing policy (4 classes, 4× budget) paid 5×."""
-    active = np.flatnonzero(grouping.active_counts > 0)
+    active, slot, caps = _capacity_slots(
+        grouping.active_counts, capacities, target_buckets, max_padded_ratio
+    )
     if len(active) == 0:
         return EntityBuckets(capacities=(), entity_ids=[], row_indices=[])
-    max_count = int(grouping.active_counts[active].max())
-    explicit = capacities is not None
-    if capacities is None:
-        capacities = default_capacities(max_count)
-    caps = np.asarray(sorted(capacities))
-    if caps[-1] < max_count:
-        raise ValueError(
-            f"largest bucket capacity {caps[-1]} < max active entity size {max_count}"
-        )
-    # smallest capacity >= count, per entity
-    slot = np.searchsorted(caps, grouping.active_counts[active])
-    if not explicit:
-        slot, caps = _merge_bucket_classes(
-            slot, caps, grouping.active_counts[active],
-            target_buckets, max_padded_ratio,
-        )
     ent_ids: list[np.ndarray] = []
     row_idx: list[np.ndarray] = []
     used_caps: list[int] = []
@@ -335,6 +355,44 @@ def bucket_entities(
         ent_ids.append(members.astype(np.int64))
         row_idx.append(rows)
     return EntityBuckets(capacities=tuple(used_caps), entity_ids=ent_ids, row_indices=row_idx)
+
+
+def capacity_classes(
+    active_counts: np.ndarray,
+    capacities: tuple[int, ...] | None = None,
+    target_buckets: int = 8,
+    max_padded_ratio: float = 0.5,
+) -> tuple[tuple[int, ...], tuple[int, ...]]:
+    """The (used capacities, per-class entity populations) that
+    ``bucket_entities`` would produce for this active-count population —
+    WITHOUT building any row matrices.
+
+    The point: after the greedy merge, every entity's class is the
+    smallest SURVIVING capacity ≥ its active count (merging class lo
+    into the next used class hi leaves no survivor between them), so
+    bucketing any SUBSET of these entities with the returned capacities
+    passed EXPLICITLY reproduces each entity's capacity exactly. That is
+    what makes sharded bucket prep population-independent: every shard
+    computes the ladder from the GLOBAL counts (one allreduced bincount)
+    and buckets its owned entities against it, so an entity's bucket
+    geometry — and therefore its solve, bitwise — does not depend on
+    which shard owns it or on how many shards exist. The populations are
+    the lane-floor input: a shard whose local class holds ONE entity of
+    a globally ≥2-entity class must pad to 2 lanes (XLA's batch-1
+    lowering is not bitwise-stable against the batched one — the PR-5
+    caveat), while a globally-singleton class stays 1-lane everywhere.
+    """
+    active, slot, caps = _capacity_slots(
+        active_counts, capacities, target_buckets, max_padded_ratio
+    )
+    if len(active) == 0:
+        return (), ()
+    pops = np.bincount(slot, minlength=len(caps))
+    used = np.flatnonzero(pops > 0)
+    return (
+        tuple(int(caps[b]) for b in used),
+        tuple(int(pops[b]) for b in used),
+    )
 
 
 def _merge_bucket_classes(

@@ -276,12 +276,20 @@ class PreparedBucket:
     it into the full (E, d) matrix."""
 
     entity_ids: np.ndarray  # (k,) original entity ids (host)
-    ids: Array  # (k,) the same ids staged to device (W gather/scatter key)
-    static: Batch  # (k_pad, C, …) features/labels/weights; offsets zero
-    row_idx: Array  # (k_pad, C) int32 device, clipped to >= 0
-    mask: Array  # (k_pad, C) 1.0 where the slot holds a real sample
+    ids: Array | None  # (k,) the same ids staged to device (W scatter key)
+    static: Batch | None  # (k_pad, C, …) features/labels/weights
+    row_idx: Array | None  # (k_pad, C) int32 device, clipped to >= 0
+    mask: Array | None  # (k_pad, C) 1.0 where the slot holds a real sample
     num_real: int  # k (before device-count padding)
     columns: Array | None = None  # (k_pad, p) int32 per-entity column map
+    # owning PROCESS under entity-sharded placement (PHOTON_RE_SHARD=1
+    # with a mesh): this whole bucket solves on exactly one process and
+    # the others receive its results through the post-loop combine.
+    # None = the classic replicated/lane-sharded schedule. Buckets owned
+    # ELSEWHERE keep host bookkeeping only — ids/static/row_idx/mask are
+    # None (never gathered, never uploaded; the dispatch loop skips them
+    # and the combine fills their results in).
+    owner: int | None = None
 
 
 def prepare_buckets(
@@ -302,14 +310,43 @@ def prepare_buckets(
     SURVEY.md §2.2): each bucket solves at width
     p = min(d, ceil(ratio · capacity)) over each entity's most-frequent
     columns. Dense features only (sparse rows are already width-bounded).
+
+    ``PHOTON_RE_SHARD=1`` with a mesh switches to OWNED-BUCKET prep:
+    buckets are staged whole (no entity-lane padding or mesh sharding)
+    and a skew-aware placement plan (Σ active rows per bucket, LPT,
+    fusion-group-atomic so same-geometry launch fusion keeps working per
+    shard) assigns each bucket an owning process. Lanes stay fully
+    addressable, which is exactly what lifts the "compaction/fusion gate
+    off under mesh sharding" restriction — the PR-5 knobs apply per
+    owned bucket.
     """
     from photon_ml_tpu.game.projector import subspace_columns
+    from photon_ml_tpu.parallel.placement import re_shard_enabled
 
-    n_dev = mesh.shape[axis_name] if mesh is not None else 1
+    owned_prep = mesh is not None and re_shard_enabled()
+    n_dev = mesh.shape[axis_name] if (mesh is not None and not owned_prep) else 1
+    # owned prep decides placement BEFORE staging, so each process
+    # gathers/uploads ONLY its owned buckets — device residency and
+    # host→device transfer are O(owned shard), not O(total dataset).
+    # Non-owned buckets keep host bookkeeping only (entity ids, lane
+    # count, owner) — everything the post-solve combine needs.
+    owners = _plan_bucket_owners(buckets) if owned_prep else None
+    own_pid = jax.process_index()
     zeros_off = np.zeros_like(np.asarray(labels))
     prepared: list[PreparedBucket] = []
-    for ent_ids, row_idx in zip(buckets.entity_ids, buckets.row_indices):
+    for bi, (ent_ids, row_idx) in enumerate(
+        zip(buckets.entity_ids, buckets.row_indices)
+    ):
         k = len(ent_ids)
+        if owners is not None and owners[bi] != own_pid:
+            prepared.append(
+                PreparedBucket(
+                    entity_ids=ent_ids, ids=None, static=None,
+                    row_idx=None, mask=None, num_real=k,
+                    owner=int(owners[bi]),
+                )
+            )
+            continue
         static = gather_bucket(features, labels, zeros_off, weights, row_idx)
         idx = jnp.asarray(np.maximum(row_idx, 0), jnp.int32)
         mask = jnp.asarray((row_idx >= 0).astype(np.float32))
@@ -359,9 +396,38 @@ def prepare_buckets(
                 ids=jnp.asarray(ent_ids, jnp.int32),
                 static=static, row_idx=idx, mask=mask,
                 num_real=k, columns=columns,
+                owner=None if owners is None else int(owners[bi]),
             )
         )
     return prepared
+
+
+def _plan_bucket_owners(buckets: EntityBuckets) -> np.ndarray:
+    """Skew-aware whole-bucket placement over the processes of the
+    runtime, decided BEFORE any staging: balance shards by Σ active rows
+    (NOT bucket or entity count — Zipf traffic puts most rows behind a
+    few head entities), with fusion groups placed atomically (keyed by
+    bucket capacity, which determines the geometry pre-staging: the
+    subspace width is a deterministic function of capacity, and feature
+    type/width are constant within one coordinate — the same sets
+    plan_fusion_groups forms at launch time, so every fusable set stays
+    co-owned). Deterministic pure-host arithmetic on replicated inputs —
+    every process computes the identical plan with no communication."""
+    from photon_ml_tpu.parallel.placement import (
+        plan_shard_placement,
+        record_placement_metrics,
+    )
+
+    P_ = jax.process_count()
+    lanes = [len(e) for e in buckets.entity_ids]
+    keys = [int(r.shape[1]) for r in buckets.row_indices]
+    groups = [idxs for idxs, _ in plan_fusion_groups(keys, lanes)]
+    rows = [
+        int(np.sum(np.asarray(r) >= 0)) for r in buckets.row_indices
+    ]
+    plan = plan_shard_placement(rows, P_, groups=groups)
+    record_placement_metrics(plan, shard=jax.process_index())
+    return plan.owner
 
 
 @partial(
@@ -799,7 +865,13 @@ def _fusion_units(
     order; single-member units pass through untouched. Callers gate on
     ``sharding is None`` (concatenation would break mesh lane padding)."""
     plan = plan_fusion_groups(
-        [_bucket_geometry(pb) for pb in prepared],
+        [
+            # remotely-owned buckets carry no staged tensors (and are
+            # never dispatched here) — a unique key keeps each one a
+            # passthrough solo unit instead of touching pb.static
+            ("__remote__", i) if pb.static is None else _bucket_geometry(pb)
+            for i, pb in enumerate(prepared)
+        ],
         [pb.num_real for pb in prepared],
     )
     units: list[tuple[PreparedBucket, list[tuple[int, int, int]]]] = []
@@ -820,6 +892,10 @@ def _fusion_units(
                 None if prepared[idxs[0]].columns is None
                 else cat(*(prepared[i].columns for i in idxs))
             ),
+            # placement is fusion-group-atomic (the same
+            # plan_fusion_groups bookkeeping drives both), so every
+            # member shares one owner — the fused unit inherits it
+            owner=prepared[idxs[0]].owner,
         )
         units.append((fused, members))
     return units
@@ -996,7 +1072,16 @@ def _train_prepared_core(
     V = jnp.zeros((num_entities, d), jnp.float32) if compute_variance else None
 
     l2 = jnp.asarray(l2_weight, jnp.float32)
-    sharding = NamedSharding(mesh, P(axis_name)) if mesh is not None else None
+    # entity-sharded owned-bucket mode (PHOTON_RE_SHARD=1 under a mesh):
+    # buckets were staged WHOLE by prepare_buckets, so lanes are fully
+    # addressable (sharding=None below) — which both lifts the
+    # compaction/fusion gate and lets each process dispatch ONLY the
+    # buckets it owns; the post-loop combine exchanges owned results.
+    owned_mode = any(pb.owner is not None for pb in prepared)
+    sharding = (
+        NamedSharding(mesh, P(axis_name))
+        if (mesh is not None and not owned_mode) else None
+    )
 
     # per-bucket diagnostics stay ON DEVICE — materialized lazily by the
     # result object on first access, so a descent visit that nobody
@@ -1025,7 +1110,12 @@ def _train_prepared_core(
     diag: list[tuple[Array, Array, Array]] = [None] * len(prepared)
     accounting = _DeferredLaunchAccounting()
 
+    own_pid = jax.process_index() if owned_mode else 0
     for pb, members in units:
+        if owned_mode and pb.owner is not None and pb.owner != own_pid:
+            # another process owns this whole unit — its results arrive
+            # through the combine below; nothing is dispatched here
+            continue
         if chunked is not None:
             W, V, f_k, it_k, reason_k = _bucket_step_compacted(
                 W,
@@ -1087,6 +1177,8 @@ def _train_prepared_core(
                 diag[orig_i] = (f_k[lo:hi], it_k[lo:hi], reason_k[lo:hi])
 
     accounting.flush()  # one batched readback, after every bucket enqueued
+    if owned_mode and jax.process_count() > 1:
+        W, V, diag = _combine_owned_results(prepared, W, V, diag)
     if norm is not None:
         # back to the ORIGINAL feature space (W was held in normalized space
         # throughout so per-bucket warm starts stayed consistent)
@@ -1095,6 +1187,74 @@ def _train_prepared_core(
             # linear map u = f⊙w ⇒ variances scale by f² (diagonal approx.)
             V = norm.factors**2 * V
 
+    return W, V, diag
+
+
+def _combine_owned_results(
+    prepared: list[PreparedBucket],
+    W: Array,
+    V: Array | None,
+    diag: list,
+) -> tuple[Array, Array | None, list]:
+    """Cross-process combine for the owned-bucket schedule: every process
+    solved only its owned buckets, so each bucket's coefficient rows,
+    variances and diagnostics live on exactly ONE process. A single
+    fixed-layout allreduce (bucket order, ``num_real`` rows each; owners
+    fill their segments, everyone else contributes zeros — and x + 0.0
+    is exact, so the summed result is the owner's values BITWISE)
+    delivers every bucket everywhere; non-owned rows of the (E, d)
+    matrices are then overwritten and non-owned diagnostics filled in.
+    Entity ids partition across buckets, so the row writes are disjoint.
+
+    Known scale limit (ROADMAP follow-up): the allgather moves the dense
+    (Σ lanes, d) buffer from EVERY process — O(P·E·d) traffic per visit
+    where owned segments (O(E·d) total) would do; at pod scale this
+    should ride the owner-segment framed-P2P exchange instead.
+    """
+    from photon_ml_tpu.parallel.multihost import allreduce_sum_host
+
+    pid = jax.process_index()
+    ks = [pb.num_real for pb in prepared]
+    offs = np.concatenate([[0], np.cumsum(ks)]).astype(np.int64)
+    total = int(offs[-1])
+    d = int(W.shape[1])
+    Wc = np.zeros((total, d), np.float32)
+    Vc = np.zeros((total, d), np.float32) if V is not None else None
+    Fc = np.zeros(total, np.float64)
+    Ic = np.zeros(total, np.int64)
+    Rc = np.zeros(total, np.int64)
+    W_h = np.asarray(jax.device_get(W)).copy()
+    V_h = None if V is None else np.asarray(jax.device_get(V)).copy()
+    owned = [i for i, pb in enumerate(prepared) if pb.owner == pid]
+    owned_diag = jax.device_get([diag[i] for i in owned])
+    for i, (f_h, it_h, r_h) in zip(owned, owned_diag):
+        lo, hi = int(offs[i]), int(offs[i + 1])
+        ent = prepared[i].entity_ids
+        Wc[lo:hi] = W_h[ent]
+        if Vc is not None:
+            Vc[lo:hi] = V_h[ent]
+        Fc[lo:hi] = np.asarray(f_h, np.float64)
+        Ic[lo:hi] = np.asarray(it_h, np.int64)
+        Rc[lo:hi] = np.asarray(r_h, np.int64)
+    if Vc is None:
+        Wc, Fc, Ic, Rc = allreduce_sum_host(Wc, Fc, Ic, Rc)
+    else:
+        Wc, Vc, Fc, Ic, Rc = allreduce_sum_host(Wc, Vc, Fc, Ic, Rc)
+    diag = list(diag)
+    for i, pb in enumerate(prepared):
+        if pb.owner == pid:
+            continue  # locally-solved: device refs already in place
+        lo, hi = int(offs[i]), int(offs[i + 1])
+        W_h[pb.entity_ids] = Wc[lo:hi]
+        if V_h is not None:
+            V_h[pb.entity_ids] = Vc[lo:hi]
+        diag[i] = (
+            jnp.asarray(Fc[lo:hi], jnp.float32),
+            jnp.asarray(Ic[lo:hi], jnp.int32),
+            jnp.asarray(Rc[lo:hi], jnp.int32),
+        )
+    W = jnp.asarray(W_h)
+    V = None if V_h is None else jnp.asarray(V_h)
     return W, V, diag
 
 

@@ -194,6 +194,14 @@ def _num_processes() -> tuple[int, int]:
     return jax.process_index(), jax.process_count()
 
 
+def _re_shard_enabled() -> bool:
+    """PHOTON_RE_SHARD (lazy import — the parallel package pulls in the
+    full distributed runtime, which this module otherwise defers)."""
+    from photon_ml_tpu.parallel.placement import re_shard_enabled
+
+    return re_shard_enabled()
+
+
 def _take_features(f: Features, idx: np.ndarray) -> dict[str, np.ndarray]:
     """Host row-slice of a feature container as plain arrays (for the
     exchange rounds)."""
@@ -259,6 +267,112 @@ class _ReShard:
     # per-bucket per-entity subspace column maps ((k, p) int arrays, or
     # None entries for full-width buckets), computed ONCE at ingest
     subspace_cols: tuple | None = None
+    # skew-aware placement (PHOTON_RE_SHARD=1): owner process per GLOBAL
+    # entity id (identical on every process — computed from the
+    # allreduced row counts) and this process's sorted owned ids.
+    # None = the modular entity_id % P owner rule.
+    entity_owner: np.ndarray | None = None  # (E,) int64
+    owned_global: np.ndarray | None = None  # (E_local,) int64, sorted
+    # lane floor (placement mode): per-bucket dummy-lane pad (0/1). A
+    # shard-local 1-entity bucket whose GLOBAL capacity class holds >= 2
+    # entities pads to 2 lanes so its solve goes down the batched XLA
+    # lowering — the one the single-process run used for that entity
+    # (batch-1 lowering is not bitwise-stable against it; PR-5 caveat).
+    lane_floor_pad: tuple | None = None
+
+
+def _offsets_payload(shard: _ReShard, offs_local: np.ndarray, row_base: int):
+    """(arrays, dest) of the owner-ward offsets exchange — ONE definition
+    shared by the blocking and overlapped schedules, so the two can
+    never drift."""
+    return (
+        {
+            "grow": shard.origin_grow,
+            "off": offs_local[shard.origin_grow - row_base].astype(
+                np.float32
+            ),
+        },
+        shard.origin_dest,
+    )
+
+
+def _scatter_offsets(shard: _ReShard, recv: dict) -> np.ndarray:
+    """Owner-side epilogue of the offsets exchange: place each received
+    row's offset at its owned position (grow-keyed). Shared by the
+    blocking and overlapped schedules."""
+    out = np.zeros(len(shard.grow), np.float32)
+    if not len(shard.grow_sorted):
+        return out
+    g = recv["grow"]
+    pos = np.minimum(
+        np.searchsorted(shard.grow_sorted, g),
+        max(len(shard.grow_sorted) - 1, 0),
+    )
+    match = shard.grow_sorted[pos] == g
+    out[shard.grow_order[pos[match]]] = recv["off"][match]
+    return out
+
+
+def _scatter_scores(
+    shard: _ReShard, recv: dict, n_local: int, row_base: int
+) -> np.ndarray:
+    """Origin-side epilogue of the reverse score exchange. Shared by the
+    blocking and overlapped schedules."""
+    out = np.zeros(n_local, np.float32)
+    out[recv["grow"] - row_base] = recv["score"]
+    return out
+
+
+class _ReadyValue:
+    """Degenerate exchange handle: the value was computable inline
+    (single process). Keeps the overlapped schedule's call shape."""
+
+    def __init__(self, value):
+        self._value = value
+
+    def result(self):
+        return self._value
+
+
+class _PendingExchange:
+    """An in-flight ``exchange_rows_async`` plus its host epilogue;
+    ``result()`` joins once and memoizes — thread-safely, because the
+    overlapped schedule resolves the offsets handle from prefetch
+    workers (whichever gather runs first pays the join)."""
+
+    def __init__(self, handle, finish):
+        import threading
+
+        self._handle = handle
+        self._finish = finish
+        self._value = None
+        self._done = False
+        self._lock = threading.Lock()
+
+    def result(self):
+        with self._lock:
+            if not self._done:
+                self._value = self._finish(self._handle.result())
+                self._handle = self._finish = None
+                self._done = True
+        return self._value
+
+
+def _slice_owned_rows(
+    shard: _ReShard, M_full: np.ndarray, pid: int, P: int,
+    limit: int | None = None,
+) -> np.ndarray:
+    """This process's owned rows of a GLOBAL (E, d) matrix (warm start /
+    prior / resume slicing), honoring the shard's owner layout: the
+    placement map when skew-aware sharding built it, else the modular
+    interleave. Always a writable copy (the bucket solves write rows
+    back in place)."""
+    if shard is not None and shard.owned_global is not None:
+        return M_full[shard.owned_global].copy()
+    if P > 1:
+        out = M_full[pid::P]
+        return (out[:limit] if limit is not None else out).copy()
+    return (M_full[:limit] if limit is not None else M_full).copy()
 
 
 class StreamedGameTrainer:
@@ -414,9 +528,11 @@ class StreamedGameTrainer:
         feats: Features,
         ids: np.ndarray,
         row_layout: tuple[int, ...] = (),
+        entity_owner: np.ndarray | None = None,
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray, Features, np.ndarray]:
         """Route every row of this coordinate to its entity's owner process
-        (owner = ``entity_id % P``) in chunked POINT-TO-POINT rounds: each
+        (owner = ``entity_id % P``, or ``entity_owner[entity_id]`` under
+        skew-aware placement) in chunked POINT-TO-POINT rounds: each
         round exchanges one ``chunk_rows`` slice through the all-to-all
         (peak memory O(P·chunk) like the old broadcast rounds, but
         O(n_local) total traffic per host instead of O(P·n) — with this,
@@ -461,7 +577,10 @@ class StreamedGameTrainer:
             lo = min(r * self.chunk_rows, n_rows)
             hi = min(lo + self.chunk_rows, n_rows)
             sub = {k: v[lo:hi] for k, v in arrays.items()}
-            dest = (sub["ent"] % P).astype(np.int64)
+            if entity_owner is not None:
+                dest = entity_owner[sub["ent"]].astype(np.int64)
+            else:
+                dest = (sub["ent"] % P).astype(np.int64)
             recv = exchange_rows(sub, dest)
             for k, v in recv.items():
                 keep[k].append(v)
@@ -488,11 +607,19 @@ class StreamedGameTrainer:
         row_base: int,
         row_layout: tuple[int, ...],
         drop_unseen: bool = False,
+        reuse_layout: _ReShard | None = None,
     ) -> _ReShard:
         """``drop_unseen``: rows whose entity id is -1 (validation rows for
         entities unseen at training) are excluded from the shard — they
         keep score 0 for this coordinate, the in-memory scorer's semantics
-        for the unseen-entity sentinel."""
+        for the unseen-entity sentinel.
+
+        ``reuse_layout``: a TRAINING shard whose owner layout this shard
+        must follow (validation shards under skew-aware placement): the
+        per-entity coefficient matrix is laid out by the TRAINING plan's
+        owned ranks, so a validation shard that re-planned from its own
+        row counts would route rows to the wrong process and index the
+        wrong coefficient rows."""
         c = self.config.random_effect_coordinates[cid]
         feats = data.feature_container(c.feature_shard_id)
         ids = np.asarray(data.id_tags[c.random_effect_type], np.int64)
@@ -526,8 +653,68 @@ class StreamedGameTrainer:
         pid, P = _num_processes()
         if not self._distributed():
             P, pid = 1, 0
+        # skew-aware entity placement (PHOTON_RE_SHARD=1): owners balance
+        # Σ per-entity rows (one allreduced bincount — identical plan on
+        # every process), not entity count; the same global counts also
+        # fix the bucket capacity ladder, so an entity's bucket geometry
+        # (and its solve, bitwise) is independent of which process owns
+        # it and of the process count.
+        entity_owner = owned_global = None
+        global_caps = global_pops = None
+        if reuse_layout is not None and reuse_layout.entity_owner is not None:
+            # follow the TRAINING plan verbatim — gated on the PREPARED
+            # STATE, never a re-read of the knob (a flip between
+            # training-shard ingest and validation setup must not change
+            # which layout re_W rows are indexed by): no re-planning (a
+            # plan from validation row counts would disagree with the
+            # coefficient-matrix layout), no gauge overwrite, and no
+            # global capacity ladder (this shard never solves)
+            entity_owner = reuse_layout.entity_owner
+            owned_global = reuse_layout.owned_global
+            if len(ids) and int(ids.max()) >= len(entity_owner):
+                raise ValueError(
+                    f"coordinate {cid!r}: validation entity id "
+                    f"{int(ids.max())} outside the training dictionary "
+                    f"(E={len(entity_owner)}) — unseen entities must "
+                    "carry the -1 sentinel"
+                )
+        elif P > 1 and _re_shard_enabled() and reuse_layout is None:
+            # plan ONLY for shards that own solves; a shard following a
+            # modular-layout training shard (reuse_layout given, no
+            # owner map) must keep the modular rule below even when the
+            # knob is on NOW
+            from photon_ml_tpu.game.data import capacity_classes
+            from photon_ml_tpu.parallel.multihost import allreduce_sum_host
+            from photon_ml_tpu.parallel.placement import (
+                plan_entity_placement,
+                record_placement_metrics,
+            )
+
+            counts_g = np.asarray(
+                allreduce_sum_host(
+                    np.bincount(
+                        ids[ids >= 0], minlength=E
+                    ).astype(np.int64)
+                )
+            )
+            plan = plan_entity_placement(counts_g, P)
+            entity_owner = plan.owner
+            owned_global = np.flatnonzero(entity_owner == pid).astype(
+                np.int64
+            )
+            record_placement_metrics(plan, shard=pid)
+            active_g = counts_g
+            if c.active_data_upper_bound is not None:
+                active_g = np.minimum(counts_g, c.active_data_upper_bound)
+            global_caps, global_pops = capacity_classes(
+                active_g,
+                c.sample_bucket_sizes,
+                target_buckets=c.bucket_target_count,
+                max_padded_ratio=c.bucket_max_padded_ratio,
+            )
         ent_g, labels, weights, feats_o, grow = self._exchange_to_owners(
-            cid, data, grow_in, feats, ids, row_layout
+            cid, data, grow_in, feats, ids, row_layout,
+            entity_owner=entity_owner,
         )
         if c.random_projection_dim is not None:
             # shared random projection (reference: ProjectionMatrix):
@@ -548,8 +735,15 @@ class StreamedGameTrainer:
                 X=np.asarray(feats_o.X, np.float32)
                 @ np.asarray(proj.matrix, np.float32)
             )
-        ent_local = (ent_g // P).astype(np.int64) if P > 1 else ent_g
-        E_local = (E - pid + P - 1) // P if P > 1 else E
+        if owned_global is not None:
+            # owner-local dense id = rank among this process's owned ids
+            ent_local = np.searchsorted(owned_global, ent_g).astype(np.int64)
+            E_local = int(len(owned_global))
+        elif P > 1:
+            ent_local = (ent_g // P).astype(np.int64)
+            E_local = (E - pid + P - 1) // P
+        else:
+            ent_local, E_local = ent_g, E
         grouping = group_by_entity(
             ent_local.astype(np.int64),
             num_entities=E_local,
@@ -557,10 +751,28 @@ class StreamedGameTrainer:
         )
         buckets = bucket_entities(
             grouping,
-            c.sample_bucket_sizes,
+            (
+                global_caps
+                if global_caps is not None and len(global_caps)
+                else c.sample_bucket_sizes
+            ),
             target_buckets=c.bucket_target_count,
             max_padded_ratio=c.bucket_max_padded_ratio,
         )
+        lane_pad = None
+        if global_caps is not None and len(global_caps):
+            cap_pop = dict(zip(global_caps, global_pops))
+            lane_pad = tuple(
+                1
+                if (
+                    len(ent_b) == 1
+                    and cap_pop.get(int(rows_b.shape[1]), 0) >= 2
+                )
+                else 0
+                for ent_b, rows_b in zip(
+                    buckets.entity_ids, buckets.row_indices
+                )
+            )
         order = np.argsort(grow)
         # point-to-point routing for the per-visit exchanges: origin rows
         # go to their entity's owner; owned rows return to their origin
@@ -616,9 +828,16 @@ class StreamedGameTrainer:
             buckets=buckets,
             num_entities_local=E_local,
             origin_grow=grow_in,
-            origin_dest=(ids % max(P, 1)).astype(np.int64),
+            origin_dest=(
+                entity_owner[ids].astype(np.int64)
+                if entity_owner is not None
+                else (ids % max(P, 1)).astype(np.int64)
+            ),
             owner_dest=owner_dest,
             subspace_cols=subspace_cols,
+            entity_owner=entity_owner,
+            owned_global=owned_global,
+            lane_floor_pad=lane_pad,
         )
 
     def _offsets_to_owners(
@@ -635,26 +854,54 @@ class StreamedGameTrainer:
             return offs_local[shard.grow]
         from photon_ml_tpu.parallel.multihost import exchange_rows
 
-        recv = exchange_rows(
-            {
-                "grow": shard.origin_grow,
-                "off": offs_local[shard.origin_grow - row_base].astype(
-                    np.float32
-                ),
-            },
-            shard.origin_dest,
+        arrays, dest = _offsets_payload(shard, offs_local, row_base)
+        return _scatter_offsets(shard, exchange_rows(arrays, dest))
+
+    def _offsets_to_owners_async(
+        self, shard: _ReShard, offs_local: np.ndarray, row_base: int
+    ):
+        """Overlapped twin of ``_offsets_to_owners`` (PHOTON_RE_SHARD=1):
+        the exchange is ISSUED here — on the collective-free framed P2P
+        worker — and the owned-offset vector materializes at
+        ``.result()``, so the transfer rides under the bucket-unit
+        planning and first gathers instead of barriering the visit.
+        Same values as the sync path, bit for bit."""
+        if not self._distributed():
+            return _ReadyValue(offs_local[shard.grow])
+        from photon_ml_tpu.parallel.multihost import exchange_rows_async
+
+        arrays, dest = _offsets_payload(shard, offs_local, row_base)
+        return _PendingExchange(
+            exchange_rows_async(arrays, dest),
+            lambda recv: _scatter_offsets(shard, recv),
         )
-        out = np.zeros(len(shard.grow), np.float32)
-        if not len(shard.grow_sorted):
-            return out
-        g = recv["grow"]
-        pos = np.minimum(
-            np.searchsorted(shard.grow_sorted, g),
-            max(len(shard.grow_sorted) - 1, 0),
+
+    def _scores_to_origin_async(
+        self,
+        shard: _ReShard,
+        scores_re: np.ndarray,
+        n_local: int,
+        row_base: int,
+    ):
+        """Overlapped twin of ``_scores_to_origin``: issued right after
+        the owner-side scoring, joined only when the origin-side total
+        update needs the rows — the per-coordinate diagnostics
+        collective and visit bookkeeping run while the payload is in
+        flight."""
+        if not self._distributed():
+            out = np.zeros(n_local, np.float32)
+            out[shard.grow] = scores_re
+            return _ReadyValue(out)
+        from photon_ml_tpu.parallel.multihost import exchange_rows_async
+
+        handle = exchange_rows_async(
+            {"grow": shard.grow, "score": scores_re.astype(np.float32)},
+            shard.owner_dest,
         )
-        match = shard.grow_sorted[pos] == g
-        out[shard.grow_order[pos[match]]] = recv["off"][match]
-        return out
+        return _PendingExchange(
+            handle,
+            lambda recv: _scatter_scores(shard, recv, n_local, row_base),
+        )
 
     def _scores_to_origin(
         self,
@@ -676,9 +923,7 @@ class StreamedGameTrainer:
             {"grow": shard.grow, "score": scores_re.astype(np.float32)},
             shard.owner_dest,
         )
-        g = recv["grow"]
-        out[g - row_base] = recv["score"]
-        return out
+        return _scatter_scores(shard, recv, n_local, row_base)
 
     def _gather_global(
         self,
@@ -978,11 +1223,30 @@ class StreamedGameTrainer:
         bucket_args = list(
             zip(buckets.entity_ids, buckets.row_indices, sub_cols)
         )
+        # lane floor (skew-aware sharding): a shard-local 1-entity bucket
+        # whose GLOBAL capacity class holds >= 2 entities launches with
+        # one dummy all-masked lane, so its entity goes down the batched
+        # XLA lowering — the one the single-process run used for it
+        # (batch-1 is not bitwise-stable against batched; PR-5 caveat).
+        # The dummy lane's outputs are sliced off before collect().
+        pads = shard.lane_floor_pad or (0,) * len(bucket_args)
+
+        def padded_args(i):
+            ent, rows, cols = bucket_args[i]
+            if not pads[i]:
+                return ent, rows, cols
+            rows = np.concatenate(
+                [rows, np.full((1, rows.shape[1]), -1, rows.dtype)]
+            )
+            cols = None if cols is None else np.concatenate([cols, cols[:1]])
+            return ent, rows, cols
+
         # PHOTON_RE_FUSE_BUCKETS: same-(C, p)-geometry buckets concatenate
         # along the entity lane into ONE launch unit (the gather below then
         # uploads one fused batch); results split back per original bucket
         # in collect(). Knob off (default): one unit per bucket, the
-        # classic schedule bit-for-bit.
+        # classic schedule bit-for-bit. Lane-floor-padded buckets are
+        # always 1-real-lane, which plan_fusion_groups keeps solo.
         units: list[tuple[list[tuple[int, int, int]], tuple]] = []
         if _re_fuse_buckets() and len(bucket_args) > 1:
             from photon_ml_tpu.game.random_effect import plan_fusion_groups
@@ -999,7 +1263,7 @@ class StreamedGameTrainer:
             )
             for idxs, members in plan:
                 if len(idxs) == 1:
-                    units.append((members, bucket_args[idxs[0]]))
+                    units.append((members, padded_args(idxs[0])))
                     continue
                 ent = np.concatenate([bucket_args[i][0] for i in idxs])
                 rows = np.concatenate(
@@ -1014,10 +1278,19 @@ class StreamedGameTrainer:
                 units.append((members, (ent, rows, cols)))
         else:
             units = [
-                ([(i, 0, len(args[0]))], args)
-                for i, args in enumerate(bucket_args)
+                ([(i, 0, len(bucket_args[i][0]))], padded_args(i))
+                for i in range(len(bucket_args))
             ]
         from photon_ml_tpu.ops import prefetch
+
+        # overlapped exchange schedule: offs_re may be an in-flight
+        # exchange handle (joined-and-memoized, thread-safely, by its
+        # own result()) — resolved at the first gather, usually on a
+        # prefetch worker, so the exchange hides under the unit planning
+        # above and the launch pipeline itself
+        _offs = offs_re.result if hasattr(offs_re, "result") else (
+            lambda: offs_re
+        )
 
         def gather(i):
             # bucket INGEST (host row gather + padding + upload) for bucket
@@ -1028,7 +1301,7 @@ class StreamedGameTrainer:
             # solve/collect order (and thus every result) stays identical
             _, rows_i, cols_i = units[i][1]
             return gather_bucket(
-                shard.features, shard.labels, offs_re, shard.weights,
+                shard.features, shard.labels, _offs(), shard.weights,
                 rows_i, columns=cols_i,
             )
 
@@ -1036,6 +1309,10 @@ class StreamedGameTrainer:
             prefetch.prefetch_iter(len(units), gather)
         ):
             members, (ent_ids, rows, cols) = units[i]
+            n_real = len(ent_ids)
+            lane_pad = rows.shape[0] - n_real  # lane-floor dummy lanes
+            if cols is not None and lane_pad:
+                cols = cols[:n_real]
             any_entities = True
             # incremental training: this bucket's rows of the (already
             # solver-space) per-entity prior; subspace projection selects
@@ -1051,6 +1328,19 @@ class StreamedGameTrainer:
                     mu_rows = np.take_along_axis(mu_rows, cols, axis=1)
                     if var_rows is not None:
                         var_rows = np.take_along_axis(var_rows, cols, axis=1)
+                if lane_pad:
+                    # dummy lanes: zero-mean unit-variance prior (the
+                    # same inert pad convention as _extract_lanes)
+                    mu_rows = np.concatenate(
+                        [mu_rows,
+                         np.zeros((lane_pad, mu_rows.shape[1]), mu_rows.dtype)]
+                    )
+                    if var_rows is not None:
+                        var_rows = np.concatenate(
+                            [var_rows,
+                             np.ones((lane_pad, var_rows.shape[1]),
+                                     var_rows.dtype)]
+                        )
                 prior_mu = jnp.asarray(mu_rows, jnp.float32)
                 if var_rows is not None:
                     prior_var = jnp.asarray(var_rows, jnp.float32)
@@ -1062,6 +1352,11 @@ class StreamedGameTrainer:
             w0_rows = W[ent_ids]
             if cols is not None:
                 w0_rows = np.take_along_axis(w0_rows, cols, axis=1)
+            if lane_pad:
+                w0_rows = np.concatenate(
+                    [w0_rows,
+                     np.zeros((lane_pad, w0_rows.shape[1]), w0_rows.dtype)]
+                )
             w0 = jnp.asarray(w0_rows, jnp.float32)
             if norm is not None:
                 w0 = jax.vmap(norm.model_from_original_space)(w0)
@@ -1082,6 +1377,11 @@ class StreamedGameTrainer:
                 accounting=accounting,
                 **extra,
             )
+            if lane_pad:
+                # lane-floor dummy outputs never reach collect() — the
+                # real entity's lane is bitwise what a larger batch
+                # would have produced, which was the pad's whole point
+                out = tuple(a[:n_real] for a in out)
             if pending is not None:
                 collect(*pending)  # blocks on the PREVIOUS bucket only
             pending = (members, ent_ids, cols, out)
@@ -1089,6 +1389,10 @@ class StreamedGameTrainer:
             collect(*pending)
         accounting.flush()  # one batched readback, all solves now complete
         if not any_entities:
+            # a shard that owns no buckets still joins its (empty)
+            # offsets exchange — the handle must not linger in the
+            # pending queue across visits
+            _offs()
             return 0.0, 0, True
         loss_sum = 0.0
         for i in range(len(bucket_args)):
@@ -1154,22 +1458,35 @@ class StreamedGameTrainer:
 
     # -- random-effect model assembly ---------------------------------------
 
-    def _full_re_matrix(self, W_local: np.ndarray, E: int) -> np.ndarray:
-        """The full (E, d) coefficient matrix from per-process owned rows
-        (owner p holds global entities p, p+P, ... as local rows 0, 1, ...)."""
+    def _full_re_matrix(
+        self, W_local: np.ndarray, E: int,
+        entity_owner: np.ndarray | None = None,
+    ) -> np.ndarray:
+        """The full (E, d) coefficient matrix from per-process owned rows.
+        Default owner rule: owner p holds global entities p, p+P, ... as
+        local rows 0, 1, ...; under skew-aware placement the
+        ``entity_owner`` map (identical on every process) provides the
+        layout instead."""
         pid, P = _num_processes()
         if not self._distributed():
             return W_local
         from jax.experimental import multihost_utils
 
         d = W_local.shape[1]
-        E_max = (E + P - 1) // P
-        padded = np.zeros((E_max, d), np.float32)
+        if entity_owner is not None:
+            per_owner = np.bincount(entity_owner, minlength=P)
+            E_max = int(per_owner.max()) if len(per_owner) else 0
+        else:
+            E_max = (E + P - 1) // P
+        padded = np.zeros((max(E_max, 1), d), np.float32)
         padded[: len(W_local)] = W_local
         stacked = np.asarray(multihost_utils.process_allgather(padded))
         W = np.zeros((E, d), np.float32)
         for p in range(P):
-            own = np.arange(p, E, P)
+            own = (
+                np.flatnonzero(entity_owner == p)
+                if entity_owner is not None else np.arange(p, E, P)
+            )
             W[own] = stacked[p][: len(own)]
         return W
 
@@ -1247,7 +1564,9 @@ class StreamedGameTrainer:
         return fracs
 
     def _prepare_validation(
-        self, validation: StreamedGameData
+        self,
+        validation: StreamedGameData,
+        re_shards: dict[str, _ReShard] | None = None,
     ) -> dict[str, Any]:
         """Setup-time structures for per-visit validation scoring: fixed
         shards score locally (streamed); random-effect shards exchange the
@@ -1272,7 +1591,11 @@ class StreamedGameTrainer:
             state["scores"][cid] = np.zeros(n_val, np.float32)
         for cid, c in cfg.random_effect_coordinates.items():
             state["re_shards"][cid] = self._build_re_shard(
-                cid, validation, val_base, val_layout, drop_unseen=True
+                cid, validation, val_base, val_layout, drop_unseen=True,
+                # skew-aware placement: the validation shard must follow
+                # the TRAINING shard's owner layout (re_W is laid out by
+                # the training plan's owned ranks)
+                reuse_layout=(re_shards or {}).get(cid),
             )
         state["total"] = state["base_offsets"].copy()
         state["grouped_dropped"] = self._log_grouped_dropped(validation)
@@ -1825,13 +2148,17 @@ class StreamedGameTrainer:
                 feature_shard_id=c.feature_shard_id,
             )
         for cid, c in cfg.random_effect_coordinates.items():
+            owner = self.__dict__.get("_re_layouts", {}).get(cid)
             W_full = self._full_re_matrix(
-                model_state["re_W"][cid], model_state["re_E"][cid]
+                model_state["re_W"][cid], model_state["re_E"][cid],
+                entity_owner=owner,
             )
             V_local = re_V.get(cid)
             V_full = (
                 None if V_local is None
-                else self._full_re_matrix(V_local, model_state["re_E"][cid])
+                else self._full_re_matrix(
+                    V_local, model_state["re_E"][cid], entity_owner=owner
+                )
             )
             W_out = jnp.asarray(W_full)
             if cid in self._projectors:
@@ -1911,6 +2238,13 @@ class StreamedGameTrainer:
                 re_shards[cid] = self._build_re_shard(
                     cid, data, row_base, row_layout
                 )
+        # model assembly (and checkpointing mid-fit) needs each shard's
+        # OWNER LAYOUT to reassemble the (E, d) matrices under placement
+        # — only the layout is kept on the trainer (the shards themselves
+        # hold O(dataset) arrays and must not outlive the fit)
+        self._re_layouts = {
+            cid: s.entity_owner for cid, s in re_shards.items()
+        }
 
         # model state on HOST: fixed vectors + OWNED random-effect rows
         pid, P = _num_processes()
@@ -1983,9 +2317,11 @@ class StreamedGameTrainer:
                         W_full = W_full @ np.asarray(
                             self._projectors[cid].matrix, np.float32
                         )
-                    re_W[cid] = (
-                        W_full[pid::P][: re_W[cid].shape[0]].copy()
-                        if P > 1 else W_full[: re_E[cid]].copy()
+                    re_W[cid] = _slice_owned_rows(
+                        re_shards[cid], W_full, pid, P,
+                        limit=(
+                            re_W[cid].shape[0] if P > 1 else re_E[cid]
+                        ),
                     )
                 # coordinates absent from the update sequence are ignored
                 # (the streamed path has no locked-coordinate scoring)
@@ -2029,9 +2365,11 @@ class StreamedGameTrainer:
                     V_loc = None
                     if cid not in self._projectors and sub.variances is not None:
                         V_full = np.asarray(sub.variances, np.float32)
-                        V_loc = (
-                            V_full[pid::P][: re_W[cid].shape[0]].copy()
-                            if P > 1 else V_full[: re_E[cid]].copy()
+                        V_loc = _slice_owned_rows(
+                            re_shards[cid], V_full, pid, P,
+                            limit=(
+                                re_W[cid].shape[0] if P > 1 else re_E[cid]
+                            ),
                         )
                     c_norm = self._norm_contexts.get(
                         cfg.random_effect_coordinates[cid].feature_shard_id
@@ -2084,7 +2422,7 @@ class StreamedGameTrainer:
         # CoordinateDescent has the same contract; a default metric would be
         # wrong for half the task types)
         if validation is not None and self.evaluators:
-            vstate = self._prepare_validation(validation)
+            vstate = self._prepare_validation(validation, re_shards)
 
         # checkpoint/resume (per coordinate VISIT)
         seq = list(cfg.coordinate_update_sequence)
@@ -2122,18 +2460,18 @@ class StreamedGameTrainer:
                         if v is not None and want_var:
                             fixed_var[cid] = np.asarray(v, np.float32)
                     elif cid in re_W:
-                        # .copy() everywhere: np.asarray over a jax array
-                        # yields a READ-ONLY buffer, and the bucket solves
-                        # write rows back in place
+                        # .copy() everywhere (via _slice_owned_rows):
+                        # np.asarray over a jax array yields a READ-ONLY
+                        # buffer, and the bucket solves write rows back
+                        # in place
                         W_full = np.asarray(sub.coefficients, np.float32)
-                        re_W[cid] = (
-                            W_full[pid::P].copy() if P > 1 else W_full.copy()
+                        re_W[cid] = _slice_owned_rows(
+                            re_shards[cid], W_full, pid, P
                         )
                         if sub.variances is not None and want_var:
                             V_full = np.asarray(sub.variances, np.float32)
-                            re_V[cid] = (
-                                V_full[pid::P].copy() if P > 1
-                                else V_full.copy()
+                            re_V[cid] = _slice_owned_rows(
+                                re_shards[cid], V_full, pid, P
                             )
                 if resume.get("scores_local"):
                     # sharded checkpoints return this host's slice directly
@@ -2202,9 +2540,25 @@ class StreamedGameTrainer:
                         else:
                             c = cfg.random_effect_coordinates[cid]
                             shard = re_shards[cid]
-                            offs_re = self._offsets_to_owners(
-                                shard, offs, row_base
+                            # overlapped exchange schedule (the knob-on
+                            # pipeline): the offsets exchange is ISSUED
+                            # here and joined inside the first bucket
+                            # gather, and the reverse score exchange
+                            # rides under the diagnostics collective —
+                            # no barrier per coordinate. Knob off: the
+                            # classic blocking sequence, bit-for-bit
+                            # (same exchanges, same counters).
+                            overlap = (
+                                self._distributed() and _re_shard_enabled()
                             )
+                            if overlap:
+                                offs_re = self._offsets_to_owners_async(
+                                    shard, offs, row_base
+                                )
+                            else:
+                                offs_re = self._offsets_to_owners(
+                                    shard, offs, row_base
+                                )
                             loss_sum, max_it, conv = self._solve_re_buckets(
                                 shard, offs_re, c.optimization, re_W[cid],
                                 None if cid in self._projectors
@@ -2218,6 +2572,17 @@ class StreamedGameTrainer:
                                 W_prior=re_W_prior.get(cid),
                                 V_prior=re_V_prior.get(cid),
                             )
+                            score_pending = None
+                            if overlap:
+                                # owner-side scoring first, so the
+                                # reverse exchange is in flight through
+                                # the collective below
+                                s_re = self._score_re_rows(
+                                    shard, re_W[cid]
+                                )
+                                score_pending = self._scores_to_origin_async(
+                                    shard, s_re, n, row_base
+                                )
                             if self._distributed():
                                 # per-owner partial diagnostics → global
                                 # (sum the losses, max the iteration
@@ -2235,10 +2600,15 @@ class StreamedGameTrainer:
                                 loss_sum = float(agg[:, 0].sum())
                                 max_it = int(agg[:, 1].max())
                                 conv = bool((agg[:, 2] == 0).all())
-                            s_re = self._score_re_rows(shard, re_W[cid])
-                            new_scores = self._scores_to_origin(
-                                shard, s_re, n, row_base
-                            )
+                            if score_pending is not None:
+                                new_scores = score_pending.result()
+                            else:
+                                s_re = self._score_re_rows(
+                                    shard, re_W[cid]
+                                )
+                                new_scores = self._scores_to_origin(
+                                    shard, s_re, n, row_base
+                                )
                             info[cid] = StreamedCoordinateInfo(
                                 final_loss=loss_sum, iterations=max_it,
                                 converged=conv,

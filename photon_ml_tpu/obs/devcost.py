@@ -143,16 +143,25 @@ def _knob_raw_state() -> tuple:
         )
     except Exception:
         re_state = None
+    try:
+        import sys
+
+        pl_mod = sys.modules.get("photon_ml_tpu.parallel.placement")
+        shard_state = None if pl_mod is None else pl_mod.RE_SHARD
+    except Exception:
+        shard_state = None
     return (
         env.get("PHOTON_PREFETCH_DEPTH"),
         env.get("PHOTON_CHUNK_CACHE_BUDGET"),
         env.get("PHOTON_KERNEL_DTYPE"),
         env.get("PHOTON_RE_COMPACT_EVERY"),
         env.get("PHOTON_RE_FUSE_BUCKETS"),
+        env.get("PHOTON_RE_SHARD"),
         pf.PREFETCH_DEPTH, pf.CHUNK_CACHE_BUDGET,
         len(pf._device_budget_memo),
         st.GROUPS_PER_RUN, st.PIPELINE_SEGMENTS, st.KERNEL_DTYPE,
         re_state,
+        shard_state,
     )
 
 

@@ -165,6 +165,18 @@ def summarize_run(path: str) -> dict:
         ),
     }
 
+    # entity-sharded placement gauges (re_shard.*, parallel/placement +
+    # the overlapped-exchange ratio from parallel/multihost): per-shard
+    # load (Σ rows), max/mean balance, and the fraction of exchange wall
+    # hidden behind other work — the scale-out counterpart of the
+    # wasted-lane accounting below
+    metrics_gauges = metrics.get("gauges", {})
+    re_shard = {
+        k[len("re_shard."):]: float(v)
+        for k, v in metrics_gauges.items()
+        if k.startswith("re_shard.") and isinstance(v, (int, float))
+    } or None
+
     optim = [r for r in records if r["event"] == "optim_result"]
     reasons: dict[str, int] = {}
     for r in optim:
@@ -316,6 +328,7 @@ def summarize_run(path: str) -> dict:
             "reasons": reasons,
         },
         "re_solve": re_solve,
+        "re_shard": re_shard,
         "quality_parity": quality_parity,
         "devcost": devcost,
         "hbm": hbm,
@@ -389,6 +402,20 @@ def format_summary(s: dict) -> str:
             f"{int(rs['executed_entity_iterations'])} executed entity-iters "
             f"({int(rs['useful_entity_iterations'])} useful), "
             f"wasted-lane {rs['wasted_lane_fraction']:.1%}"
+        )
+    rsh = s.get("re_shard") or {}
+    if rsh.get("shards"):
+        overlap = rsh.get("exchange_overlap_ratio")
+        lines.append(
+            f"  re-shard: {int(rsh['shards'])} shards, rows "
+            f"{rsh.get('rows', 0):.0f} "
+            f"(max {rsh.get('rows_max', 0):.0f} / mean "
+            f"{rsh.get('rows_mean', 0):.1f}), "
+            f"balance {rsh.get('balance', 1.0):.3f}x"
+            + (
+                f", exchange-overlap {overlap:.1%}"
+                if overlap is not None else ""
+            )
         )
     if s.get("quality_parity"):
         lines.append(
@@ -493,6 +520,30 @@ def diff_summaries(a: dict, b: dict) -> str:
             f"{int(ra.get('executed_entity_iterations') or 0):>10} "
             f"{int(rb.get('executed_entity_iterations') or 0):>10}"
         )
+    sha, shb = a.get("re_shard") or {}, b.get("re_shard") or {}
+    if sha.get("shards") or shb.get("shards"):
+        # the per-shard load-balance line, next to the wasted-lane
+        # column: the placement-sweep readout for PHOTON_RE_SHARD
+        def bal(v):
+            return "-" if v is None else f"{v:.3f}x"
+
+        def pct2(v):
+            return "-" if v is None else f"{v:.1%}"
+
+        lines.append(
+            f"  {'shard-balance':<16} {bal(sha.get('balance')):>10} "
+            f"{bal(shb.get('balance')):>10}"
+        )
+        lines.append(
+            f"  {'shard-rows-max':<16} "
+            f"{sha.get('rows_max', 0):>10.0f} "
+            f"{shb.get('rows_max', 0):>10.0f}"
+        )
+        lines.append(
+            f"  {'exch-overlap':<16} "
+            f"{pct2(sha.get('exchange_overlap_ratio')):>10} "
+            f"{pct2(shb.get('exchange_overlap_ratio')):>10}"
+        )
     da, db = a.get("devcost") or {}, b.get("devcost") or {}
     if da or db:
         # the knob-keyed byte-delta readout: the dtype-ladder /
@@ -579,6 +630,16 @@ DEFAULT_GATE_THRESHOLDS: dict[str, dict] = {
     "devcost/": {"rel": 0.02},
     "packed_stream_bytes": {"rel": 0.01},
     "hbm/": {"rel": 0.10},
+    # placement tiers: every planner readout (balance ratios, rows_max)
+    # is deterministic for a given planner + row distribution, so the
+    # whole re_shard/ family gates TIGHT — a regression is a planner
+    # change. The overlap ratio (longest-substring match wins over the
+    # prefix tier) is bounded in [0, 1] and higher-is-better, so it
+    # gates on PRESENCE only: abs 1.0 headroom can never fail on a
+    # value, but a missing gauge still FAILs — losing the instrument
+    # must trip the gate.
+    "re_shard/": {"rel": 0.05},
+    "re_shard/exchange_overlap_ratio": {"abs": 1.0},
     # quality tiers: deltas vs the f32 anchor, absolute headroom at the
     # parity-gate scale (|ΔAUC| ≤ 0.005 is the ladder's own bf16 gate)
     "quality/": {"rel": 0.0, "abs": 0.005},
@@ -642,6 +703,9 @@ def gate_metrics_from_summary(s: dict) -> dict[str, float]:
         )
         if agg.get("peak_bytes"):
             m[f"devcost/{lab}/peak_bytes"] = float(agg["peak_bytes"])
+    for k, v in (s.get("re_shard") or {}).items():
+        if k in ("balance", "rows_max", "exchange_overlap_ratio"):
+            m[f"re_shard/{k}"] = float(v)
     m.update(_qp_metrics(s.get("quality_parity") or {}))
     o = s.get("optim") or {}
     if o.get("solves"):
@@ -674,6 +738,13 @@ def gate_metrics_from_bench(doc: dict) -> dict[str, float]:
                 m[f"{cfg}/devcost/{g[len('devcost.'):]}"] = float(v)
             elif g.startswith("hbm.") and g != "hbm.budget_queried":
                 m[f"{cfg}/hbm/{g[len('hbm.'):]}"] = float(v)
+            elif g.startswith("re_shard.") and g in (
+                "re_shard.balance",
+                "re_shard.rows_max",
+                "re_shard.round_robin_balance",
+                "re_shard.exchange_overlap_ratio",
+            ):
+                m[f"{cfg}/re_shard/{g[len('re_shard.'):]}"] = float(v)
         timers = tmetrics.get("timers") or {}
         if "jax.compile_s" in timers:
             m[f"{cfg}/compile_s"] = float(

@@ -253,6 +253,12 @@ def _knob_snapshot() -> dict:
         knobs["re_fuse_buckets"] = int(bool(re_mod.fuse_buckets()))
     except Exception:
         pass
+    try:
+        from photon_ml_tpu.parallel import placement
+
+        knobs["re_shard"] = int(bool(placement.re_shard_enabled()))
+    except Exception:
+        pass
     return knobs
 
 

@@ -18,3 +18,9 @@ from photon_ml_tpu.parallel.multihost import (  # noqa: F401
     initialize_multihost,
     shard_batch_multihost,
 )
+from photon_ml_tpu.parallel.placement import (  # noqa: F401
+    PlacementPlan,
+    plan_entity_placement,
+    plan_shard_placement,
+    re_shard_enabled,
+)

@@ -137,13 +137,27 @@ def is_output_process() -> bool:
     return jax.process_index() == 0
 
 
+# per-call monotonic barrier suffix: every process calls sync_processes
+# at the same program points in the same order, so the counters agree —
+# and two overlapping barriers carrying the SAME caller tag (possible
+# once the pipelined exchange schedule defers work past a barrier site)
+# can no longer alias each other inside the runtime's key-matched
+# barrier bookkeeping.
+_BARRIER_SEQ = [0]
+
+
 def sync_processes(tag: str = "photon-ml-barrier") -> None:
     """Barrier across all processes (e.g. before reading files another
-    process wrote). No-op on a single process."""
+    process wrote). No-op on a single process. The wire tag is
+    ``{tag}#{n}`` with ``n`` a per-process monotonic call counter
+    (identical across processes by the matched-call-order requirement
+    every collective already has), so repeated barriers under one caller
+    tag are distinct barrier keys."""
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(tag)
+        _BARRIER_SEQ[0] += 1
+        multihost_utils.sync_global_devices(f"{tag}#{_BARRIER_SEQ[0]}")
 
 
 def broadcast_from_host0(pytree):
@@ -244,10 +258,17 @@ _A2A_JIT = None
 def _all_to_all_jit():
     """One cached jitted all_to_all program (jit handles shape/dtype
     polymorphism through its own cache; rebuilding the shard_map per call
-    would recompile every exchange)."""
+    would recompile every exchange). Audited for per-call re-trace:
+    the mesh object, the shard_map closure and the jit wrapper are all
+    process-lifetime singletons, so repeated exchanges with identical
+    (shape, dtype) reuse ONE executable — asserted by the cache-growth
+    test in tests/test_multihost.py (``_a2a_cache_size``)."""
     global _A2A_JIT
     if _A2A_JIT is None:
-        from jax.experimental.shard_map import shard_map
+        try:  # jax.experimental.shard_map moved in newer jax releases
+            from photon_ml_tpu.utils.compat import shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
         from jax.sharding import PartitionSpec as P
 
         _A2A_JIT = jax.jit(
@@ -261,6 +282,20 @@ def _all_to_all_jit():
             )
         )
     return _A2A_JIT
+
+
+def _a2a_cache_size() -> int:
+    """Number of compiled variants behind the cached all_to_all jit —
+    the executable-reuse tripwire: coordinate descent re-enters the
+    exchange with identical shapes every visit, so this must stay FLAT
+    across repeated same-shape calls (growth = a re-trace regression
+    that would recompile the exchange every visit)."""
+    if _A2A_JIT is None:
+        return 0
+    try:
+        return int(_A2A_JIT._cache_size())
+    except AttributeError:  # very old jax: no public cache introspection
+        return 0
 
 
 def exchange_rows(arrays, dest: np.ndarray):
@@ -318,7 +353,12 @@ def exchange_rows(arrays, dest: np.ndarray):
     # counts.sum() real rows; beyond 2× padding, go point-to-point.
     total_payload = max(int(counts_matrix.sum()), 1)
     if P_ * P_ * maxc > 2 * total_payload:
+        # one global socket-use order: never interleave with an in-flight
+        # worker-thread exchange mid-frame (no-op when none are pending)
+        drain_async_exchanges()
         return _host_p2p_exchange(arrays, order, starts, counts_matrix)
+
+    from photon_ml_tpu.obs import devcost
 
     mesh = _process_mesh()
     pid = jax.process_index()
@@ -334,6 +374,12 @@ def exchange_rows(arrays, dest: np.ndarray):
         bytes_sent += local.nbytes
         g = mhu.host_local_array_to_global_array(local, mesh, P("proc"))
         swapped = _all_to_all_jit()(g)
+        # analytic cost of the exchange-adjacent executable, captured
+        # AFTER the collective ran: the capture's AOT compile happens on
+        # the sink-holding process only, and doing it before the call
+        # would park every peer mid-collective behind that compile. One
+        # capture per fresh (shape, dtype) — the devcost layer dedups.
+        devcost.capture("multihost.all_to_all", _all_to_all_jit(), (g,))
         recv = np.asarray(
             mhu.global_array_to_host_local_array(swapped, mesh, P("proc"))
         )  # (P, maxc, *feat): slice s = rows from source s
@@ -580,7 +626,8 @@ def _host_links() -> dict:
     return _HOST_LINKS
 
 
-def _host_p2p_exchange(arrays, order, starts, counts_matrix):
+def _host_p2p_exchange(arrays, order, starts, counts_matrix=None,
+                       transport="p2p_host"):
     """Skew-robust transport for ``exchange_rows``: each (source, dest)
     bucket travels EXACTLY, length-prefixed, over its pair's dedicated TCP
     link — no padding under any skew (an SPMD collective must pad every
@@ -598,7 +645,9 @@ def _host_p2p_exchange(arrays, order, starts, counts_matrix):
     the mesh instead of corrupting data.
     """
     try:
-        return _host_p2p_exchange_impl(arrays, order, starts, counts_matrix)
+        return _host_p2p_exchange_impl(
+            arrays, order, starts, counts_matrix, transport
+        )
     except BaseException:
         # closing the sockets also unblocks a sender thread stuck in
         # sendall against a stalled peer — it errors out and exits
@@ -606,7 +655,16 @@ def _host_p2p_exchange(arrays, order, starts, counts_matrix):
         raise
 
 
-def _host_p2p_exchange_impl(arrays, order, starts, counts_matrix):
+def _host_p2p_exchange_impl(arrays, order, starts, counts_matrix,
+                            transport="p2p_host"):
+    """``counts_matrix=None`` is the COLLECTIVE-FREE framing mode (the
+    overlapped exchange schedule): each bucket's row count is derived
+    from its length prefix instead of a pre-exchanged (P, P) count
+    matrix, so the whole exchange is pure sockets — safe to run on the
+    exchange worker thread concurrently with main-thread jax
+    collectives, whose global ordering a worker-side allgather would
+    violate. Frame sizes are validated per key (row-multiple + all keys
+    from one source agreeing on the row count)."""
     import struct
     import threading
 
@@ -643,16 +701,37 @@ def _host_p2p_exchange_impl(arrays, order, starts, counts_matrix):
     for r in range(1, P_):
         src = (pid - r) % P_
         sock = links["recv"][src]
+        n_src: int | None = None  # framed mode: all keys must agree
         for k in keys:
             a = arrays[k]
-            n = int(counts_matrix[src, pid])
-            want = n * a.itemsize * int(np.prod(a.shape[1:], dtype=np.int64))
+            row_bytes = a.itemsize * int(
+                np.prod(a.shape[1:], dtype=np.int64)
+            )
             got = struct.unpack("!q", _recv_exact(sock, 8))[0]
-            if got != want:
-                raise RuntimeError(
-                    f"exchange size mismatch from process {src} key {k!r}: "
-                    f"expected {want} bytes ({n} rows), got {got}"
-                )
+            if counts_matrix is not None:
+                n = int(counts_matrix[src, pid])
+                want = n * row_bytes
+                if got != want:
+                    raise RuntimeError(
+                        f"exchange size mismatch from process {src} key "
+                        f"{k!r}: expected {want} bytes ({n} rows), got {got}"
+                    )
+            else:
+                if row_bytes <= 0 or got % row_bytes:
+                    raise RuntimeError(
+                        f"exchange frame from process {src} key {k!r}: "
+                        f"{got} bytes is not a multiple of the "
+                        f"{row_bytes}-byte row"
+                    )
+                n = got // row_bytes
+                if n_src is None:
+                    n_src = n
+                elif n != n_src:
+                    raise RuntimeError(
+                        f"exchange frames from process {src} disagree on "
+                        f"row count: key {k!r} carries {n} rows, earlier "
+                        f"keys carried {n_src}"
+                    )
             raw = _recv_exact(sock, got)
             parts[k][src] = np.frombuffer(raw, a.dtype).reshape(
                 (n,) + a.shape[1:]
@@ -660,18 +739,170 @@ def _host_p2p_exchange_impl(arrays, order, starts, counts_matrix):
     sender.join()
     if send_err:
         raise send_err[0]
-    counts_local = counts_matrix[pid]
+    # this process's send counts: identical to counts_matrix[pid] when a
+    # matrix was exchanged, and derivable locally when not (framed mode)
+    counts_send = np.diff(starts)
     LAST_EXCHANGE_STATS.update(
         bytes_sent=bytes_sent,
-        rows_sent=int(counts_local.sum()),
+        rows_sent=int(counts_send.sum()),
         # same accounting as the all_to_all branch (allocated row-slots,
         # summed over keys) — here exactly the payload: zero padded slots
-        padded_rows=int(counts_local.sum()) * len(arrays),
-        transport="p2p_host",
+        padded_rows=int(counts_send.sum()) * len(arrays),
+        transport=transport,
     )
     return {
         k: np.concatenate([parts[k][s] for s in range(P_)]) for k in keys
     }
+
+
+# -- overlapped (asynchronous) point-to-point exchange ----------------------
+#
+# The pipelined exchange schedule (PHOTON_RE_SHARD=1): an exchange is
+# ISSUED at one program point and JOINED at a later one, with device
+# solves / host bookkeeping / jax collectives in between — instead of a
+# barrier per coordinate. The exchange body runs on ONE dedicated worker
+# thread per process in strict submission order (every process submits
+# the same exchange sequence at the same program points, so the socket
+# streams stay frame-matched), and it is COLLECTIVE-FREE (framed p2p:
+# row counts ride the length prefixes) so a worker-side exchange can
+# never interleave a collective against the main thread's.
+
+_EXCHANGE_POOL = None
+_EXCHANGE_LOCK = None  # guards the pending list + overlap accounting
+_PENDING_EXCHANGES: list = []
+_EXCHANGE_TOTALS = {"exchange_s": 0.0, "wait_s": 0.0}
+
+
+def _exchange_state():
+    global _EXCHANGE_POOL, _EXCHANGE_LOCK
+    if _EXCHANGE_LOCK is None:
+        import threading
+
+        _EXCHANGE_LOCK = threading.Lock()
+    if _EXCHANGE_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _EXCHANGE_POOL = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="photon-exchange"
+        )
+    return _EXCHANGE_POOL, _EXCHANGE_LOCK
+
+
+def _record_overlap(kind: str, seconds: float) -> None:
+    """Cumulative exchange/wait seconds + the derived overlap-ratio
+    gauge: the fraction of exchange wall the consumer did NOT block on
+    (1.0 = fully hidden behind other work, 0.0 = a barrier schedule).
+    Mirrored into the PR-4 registry so the ratio rides every telemetry
+    snapshot and ``photon-ml-tpu report``."""
+    from photon_ml_tpu.obs.metrics import REGISTRY
+
+    _, lock = _exchange_state()
+    with lock:
+        _EXCHANGE_TOTALS[kind] += seconds
+        wall = _EXCHANGE_TOTALS["exchange_s"]
+        wait = _EXCHANGE_TOTALS["wait_s"]
+    REGISTRY.timer_add(f"re_exchange.{kind}", seconds)
+    # zero wall (the single-process identity path) reads as fully
+    # overlapped: there was nothing to wait for — and the gauge must
+    # exist on every topology the schedule runs on
+    ratio = 1.0 if wall <= 0.0 else max(0.0, min(1.0, 1.0 - wait / wall))
+    REGISTRY.gauge_set("re_shard.exchange_overlap_ratio", ratio)
+
+
+class ExchangeHandle:
+    """A pending ``exchange_rows_async``. ``result()`` blocks until the
+    exchange lands and returns the received-rows dict (the same layout
+    contract as ``exchange_rows``); the blocked seconds are recorded as
+    ``re_exchange.wait_s`` against the worker's ``re_exchange.exchange_s``
+    for the overlap-ratio gauge."""
+
+    def __init__(self, future=None, value=None):
+        self._future = future
+        self._value = value
+
+    @property
+    def done(self) -> bool:
+        return self._future is None or self._future.done()
+
+    def result(self) -> dict:
+        if self._future is None:
+            return self._value
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            out = self._future.result()
+        finally:
+            _record_overlap("wait_s", _time.perf_counter() - t0)
+            _, lock = _exchange_state()
+            with lock:
+                if self._future in _PENDING_EXCHANGES:
+                    _PENDING_EXCHANGES.remove(self._future)
+        self._future = None
+        self._value = out
+        return out
+
+
+def drain_async_exchanges() -> None:
+    """Wait for every in-flight async exchange (results stay claimable
+    through their handles). A SYNCHRONOUS p2p exchange must not touch
+    the sockets while the worker is mid-frame, and submission order is
+    the cross-process consistency invariant — so the sync path drains
+    first, preserving one global socket-use order."""
+    _, lock = _exchange_state()
+    with lock:
+        pending = list(_PENDING_EXCHANGES)
+    for f in pending:
+        try:
+            f.exception()  # waits; the owner handle re-raises on result()
+        except Exception:
+            pass
+
+
+def exchange_rows_async(arrays, dest: np.ndarray) -> ExchangeHandle:
+    """Issue ``exchange_rows`` without blocking: returns a handle whose
+    ``result()`` yields the identical received-rows layout. Transport is
+    ALWAYS the framed host P2P path (collective-free — the worker thread
+    must never run a jax collective; padding-free — the schedule exists
+    for the skewed configs where all_to_all padding is pathological).
+    The socket mesh is built (collectively) on the CALLING thread at
+    first use, so the collective stays in program order. Single process:
+    completes inline (identity)."""
+    arrays = {k: np.asarray(v) for k, v in arrays.items()}
+    P_ = jax.process_count()
+    if P_ <= 1:
+        LAST_EXCHANGE_STATS.update(
+            bytes_sent=0, rows_sent=len(dest), padded_rows=len(dest),
+            transport="local",
+        )
+        # inline identity still contributes (zero-wait) overlap samples,
+        # so the gauge exists on every topology the schedule runs on
+        _record_overlap("exchange_s", 0.0)
+        _record_overlap("wait_s", 0.0)
+        return ExchangeHandle(value=arrays)
+    dest = np.asarray(dest, np.int64)
+    order = np.argsort(dest, kind="stable")
+    counts = np.bincount(dest, minlength=P_).astype(np.int64)
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    _host_links()  # collective bootstrap happens HERE, in program order
+    pool, lock = _exchange_state()
+
+    def run():
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            return _host_p2p_exchange(
+                arrays, order, starts, counts_matrix=None,
+                transport="p2p_host_async",
+            )
+        finally:
+            _record_overlap("exchange_s", _time.perf_counter() - t0)
+
+    fut = pool.submit(run)
+    with lock:
+        _PENDING_EXCHANGES.append(fut)
+    return ExchangeHandle(future=fut)
 
 
 def allreduce_max_host(*arrays: np.ndarray):

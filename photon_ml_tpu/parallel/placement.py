@@ -1,0 +1,195 @@
+"""Skew-aware shard placement for entity-sharded random-effect solves.
+
+The random-effect phase is embarrassingly parallel over entities, so the
+scale-out question is pure PLACEMENT: which process/chip owns which
+entities (streamed path) or which whole buckets (in-memory path). The
+naive rule — ``entity_id % P`` — balances entity COUNT, but under Zipf
+traffic the head entities carry orders of magnitude more rows than the
+tail, so one shard ends up solving (and receiving, every visit, through
+the offset/score exchanges) a large multiple of the mean row load while
+the others idle.
+
+``plan_shard_placement`` balances by Σ per-entity rows instead: LPT
+(longest-processing-time) greedy — heaviest placement unit first, each
+onto the currently-lightest shard. Units may be GROUPS of items that
+must land on one shard together: the same bookkeeping PR-5's
+``plan_fusion_groups`` uses to fuse same-geometry bucket launches also
+drives group-atomic placement, so the launch fusion keeps working per
+shard (a fusion group split across shards could no longer concatenate
+into one launch anywhere).
+
+Everything here is deterministic pure-host arithmetic on inputs that are
+identical on every process (globally-reduced row counts), so every
+process computes the SAME plan with zero extra communication.
+
+Knob: ``PHOTON_RE_SHARD`` (env > module global, strict int parse, read
+at call time — the bench RETUNE idiom). 0 (default) keeps today's
+modular owner rule and exchange schedule bit-for-bit; 1 enables
+skew-aware placement and the overlapped exchange schedule in the
+consumers that opt in (``game/streaming.py``, ``game/random_effect.py``).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+# Entity-sharded random-effect solves (placement + overlapped exchange).
+# 0 = the pre-sharding schedule bit-for-bit (modular owners, blocking
+# exchanges); 1 = skew-aware placement + overlapped P2P exchange.
+RE_SHARD = 0
+
+
+def re_shard_enabled() -> bool:
+    """``PHOTON_RE_SHARD`` (env > module global), strict parse like the
+    sibling RE knobs — a typo fails loudly instead of silently benching
+    the default schedule."""
+    env = os.environ.get("PHOTON_RE_SHARD")
+    if env is not None and env != "":
+        return int(env) != 0
+    return int(RE_SHARD) != 0
+
+
+@dataclass(frozen=True)
+class PlacementPlan:
+    """One placement decision: ``owner[i]`` is the shard of item ``i``
+    (an entity, or a bucket, depending on the caller's granularity);
+    ``loads[s]`` is shard ``s``'s Σ rows under the plan."""
+
+    owner: np.ndarray  # (n_items,) int64
+    loads: np.ndarray  # (num_shards,) float64
+    num_shards: int
+
+    @property
+    def balance(self) -> float:
+        """max shard load / mean shard load (1.0 = perfectly even).
+        The skew metric the 1.15× acceptance bound is written against;
+        0-load plans (no rows anywhere) read as perfectly balanced."""
+        mean = float(self.loads.mean()) if len(self.loads) else 0.0
+        if mean <= 0.0:
+            return 1.0
+        return float(self.loads.max()) / mean
+
+    def owned_items(self, shard: int) -> np.ndarray:
+        """Ascending item indices owned by ``shard``."""
+        return np.flatnonzero(self.owner == shard)
+
+
+def plan_shard_placement(
+    row_counts: Sequence[float] | np.ndarray,
+    num_shards: int,
+    groups: Sequence[Sequence[int]] | None = None,
+    skew_aware: bool = True,
+) -> PlacementPlan:
+    """Assign items to ``num_shards`` shards, balancing Σ ``row_counts``.
+
+    ``groups`` lists index sets that must be CO-LOCATED (placement is
+    group-atomic — e.g. PR-5 fusion groups, so same-geometry launch
+    fusion keeps working inside each shard). Unlisted items place as
+    singleton groups. ``skew_aware=True`` is LPT greedy: groups by total
+    rows descending (ties: first item index ascending — deterministic),
+    each onto the lightest shard so far (ties: lowest shard id).
+    ``skew_aware=False`` is the naive baseline: round-robin by group
+    order — the comparison arm the bench records.
+
+    Deterministic: identical inputs produce the identical plan on every
+    process (no RNG, no dict-order dependence).
+    """
+    counts = np.asarray(row_counts, np.float64)
+    if counts.ndim != 1:
+        raise ValueError(f"row_counts must be 1-D, got shape {counts.shape}")
+    P = int(num_shards)
+    if P < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    n = len(counts)
+    if groups is None:
+        group_list = [[i] for i in range(n)]
+    else:
+        group_list = [list(g) for g in groups]
+        seen = np.zeros(n, bool)
+        for g in group_list:
+            for i in g:
+                if not (0 <= i < n):
+                    raise ValueError(f"group member {i} out of range [0, {n})")
+                if seen[i]:
+                    raise ValueError(f"item {i} appears in two groups")
+                seen[i] = True
+        # items not named by any group place as singletons, after the
+        # explicit groups (stable: ascending index)
+        group_list += [[i] for i in np.flatnonzero(~seen)]
+    owner = np.zeros(n, np.int64)
+    loads = np.zeros(P, np.float64)
+    if P == 1 or n == 0:
+        return PlacementPlan(owner=owner, loads=_add_loads(loads, counts, owner), num_shards=P)
+    totals = [float(counts[g].sum()) for g in group_list]
+    if skew_aware:
+        # LPT: heaviest group first onto the lightest shard, via a heap
+        # keyed (load, shard id) — O(G log P) where a per-group argmin
+        # would be O(G·P) Python work (G = entity count on the streamed
+        # path). Ties break exactly like np.argmin did: equal loads go
+        # to the lowest shard id; the sort key ties break toward the
+        # earliest group (its first member's index).
+        import heapq
+
+        order = sorted(
+            range(len(group_list)),
+            key=lambda gi: (-totals[gi], group_list[gi][0] if group_list[gi] else -1),
+        )
+        heap = [(0.0, s) for s in range(P)]
+        for gi in order:
+            load, s = heapq.heappop(heap)
+            load += totals[gi]
+            loads[s] = load
+            heapq.heappush(heap, (load, s))
+            for i in group_list[gi]:
+                owner[i] = s
+    else:
+        for gi, g in enumerate(group_list):
+            s = gi % P
+            loads[s] += totals[gi]
+            for i in g:
+                owner[i] = s
+    return PlacementPlan(owner=owner, loads=loads, num_shards=P)
+
+
+def _add_loads(loads: np.ndarray, counts: np.ndarray, owner: np.ndarray) -> np.ndarray:
+    np.add.at(loads, owner, counts)
+    return loads
+
+
+def plan_entity_placement(
+    entity_row_counts: np.ndarray, num_shards: int, skew_aware: bool = True
+) -> PlacementPlan:
+    """Entity-granularity placement (the streamed trainer's unit): each
+    entity is one atom — all of an entity's rows live at its owner, the
+    invariant every per-visit exchange and the per-entity solves rely
+    on."""
+    return plan_shard_placement(
+        entity_row_counts, num_shards, groups=None, skew_aware=skew_aware
+    )
+
+
+def record_placement_metrics(
+    plan: PlacementPlan, shard: int | None = None, prefix: str = "re_shard"
+) -> None:
+    """Publish the plan's load gauges through the PR-4 registry:
+    ``re_shard.rows`` (THIS shard's Σ rows when ``shard`` is given, else
+    the max — the number that bounds the critical path either way),
+    ``re_shard.rows_max`` / ``rows_mean``, ``re_shard.balance``
+    (max/mean) and ``re_shard.shards``. Pure gauges — safe to call from
+    every process (each publishes its own view; only process 0's sink
+    writes)."""
+    from photon_ml_tpu.obs.metrics import REGISTRY
+
+    loads = plan.loads
+    rows_max = float(loads.max()) if len(loads) else 0.0
+    rows_mean = float(loads.mean()) if len(loads) else 0.0
+    own = rows_max if shard is None else float(loads[int(shard)])
+    REGISTRY.gauge_set(f"{prefix}.rows", own)
+    REGISTRY.gauge_set(f"{prefix}.rows_max", rows_max)
+    REGISTRY.gauge_set(f"{prefix}.rows_mean", rows_mean)
+    REGISTRY.gauge_set(f"{prefix}.balance", plan.balance)
+    REGISTRY.gauge_set(f"{prefix}.shards", float(plan.num_shards))

@@ -4,6 +4,13 @@
 # baseline. Exits nonzero on any byte/flop/quality regression (see
 # DEFAULT_GATE_THRESHOLDS in photon_ml_tpu/obs/report.py for the tiers).
 #
+# Coverage includes the entity-shard placement instruments
+# (re_shard.balance / round_robin_balance / rows_max, gated tight — the
+# planner is deterministic — and re_shard.exchange_overlap_ratio, gated
+# on PRESENCE: losing the overlap instrument fails the gate even though
+# its value can only improve). Multi-process wall/overlap captures live
+# in MULTICHIP_r06.json (`python bench.py --multichip-r06`).
+#
 # Usage:
 #   scripts/gate_quick.sh                      # gate vs BASELINE_cost_cpu.json
 #   scripts/gate_quick.sh MY_BASELINE.json     # gate vs another baseline

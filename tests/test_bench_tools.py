@@ -470,15 +470,17 @@ class TestNarrativeNumberDiscipline:
 
         here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         # Union of the session artifact (BENCH_DETAIL.json, gitignored — may
-        # not exist on a fresh checkout) and every COMMITTED driver capture
-        # (BENCH_r*.json): a prose claim backed by either survives.
+        # not exist on a fresh checkout) and every COMMITTED capture:
+        # BENCH_r*.json, the MULTICHIP_r* harness captures, and the gate
+        # baseline. A prose claim backed by any of them survives.
         pieces = []
-        for path in sorted(
-            glob.glob(os.path.join(here, "BENCH_*.json"))
+        for pattern in (
+            "BENCH_*.json", "MULTICHIP_*.json", "BASELINE_cost_cpu.json"
         ):
-            with open(path) as f:
-                pieces.append(f.read())
-        assert pieces, "no BENCH_*.json artifact found to audit against"
+            for path in sorted(glob.glob(os.path.join(here, pattern))):
+                with open(path) as f:
+                    pieces.append(f.read())
+        assert pieces, "no committed JSON artifact found to audit against"
         artifact = "\n".join(pieces)
         offenders = []
         for name in ("README.md", "BASELINE.md"):

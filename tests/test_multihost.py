@@ -27,6 +27,11 @@ _WORKER = textwrap.dedent(
     os.environ["PALLAS_AXON_POOL_IPS"] = ""
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # cross-process CPU collectives: newer jaxlib CPU clients implement
+    # multiprocess computations only through an explicit collectives
+    # backend (gloo over TCP) — without this every worker dies with
+    # "Multiprocess computations aren't implemented on the CPU backend"
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     from jax._src import xla_bridge as _xb
     _xb._backend_factories.pop("axon", None)
 
@@ -164,6 +169,11 @@ _GLM_WORKER = textwrap.dedent(
     os.environ["PALLAS_AXON_POOL_IPS"] = ""
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # cross-process CPU collectives: newer jaxlib CPU clients implement
+    # multiprocess computations only through an explicit collectives
+    # backend (gloo over TCP) — without this every worker dies with
+    # "Multiprocess computations aren't implemented on the CPU backend"
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     from jax._src import xla_bridge as _xb
     _xb._backend_factories.pop("axon", None)
 
@@ -293,6 +303,11 @@ _SCORE_WORKER = textwrap.dedent(
     os.environ["PALLAS_AXON_POOL_IPS"] = ""
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # cross-process CPU collectives: newer jaxlib CPU clients implement
+    # multiprocess computations only through an explicit collectives
+    # backend (gloo over TCP) — without this every worker dies with
+    # "Multiprocess computations aren't implemented on the CPU backend"
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     from jax._src import xla_bridge as _xb
     _xb._backend_factories.pop("axon", None)
 
@@ -442,6 +457,11 @@ _GAME_WORKER = textwrap.dedent(
     os.environ["PALLAS_AXON_POOL_IPS"] = ""
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # cross-process CPU collectives: newer jaxlib CPU clients implement
+    # multiprocess computations only through an explicit collectives
+    # backend (gloo over TCP) — without this every worker dies with
+    # "Multiprocess computations aren't implemented on the CPU backend"
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     from jax._src import xla_bridge as _xb
     _xb._backend_factories.pop("axon", None)
 
@@ -655,6 +675,11 @@ _TRAFFIC_WORKER = textwrap.dedent(
     os.environ["PALLAS_AXON_POOL_IPS"] = ""
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # cross-process CPU collectives: newer jaxlib CPU clients implement
+    # multiprocess computations only through an explicit collectives
+    # backend (gloo over TCP) — without this every worker dies with
+    # "Multiprocess computations aren't implemented on the CPU backend"
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     from jax._src import xla_bridge as _xb
     _xb._backend_factories.pop("axon", None)
 
@@ -758,6 +783,11 @@ _SHARDED_CKPT_WORKER = textwrap.dedent(
     os.environ["PALLAS_AXON_POOL_IPS"] = ""
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # cross-process CPU collectives: newer jaxlib CPU clients implement
+    # multiprocess computations only through an explicit collectives
+    # backend (gloo over TCP) — without this every worker dies with
+    # "Multiprocess computations aren't implemented on the CPU backend"
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     from jax._src import xla_bridge as _xb
     _xb._backend_factories.pop("axon", None)
 
@@ -871,6 +901,11 @@ _SKEW_WORKER = textwrap.dedent(
     os.environ["PALLAS_AXON_POOL_IPS"] = ""
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # cross-process CPU collectives: newer jaxlib CPU clients implement
+    # multiprocess computations only through an explicit collectives
+    # backend (gloo over TCP) — without this every worker dies with
+    # "Multiprocess computations aren't implemented on the CPU backend"
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     from jax._src import xla_bridge as _xb
     _xb._backend_factories.pop("axon", None)
 
@@ -1332,3 +1367,431 @@ class TestExchangeHardening:
         # env var wins when set
         monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1")
         assert mh._coordinator_address() == "10.0.0.1:1"
+
+
+# -- entity-sharded random-effect solves (PHOTON_RE_SHARD) -------------------
+
+_RE_SHARD_WORKER = textwrap.dedent(
+    """
+    import hashlib, json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    coordinator, pid, nproc, knob = sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    os.environ["PHOTON_RE_SHARD"] = knob
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    if nproc > 1:
+        # the gloo CPU collectives client needs the distributed runtime;
+        # a single-process reference run must keep the plain CPU client
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+    import numpy as np
+
+    if nproc > 1:
+        from photon_ml_tpu.parallel.multihost import initialize_multihost
+        initialize_multihost(coordinator, num_processes=nproc, process_id=pid)
+
+    import jax.numpy as jnp
+    from photon_ml_tpu.config import (
+        GameTrainingConfig, OptimizationConfig, OptimizerConfig,
+        RandomEffectCoordinateConfig, RegularizationContext,
+    )
+    from photon_ml_tpu.game.models import GameModel, RandomEffectModel
+    from photon_ml_tpu.game.streaming import StreamedGameData, StreamedGameTrainer
+    from photon_ml_tpu.types import (
+        RegularizationType, TaskType, VarianceComputationType,
+    )
+
+    # Zipf-skewed entity traffic (R_re_skew-style): head entities carry
+    # most rows, so naive modular/round-robin owners lose a shard to them
+    rng = np.random.default_rng(42)
+    E = 24
+    sizes = np.maximum((80.0 / (1 + np.arange(E)) ** 1.1).astype(int), 3)
+    ids = np.repeat(np.arange(E), sizes).astype(np.int64)
+    ids = ids[rng.permutation(len(ids))]
+    n = len(ids)
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    W_true = (rng.normal(size=(E, 3)) * 0.5).astype(np.float32)
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(
+        -np.sum(W_true[ids] * X, axis=1)))).astype(np.float32)
+    # warm start + incremental MAP prior: the acceptance criterion covers
+    # variances AND priors through the sharded path
+    W0 = (rng.normal(size=(E, 3)) * 0.1).astype(np.float32)
+    V0 = (0.5 + rng.uniform(size=(E, 3))).astype(np.float32)
+
+    opt = OptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=8, tolerance=1e-9),
+        regularization=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+    cfg = GameTrainingConfig(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinate_update_sequence=("per_entity",),
+        coordinate_descent_iterations=2,
+        fixed_effect_coordinates={},
+        random_effect_coordinates={
+            "per_entity": RandomEffectCoordinateConfig(
+                random_effect_type="eid", feature_shard_id="r",
+                optimization=opt,
+            )
+        },
+        variance_computation=VarianceComputationType.SIMPLE,
+        incremental=True,
+    )
+    warm = GameModel(
+        models={
+            "per_entity": RandomEffectModel(
+                coefficients=jnp.asarray(W0), variances=jnp.asarray(V0),
+                random_effect_type="eid", feature_shard_id="r",
+                task_type=cfg.task_type,
+            )
+        },
+        task_type=cfg.task_type,
+    )
+    # validation rows: a deterministic tail draw over the SAME entity
+    # dictionary, plus unseen-entity sentinels — exercises the
+    # validation re-shard's reuse of the TRAINING owner layout (scoring
+    # re_W rows through a re-planned validation layout was the review
+    # bug) and the grouped owner-routed metric path
+    vrng = np.random.default_rng(7)
+    n_val = 60
+    val_ids = vrng.integers(0, E, size=n_val).astype(np.int64)
+    val_ids[::15] = -1  # unseen-entity sentinel rows
+    val_X = vrng.normal(size=(n_val, 3)).astype(np.float32)
+    val_y = (vrng.uniform(size=n_val) < 0.5).astype(np.float32)
+    if nproc > 1:
+        bounds = np.linspace(0, n, nproc + 1).astype(int)
+        lo, hi = bounds[pid], bounds[pid + 1]
+        vbounds = np.linspace(0, n_val, nproc + 1).astype(int)
+        vlo, vhi = vbounds[pid], vbounds[pid + 1]
+    else:
+        lo, hi = 0, n
+        vlo, vhi = 0, n_val
+    data = StreamedGameData(
+        labels=y[lo:hi], features={"r": X[lo:hi]},
+        id_tags={"eid": ids[lo:hi]},
+    )
+    validation = StreamedGameData(
+        labels=val_y[vlo:vhi], features={"r": val_X[vlo:vhi]},
+        id_tags={"eid": val_ids[vlo:vhi]},
+    )
+    trainer = StreamedGameTrainer(
+        cfg, chunk_rows=1 << 16, multihost=nproc > 1,
+        evaluators=("AUC", "MULTI_AUC(eid)"),
+    )
+    model, info = trainer.fit(data, validation=validation, initial_model=warm)
+    val_metrics = [
+        {k: v.metrics for k, v in h.items()}
+        for h in trainer.validation_history
+    ]
+    W = np.asarray(model.models["per_entity"].coefficients, np.float64)
+    V = np.asarray(model.models["per_entity"].variances, np.float64)
+
+    # in-memory owned-bucket leg: train_random_effects under a mesh with
+    # the SAME knob — whole buckets solve on one owner each, results
+    # combine across processes; must equal the unsharded solve bitwise
+    from photon_ml_tpu.config import OptimizerConfig as _OC
+    from photon_ml_tpu.game import bucket_entities, group_by_entity
+    from photon_ml_tpu.game.data import DenseFeatures
+    from photon_ml_tpu.game.random_effect import train_random_effects
+    from photon_ml_tpu.ops.losses import loss_for_task
+    from photon_ml_tpu.parallel import data_mesh
+
+    mem_kwargs = dict(
+        features=DenseFeatures(X=jnp.asarray(X)),
+        labels=y,
+        offsets=np.zeros(n, np.float32),
+        weights=np.ones(n, np.float32),
+        buckets=bucket_entities(group_by_entity(ids, num_entities=E)),
+        num_entities=E,
+        loss=loss_for_task(cfg.task_type),
+        config=_OC(max_iterations=6, tolerance=1e-9),
+        l2_weight=1.0,
+        initial_coefficients=jnp.asarray(W0),
+        variance_computation=VarianceComputationType.SIMPLE,
+        prior_coefficients=jnp.asarray(W0),
+        prior_variances=jnp.asarray(V0),
+    )
+    # knob on: the owned-bucket sharded schedule under the global mesh;
+    # knob off / single process: the plain unsharded solve (the
+    # reference anchor) — the legacy LANE-sharded mesh path is not
+    # exercised here (it has no cross-process bitwise contract)
+    mem = train_random_effects(
+        mesh=data_mesh() if (nproc > 1 and knob == "1") else None,
+        **mem_kwargs
+    )
+    W_mem = np.asarray(jax.device_get(mem.coefficients), np.float64)
+    V_mem = np.asarray(jax.device_get(mem.variances), np.float64)
+    it_mem = np.asarray(mem.iterations, np.int64)
+
+    # satellite: repeated identical-shape exchanges reuse ONE executable
+    from photon_ml_tpu.parallel import multihost as mh
+    a2a_growth = None
+    if nproc > 1:
+        probe = {"v": np.arange(8, dtype=np.float32)}
+        dest = np.arange(8, dtype=np.int64) % nproc  # balanced -> all_to_all
+        mh.exchange_rows(probe, dest)
+        before = mh._a2a_cache_size()
+        mh.exchange_rows(probe, dest)
+        mh.exchange_rows(probe, dest)
+        a2a_growth = mh._a2a_cache_size() - before
+
+    from photon_ml_tpu.obs.metrics import REGISTRY
+    snap = REGISTRY.snapshot()
+    gauges = {
+        k: v for k, v in snap.get("gauges", {}).items()
+        if k.startswith("re_shard.")
+    }
+    launches = snap.get("counters", {}).get(
+        "re_solve.launches", {}
+    ).get("value", 0.0)
+    print("RESULT " + json.dumps({
+        "pid": pid, "knob": knob,
+        "W": W.tolist(), "V": V.tolist(),
+        "W_mem": W_mem.tolist(), "V_mem": V_mem.tolist(),
+        "it_mem": it_mem.tolist(),
+        "val_metrics": val_metrics,
+        "gauges": gauges,
+        "launches": launches,
+        "a2a_growth": a2a_growth,
+        "last_transport": mh.LAST_EXCHANGE_STATS.get("transport"),
+    }))
+    """
+)
+
+
+def _run_re_shard_workers(nproc: int, knob: str) -> dict:
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _RE_SHARD_WORKER, coordinator,
+             str(pid), str(nproc), knob],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for pid in range(nproc)
+    ]
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err[-4000:]}"
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                r = json.loads(line[len("RESULT "):])
+                results[r["pid"]] = r
+    assert set(results) == set(range(nproc))
+    return results
+
+
+@pytest.mark.slow
+def test_entity_sharded_re_solve_bitwise_matches_single_process(tmp_path):
+    """PHOTON_RE_SHARD=1 on 2 AND 4 processes (loopback coordinator):
+    the streamed random-effect solve — including SIMPLE variances, a
+    warm start and an incremental MAP prior — and the in-memory
+    owned-bucket solve are BITWISE identical (assert_array_equal, not
+    allclose) to the single-process solve on a Zipf-skewed entity
+    distribution. The skew-aware placement gauges and the
+    exchange-overlap ratio ride the registry on every process, and
+    repeated identical-shape exchanges reuse one all_to_all executable
+    (zero jit-cache growth)."""
+    ref = _run_re_shard_workers(1, "0")[0]
+    for nproc in (2, 4):
+        got = _run_re_shard_workers(nproc, "1")
+        for pid, r in got.items():
+            tag = f"nproc={nproc} pid={pid}"
+            np.testing.assert_array_equal(
+                np.asarray(r["W"]), np.asarray(ref["W"]), err_msg=tag
+            )
+            np.testing.assert_array_equal(
+                np.asarray(r["V"]), np.asarray(ref["V"]), err_msg=tag
+            )
+            np.testing.assert_array_equal(
+                np.asarray(r["W_mem"]), np.asarray(ref["W_mem"]),
+                err_msg=tag,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(r["V_mem"]), np.asarray(ref["V_mem"]),
+                err_msg=tag,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(r["it_mem"]), np.asarray(ref["it_mem"]),
+                err_msg=tag,
+            )
+            # per-visit validation through the TRAINING owner layout:
+            # grouped per-entity AUC partials are exact sums over
+            # complete owner-side groups (float order drift only);
+            # scalar AUC rides the sharded histogram recipe (<~1e-4
+            # off the single-process exact sort)
+            assert len(r["val_metrics"]) == len(ref["val_metrics"])
+            for got_h, ref_h in zip(r["val_metrics"], ref["val_metrics"]):
+                for coord, m_ref in ref_h.items():
+                    m_got = got_h[coord]
+                    np.testing.assert_allclose(
+                        m_got["MULTI_AUC(eid)"], m_ref["MULTI_AUC(eid)"],
+                        rtol=1e-6, err_msg=tag,
+                    )
+                    np.testing.assert_allclose(
+                        m_got["AUC"], m_ref["AUC"], atol=2e-4,
+                        err_msg=tag,
+                    )
+            # placement + overlap instruments present on every process
+            assert r["gauges"].get("re_shard.shards") == float(nproc), r["gauges"]
+            assert "re_shard.exchange_overlap_ratio" in r["gauges"], tag
+            assert r["gauges"].get("re_shard.balance", 99.0) <= 1.5, r["gauges"]
+            # identical-shape exchange reuse: no executable-cache growth
+            assert r["a2a_growth"] == 0, tag
+
+
+@pytest.mark.slow
+def test_entity_shard_knob_off_keeps_legacy_schedule(tmp_path):
+    """PHOTON_RE_SHARD=0 on 2 processes: the legacy modular owner rule and
+    blocking exchange schedule — no placement gauges, no async transport,
+    and the same per-process launch counter the pre-sharding code
+    produced (one launch per owned bucket per visit)."""
+    got = _run_re_shard_workers(2, "0")
+    for pid, r in got.items():
+        assert not any(
+            k.startswith("re_shard.") for k in r["gauges"]
+        ), r["gauges"]
+        assert r["last_transport"] in ("all_to_all", "p2p_host"), r
+        assert r["launches"] > 0
+
+
+class TestExchangeExecutableReuse:
+    """Satellite: repeated coordinate-descent exchanges with identical
+    shapes must reuse ONE all_to_all executable (audit finding asserted
+    as a cache-growth tripwire, the test_streaming idiom)."""
+
+    def test_a2a_jit_cache_growth_only_on_new_shapes(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils as mhu
+        from jax.sharding import PartitionSpec as P
+
+        import photon_ml_tpu.parallel.multihost as mh
+
+        mesh = mh._process_mesh()  # 1-process mesh in tier-1
+
+        def call(shape):
+            local = np.zeros(shape, np.float32)
+            g = mhu.host_local_array_to_global_array(local, mesh, P("proc"))
+            return np.asarray(
+                mhu.global_array_to_host_local_array(
+                    mh._all_to_all_jit()(g), mesh, P("proc")
+                )
+            )
+
+        call((1, 4))
+        size_after_first = mh._a2a_cache_size()
+        assert size_after_first >= 1
+        call((1, 4))
+        call((1, 4))
+        assert mh._a2a_cache_size() == size_after_first  # reuse, no growth
+        call((1, 8))  # a genuinely new shape compiles exactly one more
+        assert mh._a2a_cache_size() == size_after_first + 1
+
+    def test_framed_p2p_row_count_validation(self):
+        """The collective-free framing mode rejects frames that are not a
+        whole number of rows (a mis-framed stream must fail loudly, not
+        reshape garbage)."""
+        import struct
+
+        import photon_ml_tpu.parallel.multihost as mh
+
+        class FrameSock:
+            def __init__(self, frames):
+                self.buf = b"".join(
+                    struct.pack("!q", len(f)) + f for f in frames
+                )
+
+            def recv(self, n):
+                out, self.buf = self.buf[:n], self.buf[n:]
+                return out
+
+            def sendall(self, *_):
+                pass
+
+            def close(self):
+                pass
+
+        import jax
+
+        import pytest as _pytest
+
+        links = {
+            "send": {1: FrameSock([])},
+            # 6 bytes is not a multiple of the 4-byte f32 row
+            "recv": {1: FrameSock([b"\x00" * 6])},
+        }
+        orig_links, mh._HOST_LINKS = mh._HOST_LINKS, links
+        orig_count = jax.process_count
+        orig_index = jax.process_index
+        jax.process_count = lambda: 2
+        jax.process_index = lambda: 0
+        try:
+            arrays = {"v": np.arange(4, dtype=np.float32)}
+            order = np.arange(4, dtype=np.int64)
+            starts = np.asarray([0, 2, 4], np.int64)
+            with _pytest.raises(RuntimeError, match="not a multiple"):
+                mh._host_p2p_exchange(arrays, order, starts, None)
+            assert mh._HOST_LINKS is None  # error tore the mesh down
+        finally:
+            jax.process_count = orig_count
+            jax.process_index = orig_index
+            mh._HOST_LINKS = orig_links
+
+
+class TestBarrierTagSuffix:
+    """Satellite: every ``sync_processes`` call gets a monotonic ``#n``
+    suffix, so two overlapping barriers with the same caller tag cannot
+    alias across the pipelined exchange schedule."""
+
+    def test_suffix_is_per_call_monotonic(self, monkeypatch):
+        import jax
+        from jax.experimental import multihost_utils
+
+        import photon_ml_tpu.parallel.multihost as mh
+
+        seen = []
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            multihost_utils, "sync_global_devices", seen.append
+        )
+        mh.sync_processes("ckpt")
+        mh.sync_processes("ckpt")
+        mh.sync_processes("other")
+        assert len(seen) == 3 and len(set(seen)) == 3
+        bases = [t.rsplit("#", 1)[0] for t in seen]
+        seqs = [int(t.rsplit("#", 1)[1]) for t in seen]
+        assert bases == ["ckpt", "ckpt", "other"]
+        assert seqs == sorted(seqs) and len(set(seqs)) == 3
+
+    def test_single_process_is_noop(self):
+        from photon_ml_tpu.parallel.multihost import sync_processes
+
+        sync_processes("anything")  # must not touch collectives
+
+
+class TestAsyncExchangeSingleProcess:
+    """The overlapped-exchange surface on one process: identity value,
+    memoized result, and the overlap-ratio gauge present."""
+
+    def test_identity_handle_and_overlap_gauge(self):
+        from photon_ml_tpu.obs.metrics import REGISTRY
+        from photon_ml_tpu.parallel.multihost import exchange_rows_async
+
+        arrays = {"off": np.arange(6, dtype=np.float32)}
+        handle = exchange_rows_async(arrays, np.zeros(6, np.int64))
+        out = handle.result()
+        np.testing.assert_array_equal(out["off"], arrays["off"])
+        assert handle.result() is out  # memoized
+        g = REGISTRY.snapshot("re_shard.")["gauges"]
+        assert "re_shard.exchange_overlap_ratio" in g
+        assert 0.0 <= g["re_shard.exchange_overlap_ratio"] <= 1.0

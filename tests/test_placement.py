@@ -1,0 +1,374 @@
+"""Skew-aware shard placement (``parallel/placement``) + the
+placement-aware bucket machinery it drives.
+
+All host-side/unmarked (ROADMAP tier-1 discipline: the planner is pure
+numpy; the few solver-backed parity tests run tiny geometries). The
+multi-process acceptance harness (2/4-process loopback, bitwise vs
+single process) lives in ``tests/test_multihost.py`` behind the ``slow``
+marker.
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.parallel.placement import (
+    PlacementPlan,
+    plan_entity_placement,
+    plan_shard_placement,
+    re_shard_enabled,
+    record_placement_metrics,
+)
+
+
+def _zipf_sizes(E: int = 64, base: float = 300.0, alpha: float = 1.1):
+    return np.maximum((base / (1 + np.arange(E)) ** alpha).astype(np.int64), 2)
+
+
+class TestPlanner:
+    def test_zipf_64_entities_4_shards_meets_balance_bound(self):
+        """The acceptance bound: LPT ≤ 1.15× max/mean where round-robin
+        loses a full shard to the head entities (≥ 1.5×)."""
+        sizes = _zipf_sizes()
+        sk = plan_entity_placement(sizes, 4)
+        rr = plan_entity_placement(sizes, 4, skew_aware=False)
+        assert sk.balance <= 1.15, sk.loads
+        assert rr.balance >= 1.5, rr.loads
+        assert sk.balance < rr.balance
+
+    def test_uniform_rows_balance_exactly(self):
+        plan = plan_entity_placement(np.full(64, 7), 4)
+        assert plan.balance == 1.0
+        assert np.bincount(plan.owner, minlength=4).tolist() == [16] * 4
+
+    def test_loads_match_owner_assignment(self):
+        sizes = _zipf_sizes(32)
+        plan = plan_entity_placement(sizes, 4)
+        for s in range(4):
+            assert plan.loads[s] == sizes[plan.owned_items(s)].sum()
+
+    def test_single_item_and_more_shards_than_items(self):
+        plan = plan_shard_placement([10.0], 4)
+        assert plan.owner.tolist() == [0]
+        assert plan.loads.tolist() == [10.0, 0.0, 0.0, 0.0]
+        assert plan.balance == 4.0  # one loaded shard over mean/4
+
+    def test_empty_items(self):
+        plan = plan_shard_placement([], 3)
+        assert len(plan.owner) == 0 and plan.balance == 1.0
+
+    def test_single_shard_degenerates(self):
+        sizes = _zipf_sizes(16)
+        plan = plan_entity_placement(sizes, 1)
+        assert set(plan.owner.tolist()) == {0}
+        assert plan.loads[0] == sizes.sum()
+
+    def test_group_atomic_assignment(self):
+        """Fusion groups place WHOLE: every member shares one owner, and
+        group totals (not member counts) drive the balance."""
+        rows = [50, 1, 1, 1, 40, 30, 20, 10]
+        groups = [[0, 1], [2, 3], [4], [5], [6, 7]]
+        plan = plan_shard_placement(rows, 3, groups=groups)
+        for g in groups:
+            assert len({int(plan.owner[i]) for i in g}) == 1, (g, plan.owner)
+        # LPT over group totals [51, 2, 40, 30, 30]: 51|40|30 then the
+        # second 30 joins the lightest shard (30→60), then 2 joins 40
+        assert sorted(plan.loads.tolist()) == [42.0, 51.0, 60.0]
+
+    def test_unlisted_items_become_singletons(self):
+        plan = plan_shard_placement([5, 5, 5, 5], 2, groups=[[1, 2]])
+        assert int(plan.owner[1]) == int(plan.owner[2])
+        assert plan.loads.sum() == 20.0
+
+    def test_group_validation(self):
+        with pytest.raises(ValueError, match="two groups"):
+            plan_shard_placement([1, 2], 2, groups=[[0], [0]])
+        with pytest.raises(ValueError, match="out of range"):
+            plan_shard_placement([1, 2], 2, groups=[[5]])
+        with pytest.raises(ValueError, match="num_shards"):
+            plan_shard_placement([1.0], 0)
+        with pytest.raises(ValueError, match="1-D"):
+            plan_shard_placement(np.ones((2, 2)), 2)
+
+    def test_deterministic_including_ties(self):
+        rows = [3, 3, 3, 3, 3, 3]  # all-tie: order must still be fixed
+        a = plan_shard_placement(rows, 3)
+        b = plan_shard_placement(rows, 3)
+        np.testing.assert_array_equal(a.owner, b.owner)
+        sizes = _zipf_sizes(48)
+        np.testing.assert_array_equal(
+            plan_entity_placement(sizes, 4).owner,
+            plan_entity_placement(sizes, 4).owner,
+        )
+
+    def test_round_robin_is_group_order(self):
+        plan = plan_shard_placement([9, 1, 9, 1], 2, skew_aware=False)
+        assert plan.owner.tolist() == [0, 1, 0, 1]
+        assert plan.balance == pytest.approx(18.0 / 10.0)
+
+    def test_record_placement_metrics_gauges(self):
+        from photon_ml_tpu.obs.metrics import REGISTRY
+
+        plan = plan_entity_placement(_zipf_sizes(16), 4)
+        record_placement_metrics(plan, shard=2)
+        snap = REGISTRY.snapshot("re_shard.")
+        g = snap["gauges"]
+        assert g["re_shard.shards"] == 4.0
+        assert g["re_shard.rows"] == float(plan.loads[2])
+        assert g["re_shard.rows_max"] == float(plan.loads.max())
+        assert g["re_shard.balance"] == pytest.approx(plan.balance)
+
+
+class TestKnob:
+    def test_default_off(self, monkeypatch):
+        monkeypatch.delenv("PHOTON_RE_SHARD", raising=False)
+        assert re_shard_enabled() is False
+
+    def test_env_wins_and_parses_strictly(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_RE_SHARD", "1")
+        assert re_shard_enabled() is True
+        monkeypatch.setenv("PHOTON_RE_SHARD", "0")
+        assert re_shard_enabled() is False
+        monkeypatch.setenv("PHOTON_RE_SHARD", "yes")
+        with pytest.raises(ValueError):
+            re_shard_enabled()
+
+    def test_module_global_fallback(self, monkeypatch):
+        import photon_ml_tpu.parallel.placement as pl
+
+        monkeypatch.delenv("PHOTON_RE_SHARD", raising=False)
+        monkeypatch.setattr(pl, "RE_SHARD", 1)
+        assert re_shard_enabled() is True
+
+
+class TestCapacityClasses:
+    """``game.data.capacity_classes`` must reproduce ``bucket_entities``'s
+    per-entity capacities exactly — including the greedy merge — so a
+    shard bucketing only ITS entities against the global ladder gives
+    every entity the same geometry the single-process run gave it."""
+
+    @pytest.mark.parametrize("seed", [0, 3, 7])
+    def test_matches_bucket_entities_implicit_ladder(self, seed):
+        from photon_ml_tpu.game.data import (
+            bucket_entities,
+            capacity_classes,
+            group_by_entity,
+        )
+
+        rng = np.random.default_rng(seed)
+        E = 40
+        sizes = np.maximum(
+            rng.zipf(1.6, size=E) % 97, 1
+        ).astype(np.int64)
+        ids = np.repeat(np.arange(E), sizes)
+        grouping = group_by_entity(ids, num_entities=E)
+        buckets = bucket_entities(grouping)
+        caps, pops = capacity_classes(grouping.active_counts)
+        assert caps == buckets.capacities
+        assert pops == tuple(len(e) for e in buckets.entity_ids)
+
+    def test_subset_bucketing_reproduces_capacities(self):
+        from photon_ml_tpu.game.data import (
+            bucket_entities,
+            capacity_classes,
+            group_by_entity,
+        )
+
+        sizes = _zipf_sizes(24, base=60.0)
+        ids = np.repeat(np.arange(24), sizes)
+        grouping = group_by_entity(ids, num_entities=24)
+        caps, _ = capacity_classes(grouping.active_counts)
+        # capacity of each entity under the GLOBAL ladder
+        global_cap = {}
+        full = bucket_entities(grouping, capacities=caps)
+        for ent_b, rows_b in zip(full.entity_ids, full.row_indices):
+            for e in ent_b:
+                global_cap[int(e)] = rows_b.shape[1]
+        # bucket an arbitrary SUBSET against the same explicit ladder:
+        # every entity keeps its capacity (the sharded-prep invariant)
+        subset = np.arange(0, 24, 3)
+        keep = np.isin(ids, subset)
+        sub_ids = np.searchsorted(subset, ids[keep])  # dense local ids
+        sub_grouping = group_by_entity(sub_ids, num_entities=len(subset))
+        sub = bucket_entities(sub_grouping, capacities=caps)
+        for ent_b, rows_b in zip(sub.entity_ids, sub.row_indices):
+            for e_local in ent_b:
+                e = int(subset[int(e_local)])
+                assert rows_b.shape[1] == global_cap[e], e
+
+    def test_explicit_capacities_and_empty(self):
+        from photon_ml_tpu.game.data import capacity_classes
+
+        caps, pops = capacity_classes(
+            np.asarray([3, 9, 17]), capacities=(4, 16, 32)
+        )
+        assert caps == (4, 16, 32)
+        assert pops == (1, 1, 1)
+        assert capacity_classes(np.zeros(5, np.int64)) == ((), ())
+        with pytest.raises(ValueError, match="largest bucket capacity"):
+            capacity_classes(np.asarray([100]), capacities=(4, 16))
+
+
+class TestLaneFloorBitwise:
+    """The sharded path's lane floor: a 1-real-lane launch padded with
+    one all-masked dummy lane must give the real entity BITWISE the
+    result it gets inside a larger batch (the batched XLA lowering),
+    because that is what the single-process run produced for it."""
+
+    def test_padded_single_lane_matches_batched_lane(self):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.config import OptimizerConfig
+        from photon_ml_tpu.game.data import DenseFeatures, gather_bucket
+        from photon_ml_tpu.game.random_effect import solve_bucket_lanes
+        from photon_ml_tpu.ops.losses import loss_for_task
+        from photon_ml_tpu.optim.common import select_minimize_fn
+        from photon_ml_tpu.types import TaskType, VarianceComputationType
+
+        rng = np.random.default_rng(5)
+        k, C, d = 3, 8, 3
+        X = rng.normal(size=(k * C, d)).astype(np.float32)
+        y = (rng.uniform(size=k * C) < 0.5).astype(np.float32)
+        offs = np.zeros(k * C, np.float32)
+        wgt = np.ones(k * C, np.float32)
+        rows = np.arange(k * C).reshape(k, C)
+        feats = DenseFeatures(X=X)
+        cfg = OptimizerConfig(max_iterations=6, tolerance=1e-9)
+        loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+        minimize_fn, extra = select_minimize_fn(cfg, 0.0)
+        common = dict(
+            minimize_fn=minimize_fn, loss=loss, config=cfg,
+            intercept_index=None,
+            variance_computation=VarianceComputationType.SIMPLE,
+            **extra,
+        )
+        l2 = jnp.asarray(1.0, jnp.float32)
+
+        batched = solve_bucket_lanes(
+            gather_bucket(feats, y, offs, wgt, rows),
+            jnp.zeros((k, d), jnp.float32), l2, None, None, None, **common
+        )
+        # entity 0 alone + one dummy lane whose rows are all -1 (masked)
+        rows_pad = np.stack([rows[0], np.full(C, -1, rows.dtype)])
+        padded = solve_bucket_lanes(
+            gather_bucket(feats, y, offs, wgt, rows_pad),
+            jnp.zeros((2, d), jnp.float32), l2, None, None, None, **common
+        )
+        for b_out, p_out in zip(batched, padded):
+            np.testing.assert_array_equal(
+                np.asarray(b_out)[0], np.asarray(p_out)[0]
+            )
+
+
+class TestOwnedBucketMode:
+    """PHOTON_RE_SHARD=1 under a (single-process) mesh: owned-bucket prep
+    keeps lanes fully addressable — bitwise-identical to the unsharded
+    solve, with the PR-5 compaction/fusion knobs now LEGAL under the
+    mesh (the lifted gate) and the legacy knob-off schedule untouched."""
+
+    @pytest.fixture()
+    def problem(self):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.config import OptimizerConfig
+        from photon_ml_tpu.game import bucket_entities, group_by_entity
+        from photon_ml_tpu.game.data import DenseFeatures
+        from photon_ml_tpu.ops.losses import loss_for_task
+        from photon_ml_tpu.types import TaskType, VarianceComputationType
+
+        rng = np.random.default_rng(11)
+        n, E, d = 96, 12, 3
+        ids = rng.integers(0, E, size=n).astype(np.int32)
+        kwargs = dict(
+            labels=(rng.uniform(size=n) < 0.5).astype(np.float32),
+            offsets=np.zeros(n, np.float32),
+            weights=np.ones(n, np.float32),
+            buckets=bucket_entities(group_by_entity(ids, num_entities=E)),
+            num_entities=E,
+            loss=loss_for_task(TaskType.LOGISTIC_REGRESSION),
+            config=OptimizerConfig(max_iterations=6, tolerance=1e-9),
+            l2_weight=1.0,
+            variance_computation=VarianceComputationType.SIMPLE,
+        )
+        feats = DenseFeatures(
+            X=jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        )
+        return feats, kwargs
+
+    def test_owned_mesh_solve_is_bitwise(self, problem, monkeypatch):
+        from photon_ml_tpu.game.random_effect import train_random_effects
+        from photon_ml_tpu.parallel import data_mesh
+
+        feats, kwargs = problem
+        ref = train_random_effects(feats, **kwargs)
+        monkeypatch.setenv("PHOTON_RE_SHARD", "1")
+        got = train_random_effects(feats, mesh=data_mesh(), **kwargs)
+        np.testing.assert_array_equal(
+            np.asarray(got.coefficients), np.asarray(ref.coefficients)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.variances), np.asarray(ref.variances)
+        )
+        np.testing.assert_array_equal(got.iterations, ref.iterations)
+
+    def test_gate_lift_compaction_fusion_apply_under_mesh(
+        self, problem, monkeypatch
+    ):
+        """With the knob on, PHOTON_RE_COMPACT_EVERY/FUSE_BUCKETS run
+        under a mesh (they were gated off before) — still bitwise."""
+        from photon_ml_tpu.game.random_effect import train_random_effects
+        from photon_ml_tpu.obs.metrics import REGISTRY
+        from photon_ml_tpu.parallel import data_mesh
+
+        feats, kwargs = problem
+        ref = train_random_effects(feats, **kwargs)
+        monkeypatch.setenv("PHOTON_RE_SHARD", "1")
+        monkeypatch.setenv("PHOTON_RE_FUSE_BUCKETS", "1")
+        monkeypatch.setenv("PHOTON_RE_COMPACT_EVERY", "2")
+
+        def launches():
+            return (
+                REGISTRY.snapshot("re_solve.")["counters"]
+                .get("re_solve.launches", {})
+                .get("value", 0.0)
+            )
+
+        before = launches()
+        got = train_random_effects(feats, mesh=data_mesh(), **kwargs)
+        np.testing.assert_array_equal(
+            np.asarray(got.coefficients), np.asarray(ref.coefficients)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.variances), np.asarray(ref.variances)
+        )
+        # the compacted chunk schedule actually ran (multiple launches
+        # per fused unit), i.e. the knobs were NOT silently gated off
+        assert launches() > before
+
+    def test_knob_off_mesh_keeps_lane_sharded_schedule(
+        self, problem, monkeypatch
+    ):
+        """Knob off: prepare_buckets still lane-shards over the mesh and
+        assigns no owners — the legacy schedule, counter for counter."""
+        from photon_ml_tpu.game.random_effect import prepare_buckets
+        from photon_ml_tpu.parallel import data_mesh
+
+        feats, kwargs = problem
+        monkeypatch.delenv("PHOTON_RE_SHARD", raising=False)
+        prepared = prepare_buckets(
+            feats, kwargs["labels"], kwargs["weights"], kwargs["buckets"],
+            data_mesh(),
+        )
+        assert all(pb.owner is None for pb in prepared)
+        # lanes padded to divide the 8-device mesh axis
+        assert all(
+            pb.static.labels.shape[0] % 8 == 0 for pb in prepared
+        )
+        monkeypatch.setenv("PHOTON_RE_SHARD", "1")
+        owned = prepare_buckets(
+            feats, kwargs["labels"], kwargs["weights"], kwargs["buckets"],
+            data_mesh(),
+        )
+        assert all(pb.owner == 0 for pb in owned)  # single process owns all
+        assert all(
+            pb.static.labels.shape[0] == pb.num_real for pb in owned
+        )
