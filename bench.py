@@ -2017,12 +2017,17 @@ def _multichip_r06_worker(
 
 
 def run_multichip_r06(
-    out_path: str = "MULTICHIP_r06.json",
+    out_path: str = "MULTICHIP_r07.json",
     telemetry_dir: str | None = "telemetry_r06",
     nproc: int = 2,
 ) -> dict:
     """Drive the multi-process capture (parent mode) and write the
-    MULTICHIP_r06.json artifact."""
+    capture artifact (MULTICHIP_r07.json — the r06 recipe's successor:
+    same two arms, plus the FLEET telemetry readout. The skew-aware arm
+    runs with PHOTON_RE_SHARD=1, so fleet telemetry archives every
+    process's ``.p<k>`` shard next to the process-0 JSONLs in
+    ``telemetry_r06/`` and the doc records the merged straggler/P2P
+    summary from ``report fleet``)."""
     import socket
     import subprocess
 
@@ -2037,6 +2042,18 @@ def run_multichip_r06(
 
     arms: dict[str, dict] = {}
     for arm in ("baseline_modulo", "skew_aware"):
+        # run ids are fixed strings: clear any previous capture's
+        # canonical file AND .p<k> shards first, so a re-capture under
+        # different knobs (or after a crash) can never join a fresh
+        # canonical run with a stale shard of the same name
+        if telemetry_dir:
+            import glob as _glob
+
+            for stale in _glob.glob(os.path.join(
+                here, telemetry_dir,
+                f"run-MULTICHIP_r06_{arm}_P{nproc}*.jsonl",
+            )):
+                os.remove(stale)
         coordinator = f"127.0.0.1:{free_port()}"
         env = {
             k: v for k, v in os.environ.items()
@@ -2099,6 +2116,47 @@ def run_multichip_r06(
                 len({r["W_sha256"] for r in per_pid.values()}) == 1
             ),
         }
+        # merged fleet readout (skew-aware arm only: RE_SHARD=1 turns
+        # fleet telemetry on, so processes 1..N-1 wrote .p<k> shards):
+        # per-process phase walls, straggler summary, correlated P2P
+        # link table, unmatched-event health — the numbers the on-chip
+        # sweep gates across the whole fleet
+        if telemetry_dir:
+            try:
+                from photon_ml_tpu.obs.report import (
+                    fleet_run_paths,
+                    gate_metrics_from_fleet,
+                    summarize_fleet,
+                )
+
+                paths = fleet_run_paths(
+                    os.path.join(here, telemetry_dir),
+                    run_id=f"MULTICHIP_r06_{arm}_P{nproc}",
+                )
+                fs = summarize_fleet(paths)
+                arms[arm]["fleet"] = {
+                    "shards": [os.path.basename(p) for p in paths],
+                    "process_count": fs["process_count"],
+                    "straggler": fs["straggler"],
+                    "phases": {
+                        ph: {
+                            k: agg[k]
+                            for k in ("per_process", "max_s", "imbalance",
+                                      "slowest")
+                        }
+                        for ph, agg in fs["phases"].items()
+                    },
+                    "p2p": {
+                        k: v for k, v in fs["p2p"].items()
+                        if k != "links"
+                    },
+                    "p2p_links": fs["p2p"]["links"],
+                    "overlap": fs["overlap"],
+                    "exchange": fs["exchange"],
+                    "gate_metrics": gate_metrics_from_fleet(fs),
+                }
+            except Exception as e:  # the capture must still land
+                arms[arm]["fleet"] = {"error": str(e)}
 
     # pure-planner balance table on the same distribution: the
     # ≤1.15×-vs-≥1.5× acceptance readout, deterministic on any host
@@ -2116,10 +2174,12 @@ def run_multichip_r06(
             "round_robin_rows_max": float(rr.loads.max()),
         }
     doc = {
-        "round": 6,
+        "round": 7,
         "what": (
-            "entity-sharded multi-process random-effect solves: "
-            "skew-aware bucket placement + overlapped P2P exchange "
+            "entity-sharded multi-process random-effect solves with "
+            "FLEET telemetry: skew-aware bucket placement + overlapped "
+            "P2P exchange, per-process sink shards, correlated P2P "
+            "link events and the merged straggler readout "
             f"(streamed GAME, Zipf E config, {nproc}-process loopback "
             "CPU harness, gloo collectives)"
         ),
@@ -2250,7 +2310,10 @@ if __name__ == "__main__":
             args[1], int(args[2]), int(args[3]), args[4],
             telemetry_dir,
         )
-    elif args and args[0] == "--multichip-r06":
+    elif args and args[0] in ("--multichip-r06", "--multichip-r07"):
+        # one recipe, two names: --multichip-r07 is the r06 capture plus
+        # the fleet-telemetry readout (shards + straggler summary); the
+        # old flag keeps working and produces the same successor doc
         run_multichip_r06(
             telemetry_dir=telemetry_dir or "telemetry_r06",
             nproc=int(args[1]) if len(args) > 1 else 2,
@@ -2259,6 +2322,6 @@ if __name__ == "__main__":
         main(telemetry_dir=telemetry_dir)
     else:
         _log(f"usage: bench.py [--quick | --update-baseline | "
-             f"--config NAME [--quick] | --multichip-r06 [NPROC]] "
+             f"--config NAME [--quick] | --multichip-r07 [NPROC]] "
              f"[--telemetry-dir DIR]; got {args}")
         sys.exit(2)

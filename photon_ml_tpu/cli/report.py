@@ -13,9 +13,21 @@ Usage:
     photon-ml-tpu report TELEMETRY_DIR            # newest run in the dir
     photon-ml-tpu report RUN.jsonl --export-trace trace.json
     photon-ml-tpu report RUN.jsonl --json         # machine-readable summary
+    photon-ml-tpu report fleet RUNDIR             # merged multi-process view
+    photon-ml-tpu report fleet RUNDIR --run-id ID --export-trace trace.json
     photon-ml-tpu report validate RUN.jsonl       # exit 1 on schema errors
     photon-ml-tpu report gate RUN --baseline BASE # exit 1 on regression
+    photon-ml-tpu report gate --fleet RUNDIR --baseline BASE
     photon-ml-tpu report gate RUN --write-baseline OUT.json
+
+``fleet`` joins one run's canonical ``run-<id>.jsonl`` with its
+per-process ``run-<id>.p<k>.jsonl`` shards (written by every non-zero
+process under fleet telemetry) and renders the per-process phase-wall
+table, the straggler summary, the correlated per-link P2P table and the
+unmatched-event telemetry-health count; ``--export-trace`` merges every
+shard into ONE Chrome-trace timeline (pid = process index). ``gate
+--fleet`` gates the MERGED view — balance/overlap/straggler regressions
+anywhere in the fleet trip it, not just on process 0.
 
 ``gate`` accepts a telemetry run JSONL/dir, a ``bench.py`` JSON document
 (``--quick`` stdout capture — the committed ``BASELINE_cost_cpu.json``
@@ -82,6 +94,49 @@ def _validate_main(argv: list[str]) -> None:
     raise SystemExit(1 if problems else 0)
 
 
+def _fleet_main(argv: list[str]) -> None:
+    p = argparse.ArgumentParser(
+        prog="photon-ml-tpu report fleet",
+        description="merged per-process view of one fleet run "
+                    "(canonical file + .p<k> shards)",
+    )
+    p.add_argument("run", help="telemetry dir, canonical run JSONL, or "
+                               "any one shard of the run")
+    p.add_argument("--run-id", default=None,
+                   help="pick a specific run inside a telemetry dir "
+                        "(default: newest canonical run)")
+    p.add_argument("--export-trace", default=None, metavar="OUT_JSON",
+                   help="also write ONE merged Chrome-trace/Perfetto "
+                        "timeline (pid = process index)")
+    p.add_argument("--json", action="store_true",
+                   help="print the machine-readable fleet dict instead "
+                        "of the tables")
+    args = p.parse_args(argv)
+
+    from photon_ml_tpu.obs.report import (
+        fleet_run_paths,
+        format_fleet,
+        summarize_fleet,
+    )
+
+    try:
+        paths = fleet_run_paths(args.run, run_id=args.run_id)
+        fs = summarize_fleet(paths)
+    except (OSError, ValueError) as e:
+        # load errors exit 2 (the gate/validate contract): a path typo
+        # must be distinguishable from a real fleet-health failure
+        if args.json:
+            print(json.dumps({"run": args.run, "error": str(e)}))
+        else:
+            print(f"{args.run}: cannot load fleet run: {e}")
+        raise SystemExit(2)
+    if args.export_trace:
+        from photon_ml_tpu.obs.export import export_chrome_trace
+
+        export_chrome_trace(paths, args.export_trace)
+    print(json.dumps(fs) if args.json else format_fleet(fs))
+
+
 def _load_thresholds(spec: str | None) -> dict | None:
     if not spec:
         return None
@@ -99,6 +154,10 @@ def _gate_main(argv: list[str]) -> None:
     )
     p.add_argument("run", help="telemetry run JSONL/dir, or a bench.py "
                                "JSON document")
+    p.add_argument("--fleet", action="store_true",
+                   help="gate the MERGED fleet view of the run "
+                        "(canonical file + every .p<k> shard) instead "
+                        "of process 0's summary alone")
     p.add_argument("--baseline", default=None,
                    help="baseline artifact (same formats as RUN)")
     p.add_argument("--thresholds", default=None, metavar="JSON",
@@ -130,7 +189,7 @@ def _gate_main(argv: list[str]) -> None:
 
     def _load(path, side):
         try:
-            return load_gate_metrics(path)
+            return load_gate_metrics(path, fleet=args.fleet)
         except (OSError, ValueError, json.JSONDecodeError) as e:
             _error(f"cannot load {side} {path!r}: {e}")
 
@@ -244,6 +303,9 @@ def main(argv: list[str] | None = None) -> None:
         return
     if argv and argv[0] == "gate":
         _gate_main(argv[1:])
+        return
+    if argv and argv[0] == "fleet":
+        _fleet_main(argv[1:])
         return
     p = argparse.ArgumentParser(
         prog="photon-ml-tpu report",

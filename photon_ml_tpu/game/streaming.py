@@ -581,7 +581,7 @@ class StreamedGameTrainer:
                 dest = entity_owner[sub["ent"]].astype(np.int64)
             else:
                 dest = (sub["ent"] % P).astype(np.int64)
-            recv = exchange_rows(sub, dest)
+            recv = exchange_rows(sub, dest, tag=f"ingest/{cid}")
             for k, v in recv.items():
                 keep[k].append(v)
         merged = {k: np.concatenate(v) if v else np.zeros((0,)) for k, v in keep.items()}
@@ -855,7 +855,9 @@ class StreamedGameTrainer:
         from photon_ml_tpu.parallel.multihost import exchange_rows
 
         arrays, dest = _offsets_payload(shard, offs_local, row_base)
-        return _scatter_offsets(shard, exchange_rows(arrays, dest))
+        return _scatter_offsets(
+            shard, exchange_rows(arrays, dest, tag="offsets")
+        )
 
     def _offsets_to_owners_async(
         self, shard: _ReShard, offs_local: np.ndarray, row_base: int
@@ -872,7 +874,7 @@ class StreamedGameTrainer:
 
         arrays, dest = _offsets_payload(shard, offs_local, row_base)
         return _PendingExchange(
-            exchange_rows_async(arrays, dest),
+            exchange_rows_async(arrays, dest, tag="offsets"),
             lambda recv: _scatter_offsets(shard, recv),
         )
 
@@ -896,7 +898,7 @@ class StreamedGameTrainer:
 
         handle = exchange_rows_async(
             {"grow": shard.grow, "score": scores_re.astype(np.float32)},
-            shard.owner_dest,
+            shard.owner_dest, tag="scores",
         )
         return _PendingExchange(
             handle,
@@ -921,7 +923,7 @@ class StreamedGameTrainer:
 
         recv = exchange_rows(
             {"grow": shard.grow, "score": scores_re.astype(np.float32)},
-            shard.owner_dest,
+            shard.owner_dest, tag="scores",
         )
         return _scatter_scores(shard, recv, n_local, row_base)
 
@@ -1656,7 +1658,8 @@ class StreamedGameTrainer:
         dest = (gids % max(P, 1)).astype(np.int64)
         labels = np.asarray(validation.labels, np.float32)[keep]
         recv = exchange_rows(
-            {"gid": gids, "label": labels, "grow": grow_in}, dest
+            {"gid": gids, "label": labels, "grow": grow_in}, dest,
+            tag=f"val_route/{tag}",
         )
         grow = recv["grow"]
         order = np.argsort(grow)

@@ -19,9 +19,14 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from typing import Any
 
 from photon_ml_tpu.obs.sink import SCHEMA_VERSION
+
+# fleet shard files: run-<id>.p<k>.jsonl (processes 1..N-1 of one run,
+# next to process 0's canonical run-<id>.jsonl)
+_SHARD_RE = re.compile(r"\.p(\d+)\.jsonl$")
 
 _SPAN_REQUIRED = ("name", "span_id", "dur_s", "t")
 
@@ -83,9 +88,12 @@ def _union_seconds(intervals: list[tuple[float, float]]) -> float:
     return total
 
 
-def summarize_run(path: str) -> dict:
-    """One run's JSONL → a JSON-plain summary dict."""
-    records = load_run(path)
+def summarize_run(path: str, records: list[dict] | None = None) -> dict:
+    """One run's JSONL → a JSON-plain summary dict. ``records`` skips
+    the re-read when the caller already parsed the file (the fleet
+    summarizer loads each shard once for the P2P-event join)."""
+    if records is None:
+        records = load_run(path)
     errors = validate_run(records)
     if errors:
         raise ValueError(f"{path}: invalid telemetry run: {errors}")
@@ -309,7 +317,7 @@ def summarize_run(path: str) -> dict:
         ),
     }
 
-    return {
+    out = {
         "path": os.path.abspath(path),
         "run_id": run_start.get("run_id"),
         "schema_version": run_start.get("schema_version"),
@@ -338,6 +346,15 @@ def summarize_run(path: str) -> dict:
         ),
         "metrics": metrics,
     }
+    # overlapped-exchange accounting — only on runs that recorded it, so
+    # the summary of a fleet-off run stays key-for-key what it was
+    if "re_exchange.exchange_s" in timers or \
+            "re_exchange.exchange_s" in base_timers:
+        out["exchange_s"] = timer_s("re_exchange.exchange_s")
+        out["exchange_wait_s"] = timer_s("re_exchange.wait_s")
+    if run_start.get("fleet"):
+        out["fleet"] = run_start["fleet"]
+    return out
 
 
 # -- rendering --------------------------------------------------------------
@@ -591,13 +608,355 @@ def diff_summaries(a: dict, b: dict) -> str:
 
 
 def latest_run(directory: str) -> str | None:
-    """Newest ``run-*.jsonl`` in a telemetry directory (mtime order)."""
+    """Newest CANONICAL ``run-*.jsonl`` in a telemetry directory (mtime
+    order). ``.p<k>`` fleet shards are excluded — the newest run of a
+    fleet directory is its process-0 file, exactly what every
+    single-process consumer expects."""
     runs = [
         os.path.join(directory, f)
         for f in os.listdir(directory)
         if f.startswith("run-") and f.endswith(".jsonl")
+        and not _SHARD_RE.search(f)
     ]
     return max(runs, key=os.path.getmtime) if runs else None
+
+
+def fleet_run_paths(path: str, run_id: str | None = None) -> list[str]:
+    """All files of one fleet run, canonical first: given a telemetry
+    directory (newest canonical run, or ``run_id``), a canonical run
+    file, or any one shard, return ``[run-<id>.jsonl,
+    run-<id>.p1.jsonl, …]`` in ascending process order. A run with no
+    shards returns just its canonical file, so every fleet entry point
+    degrades to the single-process view."""
+    if os.path.isdir(path):
+        if run_id is not None:
+            canonical = os.path.join(path, f"run-{run_id}.jsonl")
+            if not os.path.exists(canonical):
+                raise ValueError(
+                    f"no run-{run_id}.jsonl in {path}"
+                )
+        else:
+            canonical = latest_run(path)
+            if canonical is None:
+                raise ValueError(f"no run-*.jsonl files in {path}")
+    else:
+        canonical = path
+        m = _SHARD_RE.search(canonical)
+        if m:  # a shard was named: walk back to its canonical file
+            canonical = canonical[: m.start()] + ".jsonl"
+        if not canonical.endswith(".jsonl"):
+            raise ValueError(
+                f"not a telemetry run file (want *.jsonl): {canonical}"
+            )
+        if not os.path.exists(canonical):
+            raise ValueError(f"canonical run file missing: {canonical}")
+    base = os.path.basename(canonical)
+    directory = os.path.dirname(canonical) or "."
+    stem = base[: -len(".jsonl")]
+    shard_re = re.compile(re.escape(stem) + r"\.p(\d+)\.jsonl$")
+    shards: dict[int, str] = {}
+    for f in os.listdir(directory):
+        m = shard_re.fullmatch(f)
+        if m:
+            shards[int(m.group(1))] = os.path.join(directory, f)
+    return [canonical] + [shards[k] for k in sorted(shards)]
+
+
+# -- fleet view --------------------------------------------------------------
+#
+# ``photon-ml-tpu report fleet RUNDIR`` joins one run's canonical file
+# and its per-process shards into the cross-process readout the on-chip
+# multichip sweeps gate on: a per-process phase-wall table, a straggler
+# summary (max/median/imbalance per phase, slowest process named), a
+# per-link P2P table built by joining the correlated ``p2p_send`` /
+# ``p2p_recv`` events the framed exchange emits on both ends of every
+# link (one-sided wait = recv-start − send-start; same-host clocks on
+# the loopback harness, NTP-disciplined hosts on a pod — cross-host
+# skew shows up as negative waits, which clip to zero), and an
+# unmatched-event count as a telemetry-health signal (a clean run joins
+# every pair; unmatched events mean a torn mesh, a lost shard file, or
+# a truncated run).
+
+
+def _p2p_link_table(records_by_process: dict[int, list[dict]]) -> dict:
+    """Join correlated send/recv events across all shards of one run."""
+    sends: dict[str, dict] = {}
+    recvs: dict[str, dict] = {}
+    duplicates = 0
+    heartbeats = 0
+    for recs in records_by_process.values():
+        for r in recs:
+            ev = r.get("event")
+            if ev == "p2p_heartbeat":
+                heartbeats += 1
+                continue
+            if ev not in ("p2p_send", "p2p_recv"):
+                continue
+            corr = str(r.get("corr"))
+            side = sends if ev == "p2p_send" else recvs
+            if corr in side:
+                duplicates += 1
+            side[corr] = r
+    links: dict[str, dict] = {}
+
+    def link_agg(corr: str) -> dict | None:
+        # corr = "p2p:<src>><dst>#<seq>"
+        m = re.fullmatch(r"p2p:(\d+)>(\d+)#\d+", corr)
+        if m is None:
+            return None
+        return links.setdefault(
+            f"{m.group(1)}->{m.group(2)}",
+            {
+                "transfers": 0, "bytes": 0, "rows": 0,
+                "send_s": 0.0, "recv_s": 0.0,
+                "one_sided_wait_s": 0.0, "matched": 0,
+                "tags": [],
+            },
+        )
+
+    matched = 0
+    for corr, s in sends.items():
+        agg = link_agg(corr)
+        if agg is None:
+            continue
+        agg["transfers"] += 1
+        agg["bytes"] += int(s.get("bytes") or 0)
+        agg["rows"] += int(s.get("rows") or 0)
+        agg["send_s"] += float(s.get("dur_s") or 0.0)
+        t = str(s.get("tag") or "")
+        if t and t not in agg["tags"]:
+            agg["tags"].append(t)
+        r = recvs.get(corr)
+        if r is None:
+            continue
+        matched += 1
+        agg["matched"] += 1
+        agg["recv_s"] += float(r.get("dur_s") or 0.0)
+        agg["one_sided_wait_s"] += max(
+            float(r.get("t_start") or 0.0) - float(s.get("t_start") or 0.0),
+            0.0,
+        )
+    # recv-only correlations still surface on their link rows
+    for corr, r in recvs.items():
+        if corr in sends:
+            continue
+        agg = link_agg(corr)
+        if agg is None:
+            continue
+        agg["transfers"] += 1
+        agg["recv_s"] += float(r.get("dur_s") or 0.0)
+    unmatched = (len(sends) - matched) + (len(recvs) - matched)
+    for agg in links.values():
+        agg["tags"] = sorted(agg["tags"])
+    return {
+        "links": {k: links[k] for k in sorted(links)},
+        "sends": len(sends),
+        "recvs": len(recvs),
+        "matched": matched,
+        "unmatched": unmatched,
+        "duplicate_correlations": duplicates,
+        "heartbeats": heartbeats,
+    }
+
+
+def summarize_fleet(paths: list[str]) -> dict:
+    """All shards of one run → the merged fleet view (JSON-plain)."""
+    if not paths:
+        raise ValueError("no run files to summarize")
+    processes: dict[str, dict] = {}
+    records_by_process: dict[int, list[dict]] = {}
+    expected = None
+    for p in paths:
+        records = load_run(p)
+        errors = validate_run(records)
+        if errors:
+            raise ValueError(f"{p}: invalid telemetry run: {errors}")
+        pidx = int(records[0].get("process_index", 0))
+        if pidx in records_by_process:
+            raise ValueError(
+                f"{p}: duplicate process index {pidx} in fleet run"
+            )
+        records_by_process[pidx] = records
+        s = summarize_run(p, records=records)
+        s["process_index"] = pidx
+        processes[str(pidx)] = s
+        fleet_info = records[0].get("fleet") or {}
+        if fleet_info.get("process_count"):
+            expected = int(fleet_info["process_count"])
+    pidxs = sorted(records_by_process)
+    run_ids = {s["run_id"] for s in processes.values()}
+    if len(run_ids) > 1:
+        raise ValueError(f"shards disagree on run_id: {sorted(run_ids)}")
+
+    # per-process phase walls + straggler summary. Imbalance is
+    # max/median over ALL processes (absent phases count 0.0): a phase
+    # only one process runs — ingest on the data-holding host, say — is
+    # by definition maximally imbalanced, which is exactly what a
+    # straggler table must say.
+    from statistics import median
+
+    phase_names = sorted(
+        {ph for s in processes.values() for ph in s["phases"]}
+    )
+    phases: dict[str, dict] = {}
+    for ph in phase_names:
+        walls = {
+            k: float(s["phases"].get(ph, {}).get("wall_s", 0.0))
+            for k, s in processes.items()
+        }
+        mx = max(walls.values())
+        med = median(list(walls.values()))
+        slowest = max(walls, key=lambda k: walls[k])
+        phases[ph] = {
+            "per_process": walls,
+            "max_s": mx,
+            "median_s": med,
+            "imbalance": (mx / med) if med > 0 else None,
+            "slowest": int(slowest),
+        }
+    walls_total = {
+        k: float(s["wall_s"]) for k, s in processes.items()
+    }
+    slowest_proc = max(walls_total, key=lambda k: walls_total[k]) \
+        if walls_total else "0"
+
+    overlap = {
+        k: (s.get("re_shard") or {}).get("exchange_overlap_ratio")
+        for k, s in processes.items()
+        if (s.get("re_shard") or {}).get("exchange_overlap_ratio")
+        is not None
+    }
+    exchange = {
+        k: {
+            "exchange_s": s["exchange_s"],
+            "wait_s": s["exchange_wait_s"],
+        }
+        for k, s in processes.items()
+        if "exchange_s" in s
+    }
+    head = processes[str(pidxs[0])]
+    return {
+        "run_id": head["run_id"],
+        "schema_version": head["schema_version"],
+        "knobs": head["knobs"],
+        "paths": [os.path.abspath(p) for p in paths],
+        "process_count": len(pidxs),
+        "expected_process_count": expected,
+        "missing_shards": (
+            max(expected - len(pidxs), 0) if expected else 0
+        ),
+        "complete": all(s["complete"] for s in processes.values()),
+        "wall_s": max(walls_total.values()) if walls_total else 0.0,
+        "phases": phases,
+        "straggler": {
+            "slowest_process": int(slowest_proc),
+            "per_process_wall_s": walls_total,
+            "max_imbalance": max(
+                (
+                    agg["imbalance"]
+                    for agg in phases.values()
+                    if agg["imbalance"] is not None
+                ),
+                default=None,
+            ),
+        },
+        "p2p": _p2p_link_table(records_by_process),
+        "overlap": overlap,
+        "exchange": exchange,
+        "processes": processes,
+    }
+
+
+def format_fleet(fs: dict) -> str:
+    """The fleet-run tables (the human half of ``report fleet``)."""
+    pidxs = sorted(int(k) for k in fs["processes"])
+    cols = [str(k) for k in pidxs]
+    expected = fs.get("expected_process_count")
+    head = (
+        f"fleet run {fs['run_id']}  (schema v{fs['schema_version']}, "
+        f"{fs['process_count']} process"
+        f"{'es' if fs['process_count'] != 1 else ''}"
+    )
+    if fs.get("missing_shards"):
+        head += f", {fs['missing_shards']} of {expected} shards MISSING"
+    head += ", complete)" if fs["complete"] else ", TRUNCATED?)"
+    lines = [head, f"  fleet wall {_fmt_s(fs['wall_s'])}", ""]
+
+    # per-process phase-wall table + straggler columns
+    hdr = f"  {'phase':<16}" + "".join(f" {'p' + c:>9}" for c in cols)
+    lines.append(hdr + f" {'max':>9} {'imbal':>6}  slowest")
+    for ph, agg in sorted(
+        fs["phases"].items(), key=lambda kv: -kv[1]["max_s"]
+    ):
+        row = f"  {ph:<16}" + "".join(
+            f" {_fmt_s(agg['per_process'].get(c, 0.0)):>9}" for c in cols
+        )
+        imb = agg["imbalance"]
+        row += (
+            f" {_fmt_s(agg['max_s']):>9} "
+            f"{'-' if imb is None else f'{imb:.2f}x':>6}  "
+            f"p{agg['slowest']}"
+        )
+        lines.append(row)
+    st = fs["straggler"]
+    imb = st.get("max_imbalance")
+    lines.append(
+        f"  straggler: slowest process p{st['slowest_process']} "
+        f"(wall {_fmt_s(st['per_process_wall_s'][str(st['slowest_process'])])})"
+        + (
+            f", worst phase imbalance {imb:.2f}x"
+            if imb is not None else ""
+        )
+    )
+
+    if fs.get("overlap") or fs.get("exchange"):
+        parts = []
+        for c in cols:
+            o = fs["overlap"].get(c)
+            e = fs["exchange"].get(c) or {}
+            seg = f"p{c}"
+            if o is not None:
+                seg += f" {o:.1%}"
+            if e:
+                seg += (
+                    f" (exch {_fmt_s(e['exchange_s'])}, "
+                    f"wait {_fmt_s(e['wait_s'])})"
+                )
+            parts.append(seg)
+        lines.append("  exchange-overlap: " + "  ".join(parts))
+
+    p2p = fs.get("p2p") or {}
+    if p2p.get("links"):
+        lines.append("")
+        lines.append(
+            f"  {'link':<8} {'xfers':>6} {'bytes':>9} {'rows':>8} "
+            f"{'send':>9} {'wait(1-sided)':>14}  tags"
+        )
+        for link, a in p2p["links"].items():
+            lines.append(
+                f"  {link:<8} {a['transfers']:>6} "
+                f"{_fmt_qty(a['bytes']):>9} {a['rows']:>8} "
+                f"{_fmt_s(a['send_s']):>9} "
+                f"{_fmt_s(a['one_sided_wait_s']):>14}  "
+                + ",".join(a["tags"])
+            )
+    health = (
+        f"  p2p health: {p2p.get('matched', 0)} correlated pairs, "
+        f"{p2p.get('unmatched', 0)} unmatched"
+    )
+    if p2p.get("duplicate_correlations"):
+        health += f", {p2p['duplicate_correlations']} DUPLICATE ids"
+    if p2p.get("heartbeats"):
+        health += f", {p2p['heartbeats']} blocked-recv heartbeats"
+    lines.append(health)
+    if p2p.get("unmatched"):
+        lines.append(
+            "  WARNING: unmatched correlated events — a torn exchange "
+            "mesh, a missing shard file, or a truncated run"
+        )
+    if fs["knobs"]:
+        lines.append(f"  knobs: {json.dumps(fs['knobs'], sort_keys=True)}")
+    return "\n".join(lines)
 
 
 # -- regression gate --------------------------------------------------------
@@ -640,6 +999,18 @@ DEFAULT_GATE_THRESHOLDS: dict[str, dict] = {
     # must trip the gate.
     "re_shard/": {"rel": 0.05},
     "re_shard/exchange_overlap_ratio": {"abs": 1.0},
+    # fleet tiers (the merged cross-process view from ``report fleet``):
+    # telemetry-health counts gate EXACT — one unmatched correlated
+    # event or one missing shard is a broken instrument, not noise —
+    # while wall-derived imbalance gates loose (CPU scheduling jitter
+    # moves a 2-process toy run's phase ratios hard). P2P bytes are
+    # deterministic for a given router + row distribution: near-tight.
+    "fleet/missing_shards": {"rel": 0.0, "abs": 0.0},
+    "fleet/unmatched_p2p": {"rel": 0.0, "abs": 0.0},
+    "fleet/p2p_bytes_total": {"rel": 0.05},
+    "/imbalance": {"rel": 1.0, "abs": 1.0},
+    "exchange_wait_s": {"rel": 2.0, "abs": 5.0},
+    "exchange_s": {"rel": 2.0, "abs": 5.0},
     # quality tiers: deltas vs the f32 anchor, absolute headroom at the
     # parity-gate scale (|ΔAUC| ≤ 0.005 is the ladder's own bf16 gate)
     "quality/": {"rel": 0.0, "abs": 0.005},
@@ -693,7 +1064,10 @@ def gate_metrics_from_summary(s: dict) -> dict[str, float]:
     """Flatten one telemetry-run summary into gateable metrics."""
     m: dict[str, float] = {}
     for k in ("wall_s", "compile_s", "transfer_s", "host_pack_s",
-              "consumer_wait_s"):
+              "consumer_wait_s", "exchange_s", "exchange_wait_s"):
+        # exchange_s/exchange_wait_s exist only on runs that recorded
+        # the overlapped-exchange timers; a pre-fleet baseline simply
+        # never lists them, so old-vs-new gates stay comparable
         if isinstance(s.get(k), (int, float)):
             m[k] = float(s[k])
     for lab, agg in (s.get("devcost") or {}).items():
@@ -765,11 +1139,82 @@ def gate_metrics_from_bench(doc: dict) -> dict[str, float]:
     return m
 
 
-def load_gate_metrics(path: str) -> tuple[str, dict[str, float]]:
+def gate_metrics_from_fleet(fs: dict) -> dict[str, float]:
+    """Flatten a ``summarize_fleet`` view into gateable metrics — the
+    whole-fleet gate the multichip sweeps use, so a balance/overlap
+    regression on process 3 trips even though process 0's own summary
+    looks fine. Telemetry-health counts (missing shards, unmatched
+    correlated events) gate exact; per-phase imbalance and exchange wait
+    gate loose (wall-derived); the overlap ratio gates on PRESENCE via
+    the standard ``re_shard/exchange_overlap_ratio`` tier, taken as the
+    fleet MINIMUM (the worst process is the one a regression hides in)."""
+    m: dict[str, float] = {
+        "fleet/processes": float(fs.get("process_count") or 0),
+        "fleet/missing_shards": float(fs.get("missing_shards") or 0),
+        "fleet/unmatched_p2p": float(
+            (fs.get("p2p") or {}).get("unmatched") or 0
+        ),
+        "fleet/wall_s": float(fs.get("wall_s") or 0.0),
+    }
+    p2p = fs.get("p2p") or {}
+    if p2p.get("links"):
+        m["fleet/p2p_bytes_total"] = float(
+            sum(a["bytes"] for a in p2p["links"].values())
+        )
+    for ph, agg in (fs.get("phases") or {}).items():
+        if agg.get("imbalance") is not None:
+            m[f"fleet/phase/{ph}/imbalance"] = float(agg["imbalance"])
+    if fs.get("overlap"):
+        m["re_shard/exchange_overlap_ratio"] = float(
+            min(fs["overlap"].values())
+        )
+    for k, e in (fs.get("exchange") or {}).items():
+        m[f"fleet/p{k}/exchange_wait_s"] = float(e["wait_s"])
+    # placement readouts are identical on every process; gate the fleet
+    # MAX so one disagreeing shard (itself a bug) can only look worse
+    for name in ("balance", "rows_max"):
+        vals = [
+            (s.get("re_shard") or {}).get(name)
+            for s in (fs.get("processes") or {}).values()
+        ]
+        vals = [float(v) for v in vals if isinstance(v, (int, float))]
+        if vals:
+            m[f"re_shard/{name}"] = max(vals)
+    return m
+
+
+def load_gate_metrics(
+    path: str, fleet: bool = False
+) -> tuple[str, dict[str, float]]:
     """(kind, metrics) from any gate-readable artifact: a telemetry run
     JSONL (or a telemetry DIR — newest run wins), a ``bench.py`` JSON
     document, or a gate-baseline file written by ``report gate
-    --write-baseline``."""
+    --write-baseline``. ``fleet=True`` loads a telemetry run (file or
+    dir) as the MERGED fleet view — canonical file plus every ``.p<k>``
+    shard — instead of process 0's summary alone; saved gate-baseline
+    files still load as baselines."""
+    if fleet:
+        doc = None
+        if not os.path.isdir(path):
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except json.JSONDecodeError:
+                doc = None
+        if isinstance(doc, dict) and doc.get("gate_baseline"):
+            return "baseline", {
+                k: float(v) for k, v in (doc.get("metrics") or {}).items()
+                if isinstance(v, (int, float))
+            }
+        if isinstance(doc, dict) and (
+            "configs" in doc or "telemetry" in doc
+        ) and doc.get("event") != "run_start":
+            # the EITHER-side contract holds under --fleet too: a
+            # bench.py JSON document is a valid (non-fleet) side
+            return "bench", gate_metrics_from_bench(doc)
+        return "fleet", gate_metrics_from_fleet(
+            summarize_fleet(fleet_run_paths(path))
+        )
     if os.path.isdir(path):
         run = latest_run(path)
         if run is None:

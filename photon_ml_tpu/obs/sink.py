@@ -21,9 +21,18 @@ per-iteration record volume is a few hundred bytes each; even a
 day-long sweep stays in the tens of MB). A workload emitting orders of
 magnitude more should thin its per-iteration records, not the spans.
 
-Multihost: only the output process (``jax.process_index() == 0``) writes
-by default — ``configure`` returns a disabled subsystem elsewhere, the
-same single-writer discipline the drivers use for models and metrics.
+Multihost: process 0 writes the canonical ``run-<id>.jsonl`` — the file
+every existing consumer reads unchanged. Under **fleet telemetry**
+(``PHOTON_TELEMETRY_FLEET``; defaults to the ``PHOTON_RE_SHARD`` knob,
+because the sharded random-effect schedule is exactly the workload whose
+phase walls, exchange waits and per-link transfers live on processes
+1..N-1) every non-zero process writes its own schema-versioned shard
+``run-<id>.p<k>.jsonl`` under the same atomic-rotation durability
+contract; ``photon-ml-tpu report fleet`` joins the canonical file and
+its shards into one per-process view. With fleet telemetry off (the
+default), ``configure`` on a non-zero process returns a disabled
+subsystem exactly as before — the same single-writer discipline the
+drivers use for models and metrics, byte for byte.
 
 Disabled fast path: when no sink is configured, ``emit`` is a single
 attribute check and every ``span()`` returns a shared no-op context
@@ -80,14 +89,27 @@ class TelemetrySink:
 
     _seq = itertools.count()  # same-second same-process runs stay distinct
 
-    def __init__(self, directory: str, run_id: str | None = None):
+    def __init__(
+        self,
+        directory: str,
+        run_id: str | None = None,
+        shard_index: int | None = None,
+    ):
         os.makedirs(directory, exist_ok=True)
         self.run_id = run_id or (
             time.strftime("%Y%m%dT%H%M%S")
             + f"-{os.getpid()}-{next(self._seq)}"
         )
         self.directory = directory
-        self.path = os.path.join(directory, f"run-{self.run_id}.jsonl")
+        # shard_index k > 0: one process's slice of a FLEET run —
+        # ``run-<id>.p<k>.jsonl`` next to process 0's canonical
+        # ``run-<id>.jsonl`` (which keeps its name so every
+        # single-process consumer reads it unchanged)
+        self.shard_index = shard_index
+        suffix = f".p{shard_index}" if shard_index else ""
+        self.path = os.path.join(
+            directory, f"run-{self.run_id}{suffix}.jsonl"
+        )
         self._lock = threading.Lock()
         self._lines: list[str] = []
         self._pending = 0
@@ -155,6 +177,53 @@ def _process_index() -> int:
         return 0
 
 
+def _process_count() -> int:
+    try:
+        import jax
+
+        return int(jax.process_count())
+    except Exception:
+        return 1
+
+
+def fleet_telemetry_enabled() -> bool:
+    """Fleet telemetry knob: ``PHOTON_TELEMETRY_FLEET`` (strict int parse
+    like the sibling knobs — a typo fails loudly). Unset, it follows
+    ``PHOTON_RE_SHARD``: the sharded random-effect schedule is exactly
+    the workload whose telemetry lives on processes 1..N-1, and the
+    default keeps every non-sharded multihost run's sink behavior (and
+    file layout) bit-for-bit what it was."""
+    env = os.environ.get("PHOTON_TELEMETRY_FLEET")
+    if env is not None and env != "":
+        return int(env) != 0
+    try:
+        from photon_ml_tpu.parallel.placement import re_shard_enabled
+
+        return re_shard_enabled()
+    except Exception:
+        return False
+
+
+def _fleet_run_id() -> str:
+    """One run id for every process of a fleet run: process 0 generates
+    its usual timestamp id and broadcasts it (the shards must carry the
+    SAME ``<id>`` for ``report fleet`` to join them with the canonical
+    file). Collective — every process reaches ``configure`` at the same
+    program point, the same contract the drivers' multihost init already
+    imposes. Callers that need to avoid the collective pass an explicit
+    ``run_id`` (the bench harness does)."""
+    import numpy as np
+
+    from photon_ml_tpu.parallel.multihost import broadcast_from_host0
+
+    rid = time.strftime("%Y%m%dT%H%M%S") + f"-{os.getpid()}"
+    buf = np.zeros(64, np.uint8)
+    raw = rid.encode()[:64]
+    buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+    out = np.asarray(broadcast_from_host0(buf), np.uint8)
+    return bytes(out[out != 0]).decode()
+
+
 def configure(
     telemetry_dir: str | None,
     run_id: str | None = None,
@@ -162,35 +231,50 @@ def configure(
 ) -> str | None:
     """Enable telemetry into ``telemetry_dir`` and return the run file's
     path. ``None`` leaves telemetry disabled (the CLI drivers call this
-    unconditionally with their ``--telemetry-dir`` value). Multihost: only
-    the output process writes unless ``force_writer=True``. Re-configuring
-    closes any previous run's sink first."""
+    unconditionally with their ``--telemetry-dir`` value). Multihost: the
+    output process writes the canonical run file; under fleet telemetry
+    (``fleet_telemetry_enabled``) every other process writes its own
+    ``.p<k>`` shard, otherwise it gets a disabled subsystem unless
+    ``force_writer=True``. Re-configuring closes any previous run's sink
+    first."""
     global _ACTIVE
     with _state_lock:
         if _ACTIVE is not None:
             _shutdown_locked()
         if telemetry_dir is None:
             return None
-        writer = force_writer if force_writer is not None \
-            else _process_index() == 0
+        pidx = _process_index()
+        fleet = _process_count() > 1 and fleet_telemetry_enabled()
+        if fleet and run_id is None and force_writer is None:
+            # collective: every process must agree on the shard-join id
+            run_id = _fleet_run_id()
+        writer = force_writer if force_writer is not None else pidx == 0
+        shard_index = None
         if not writer:
-            return None
-        sink = TelemetrySink(telemetry_dir, run_id=run_id)
-        sink.emit(
-            {
-                "event": "run_start",
-                "t": time.time(),
-                "schema_version": SCHEMA_VERSION,
-                "run_id": sink.run_id,
-                "pid": os.getpid(),
-                "process_index": _process_index(),
-                "knobs": _knob_snapshot(),
-                # the registry is PROCESS-cumulative; the baseline lets a
-                # reader (obs/report) delta run_end down to THIS run's
-                # share when several runs live in one process
-                "metrics_baseline": _metrics.REGISTRY.snapshot(),
-            }
+            if not fleet:
+                return None
+            shard_index = pidx
+        sink = TelemetrySink(
+            telemetry_dir, run_id=run_id, shard_index=shard_index
         )
+        record = {
+            "event": "run_start",
+            "t": time.time(),
+            "schema_version": SCHEMA_VERSION,
+            "run_id": sink.run_id,
+            "pid": os.getpid(),
+            "process_index": pidx,
+            "knobs": _knob_snapshot(),
+            # the registry is PROCESS-cumulative; the baseline lets a
+            # reader (obs/report) delta run_end down to THIS run's
+            # share when several runs live in one process
+            "metrics_baseline": _metrics.REGISTRY.snapshot(),
+        }
+        if fleet:
+            # only fleet runs carry the field: a single-process (or
+            # fleet-off) run's file stays byte-for-byte what it was
+            record["fleet"] = {"process_count": _process_count()}
+        sink.emit(record)
         _ACTIVE = sink
         _install_jax_monitoring()
         return sink.path

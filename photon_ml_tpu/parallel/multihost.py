@@ -24,6 +24,7 @@ standard env vars (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``,
 from __future__ import annotations
 
 import os
+import time
 from typing import Sequence
 
 import jax
@@ -298,7 +299,7 @@ def _a2a_cache_size() -> int:
         return 0
 
 
-def exchange_rows(arrays, dest: np.ndarray):
+def exchange_rows(arrays, dest: np.ndarray, tag: str = ""):
     """Deliver row ``i`` of every array to process ``dest[i]`` — the
     point-to-point shuffle the reference does with a Spark exchange.
 
@@ -324,7 +325,9 @@ def exchange_rows(arrays, dest: np.ndarray):
     ascending order — every process receives with the same layout rule, so
     the result is deterministic and transport-independent). Single
     process: identity. All processes must call this collectively with the
-    same key set.
+    same key set. ``tag`` labels the exchange in telemetry (the per-link
+    ``p2p_send``/``p2p_recv`` events of the framed transport carry it);
+    it never affects routing or results.
     """
     arrays = {k: np.asarray(v) for k, v in arrays.items()}
     P_ = jax.process_count()
@@ -356,7 +359,9 @@ def exchange_rows(arrays, dest: np.ndarray):
         # one global socket-use order: never interleave with an in-flight
         # worker-thread exchange mid-frame (no-op when none are pending)
         drain_async_exchanges()
-        return _host_p2p_exchange(arrays, order, starts, counts_matrix)
+        return _host_p2p_exchange(
+            arrays, order, starts, counts_matrix, tag=tag
+        )
 
     from photon_ml_tpu.obs import devcost
 
@@ -399,6 +404,44 @@ def exchange_rows(arrays, dest: np.ndarray):
 # transport: {"send": {peer: socket}, "recv": {peer: socket}}
 _HOST_LINKS: dict | None = None
 
+# per-link frame-set sequence counters for TELEMETRY CORRELATION: the
+# framed exchange's submission-order invariant (every process issues the
+# same exchange sequence at the same program points) means the k-th
+# frame-set SENT on link i→j is exactly the k-th frame-set RECEIVED on
+# that link at j — so both ends derive the same correlation id
+# ``p2p:<src>><dst>#<k>`` with zero extra bytes on the wire, and
+# ``report fleet`` joins each link's send/recv events across shard
+# files by that id (one-sided wait = recv-start − send-start).
+# Incremented UNCONDITIONALLY (not sink-gated): a process whose sink
+# activates mid-sequence must still agree with its peers on k.
+_LINK_SEQ: dict = {"send": {}, "recv": {}}
+
+
+def _next_link_seq(direction: str, peer: int) -> int:
+    seqs = _LINK_SEQ[direction]
+    seqs[peer] = seqs.get(peer, 0) + 1
+    return seqs[peer]
+
+
+def _sink_active() -> bool:
+    """Whether telemetry is on (cheap; the exchange hot path must stay
+    byte-identical when it is not)."""
+    try:
+        from photon_ml_tpu.obs import sink as _sink
+
+        return _sink.is_active()
+    except Exception:
+        return False
+
+
+def _emit_event(event: str, **payload) -> None:
+    try:
+        from photon_ml_tpu.obs.spans import emit_event
+
+        emit_event(event, **payload)
+    except Exception:
+        pass  # telemetry must never take down the exchange it observes
+
 
 def _reset_host_links() -> None:
     """Close every cached exchange socket and drop THIS process's mesh so
@@ -414,6 +457,12 @@ def _reset_host_links() -> None:
     caller-level collective retry converges to a full mesh rebuild."""
     global _HOST_LINKS
     links, _HOST_LINKS = _HOST_LINKS, None
+    # correlation counters restart with the mesh: after a teardown both
+    # ends rebuild and resynchronize at frame-set 1 (frames lost to the
+    # error surface as UNMATCHED send/recv events in ``report fleet`` —
+    # the telemetry-health signal, by design)
+    _LINK_SEQ["send"] = {}
+    _LINK_SEQ["recv"] = {}
     if not links:
         return
     for side in ("send", "recv"):
@@ -549,14 +598,76 @@ def _configure_link_socket(sock) -> None:
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
 
-def _recv_exact(sock, n: int) -> bytes:
+def _p2p_heartbeat_s() -> float | None:
+    """Blocked-recv heartbeat cadence, knob ``PHOTON_P2P_HEARTBEAT_S``
+    (seconds; ``0`` or negative disables). While a framed-P2P recv is
+    blocked on a silent peer, the exchange emits one rate-limited
+    ``p2p_heartbeat`` telemetry event per interval — so a stuck link is
+    visible (with its peer, tag and blocked seconds) in the run's shard
+    file long before the ``PHOTON_P2P_TIMEOUT_S`` abort (default 300 s)
+    tears the mesh down."""
+    env = os.environ.get("PHOTON_P2P_HEARTBEAT_S")
+    if env is not None and env != "":
+        v = float(env)
+        return v if v > 0 else None
+    return 5.0
+
+
+def _recv_exact(sock, n: int, peer: int | None = None,
+                tag: str | None = None,
+                heartbeat: float | None = None) -> bytes:
+    """``heartbeat=None`` (the default, and always when no sink is
+    active — callers snapshot that ONCE per exchange) is the plain
+    pre-heartbeat recv, byte-identical to the original hot path."""
+    if heartbeat is None:
+        chunks = []
+        while n:
+            part = sock.recv(min(n, 1 << 20))
+            if not part:
+                raise ConnectionError("exchange peer closed the connection")
+            chunks.append(part)
+            n -= len(part)
+        return b"".join(chunks)
+    # heartbeat path: poll readiness so a silent peer surfaces in
+    # telemetry every ``heartbeat`` seconds; the knob timeout keeps its
+    # exact semantics (max SILENCE, the same contract settimeout gives
+    # the plain path — the clock resets whenever bytes arrive).
+    # selectors (epoll/poll on Linux), NOT select.select: the exchange
+    # mesh plus chunk cache plus JAX can push socket fds past
+    # FD_SETSIZE (1024), where select() raises — the instrument must
+    # never crash an exchange the plain path would have completed.
+    import selectors
+
+    timeout_s = _p2p_timeout_s()
     chunks = []
-    while n:
-        part = sock.recv(min(n, 1 << 20))
-        if not part:
-            raise ConnectionError("exchange peer closed the connection")
-        chunks.append(part)
-        n -= len(part)
+    silent = 0.0
+    with selectors.DefaultSelector() as sel:
+        sel.register(sock, selectors.EVENT_READ)
+        while n:
+            t0 = time.perf_counter()
+            ready = sel.select(timeout=heartbeat)
+            if not ready:
+                silent += time.perf_counter() - t0
+                _emit_event(
+                    "p2p_heartbeat", peer=peer, tag=tag,
+                    blocked_s=silent, bytes_remaining=n,
+                )
+                if timeout_s is not None and silent >= timeout_s:
+                    import socket as _socket
+
+                    raise _socket.timeout(
+                        f"exchange recv from process {peer} silent for "
+                        f"{silent:.1f}s (PHOTON_P2P_TIMEOUT_S)"
+                    )
+                continue
+            part = sock.recv(min(n, 1 << 20))
+            if not part:
+                raise ConnectionError(
+                    "exchange peer closed the connection"
+                )
+            silent = 0.0
+            chunks.append(part)
+            n -= len(part)
     return b"".join(chunks)
 
 
@@ -627,7 +738,7 @@ def _host_links() -> dict:
 
 
 def _host_p2p_exchange(arrays, order, starts, counts_matrix=None,
-                       transport="p2p_host"):
+                       transport="p2p_host", tag=""):
     """Skew-robust transport for ``exchange_rows``: each (source, dest)
     bucket travels EXACTLY, length-prefixed, over its pair's dedicated TCP
     link — no padding under any skew (an SPMD collective must pad every
@@ -646,7 +757,7 @@ def _host_p2p_exchange(arrays, order, starts, counts_matrix=None,
     """
     try:
         return _host_p2p_exchange_impl(
-            arrays, order, starts, counts_matrix, transport
+            arrays, order, starts, counts_matrix, transport, tag
         )
     except BaseException:
         # closing the sockets also unblocks a sender thread stuck in
@@ -656,7 +767,7 @@ def _host_p2p_exchange(arrays, order, starts, counts_matrix=None,
 
 
 def _host_p2p_exchange_impl(arrays, order, starts, counts_matrix,
-                            transport="p2p_host"):
+                            transport="p2p_host", tag=""):
     """``counts_matrix=None`` is the COLLECTIVE-FREE framing mode (the
     overlapped exchange schedule): each bucket's row count is derived
     from its length prefix instead of a pre-exchanged (P, P) count
@@ -680,6 +791,11 @@ def _host_p2p_exchange_impl(arrays, order, starts, counts_matrix,
     }
     bytes_sent = 0
     send_err: list[BaseException] = []
+    # snapshot ONCE per exchange: the env knob and the sink check stay
+    # off the per-frame hot path, and a concurrent sink reconfigure
+    # cannot flip the recv framing mid-exchange
+    telemetry = _sink_active()
+    heartbeat = _p2p_heartbeat_s() if telemetry else None
 
     def send_all():
         nonlocal bytes_sent
@@ -687,12 +803,30 @@ def _host_p2p_exchange_impl(arrays, order, starts, counts_matrix,
             for r in range(1, P_):
                 peer = (pid + r) % P_
                 sock = links["send"][peer]
+                seq = _next_link_seq("send", peer)
+                t_start = time.time()
+                t0 = time.perf_counter()
+                peer_bytes = 0
                 for k in keys:
                     rows = order[starts[peer]:starts[peer + 1]]
                     buf = np.ascontiguousarray(arrays[k][rows]).tobytes()
                     sock.sendall(struct.pack("!q", len(buf)))
                     sock.sendall(buf)
-                    bytes_sent += len(buf)
+                    peer_bytes += len(buf)
+                bytes_sent += peer_bytes
+                if telemetry:
+                    # one event per (link, exchange): the frame-set, not
+                    # per key — report fleet joins it with the peer's
+                    # p2p_recv through the shared correlation id
+                    _emit_event(
+                        "p2p_send", peer=peer,
+                        bytes=peer_bytes,
+                        rows=int(starts[peer + 1] - starts[peer]),
+                        dur_s=time.perf_counter() - t0,
+                        t_start=t_start,
+                        corr=f"p2p:{pid}>{peer}#{seq}",
+                        tag=tag, transport=transport,
+                    )
         except BaseException as e:  # surfaced after join
             send_err.append(e)
 
@@ -701,13 +835,20 @@ def _host_p2p_exchange_impl(arrays, order, starts, counts_matrix,
     for r in range(1, P_):
         src = (pid - r) % P_
         sock = links["recv"][src]
+        seq = _next_link_seq("recv", src)
+        t_start = time.time()
+        t0 = time.perf_counter()
+        src_bytes = 0
+        src_rows = 0
         n_src: int | None = None  # framed mode: all keys must agree
         for k in keys:
             a = arrays[k]
             row_bytes = a.itemsize * int(
                 np.prod(a.shape[1:], dtype=np.int64)
             )
-            got = struct.unpack("!q", _recv_exact(sock, 8))[0]
+            got = struct.unpack(
+                "!q", _recv_exact(sock, 8, src, tag, heartbeat)
+            )[0]
             if counts_matrix is not None:
                 n = int(counts_matrix[src, pid])
                 want = n * row_bytes
@@ -732,10 +873,21 @@ def _host_p2p_exchange_impl(arrays, order, starts, counts_matrix,
                         f"row count: key {k!r} carries {n} rows, earlier "
                         f"keys carried {n_src}"
                     )
-            raw = _recv_exact(sock, got)
+            raw = _recv_exact(sock, got, src, tag, heartbeat)
+            src_bytes += got
+            src_rows = n
             parts[k][src] = np.frombuffer(raw, a.dtype).reshape(
                 (n,) + a.shape[1:]
             ).copy()
+        if telemetry:
+            _emit_event(
+                "p2p_recv", peer=src,
+                bytes=src_bytes, rows=int(src_rows),
+                dur_s=time.perf_counter() - t0,
+                t_start=t_start,
+                corr=f"p2p:{src}>{pid}#{seq}",
+                tag=tag, transport=transport,
+            )
     sender.join()
     if send_err:
         raise send_err[0]
@@ -814,11 +966,14 @@ class ExchangeHandle:
     exchange lands and returns the received-rows dict (the same layout
     contract as ``exchange_rows``); the blocked seconds are recorded as
     ``re_exchange.wait_s`` against the worker's ``re_exchange.exchange_s``
-    for the overlap-ratio gauge."""
+    for the overlap-ratio gauge (and, with a sink active, emitted as an
+    ``exchange_wait`` event so the per-process timeline shows where the
+    consumer actually blocked)."""
 
-    def __init__(self, future=None, value=None):
+    def __init__(self, future=None, value=None, tag: str = ""):
         self._future = future
         self._value = value
+        self._tag = tag
 
     @property
     def done(self) -> bool:
@@ -833,7 +988,12 @@ class ExchangeHandle:
         try:
             out = self._future.result()
         finally:
-            _record_overlap("wait_s", _time.perf_counter() - t0)
+            waited = _time.perf_counter() - t0
+            _record_overlap("wait_s", waited)
+            if _sink_active():
+                _emit_event(
+                    "exchange_wait", tag=self._tag, wait_s=waited
+                )
             _, lock = _exchange_state()
             with lock:
                 if self._future in _PENDING_EXCHANGES:
@@ -859,7 +1019,9 @@ def drain_async_exchanges() -> None:
             pass
 
 
-def exchange_rows_async(arrays, dest: np.ndarray) -> ExchangeHandle:
+def exchange_rows_async(
+    arrays, dest: np.ndarray, tag: str = ""
+) -> ExchangeHandle:
     """Issue ``exchange_rows`` without blocking: returns a handle whose
     ``result()`` yields the identical received-rows layout. Transport is
     ALWAYS the framed host P2P path (collective-free — the worker thread
@@ -894,15 +1056,18 @@ def exchange_rows_async(arrays, dest: np.ndarray) -> ExchangeHandle:
         try:
             return _host_p2p_exchange(
                 arrays, order, starts, counts_matrix=None,
-                transport="p2p_host_async",
+                transport="p2p_host_async", tag=tag,
             )
         finally:
-            _record_overlap("exchange_s", _time.perf_counter() - t0)
+            dur = _time.perf_counter() - t0
+            _record_overlap("exchange_s", dur)
+            if _sink_active():
+                _emit_event("exchange", tag=tag, dur_s=dur)
 
     fut = pool.submit(run)
     with lock:
         _PENDING_EXCHANGES.append(fut)
-    return ExchangeHandle(future=fut)
+    return ExchangeHandle(future=fut, tag=tag)
 
 
 def allreduce_max_host(*arrays: np.ndarray):

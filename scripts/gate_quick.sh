@@ -9,20 +9,32 @@
 # planner is deterministic — and re_shard.exchange_overlap_ratio, gated
 # on PRESENCE: losing the overlap instrument fails the gate even though
 # its value can only improve). Multi-process wall/overlap captures live
-# in MULTICHIP_r06.json (`python bench.py --multichip-r06`).
+# in MULTICHIP_r07.json (`python bench.py --multichip-r07`).
+#
+# A FLEET leg follows the quick gate: `report fleet` + `report gate
+# --fleet` run over the committed multichip shard artifacts in
+# telemetry_r06/ (canonical run + its .p<k> shards, gated against
+# BASELINE_fleet_cpu.json) AND over a synthetic 2-shard fixture — so a
+# shard-loading / correlation-join / fleet-gate regression fails in the
+# same one command as a byte/flop regression.
 #
 # Usage:
 #   scripts/gate_quick.sh                      # gate vs BASELINE_cost_cpu.json
 #   scripts/gate_quick.sh MY_BASELINE.json     # gate vs another baseline
-#   UPDATE_BASELINE=1 scripts/gate_quick.sh    # re-capture the baseline
+#   UPDATE_BASELINE=1 scripts/gate_quick.sh    # re-capture the baselines
 #
 # The baseline is a verbatim `bench.py --quick` stdout capture (the
 # single-JSON-line contract); re-capture it whenever an INTENTIONAL cost
 # change lands, and commit the diff with the change that caused it.
+# UPDATE_BASELINE=1 also rewrites BASELINE_fleet_cpu.json from the
+# committed telemetry_r06/ artifacts (re-run `bench.py --multichip-r07`
+# first when the multichip capture itself changed).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 baseline="${1:-BASELINE_cost_cpu.json}"
+fleet_run="telemetry_r06/run-MULTICHIP_r06_skew_aware_P2.jsonl"
+fleet_baseline="BASELINE_fleet_cpu.json"
 out="$(mktemp)"
 trap 'rm -f "$out"' EXIT
 
@@ -42,7 +54,63 @@ if bad:
 PY
     cp "$out" "$baseline"
     echo "gate_quick: baseline re-captured to $baseline"
+    python -m photon_ml_tpu.cli.main report gate --fleet "$fleet_run" \
+        --write-baseline "$fleet_baseline"
+    echo "gate_quick: fleet baseline re-captured to $fleet_baseline"
     exit 0
 fi
 
 python -m photon_ml_tpu.cli.main report gate "$out" --baseline "$baseline"
+
+# ---- fleet leg: committed multichip shards + a synthetic fixture ----------
+python -m photon_ml_tpu.cli.main report fleet "$fleet_run" > /dev/null
+python -m photon_ml_tpu.cli.main report gate --fleet "$fleet_run" \
+    --baseline "$fleet_baseline"
+
+# synthetic 2-shard fixture: shard discovery, the correlated send/recv
+# join (zero unmatched on a clean run) and the fleet self-gate, with no
+# dependency on the committed artifacts' content
+python - <<'PY'
+import os, shutil, sys, tempfile
+
+from photon_ml_tpu.obs.sink import TelemetrySink
+from photon_ml_tpu.obs.report import (
+    fleet_run_paths, gate_metrics_from_fleet, gate_run, summarize_fleet,
+)
+
+d = tempfile.mkdtemp(prefix="fleet_fixture_")
+import atexit
+atexit.register(shutil.rmtree, d, True)
+t0 = 1000.0
+for pidx, shard in ((0, None), (1, 1)):
+    s = TelemetrySink(d, run_id="FX", shard_index=shard)
+    s.emit({"event": "run_start", "t": t0, "schema_version": 1,
+            "run_id": "FX", "pid": pidx, "process_index": pidx,
+            "knobs": {}, "fleet": {"process_count": 2},
+            "metrics_baseline": {}})
+    s.emit({"event": "span", "t": t0 + 0.1, "name": "descent/iter",
+            "span_id": 1, "parent_id": None, "tid": 1, "thread": "M",
+            "dur_s": 1.0 + pidx})
+    peer = 1 - pidx
+    s.emit({"event": "p2p_send", "t": t0 + 0.2, "peer": peer,
+            "bytes": 64, "rows": 2, "dur_s": 0.01, "t_start": t0 + 0.2,
+            "corr": f"p2p:{pidx}>{peer}#1", "tag": "offsets",
+            "transport": "p2p_host_async"})
+    s.emit({"event": "p2p_recv", "t": t0 + 0.4, "peer": peer,
+            "bytes": 64, "rows": 2, "dur_s": 0.01, "t_start": t0 + 0.4,
+            "corr": f"p2p:{peer}>{pidx}#1", "tag": "offsets",
+            "transport": "p2p_host_async"})
+    s.emit({"event": "run_end", "t": t0 + 2.0, "run_id": "FX",
+            "metrics": {"counters": {}, "gauges": {}, "histograms": {},
+                        "timers": {}}})
+    s.close()
+paths = fleet_run_paths(d)
+assert len(paths) == 2 and paths[1].endswith(".p1.jsonl"), paths
+fs = summarize_fleet(paths)
+assert fs["process_count"] == 2, fs["process_count"]
+assert fs["p2p"]["matched"] == 2 and fs["p2p"]["unmatched"] == 0, fs["p2p"]
+m = gate_metrics_from_fleet(fs)
+failures, _ = gate_run(m, m)
+assert not failures, failures
+print("gate_quick: synthetic 2-shard fleet fixture OK")
+PY

@@ -698,8 +698,8 @@ _TRAFFIC_WORKER = textwrap.dedent(
     # record every per-visit exchange's accounting
     calls = []
     orig = mh.exchange_rows
-    def recording(arrays, dest):
-        out = orig(arrays, dest)
+    def recording(arrays, dest, **kw):
+        out = orig(arrays, dest, **kw)
         calls.append(dict(mh.LAST_EXCHANGE_STATS, n_keys=len(arrays)))
         return out
     mh.exchange_rows = recording
@@ -971,8 +971,8 @@ _SKEW_WORKER = textwrap.dedent(
     # p2p. (Extends the P=2 uniform traffic test — VERDICT r4 next-4.)
     calls = []
     orig = mh.exchange_rows
-    def recording(arrays, dest):
-        res = orig(arrays, dest)
+    def recording(arrays, dest, **kw):
+        res = orig(arrays, dest, **kw)
         calls.append(dict(mh.LAST_EXCHANGE_STATS, n_keys=len(arrays)))
         return res
     mh.exchange_rows = recording
@@ -1795,3 +1795,356 @@ class TestAsyncExchangeSingleProcess:
         g = REGISTRY.snapshot("re_shard.")["gauges"]
         assert "re_shard.exchange_overlap_ratio" in g
         assert 0.0 <= g["re_shard.exchange_overlap_ratio"] <= 1.0
+
+
+class TestP2PTelemetry:
+    """Unmarked host-side tests for the per-link telemetry the framed
+    exchange emits: correlated send/recv events (both ends derive the
+    same id from the submission-order frame-set counters), the blocked-
+    recv heartbeat, and the no-sink fast path staying event-free."""
+
+    def _sink(self, tmp_path):
+        import photon_ml_tpu.obs as obs
+
+        return obs.configure(str(tmp_path / "tel"), run_id="p2p")
+
+    def _records(self, path):
+        import photon_ml_tpu.obs as obs
+        from photon_ml_tpu.obs.report import load_run
+
+        obs.shutdown()
+        return load_run(path)
+
+    def test_framed_exchange_emits_correlated_link_events(
+        self, tmp_path, monkeypatch
+    ):
+        import struct
+
+        import jax
+
+        import photon_ml_tpu.obs as obs
+        import photon_ml_tpu.parallel.multihost as mh
+
+        class FrameSock:
+            def __init__(self, frames):
+                self.buf = b"".join(
+                    struct.pack("!q", len(f)) + f for f in frames
+                )
+
+            def recv(self, n):
+                out, self.buf = self.buf[:n], self.buf[n:]
+                return out
+
+            def fileno(self):  # select() in the heartbeat path
+                raise AssertionError(
+                    "heartbeat path must not engage when data is ready"
+                )
+
+            def sendall(self, *_):
+                pass
+
+            def close(self):
+                pass
+
+        path = self._sink(tmp_path)
+        # peer 1 sends 2 f32 rows (8 bytes) in framed mode
+        links = {
+            "send": {1: FrameSock([])},
+            "recv": {1: FrameSock([np.arange(2, dtype=np.float32)
+                                   .tobytes()])},
+        }
+        monkeypatch.setattr(mh, "_HOST_LINKS", links)
+        monkeypatch.setattr(mh, "_host_links", lambda: links)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        monkeypatch.setattr(
+            mh, "_LINK_SEQ", {"send": {}, "recv": {}}
+        )
+        # heartbeat would need select(); frames are pre-buffered, so
+        # disable it — the plain recv path must emit the same events
+        monkeypatch.setenv("PHOTON_P2P_HEARTBEAT_S", "0")
+        try:
+            arrays = {"v": np.arange(4, dtype=np.float32)}
+            order = np.arange(4, dtype=np.int64)
+            starts = np.asarray([0, 2, 4], np.int64)
+            out = mh._host_p2p_exchange(
+                arrays, order, starts, None, tag="offsets"
+            )
+            # own rows (order[0:2]) then peer 1's 2-row frame
+            np.testing.assert_array_equal(
+                out["v"],
+                np.concatenate([arrays["v"][:2], [0.0, 1.0]]),
+            )
+        finally:
+            records = self._records(path)
+        sends = [r for r in records if r["event"] == "p2p_send"]
+        recvs = [r for r in records if r["event"] == "p2p_recv"]
+        assert len(sends) == 1 and len(recvs) == 1
+        # this end's send to peer 1 is frame-set #1 of link 0->1; its
+        # recv from peer 1 is frame-set #1 of link 1->0 — the ids peer
+        # 1's shard derives for the SAME frame-sets, so a fleet report
+        # joins them with zero unmatched pairs
+        assert sends[0]["corr"] == "p2p:0>1#1"
+        assert recvs[0]["corr"] == "p2p:1>0#1"
+        for r in sends + recvs:
+            assert r["tag"] == "offsets"
+            assert r["bytes"] == 8 and r["rows"] == 2
+            assert "t_start" in r and "dur_s" in r
+
+    def test_link_seq_advances_and_resets_with_mesh(self, monkeypatch):
+        import photon_ml_tpu.parallel.multihost as mh
+
+        monkeypatch.setattr(
+            mh, "_LINK_SEQ", {"send": {}, "recv": {}}
+        )
+        assert mh._next_link_seq("send", 1) == 1
+        assert mh._next_link_seq("send", 1) == 2
+        assert mh._next_link_seq("recv", 1) == 1
+        assert mh._next_link_seq("send", 2) == 1
+        monkeypatch.setattr(mh, "_HOST_LINKS", None)
+        mh._reset_host_links()
+        assert mh._LINK_SEQ == {"send": {}, "recv": {}}
+
+    def test_heartbeat_surfaces_blocked_recv_before_timeout(
+        self, tmp_path, monkeypatch
+    ):
+        """A silent peer: the framed recv emits rate-limited heartbeat
+        events while blocked, then raises within the knob budget."""
+        import socket
+
+        import photon_ml_tpu.parallel.multihost as mh
+
+        monkeypatch.setenv("PHOTON_P2P_TIMEOUT_S", "0.25")
+        path = self._sink(tmp_path)
+        a, b = socket.socketpair()
+        try:
+            with pytest.raises((socket.timeout, TimeoutError)):
+                mh._recv_exact(a, 8, peer=1, tag="scores",
+                               heartbeat=0.05)
+        finally:
+            records = self._records(path)
+            a.close()
+            b.close()
+        beats = [r for r in records if r["event"] == "p2p_heartbeat"]
+        # ~0.25s budget at 0.05s cadence: several beats, each naming
+        # the silent peer and the blocked wall so far
+        assert len(beats) >= 2
+        assert all(r["peer"] == 1 and r["tag"] == "scores"
+                   for r in beats)
+        assert beats[-1]["blocked_s"] >= beats[0]["blocked_s"]
+        assert all(r["bytes_remaining"] == 8 for r in beats)
+
+    def test_heartbeat_path_preserves_payload(self, tmp_path):
+        """Bytes that arrive while the heartbeat loop polls are
+        reassembled exactly (the telemetry path must not reframe)."""
+        import socket
+        import threading
+        import time
+
+        import photon_ml_tpu.obs as obs
+        import photon_ml_tpu.parallel.multihost as mh
+
+        path = obs.configure(str(tmp_path / "tel2"), run_id="hb2")
+        a, b = socket.socketpair()
+        payload = bytes(range(64)) * 4
+
+        def drip():
+            for i in range(0, len(payload), 32):
+                time.sleep(0.02)
+                b.sendall(payload[i:i + 32])
+
+        t = threading.Thread(target=drip)
+        t.start()
+        try:
+            got = mh._recv_exact(a, len(payload), peer=1, tag="x",
+                                 heartbeat=0.05)
+        finally:
+            t.join()
+            obs.shutdown()
+            a.close()
+            b.close()
+        assert got == payload
+
+    def test_no_sink_no_events_and_plain_recv(self, monkeypatch):
+        """Without a sink the exchange stays on the pre-telemetry recv
+        path (no readiness polling, no events) — the hot path is
+        byte-identical: the exchange snapshots heartbeat=None once when
+        no sink is active, and ``_recv_exact`` with heartbeat=None
+        never touches the socket's fd."""
+        import photon_ml_tpu.obs as obs
+        import photon_ml_tpu.parallel.multihost as mh
+
+        obs.shutdown()
+        assert not mh._sink_active()
+
+        class PlainSock:
+            def __init__(self, data):
+                self.data = data
+
+            def recv(self, n):
+                out, self.data = self.data[:n], self.data[n:]
+                return out
+
+            def fileno(self):
+                raise AssertionError("no-sink recv must not poll fds")
+
+        monkeypatch.setenv("PHOTON_P2P_HEARTBEAT_S", "5")
+        assert mh._recv_exact(PlainSock(b"abcd"), 4, peer=1) == b"abcd"
+
+
+_FLEET_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["PHOTON_RE_SHARD"] = "1"
+    coordinator, pid, teldir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+    import numpy as np
+
+    from photon_ml_tpu.parallel.multihost import initialize_multihost
+    initialize_multihost(coordinator, num_processes=2, process_id=pid)
+
+    import photon_ml_tpu.obs as obs
+    # NO run_id: every process must agree through the fleet run-id
+    # broadcast, and processes 1..N-1 must write .p<k> shards
+    run_path = obs.configure(teldir)
+
+    from photon_ml_tpu.config import (
+        GameTrainingConfig, OptimizationConfig, OptimizerConfig,
+        RandomEffectCoordinateConfig, RegularizationContext,
+    )
+    from photon_ml_tpu.game.streaming import (
+        StreamedGameData, StreamedGameTrainer,
+    )
+    from photon_ml_tpu.types import RegularizationType, TaskType
+
+    rng = np.random.default_rng(42)
+    E = 16
+    sizes = np.maximum((60.0 / (1 + np.arange(E)) ** 1.1).astype(int), 3)
+    ids = np.repeat(np.arange(E), sizes).astype(np.int64)
+    ids = ids[rng.permutation(len(ids))]
+    n = len(ids)
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    bounds = np.linspace(0, n, 3).astype(int)
+    lo, hi = bounds[pid], bounds[pid + 1]
+    opt = OptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=8, tolerance=1e-8),
+        regularization=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+    cfg = GameTrainingConfig(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinate_update_sequence=("per_entity",),
+        coordinate_descent_iterations=2,
+        fixed_effect_coordinates={},
+        random_effect_coordinates={
+            "per_entity": RandomEffectCoordinateConfig(
+                random_effect_type="eid", feature_shard_id="r",
+                optimization=opt,
+            )
+        },
+    )
+    data = StreamedGameData(
+        labels=y[lo:hi], features={"r": X[lo:hi]},
+        id_tags={"eid": ids[lo:hi]},
+    )
+    trainer = StreamedGameTrainer(cfg, chunk_rows=1 << 16, multihost=True)
+    model, info = trainer.fit(data)
+    obs.shutdown()
+    print("RESULT " + json.dumps({"pid": pid, "run_path": run_path}))
+    """
+)
+
+
+@pytest.mark.slow
+def test_fleet_telemetry_two_process_shards_and_report(tmp_path):
+    """Fleet-sink acceptance on the 2-process gloo harness: every
+    process writes a parseable, schema-valid shard of ONE run (run id
+    agreed through the broadcast), the correlated send/recv events of
+    the framed exchanges join with ZERO unmatched pairs on a clean run,
+    `report fleet` renders the per-process phase-wall and per-link P2P
+    tables, and `report gate --fleet` passes against a freshly written
+    fleet baseline."""
+    teldir = tmp_path / "tel"
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _FLEET_WORKER, coordinator, str(pid),
+             str(teldir)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for pid in range(2)
+    ]
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err[-4000:]}"
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                r = json.loads(line[len("RESULT "):])
+                results[r["pid"]] = r
+    assert set(results) == {0, 1}
+    # one run id across processes; process 0 canonical, process 1 shard
+    p0, p1 = results[0]["run_path"], results[1]["run_path"]
+    assert p0.endswith(".jsonl") and not p0.endswith(".p1.jsonl")
+    assert p1.endswith(".p1.jsonl")
+    assert os.path.basename(p1) == (
+        os.path.basename(p0)[:-len(".jsonl")] + ".p1.jsonl"
+    )
+
+    from photon_ml_tpu.obs.report import (
+        fleet_run_paths,
+        format_fleet,
+        load_run,
+        summarize_fleet,
+        validate_run,
+    )
+
+    paths = fleet_run_paths(str(teldir))
+    assert [os.path.basename(p) for p in paths] == [
+        os.path.basename(p0), os.path.basename(p1)
+    ]
+    for p in paths:  # every shard parseable + schema-valid
+        assert validate_run(load_run(p)) == []
+    fs = summarize_fleet(paths)
+    assert fs["process_count"] == 2 and fs["missing_shards"] == 0
+    # clean run: every correlated send/recv pair joins
+    assert fs["p2p"]["matched"] > 0
+    assert fs["p2p"]["unmatched"] == 0, fs["p2p"]
+    assert set(fs["p2p"]["links"]) == {"0->1", "1->0"}
+    # per-process phase walls + the overlap gauge from BOTH processes
+    assert set(fs["overlap"]) == {"0", "1"}
+    for agg in fs["phases"].values():
+        assert set(agg["per_process"]) == {"0", "1"}
+    text = format_fleet(fs)
+    assert "0 unmatched" in text and "0->1" in text
+
+    # gate the merged fleet view against a freshly written baseline
+    from photon_ml_tpu.cli import report as cli_report
+
+    base = tmp_path / "fleet-base.json"
+
+    def run_cli(argv):
+        try:
+            cli_report.main(argv)
+        except SystemExit as e:
+            return int(e.code or 0)
+        return 0
+
+    assert run_cli(["gate", "--fleet", p0,
+                    "--write-baseline", str(base)]) == 0
+    assert run_cli(["gate", "--fleet", p0, "--baseline", str(base)]) == 0
+    assert run_cli(["fleet", str(teldir)]) == 0

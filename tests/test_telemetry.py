@@ -695,3 +695,312 @@ class TestEndToEndGame:
         assert any(
             e["name"] == "descent/visit" for e in trace["traceEvents"]
         )
+
+
+# -- fleet telemetry: per-process sink shards + the merged fleet view -------
+
+
+def _write_fleet_fixture(directory, run_id="F1", unmatched=False,
+                         missing_shard=False):
+    """A synthetic 2-process fleet run: canonical file + one .p1 shard,
+    with correlated p2p_send/p2p_recv pairs on both links (frame-set
+    semantics matching parallel/multihost's correlation contract)."""
+    from photon_ml_tpu.obs.sink import TelemetrySink
+
+    t0 = 1_000.0
+
+    def run_start(pidx):
+        return {
+            "event": "run_start", "t": t0 + 0.01 * pidx,
+            "schema_version": obs.SCHEMA_VERSION, "run_id": run_id,
+            "pid": 100 + pidx, "process_index": pidx,
+            "knobs": {"re_shard": 1},
+            "fleet": {"process_count": 2},
+            "metrics_baseline": {},
+        }
+
+    def run_end(pidx, overlap):
+        return {
+            "event": "run_end", "t": t0 + 4.0 + pidx, "run_id": run_id,
+            "metrics": {
+                "counters": {}, "histograms": {},
+                "timers": {
+                    "re_exchange.exchange_s": {"seconds": 0.5, "calls": 2},
+                    "re_exchange.wait_s": {"seconds": 0.1, "calls": 2},
+                },
+                "gauges": {
+                    "re_shard.shards": 2.0,
+                    "re_shard.balance": 1.05,
+                    "re_shard.rows_max": 120.0,
+                    "re_shard.exchange_overlap_ratio": overlap,
+                },
+            },
+        }
+
+    s0 = TelemetrySink(str(directory), run_id=run_id)
+    s0.emit(run_start(0))
+    s0.emit({"event": "span", "t": t0 + 0.1, "name": "descent/iter",
+             "span_id": 1, "parent_id": None, "tid": 1, "thread": "Main",
+             "dur_s": 1.0})
+    s0.emit({"event": "p2p_send", "t": t0 + 0.21, "peer": 1, "bytes": 400,
+             "rows": 10, "dur_s": 0.01, "t_start": t0 + 0.2,
+             "corr": "p2p:0>1#1", "tag": "offsets",
+             "transport": "p2p_host_async"})
+    s0.emit({"event": "p2p_recv", "t": t0 + 0.52, "peer": 1, "bytes": 240,
+             "rows": 6, "dur_s": 0.02, "t_start": t0 + 0.5,
+             "corr": "p2p:1>0#1", "tag": "offsets",
+             "transport": "p2p_host_async"})
+    s0.emit(run_end(0, 0.9))
+    s0.close()
+    if missing_shard:
+        return
+    s1 = TelemetrySink(str(directory), run_id=run_id, shard_index=1)
+    s1.emit(run_start(1))
+    s1.emit({"event": "span", "t": t0 + 0.1, "name": "descent/iter",
+             "span_id": 1, "parent_id": None, "tid": 7, "thread": "Main",
+             "dur_s": 3.0})
+    s1.emit({"event": "p2p_recv", "t": t0 + 0.31, "peer": 0, "bytes": 400,
+             "rows": 10, "dur_s": 0.02, "t_start": t0 + 0.3,
+             "corr": "p2p:0>1#1", "tag": "offsets",
+             "transport": "p2p_host_async"})
+    if not unmatched:
+        s1.emit({"event": "p2p_send", "t": t0 + 0.36, "peer": 0,
+                 "bytes": 240, "rows": 6, "dur_s": 0.01,
+                 "t_start": t0 + 0.35, "corr": "p2p:1>0#1",
+                 "tag": "offsets", "transport": "p2p_host_async"})
+    s1.emit(run_end(1, 0.6))
+    s1.close()
+
+
+class TestFleetSink:
+    def test_shard_sink_filename_and_schema(self, tmp_path):
+        from photon_ml_tpu.obs.sink import TelemetrySink
+
+        s = TelemetrySink(str(tmp_path), run_id="X", shard_index=3)
+        assert s.path.endswith("run-X.p3.jsonl")
+        s.emit({"event": "run_start", "t": 1.0,
+                "schema_version": obs.SCHEMA_VERSION, "run_id": "X",
+                "process_index": 3})
+        s.close()
+        assert validate_run(load_run(s.path)) == []
+
+    def test_configure_single_process_never_shards(self, tmp_path,
+                                                   monkeypatch):
+        """Fleet telemetry is a MULTI-process behavior: on one process
+        the knob changes nothing — canonical filename, no fleet field
+        in run_start (the byte-for-byte compatibility contract)."""
+        monkeypatch.setenv("PHOTON_TELEMETRY_FLEET", "1")
+        path = obs.configure(str(tmp_path / "t"), run_id="solo")
+        obs.shutdown()
+        assert path.endswith("run-solo.jsonl")
+        records = load_run(path)
+        assert "fleet" not in records[0]
+
+    def test_fleet_knob_parses_and_follows_re_shard(self, monkeypatch):
+        from photon_ml_tpu.obs.sink import fleet_telemetry_enabled
+
+        monkeypatch.delenv("PHOTON_TELEMETRY_FLEET", raising=False)
+        monkeypatch.delenv("PHOTON_RE_SHARD", raising=False)
+        assert fleet_telemetry_enabled() is False
+        monkeypatch.setenv("PHOTON_RE_SHARD", "1")
+        assert fleet_telemetry_enabled() is True
+        # explicit fleet knob wins over the re-shard default
+        monkeypatch.setenv("PHOTON_TELEMETRY_FLEET", "0")
+        assert fleet_telemetry_enabled() is False
+        monkeypatch.setenv("PHOTON_TELEMETRY_FLEET", "junk")
+        with pytest.raises(ValueError):
+            fleet_telemetry_enabled()
+
+
+class TestFleetReport:
+    def test_latest_run_skips_shards(self, tmp_path):
+        from photon_ml_tpu.obs.report import latest_run
+
+        _write_fleet_fixture(tmp_path)
+        # the shard is the newest file on disk; latest_run must still
+        # resolve the canonical run (single-process consumers unchanged)
+        os.utime(tmp_path / "run-F1.p1.jsonl")
+        assert latest_run(str(tmp_path)).endswith("run-F1.jsonl")
+
+    def test_fleet_run_paths_from_dir_file_and_shard(self, tmp_path):
+        from photon_ml_tpu.obs.report import fleet_run_paths
+
+        _write_fleet_fixture(tmp_path)
+        expect = [str(tmp_path / "run-F1.jsonl"),
+                  str(tmp_path / "run-F1.p1.jsonl")]
+        assert fleet_run_paths(str(tmp_path)) == expect
+        assert fleet_run_paths(expect[0]) == expect
+        assert fleet_run_paths(expect[1]) == expect  # a shard walks back
+        assert fleet_run_paths(str(tmp_path), run_id="F1") == expect
+        with pytest.raises(ValueError, match="no run-NOPE"):
+            fleet_run_paths(str(tmp_path), run_id="NOPE")
+
+    def test_summarize_fleet_joins_links_and_names_straggler(
+        self, tmp_path
+    ):
+        from photon_ml_tpu.obs.report import (
+            fleet_run_paths,
+            format_fleet,
+            summarize_fleet,
+        )
+
+        _write_fleet_fixture(tmp_path)
+        fs = summarize_fleet(fleet_run_paths(str(tmp_path)))
+        assert fs["process_count"] == 2 and fs["missing_shards"] == 0
+        # per-process phase walls + straggler: p1's descent is 3s vs 1s
+        ph = fs["phases"]["descent"]
+        assert ph["per_process"] == {"0": 1.0, "1": 3.0}
+        assert ph["slowest"] == 1 and abs(ph["imbalance"] - 1.5) < 1e-9
+        assert fs["straggler"]["slowest_process"] == 1
+        # both links joined, zero unmatched; one-sided wait =
+        # recv-start − send-start (0.3−0.2 and 0.5−0.35)
+        p2p = fs["p2p"]
+        assert p2p["matched"] == 2 and p2p["unmatched"] == 0
+        l01 = p2p["links"]["0->1"]
+        assert l01["bytes"] == 400 and l01["tags"] == ["offsets"]
+        assert abs(l01["one_sided_wait_s"] - 0.1) < 1e-9
+        assert abs(p2p["links"]["1->0"]["one_sided_wait_s"] - 0.15) < 1e-9
+        # per-process overlap/exchange accounting surfaced
+        assert fs["overlap"] == {"0": 0.9, "1": 0.6}
+        assert fs["exchange"]["1"]["wait_s"] == pytest.approx(0.1)
+        text = format_fleet(fs)
+        assert "slowest process p1" in text
+        assert "0->1" in text and "0 unmatched" in text
+        json.dumps(fs)  # JSON-plain contract
+
+    def test_unmatched_and_missing_shard_are_health_signals(
+        self, tmp_path
+    ):
+        from photon_ml_tpu.obs.report import (
+            fleet_run_paths,
+            format_fleet,
+            summarize_fleet,
+        )
+
+        _write_fleet_fixture(tmp_path / "u", unmatched=True)
+        fs = summarize_fleet(fleet_run_paths(str(tmp_path / "u")))
+        # p0's recv of the missing send stays unmatched — and surfaces
+        assert fs["p2p"]["unmatched"] == 1
+        assert "unmatched correlated events" in format_fleet(fs)
+        _write_fleet_fixture(tmp_path / "m", missing_shard=True)
+        fs2 = summarize_fleet(fleet_run_paths(str(tmp_path / "m")))
+        assert fs2["missing_shards"] == 1  # run_start said 2 processes
+        assert "MISSING" in format_fleet(fs2)
+
+    def test_fleet_gate_metrics_and_gate(self, tmp_path):
+        from photon_ml_tpu.obs.report import (
+            fleet_run_paths,
+            gate_metrics_from_fleet,
+            gate_run,
+            summarize_fleet,
+        )
+
+        _write_fleet_fixture(tmp_path / "a")
+        good = gate_metrics_from_fleet(
+            summarize_fleet(fleet_run_paths(str(tmp_path / "a")))
+        )
+        assert good["fleet/unmatched_p2p"] == 0.0
+        assert good["fleet/p2p_bytes_total"] == 640.0
+        # the overlap gauge gates as the fleet MINIMUM (worst process)
+        assert good["re_shard/exchange_overlap_ratio"] == 0.6
+        assert good["re_shard/balance"] == 1.05
+        failures, _ = gate_run(good, good)  # self-gate passes
+        assert not failures
+        # an unmatched event (exact tier) and a lost shard both FAIL
+        _write_fleet_fixture(tmp_path / "b", unmatched=True)
+        bad = gate_metrics_from_fleet(
+            summarize_fleet(fleet_run_paths(str(tmp_path / "b")))
+        )
+        failures, _ = gate_run(bad, good)
+        assert any(f["metric"] == "fleet/unmatched_p2p" for f in failures)
+        _write_fleet_fixture(tmp_path / "c", missing_shard=True)
+        lost = gate_metrics_from_fleet(
+            summarize_fleet(fleet_run_paths(str(tmp_path / "c")))
+        )
+        failures, _ = gate_run(lost, good)
+        assert any(
+            f["metric"] == "fleet/missing_shards" for f in failures
+        )
+
+    def test_fleet_export_merges_pids(self, tmp_path):
+        from photon_ml_tpu.obs.report import fleet_run_paths
+
+        _write_fleet_fixture(tmp_path)
+        out = tmp_path / "trace.json"
+        export_chrome_trace(str(tmp_path), str(out))  # dir form
+        trace = json.load(open(out))
+        pids = {e.get("pid") for e in trace["traceEvents"]}
+        assert pids == {0, 1}
+        names = {
+            e["args"]["name"] for e in trace["traceEvents"]
+            if e["name"] == "process_name"
+        }
+        assert names == {"process 0", "process 1"}
+        # explicit shard-list form matches the dir form
+        trace2 = export_chrome_trace(fleet_run_paths(str(tmp_path)))
+        assert trace2 == trace
+        # single-file export behavior unchanged (no shard merge)
+        solo = chrome_trace(load_run(str(tmp_path / "run-F1.jsonl")))
+        assert {e.get("pid") for e in solo["traceEvents"]} == {0}
+
+
+class TestFleetCLI:
+    def _main(self, argv):
+        from photon_ml_tpu.cli import report as cli
+
+        try:
+            cli.main(argv)
+        except SystemExit as e:
+            return int(e.code or 0)
+        return 0
+
+    def test_report_fleet_renders_and_exports(self, tmp_path, capsys):
+        _write_fleet_fixture(tmp_path)
+        trace_out = tmp_path / "fleet-trace.json"
+        rc = self._main(
+            ["fleet", str(tmp_path), "--export-trace", str(trace_out)]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "fleet run F1" in out and "slowest process p1" in out
+        assert "0 unmatched" in out
+        trace = json.load(open(trace_out))
+        assert {e.get("pid") for e in trace["traceEvents"]} == {0, 1}
+        rc = self._main(["fleet", str(tmp_path), "--json"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        fs = json.loads(out)
+        assert fs["process_count"] == 2
+        # load errors exit 2 (path typo ≠ fleet-health failure)
+        rc = self._main(["fleet", str(tmp_path / "nope")])
+        capsys.readouterr()
+        assert rc == 2
+
+    def test_gate_fleet_baseline_round_trip(self, tmp_path, capsys):
+        _write_fleet_fixture(tmp_path / "run")
+        base = tmp_path / "fleet-base.json"
+        # write a fresh fleet baseline, then gate the same run against
+        # it: PASS. The baseline file records kind "fleet".
+        rc = self._main(
+            ["gate", "--fleet", str(tmp_path / "run"),
+             "--write-baseline", str(base)]
+        )
+        capsys.readouterr()
+        assert rc == 0
+        doc = json.load(open(base))
+        assert doc["source_kind"] == "fleet"
+        assert doc["metrics"]["fleet/unmatched_p2p"] == 0.0
+        rc = self._main(
+            ["gate", "--fleet", str(tmp_path / "run"),
+             "--baseline", str(base)]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0 and "gate PASS" in out
+        # a run that LOST its shard regresses the merged view
+        _write_fleet_fixture(tmp_path / "lost", missing_shard=True)
+        rc = self._main(
+            ["gate", "--fleet", str(tmp_path / "lost"),
+             "--baseline", str(base)]
+        )
+        out = capsys.readouterr().out
+        assert rc == 1 and "fleet/missing_shards" in out
