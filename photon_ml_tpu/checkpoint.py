@@ -19,6 +19,7 @@ import json
 import logging
 import os
 from dataclasses import dataclass
+from typing import Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -54,6 +55,11 @@ class DescentCheckpoint:
     scores: dict[str, np.ndarray] | None = None
     total: np.ndarray | None = None
     next_coordinate: int = 0
+    # the fingerprint the checkpoint was WRITTEN under — callers that
+    # accept a collection (peer-loss recovery) use it to tell whether
+    # the resumed state comes from a foreign layout (and so whether the
+    # stored global row ids need the pre-loss base for slicing)
+    fingerprint: str | None = None
 
 
 _SCORE_PREFIX = "__score__"
@@ -153,7 +159,7 @@ def save_checkpoint(
 
 def load_checkpoint(
     directory: str,
-    fingerprint: str | None = None,
+    fingerprint: str | Sequence[str] | None = None,
     data_digest: str | None = None,
 ) -> DescentCheckpoint | None:
     """The latest checkpoint in ``directory``, or None if there isn't one.
@@ -161,7 +167,11 @@ def load_checkpoint(
     When ``fingerprint`` is given and the stored checkpoint carries a
     different one, the checkpoint is ignored (returns None, with a warning)
     — it belongs to a different configuration or dataset and resuming from
-    it would return a model trained under the old settings. When
+    it would return a model trained under the old settings. A COLLECTION
+    of fingerprints accepts any of them: peer-loss recovery resumes a
+    degraded run from a checkpoint written under the pre-loss process
+    layout, whose fingerprint legitimately differs from the survivor
+    group's (the row layout is part of the fingerprint by design). When
     ``data_digest`` is given and differs from the stored one, only the
     residual-exchange ``scores``/``total`` are dropped (they embed the old
     data's per-sample values); the model itself still resumes."""
@@ -176,7 +186,13 @@ def load_checkpoint(
         )
         return None
     meta = json.loads(bytes(z[_META_KEY]).decode())
-    if fingerprint is not None and meta.get("fingerprint") != fingerprint:
+    if isinstance(fingerprint, str):
+        accepted = (fingerprint,)
+    elif fingerprint is None:
+        accepted = None
+    else:
+        accepted = tuple(fingerprint)
+    if accepted is not None and meta.get("fingerprint") not in accepted:
         _log.warning(
             "ignoring %s: fingerprint mismatch (written under a different "
             "configuration/data); training restarts from iteration 0", npz_path,
@@ -225,4 +241,5 @@ def load_checkpoint(
         scores=scores,
         total=total,
         next_coordinate=int(meta.get("next_coordinate", 0)),
+        fingerprint=meta.get("fingerprint"),
     )

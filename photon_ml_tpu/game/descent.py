@@ -210,6 +210,52 @@ class CoordinateDescent:
         for cid in update_sequence:
             if cid not in self.coordinates:
                 raise KeyError(f"update sequence names unknown coordinate {cid!r}")
+        try:
+            return self._run_inner(
+                update_sequence, num_iterations, initial_model,
+                checkpoint_dir, checkpoint_fingerprint,
+            )
+        except BaseException as e:
+            self._raise_if_peer_lost(e, checkpoint_dir)
+            raise
+
+    @staticmethod
+    def _raise_if_peer_lost(e: BaseException, checkpoint_dir) -> None:
+        """The in-memory descent cannot shrink its world mid-run — every
+        compiled program spans the FULL device mesh, so a lost process
+        invalidates the executables themselves (unlike the streamed
+        trainer, whose host-side exchanges re-plan around the survivor
+        set). What it CAN do is turn the 300 s-timeout stack into an
+        actionable, telemetry-visible instruction: restart the job on
+        the surviving hosts and resume from the per-iteration
+        checkpoint this class already writes."""
+        from photon_ml_tpu.parallel.multihost import PeerLost
+
+        if not isinstance(e, PeerLost):
+            return
+        emit_event("peer_lost", peer=int(e.peer), error=str(e))
+        hint = (
+            f"resume from the last per-iteration checkpoint in "
+            f"{checkpoint_dir!r} by restarting on the surviving hosts"
+            if checkpoint_dir is not None else
+            "re-run with checkpoint_dir set to make the restart resume "
+            "instead of retrain"
+        )
+        raise RuntimeError(
+            f"in-memory coordinate descent lost process {e.peer}: the "
+            f"mesh-spanning executables cannot degrade in place — {hint} "
+            "(the streamed trainer recovers in place; see README "
+            "'Fault tolerance & recovery')"
+        ) from e
+
+    def _run_inner(
+        self,
+        update_sequence: Sequence[str],
+        num_iterations: int,
+        initial_model: GameModel | None = None,
+        checkpoint_dir: str | None = None,
+        checkpoint_fingerprint: str | None = None,
+    ) -> CoordinateDescentResult:
 
         start_iteration = 0
         model = initial_model or GameModel(models={}, task_type=self.task_type)

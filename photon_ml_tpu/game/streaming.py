@@ -191,7 +191,16 @@ def _re_chunk_scores_sparse(W_rows: Array, idx: Array, val: Array) -> Array:
 
 
 def _num_processes() -> tuple[int, int]:
-    return jax.process_index(), jax.process_count()
+    """(rank, size) of the CURRENT process group — the jax runtime's
+    view normally, the survivor group's after peer-loss recovery
+    shrank the world (lazy import: the parallel package pulls in the
+    distributed runtime, which this module otherwise defers)."""
+    from photon_ml_tpu.parallel.multihost import (
+        effective_process_count,
+        effective_process_index,
+    )
+
+    return effective_process_index(), effective_process_count()
 
 
 def _re_shard_enabled() -> bool:
@@ -478,6 +487,17 @@ class StreamedGameTrainer:
         # shared random projectors, built lazily per coordinate (seed 0,
         # like the estimator's default — deterministic on every host)
         self._projectors: dict[str, Any] = {}
+        # peer-loss recovery context. ``resume_fingerprints``: extra
+        # checkpoint fingerprints to ACCEPT on resume (the pre-loss run's
+        # — its row layout legitimately differs from the degraded
+        # group's, and the fingerprint guard would otherwise reject the
+        # very checkpoint recovery anchors on). ``resume_row_base``: this
+        # process's row base IN THE CHECKPOINT'S layout, used to slice
+        # gathered score state when the current layout differs. Set by
+        # ``_prepare_recovery`` mid-fit; settable directly by a driver
+        # that restarts a degraded run from a foreign-layout checkpoint.
+        self.resume_fingerprints: list[str] = []
+        self.resume_row_base: int | None = None
     # -- multi-host entity exchange (the ingest-time shuffle) ---------------
 
     def _global_layout(self, n_local: int) -> tuple[int, int, tuple[int, ...]]:
@@ -490,11 +510,9 @@ class StreamedGameTrainer:
         pid, P = _num_processes()
         if P <= 1 or not self.multihost:
             return n_local, 0, (n_local,)
-        from jax.experimental import multihost_utils
+        from photon_ml_tpu.parallel.multihost import allgather_host
 
-        counts = np.asarray(
-            multihost_utils.process_allgather(np.asarray([n_local]))
-        ).reshape(-1)
+        counts = allgather_host(np.asarray([n_local])).reshape(-1)
         return (
             int(counts.sum()),
             int(counts[:pid].sum()),
@@ -510,15 +528,13 @@ class StreamedGameTrainer:
         floor = self._entity_count_floor.get(tag, 0) if tag else 0
         if not self._distributed():
             return max(local_max, floor)
-        from jax.experimental import multihost_utils
+        from photon_ml_tpu.parallel.multihost import allgather_host
 
-        maxes = np.asarray(
-            multihost_utils.process_allgather(np.asarray([local_max]))
-        ).reshape(-1)
+        maxes = allgather_host(np.asarray([local_max])).reshape(-1)
         return max(int(maxes.max()), floor)
 
     def _distributed(self) -> bool:
-        return self.multihost and jax.process_count() > 1
+        return self.multihost and _num_processes()[1] > 1
 
     def _exchange_to_owners(
         self,
@@ -1472,7 +1488,7 @@ class StreamedGameTrainer:
         pid, P = _num_processes()
         if not self._distributed():
             return W_local
-        from jax.experimental import multihost_utils
+        from photon_ml_tpu.parallel.multihost import allgather_host
 
         d = W_local.shape[1]
         if entity_owner is not None:
@@ -1482,7 +1498,7 @@ class StreamedGameTrainer:
             E_max = (E + P - 1) // P
         padded = np.zeros((max(E_max, 1), d), np.float32)
         padded[: len(W_local)] = W_local
-        stacked = np.asarray(multihost_utils.process_allgather(padded))
+        stacked = allgather_host(padded)
         W = np.zeros((E, d), np.float32)
         for p in range(P):
             own = (
@@ -1948,12 +1964,14 @@ class StreamedGameTrainer:
         from photon_ml_tpu.parallel.multihost import (
             allreduce_sum_host,
             broadcast_from_host0,
+            is_output_process,
         )
 
+        accepted = (fingerprint, *self.resume_fingerprints)
         ckpt = None
-        if jax.process_index() == 0:
+        if is_output_process():
             ckpt = load_checkpoint(
-                self.checkpoint_dir, fingerprint=fingerprint, data_digest=digest
+                self.checkpoint_dir, fingerprint=accepted, data_digest=digest
             )
         if not self._distributed():
             if ckpt is None or ckpt.scores is None or ckpt.total is None:
@@ -1964,6 +1982,12 @@ class StreamedGameTrainer:
                 "next_coordinate": ckpt.next_coordinate,
                 "scores": ckpt.scores,
                 "total": ckpt.total,
+                # written under a DIFFERENT (pre-loss) layout: its
+                # global row ids need the pre-loss base for slicing
+                "foreign": (
+                    ckpt.fingerprint is not None
+                    and ckpt.fingerprint != fingerprint
+                ),
             }
         cfg = self.config
         # deterministic coordinate order for the per-cid variance-presence
@@ -1978,7 +2002,7 @@ class StreamedGameTrainer:
             return sub.variances
 
         flags = [0] * len(var_cids)
-        if jax.process_index() == 0 and ckpt is not None:
+        if is_output_process() and ckpt is not None:
             for i, v_cid in enumerate(var_cids):
                 sub = ckpt.model.models.get(v_cid)
                 if sub is not None and _sub_var(sub) is not None:
@@ -1986,12 +2010,18 @@ class StreamedGameTrainer:
         # mode 0 = no checkpoint; 1 = gathered scores in the main file;
         # 2 = model+meta only (score slices live in per-host shard files)
         mode = 0
+        foreign = 0
         if ckpt is not None:
             mode = 1 if ckpt.scores is not None else 2
+            foreign = int(
+                ckpt.fingerprint is not None
+                and ckpt.fingerprint != fingerprint
+            )
         has = np.asarray(
             [mode,
              0 if ckpt is None else ckpt.next_iteration,
              0 if ckpt is None else ckpt.next_coordinate,
+             foreign,
              *flags],
             np.int64,
         )
@@ -2002,22 +2032,25 @@ class StreamedGameTrainer:
         local_scores = local_total = None
         if mode == 2:
             # every host validates ITS shard against the broadcast markers;
-            # resume happens only if ALL hosts hold a consistent shard
+            # resume happens only if ALL hosts of the CURRENT group hold
+            # a consistent shard (the original jax.process_count would
+            # make every post-recovery sharded resume fail the quorum
+            # and silently restart from scratch)
             local = self._load_score_shard(
                 fingerprint, digest, int(has[1]), int(has[2])
             )
             ok = allreduce_sum_host(
                 np.asarray([1.0 if local is not None else 0.0])
             )
-            if int(ok[0]) != jax.process_count():
+            if int(ok[0]) != _num_processes()[1]:
                 return None
             local_scores, local_total = local
         var_present = {
-            v_cid: bool(has[3 + i]) for i, v_cid in enumerate(var_cids)
+            v_cid: bool(has[4 + i]) for i, v_cid in enumerate(var_cids)
         }
         # broadcast the arrays with the globally-known structure
         arrays = {}
-        if jax.process_index() == 0:
+        if is_output_process():
             for cid, sub in ckpt.model.models.items():
                 if isinstance(sub, FixedEffectModel):
                     arrays[f"w__{cid}"] = np.asarray(
@@ -2100,6 +2133,7 @@ class StreamedGameTrainer:
             "total": total,
             # mode 2 score state is already this host's LOCAL slice
             "scores_local": mode == 2,
+            "foreign": bool(has[3]),
         }
 
     def _load_score_shard(
@@ -2115,11 +2149,12 @@ class StreamedGameTrainer:
         path = self._shard_path(jax.process_index())
         if not os.path.exists(path):
             return None
+        accepted = (fingerprint, *self.resume_fingerprints)
         try:
             with np.load(path) as z:
                 meta = json.loads(bytes(z["meta"]).decode())
                 if (
-                    meta.get("fingerprint") != fingerprint
+                    meta.get("fingerprint") not in accepted
                     or meta.get("data_digest") != digest
                     or meta.get("next_iteration") != next_iteration
                     or meta.get("next_coordinate") != next_coordinate
@@ -2192,13 +2227,87 @@ class StreamedGameTrainer:
         semantics. Entity rows must already be aligned to this dataset's
         dense entity ids (the driver re-uses the saved run's entity maps
         and pads new entities with zero rows)."""
+        from photon_ml_tpu.parallel.multihost import PeerLost
+
         with span(
             "game/fit",
             rows=int(data.num_rows),
             chunk_rows=int(self.chunk_rows),
             coordinates=list(self.config.coordinate_update_sequence),
         ):
-            return self._fit_inner(data, validation, initial_model)
+            while True:
+                try:
+                    return self._fit_inner(data, validation, initial_model)
+                except PeerLost as e:
+                    # checkpoint-anchored peer-loss recovery: confirm the
+                    # lost set, shrink the process group to the
+                    # survivors, then re-enter the fit — ingest re-plans
+                    # placement over the survivor group (deterministic
+                    # pure-host arithmetic: every survivor computes the
+                    # identical plan with zero extra comms) and the
+                    # resume path restores the last atomic checkpoint
+                    self._prepare_recovery(e)
+
+    def _prepare_recovery(self, err) -> None:
+        """Turn a ``PeerLost`` into a degraded-group resume, or re-raise
+        it with the reason recovery is impossible. Survivors leave this
+        method with: the process group shrunk to the roll-call survivor
+        set, the pre-loss fingerprint/row-base registered so the last
+        checkpoint is accepted under the new layout, and telemetry
+        (``peer_lost``/``recovery`` events, ``fleet.*`` counters) in
+        this process's shard."""
+        from photon_ml_tpu.parallel import multihost as mh
+
+        if self.checkpoint_dir is None or not self.multihost:
+            raise RuntimeError(
+                f"peer loss (process {err.peer}) with no recovery "
+                "substrate: streamed peer-loss recovery needs multihost "
+                "mode and a checkpoint_dir to resume from; re-run with "
+                "checkpointing enabled or restart the whole job"
+            ) from err
+        REGISTRY.counter_inc("fleet.peer_lost")
+        emit_event("peer_lost", peer=int(err.peer), error=str(err))
+        self._log(
+            f"peer loss: process {err.peer} unreachable after retries — "
+            "starting roll call"
+        )
+        # abandoned async exchanges from the failed attempt must not be
+        # re-polled (and re-reported) by every later drain
+        mh.reset_async_exchanges()
+        group = (
+            list(mh.degraded_group()["survivors"])
+            if mh.degraded_group() is not None
+            else list(range(jax.process_count()))
+        )
+        survivors = mh.roll_call()
+        lost = sorted(set(group) - set(survivors))
+        if not lost:
+            raise RuntimeError(
+                f"roll call found every process alive after a reported "
+                f"peer loss (process {err.peer}): links flapped past the "
+                "retry budget — raise PHOTON_P2P_RETRIES/BACKOFF_S "
+                "rather than recovering around a live peer"
+            ) from err
+        # accept the pre-loss layout's checkpoints (this fit's stored
+        # anchors) on the degraded resume
+        fp = getattr(self, "_last_fingerprint", None)
+        if fp is not None and fp not in self.resume_fingerprints:
+            self.resume_fingerprints.append(fp)
+        base = getattr(self, "_last_row_base", None)
+        if base is not None:
+            self.resume_row_base = int(base)
+        mh.set_degraded_group(survivors)
+        REGISTRY.counter_inc("fleet.recoveries")
+        emit_event(
+            "recovery", survivors=[int(s) for s in survivors],
+            lost=[int(p) for p in lost],
+            resume_fingerprints=len(self.resume_fingerprints),
+        )
+        self._log(
+            f"recovery: lost processes {lost}, surviving group "
+            f"{survivors} — re-planning placement and resuming from the "
+            "last checkpoint"
+        )
 
     def _fit_inner(
         self,
@@ -2440,6 +2549,11 @@ class StreamedGameTrainer:
                 np.ones(n, np.float32) if data.weights is None
                 else np.asarray(data.weights, np.float32),
             )
+            # recovery anchors: the fingerprint/row-base of THIS layout,
+            # kept so a mid-fit peer loss can accept this run's own
+            # checkpoints under the degraded group's different layout
+            self._last_fingerprint = fingerprint
+            self._last_row_base = row_base
             # shapes the non-0 processes need to receive the broadcast
             self._resume_n_global = n_global
             self._resume_shard_dims = shard_dims
@@ -2484,12 +2598,29 @@ class StreamedGameTrainer:
                         ).copy()
                     total = np.asarray(resume["total"], np.float32).copy()
                 else:
+                    # gathered score state is indexed by the CHECKPOINT
+                    # layout's global row ids — after a degraded-group
+                    # resume this process's base in that layout
+                    # (resume_row_base) differs from its base in the
+                    # current one. Applied ONLY when the loaded
+                    # checkpoint really was written under a foreign
+                    # fingerprint: a later resume from a CURRENT-layout
+                    # checkpoint must slice at the current base even
+                    # while the allow-list entries linger.
+                    ck_base = (
+                        self.resume_row_base
+                        if (
+                            resume.get("foreign")
+                            and self.resume_row_base is not None
+                        )
+                        else row_base
+                    )
                     for cid in seq:
                         scores[cid] = np.asarray(
                             resume["scores"][cid], np.float32
-                        )[row_base:row_base + n].copy()
+                        )[ck_base:ck_base + n].copy()
                     total = np.asarray(resume["total"], np.float32)[
-                        row_base:row_base + n
+                        ck_base:ck_base + n
                     ].copy()
                 self.resumed_from = (start_it, start_ci)
                 self._log(
@@ -2590,14 +2721,14 @@ class StreamedGameTrainer:
                                 # per-owner partial diagnostics → global
                                 # (sum the losses, max the iteration
                                 # counts, AND the flags)
-                                from jax.experimental import multihost_utils
+                                from photon_ml_tpu.parallel.multihost import (
+                                    allgather_host,
+                                )
 
-                                agg = np.asarray(
-                                    multihost_utils.process_allgather(
-                                        np.asarray(
-                                            [loss_sum, float(max_it),
-                                             0.0 if conv else 1.0]
-                                        )
+                                agg = allgather_host(
+                                    np.asarray(
+                                        [loss_sum, float(max_it),
+                                         0.0 if conv else 1.0]
                                     )
                                 ).reshape(-1, 3)
                                 loss_sum = float(agg[:, 0].sum())

@@ -826,6 +826,51 @@ def summarize_fleet(paths: list[str]) -> dict:
         if (s.get("re_shard") or {}).get("exchange_overlap_ratio")
         is not None
     }
+    # retry/recovery health (the PR-11 fault-tolerance tier): per-link
+    # retries and corruption detections are transient absorption (the
+    # run still completed); giveups, peer losses and recoveries mark a
+    # degraded topology the reader must know about before trusting any
+    # imbalance number in this table.
+    recovery: dict = {
+        "p2p_retries": 0, "p2p_giveups": 0, "drain_errors": 0,
+        "faults_injected": 0, "peer_lost": [], "recoveries": [],
+        "roll_calls": [],
+    }
+    retry_by_error: dict[str, int] = {}
+    for pidx, recs in records_by_process.items():
+        for r in recs:
+            ev = r.get("event")
+            if ev == "p2p_retry":
+                recovery["p2p_retries"] += 1
+                err = str(r.get("error") or "?")
+                retry_by_error[err] = retry_by_error.get(err, 0) + 1
+            elif ev == "p2p_giveup":
+                recovery["p2p_giveups"] += 1
+            elif ev == "exchange_drain_error":
+                recovery["drain_errors"] += 1
+            elif ev == "fault_injected":
+                recovery["faults_injected"] += 1
+            elif ev == "peer_lost":
+                recovery["peer_lost"].append(
+                    {"process": pidx, "peer": r.get("peer")}
+                )
+            elif ev == "recovery":
+                recovery["recoveries"].append(
+                    {
+                        "process": pidx,
+                        "survivors": r.get("survivors"),
+                        "lost": r.get("lost"),
+                    }
+                )
+            elif ev == "roll_call":
+                recovery["roll_calls"].append(
+                    {
+                        "process": pidx,
+                        "survivors": r.get("survivors"),
+                        "lost": r.get("lost"),
+                    }
+                )
+    recovery["retry_errors"] = dict(sorted(retry_by_error.items()))
     exchange = {
         k: {
             "exchange_s": s["exchange_s"],
@@ -861,6 +906,7 @@ def summarize_fleet(paths: list[str]) -> dict:
             ),
         },
         "p2p": _p2p_link_table(records_by_process),
+        "recovery": recovery,
         "overlap": overlap,
         "exchange": exchange,
         "processes": processes,
@@ -954,6 +1000,45 @@ def format_fleet(fs: dict) -> str:
             "  WARNING: unmatched correlated events — a torn exchange "
             "mesh, a missing shard file, or a truncated run"
         )
+    rec = fs.get("recovery") or {}
+    if any(
+        rec.get(k)
+        for k in (
+            "p2p_retries", "p2p_giveups", "drain_errors",
+            "faults_injected", "peer_lost", "recoveries",
+        )
+    ):
+        seg = (
+            f"  retry/recovery: {rec.get('p2p_retries', 0)} retries"
+        )
+        errs = rec.get("retry_errors") or {}
+        if errs:
+            seg += (
+                " ("
+                + ", ".join(f"{k}×{v}" for k, v in errs.items())
+                + ")"
+            )
+        seg += (
+            f", {rec.get('p2p_giveups', 0)} giveups, "
+            f"{rec.get('drain_errors', 0)} drain errors, "
+            f"{rec.get('faults_injected', 0)} injected faults"
+        )
+        lines.append(seg)
+        for pl in rec.get("peer_lost") or []:
+            lines.append(
+                f"    peer_lost: p{pl['process']} lost peer "
+                f"{pl['peer']}"
+            )
+        for rv in rec.get("recoveries") or []:
+            lines.append(
+                f"    recovery: p{rv['process']} resumed with "
+                f"survivors {rv['survivors']} (lost {rv['lost']})"
+            )
+        if rec.get("recoveries"):
+            lines.append(
+                "  WARNING: this run degraded mid-flight — wall/"
+                "imbalance rows mix pre- and post-recovery topologies"
+            )
     if fs["knobs"]:
         lines.append(f"  knobs: {json.dumps(fs['knobs'], sort_keys=True)}")
     return "\n".join(lines)
@@ -1008,6 +1093,15 @@ DEFAULT_GATE_THRESHOLDS: dict[str, dict] = {
     "fleet/missing_shards": {"rel": 0.0, "abs": 0.0},
     "fleet/unmatched_p2p": {"rel": 0.0, "abs": 0.0},
     "fleet/p2p_bytes_total": {"rel": 0.05},
+    # retry/recovery tiers (PR-11 fault-tolerance): a chaos baseline's
+    # injected-fault retries may jitter up slightly (scheduler timing
+    # can split one backoff into two attempts), but any NEW giveup,
+    # drain error, peer loss or recovery is a new failure mode
+    "fleet/p2p_retries": {"rel": 1.0, "abs": 2.0},
+    "fleet/p2p_giveups": {"rel": 0.0, "abs": 0.0},
+    "fleet/exchange_drain_errors": {"rel": 0.0, "abs": 0.0},
+    "fleet/peer_lost": {"rel": 0.0, "abs": 0.0},
+    "fleet/recoveries": {"rel": 0.0, "abs": 0.0},
     "/imbalance": {"rel": 1.0, "abs": 1.0},
     "exchange_wait_s": {"rel": 2.0, "abs": 5.0},
     "exchange_s": {"rel": 2.0, "abs": 5.0},
@@ -1161,6 +1255,20 @@ def gate_metrics_from_fleet(fs: dict) -> dict[str, float]:
         m["fleet/p2p_bytes_total"] = float(
             sum(a["bytes"] for a in p2p["links"].values())
         )
+    rec = fs.get("recovery") or {}
+    if rec:
+        # retry/recovery tier: retries gate LOOSE against a chaos
+        # baseline (the committed fault plan fixes the floor, scheduler
+        # jitter can add a few); giveups, drain errors, peer losses and
+        # recoveries gate EXACT — an extra one of any of these is a new
+        # failure mode, not noise
+        m["fleet/p2p_retries"] = float(rec.get("p2p_retries") or 0)
+        m["fleet/p2p_giveups"] = float(rec.get("p2p_giveups") or 0)
+        m["fleet/exchange_drain_errors"] = float(
+            rec.get("drain_errors") or 0
+        )
+        m["fleet/peer_lost"] = float(len(rec.get("peer_lost") or []))
+        m["fleet/recoveries"] = float(len(rec.get("recoveries") or []))
     for ph, agg in (fs.get("phases") or {}).items():
         if agg.get("imbalance") is not None:
             m[f"fleet/phase/{ph}/imbalance"] = float(agg["imbalance"])

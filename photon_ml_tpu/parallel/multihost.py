@@ -134,8 +134,10 @@ def shard_batch_multihost(local_batch, mesh: Mesh, axis_name: str = "data"):
 def is_output_process() -> bool:
     """True on the single process that writes shared outputs (models,
     metrics, checkpoints). All hosts COMPUTE; exactly one host WRITES —
-    concurrent writers to shared storage interleave and corrupt files."""
-    return jax.process_index() == 0
+    concurrent writers to shared storage interleave and corrupt files.
+    In a degraded group the lowest-ranked SURVIVOR writes (the original
+    writer may be the lost peer)."""
+    return effective_process_index() == 0
 
 
 # per-call monotonic barrier suffix: every process calls sync_processes
@@ -153,12 +155,18 @@ def sync_processes(tag: str = "photon-ml-barrier") -> None:
     ``{tag}#{n}`` with ``n`` a per-process monotonic call counter
     (identical across processes by the matched-call-order requirement
     every collective already has), so repeated barriers under one caller
-    tag are distinct barrier keys."""
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    tag are distinct barrier keys. In a degraded group the barrier
+    rides the framed-P2P survivor mesh (same tag discipline) — the jax
+    barrier would wait on the dead peer forever."""
+    if effective_process_count() <= 1:
+        return
+    _BARRIER_SEQ[0] += 1
+    if _DEGRADED is not None:
+        _p2p_allgather_obj(f"{tag}#{_BARRIER_SEQ[0]}", tag="barrier")
+        return
+    from jax.experimental import multihost_utils
 
-        _BARRIER_SEQ[0] += 1
-        multihost_utils.sync_global_devices(f"{tag}#{_BARRIER_SEQ[0]}")
+    multihost_utils.sync_global_devices(f"{tag}#{_BARRIER_SEQ[0]}")
 
 
 def broadcast_from_host0(pytree):
@@ -166,9 +174,17 @@ def broadcast_from_host0(pytree):
     leaves; identity on a single process). The pytree STRUCTURE must be
     identical on every process — only leaf values may differ. Used to make
     checkpoint-resume decisions (and restored state) consistent when hosts
-    do not share an output filesystem."""
-    if jax.process_count() <= 1:
+    do not share an output filesystem. In a degraded group "host 0" is
+    the lowest-ranked SURVIVOR and the broadcast rides the framed-P2P
+    survivor mesh."""
+    if effective_process_count() <= 1:
         return pytree
+    if _DEGRADED is not None:
+        rank = effective_process_index()
+        views = _p2p_allgather_obj(
+            pytree if rank == 0 else None, tag="broadcast0"
+        )
+        return jax.tree.map(np.asarray, views[0])
     from jax.experimental import multihost_utils
 
     out = multihost_utils.broadcast_one_to_all(pytree)
@@ -188,14 +204,10 @@ def allgather_row_chunks(arrays, chunk_rows: int, pad_values=None):
     receiver can filter, e.g. -1 entity ids). Every process yields the SAME
     number of rounds (a collective requirement).
     """
-    from jax.experimental import multihost_utils
-
     pad_values = dict(pad_values or {})
     keys = list(arrays)
     n_loc = len(arrays[keys[0]]) if keys else 0
-    counts = np.asarray(
-        multihost_utils.process_allgather(np.asarray([n_loc]))
-    ).reshape(-1)
+    counts = allgather_host(np.asarray([n_loc])).reshape(-1)
     rounds = int(-(-int(counts.max()) // chunk_rows)) if counts.max() else 0
     for r in range(rounds):
         lo = r * chunk_rows
@@ -211,17 +223,244 @@ def allgather_row_chunks(arrays, chunk_rows: int, pad_values=None):
                 )
                 part = np.concatenate([part, fill])
             chunk[k] = part
+        if _DEGRADED is not None:
+            views = _p2p_allgather_obj(chunk, tag="row_chunks")
+            yield {
+                k: np.stack([v[k] for v in views]) for k in keys
+            }
+            continue
+        from jax.experimental import multihost_utils
+
         gathered = multihost_utils.process_allgather(chunk)
         yield {k: np.asarray(v) for k, v in gathered.items()}
 
 
+def _ring_allgather(
+    links: dict, ordered_pids: list[int], rank: int, obj,
+    tag: str, heartbeat: float | None,
+) -> list:
+    """One framed allgather of a picklable host object over an explicit
+    ring: ``ordered_pids[rank]`` is this process, links are keyed by
+    ORIGINAL pid. The single implementation behind both the degraded-
+    group collectives and the roll-call agreement round (two hand-
+    rolled copies of threaded socket code WILL drift). Bumps the
+    per-link frame-set counters like every framed user, so submission-
+    order correlation stays matched. Returns the per-rank list."""
+    import pickle
+    import struct
+    import threading
+
+    protos = links.get("proto", {})
+    payload = pickle.dumps(obj, protocol=4)
+    P_ = len(ordered_pids)
+    out: dict[int, object] = {rank: obj}
+    err: list[BaseException] = []
+
+    def send_all():
+        try:
+            for r in range(1, P_):
+                peer_pid = ordered_pids[(rank + r) % P_]
+                _next_link_seq("send", peer_pid)
+                _send_frame(
+                    links["send"][peer_pid], payload,
+                    protos.get(peer_pid, 0) >= _FRAME_PROTO_CRC,
+                    peer_pid, tag, heartbeat,
+                )
+        except BaseException as e:
+            err.append(e)
+
+    t = threading.Thread(target=send_all)
+    t.start()
+    for r in range(1, P_):
+        src_rank = (rank - r) % P_
+        src_pid = ordered_pids[src_rank]
+        sock = links["recv"][src_pid]
+        _next_link_seq("recv", src_pid)
+        n = struct.unpack(
+            "!q", _recv_exact(sock, 8, src_pid, tag, heartbeat)
+        )[0]
+        raw = _recv_frame_payload(
+            sock, n, protos.get(src_pid, 0) >= _FRAME_PROTO_CRC,
+            src_pid, tag, heartbeat,
+        )
+        out[src_rank] = pickle.loads(raw)
+    t.join()
+    if err:
+        raise err[0]
+    return [out[r] for r in range(P_)]
+
+
+def _p2p_allgather_obj(obj, tag: str = "host_collective") -> list:
+    """Allgather one picklable host object over the framed-P2P links of
+    the CURRENT group — the degraded world's replacement for
+    ``multihost_utils.process_allgather`` (which would hang on the dead
+    peer). Returns the per-rank list in ascending effective rank; a
+    sync collective drains the async queue first, like every other
+    synchronous socket user.
+
+    A transient link fault here in a DEGRADED group hardens straight
+    into ``PeerLost`` (peer ``-1`` when the failing link is unknown):
+    these collectives have no completion ACK, so a mid-collective
+    retry could desync peers — but the failure is symmetric (the
+    teardown kills every peer's links), so the right recovery is
+    another roll call from the fit-level handler, not an abort."""
+    P_ = effective_process_count()
+    pid = effective_process_index()
+    if P_ <= 1:
+        return [obj]
+    drain_async_exchanges()
+    try:
+        links = _host_links()
+        heartbeat = _p2p_heartbeat_s() if _sink_active() else None
+        return _ring_allgather(
+            links, [_orig_pid(r) for r in range(P_)], pid, obj,
+            tag, heartbeat,
+        )
+    except BaseException as e:
+        _reset_host_links()
+        if _DEGRADED is not None and isinstance(e, OSError):
+            raise PeerLost(
+                getattr(e, "peer", -1),
+                f"degraded-group host collective {tag!r} failed: {e}",
+            ) from e
+        raise
+
+
+def allgather_host(array: np.ndarray) -> np.ndarray:
+    """Stack one same-shape host array from every process of the
+    CURRENT group: a ``(P_eff, ...)`` array. The jax collective
+    normally; the framed-P2P survivor mesh when degraded (the jax
+    runtime still counts the dead peer and would hang). Every
+    group-shaped reduction in the trainer routes through here so a
+    degraded group keeps training."""
+    array = np.asarray(array)
+    if effective_process_count() <= 1:
+        return array[None]
+    if _DEGRADED is None:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(array))
+    return np.stack(_p2p_allgather_obj(array, tag="allgather_host"))
+
+
+def roll_call(window_s: float | None = None) -> list[int]:
+    """Survivor census after a suspected peer loss (the barrier-tagged
+    roll call of the recovery tier). Every process that hit
+    ``PeerLost`` on the same exchange calls this at the same program
+    point (the reliable mode's completion ACK guarantees the failure —
+    and therefore the retry exhaustion — is observed by EVERY
+    survivor): each rebuilds a mesh over the current group from the
+    cached addresses, dropping peers that stay unreachable past the
+    window (knob ``PHOTON_ROLLCALL_WINDOW_S``, default 10 s), then
+    survivors exchange their reachable sets over the candidate mesh
+    and agree on the INTERSECTION — a peer any survivor cannot reach
+    is lost for everyone (a half-connected peer cannot participate in
+    a full exchange mesh anyway). Returns the sorted surviving
+    ORIGINAL process indices (always including this process)."""
+    if window_s is None:
+        env = os.environ.get("PHOTON_ROLLCALL_WINDOW_S")
+        window_s = float(env) if env else 10.0
+    global _HOST_LINKS
+    with _LINKS_BUILD_LOCK:
+        _reset_host_links()
+        pid = jax.process_index()
+        if _DEGRADED is not None:
+            group = list(_DEGRADED["survivors"])
+        else:
+            group = list(range(jax.process_count()))
+        candidates = list(group)
+        deadline = time.monotonic() + window_s
+        probe_timeout = max(min(2.0, window_s / 4.0), 0.2)
+        links = None
+        while len(candidates) > 1:
+            try:
+                links = _build_host_links(candidates, probe_timeout)
+                break
+            except PeerUnreachable as e:
+                if time.monotonic() >= deadline:
+                    candidates.remove(e.peer)
+                else:
+                    time.sleep(probe_timeout / 2.0)
+            except (OSError, RuntimeError):
+                # a build race (two peers mid-rebuild) — retry until
+                # the window closes, then give up on the stragglers
+                if time.monotonic() >= deadline:
+                    break
+                time.sleep(probe_timeout / 2.0)
+        if links is None or len(candidates) <= 1:
+            survivors = [pid]
+        else:
+            _HOST_LINKS = links
+            # barrier-tagged agreement round: intersect everyone's view
+            rank = candidates.index(pid)
+            views = _ring_allgather(
+                links, candidates, rank, list(candidates),
+                "rollcall", None,
+            )
+            agreed = set(candidates)
+            for v in views:
+                agreed &= set(v)
+            if pid not in agreed:
+                _reset_host_links()
+                raise RuntimeError(
+                    f"roll call excluded this process ({pid}): survivors "
+                    f"agreed on {sorted(agreed)}"
+                )
+            if agreed != set(candidates):
+                # some survivor could not reach a candidate this process
+                # could: drop to the intersection and rebuild over it
+                # (the excluded peer's own roll call ends with it alone)
+                _reset_host_links()
+                candidates = sorted(agreed)
+                if len(candidates) > 1:
+                    _HOST_LINKS = _build_host_links(
+                        candidates, _p2p_timeout_s()
+                    )
+            survivors = sorted(candidates)
+        # split-brain guard: a roll call has no external arbiter, so a
+        # network PARTITION (not a death) would let both halves "agree"
+        # on themselves — and both halves' rank-0 would pass
+        # is_output_process() and write checkpoints concurrently, the
+        # corruption the single-writer rule exists to prevent. Only the
+        # side holding the group's current writer (its lowest member),
+        # or a strict majority, may proceed; any other fragment aborts.
+        # At most one fragment can satisfy either condition.
+        writer = min(group)
+        if writer not in survivors and 2 * len(survivors) <= len(group):
+            _reset_host_links()
+            _emit_event(
+                "roll_call_abort", survivors=survivors,
+                group=list(group),
+            )
+            raise RuntimeError(
+                f"roll call reached only {survivors} of {sorted(group)}: "
+                f"a minority fragment without the writer (process "
+                f"{writer}) must abort rather than risk a split-brain "
+                "second writer — restart this process and rejoin"
+            )
+        _emit_event(
+            "roll_call", survivors=survivors,
+            lost=[p for p in group if p not in survivors],
+        )
+        return survivors
+
+
 def allreduce_sum_host(*arrays: np.ndarray):
-    """Sum numpy arrays across ALL processes (returns them unchanged on a
-    single process). Used by the streaming objective to combine per-host
-    partial (value, gradient) sums — the treeAggregate analog for the
-    out-of-core path."""
-    if jax.process_count() <= 1:
+    """Sum numpy arrays across ALL processes of the current group
+    (returns them unchanged on a single process). Used by the streaming
+    objective to combine per-host partial (value, gradient) sums — the
+    treeAggregate analog for the out-of-core path."""
+    if effective_process_count() <= 1:
         return arrays if len(arrays) > 1 else arrays[0]
+    if _DEGRADED is not None:
+        gathered = _p2p_allgather_obj(
+            tuple(np.asarray(a) for a in arrays), tag="allreduce_sum"
+        )
+        summed = tuple(
+            np.sum(np.stack([g[i] for g in gathered]), axis=0)
+            for i in range(len(arrays))
+        )
+        return summed if len(summed) > 1 else summed[0]
     from jax.experimental import multihost_utils
 
     stacked = multihost_utils.process_allgather(arrays)  # each: (P, ...)
@@ -330,7 +569,7 @@ def exchange_rows(arrays, dest: np.ndarray, tag: str = ""):
     it never affects routing or results.
     """
     arrays = {k: np.asarray(v) for k, v in arrays.items()}
-    P_ = jax.process_count()
+    P_ = effective_process_count()
     if P_ <= 1:
         LAST_EXCHANGE_STATS.update(
             bytes_sent=0, rows_sent=len(dest), padded_rows=len(dest),
@@ -346,16 +585,16 @@ def exchange_rows(arrays, dest: np.ndarray, tag: str = ""):
     starts = np.concatenate([[0], np.cumsum(counts)])
     # every process learns every (source, destination) bucket size — a
     # (P, P) int matrix, negligible next to the row payload
-    counts_matrix = np.asarray(
-        mhu.process_allgather(counts)
-    ).reshape(P_, P_)
+    counts_matrix = allgather_host(counts).reshape(P_, P_)
     maxc = max(int(counts_matrix.max()), 1)
 
     # transport decision — identical on every process (counts_matrix is):
     # all_to_all allocates P·maxc slots per process against its
     # counts.sum() real rows; beyond 2× padding, go point-to-point.
+    # A degraded group ALWAYS goes point-to-point: the all_to_all
+    # program spans the full device mesh, dead peer included.
     total_payload = max(int(counts_matrix.sum()), 1)
-    if P_ * P_ * maxc > 2 * total_payload:
+    if _DEGRADED is not None or P_ * P_ * maxc > 2 * total_payload:
         # one global socket-use order: never interleave with an in-flight
         # worker-thread exchange mid-frame (no-op when none are pending)
         drain_async_exchanges()
@@ -598,6 +837,168 @@ def _configure_link_socket(sock) -> None:
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
 
+def _p2p_retries() -> int:
+    """Transient-fault retry budget for the framed host P2P exchange,
+    knob ``PHOTON_P2P_RETRIES`` (default 0 = the pre-retry behavior:
+    any link error tears the mesh down and raises, bit-for-bit). N > 0
+    enables the RELIABLE exchange mode: every framed exchange ends with
+    a per-link completion ACK (so one process's failure fails every
+    process's exchange — the cross-process precondition for a
+    consistent collective retry), and a failed exchange is retried up
+    to N times through the ``_reset_host_links`` teardown + cached-
+    address rebuild path, with exponential backoff
+    (``PHOTON_P2P_BACKOFF_S``) between attempts."""
+    env = os.environ.get("PHOTON_P2P_RETRIES")
+    if env is not None and env != "":
+        return max(int(env), 0)
+    return 0
+
+
+def _p2p_backoff_s() -> float:
+    """Base backoff between exchange retry attempts, knob
+    ``PHOTON_P2P_BACKOFF_S`` (seconds; attempt k sleeps
+    ``base * 2**k``, plus a deterministic per-process jitter fraction
+    derived from (process index, attempt) — decorrelated across the
+    fleet with no RNG state, the seedless discipline the fault plan
+    uses)."""
+    env = os.environ.get("PHOTON_P2P_BACKOFF_S")
+    if env is not None and env != "":
+        return max(float(env), 0.0)
+    return 0.5
+
+
+def _retry_backoff_sleep(attempt: int) -> float:
+    base = _p2p_backoff_s()
+    if base <= 0.0:
+        return 0.0
+    # deterministic jitter in [0, 0.5): hash of (pid, attempt) — every
+    # process backs off a slightly different amount without any RNG
+    pid = jax.process_index()
+    jitter = ((pid * 2654435761 + attempt * 40503) % 512) / 1024.0
+    return base * (2.0 ** attempt) * (1.0 + jitter)
+
+
+def _p2p_crc_enabled() -> bool:
+    """``PHOTON_P2P_CRC`` (default 0): advertise frame-protocol v1 at
+    mesh build. A link uses the CRC32 integrity trailer only when BOTH
+    ends advertised v1 (the hello's spare high bytes carry the version,
+    so a v0 peer still reads its pid unchanged) — corruption then
+    surfaces as a detected ``LinkCorruption`` instead of a mis-framed
+    length prefix downstream. Off = the PR-10 wire format byte-for-
+    byte."""
+    env = os.environ.get("PHOTON_P2P_CRC")
+    if env is not None and env != "":
+        return int(env) != 0
+    return False
+
+
+# frame-protocol versions a process can advertise in the mesh hello:
+# 0 = length-prefixed frames (the original wire format), 1 = length
+# prefix + payload + CRC32 trailer. The hello int packs
+# ``pid | (version << 16)`` — version 0 leaves the hello bytes exactly
+# the PR-10 wire bytes.
+_FRAME_PROTO_CRC = 1
+
+
+class LinkCorruption(ConnectionError):
+    """A framed-P2P payload failed its CRC32 integrity check — the
+    frame ARRIVED (framing intact) but its bytes are wrong. A transient
+    fault for the retry layer: the mesh tears down and the exchange
+    re-runs."""
+
+
+class PeerUnreachable(ConnectionError):
+    """A mesh (re)build could not reach one specific peer (connect
+    refused / timed out / accept never arrived). Transient until the
+    retry budget exhausts — then it hardens into ``PeerLost``."""
+
+    def __init__(self, peer: int, message: str):
+        super().__init__(message)
+        self.peer = peer
+
+
+class PeerLost(ConnectionError):
+    """Retries exhausted against a specific peer: the exchange layer
+    has given up on reaching it. Callers with a recovery path (the
+    streamed GAME trainer) catch this, confirm the loss with a roll
+    call, re-plan placement around the dead peer and resume from the
+    last checkpoint; callers without one get a clean abort that names
+    the peer instead of a 300 s timeout stack."""
+
+    def __init__(self, peer: int, message: str):
+        super().__init__(message)
+        self.peer = peer
+
+
+# -- degraded process group (peer-loss recovery) -----------------------------
+#
+# After a confirmed peer loss the jax collective runtime is unusable
+# (every collective would include — and hang on — the dead process), so
+# recovery shrinks the world HOST-SIDE: a degraded group names the
+# surviving ORIGINAL process indices, every multihost helper in this
+# module routes through the framed-P2P survivor mesh (addresses are
+# cached from the first build — no collective needed), and
+# ``effective_process_index/count`` replace ``jax.process_index/count``
+# for group-shaped decisions. The jax runtime itself stays up (device
+# compute is process-local); it is simply never asked to cross
+# processes again.
+
+_DEGRADED: dict | None = None
+
+
+def degraded_group() -> dict | None:
+    return _DEGRADED
+
+
+def effective_process_count() -> int:
+    if _DEGRADED is not None:
+        return len(_DEGRADED["survivors"])
+    return jax.process_count()
+
+
+def effective_process_index() -> int:
+    if _DEGRADED is not None:
+        return _DEGRADED["rank"]
+    return jax.process_index()
+
+
+def set_degraded_group(survivors) -> None:
+    """Shrink this process's world to ``survivors`` (sorted original
+    process indices; must include this process). Tears the socket mesh
+    down — the next exchange rebuilds it over the survivor set from the
+    cached addresses."""
+    global _DEGRADED
+    survivors = tuple(sorted(int(s) for s in survivors))
+    pid = jax.process_index()
+    if pid not in survivors:
+        raise ValueError(
+            f"process {pid} cannot join a degraded group {survivors} "
+            "that excludes it"
+        )
+    _reset_host_links()
+    if len(survivors) == jax.process_count() and _DEGRADED is None:
+        return  # full group = not degraded
+    _DEGRADED = {
+        "survivors": survivors,
+        "rank": survivors.index(pid),
+    }
+    from photon_ml_tpu.obs.metrics import REGISTRY
+
+    REGISTRY.gauge_set("fleet.survivors", float(len(survivors)))
+    _emit_event(
+        "degraded_group", survivors=list(survivors),
+        rank=_DEGRADED["rank"],
+    )
+
+
+def _orig_pid(rank: int) -> int:
+    """Effective rank -> original process index (identity when the
+    group is whole)."""
+    if _DEGRADED is not None:
+        return _DEGRADED["survivors"][rank]
+    return rank
+
+
 def _p2p_heartbeat_s() -> float | None:
     """Blocked-recv heartbeat cadence, knob ``PHOTON_P2P_HEARTBEAT_S``
     (seconds; ``0`` or negative disables). While a framed-P2P recv is
@@ -651,6 +1052,7 @@ def _recv_exact(sock, n: int, peer: int | None = None,
                 _emit_event(
                     "p2p_heartbeat", peer=peer, tag=tag,
                     blocked_s=silent, bytes_remaining=n,
+                    direction="recv",
                 )
                 if timeout_s is not None and silent >= timeout_s:
                     import socket as _socket
@@ -671,70 +1073,329 @@ def _recv_exact(sock, n: int, peer: int | None = None,
     return b"".join(chunks)
 
 
-def _host_links() -> dict:
-    """Build (once) the P×P socket mesh: every ordered pair (i → j) gets a
-    dedicated unidirectional TCP connection, so concurrent sends and
-    receives never share a stream. Address discovery bootstraps over the
-    existing ``jax.distributed`` runtime: each process allgathers its
-    (IPv4, port) as five small ints — the only use of a collective here.
-    Must be called collectively."""
-    global _HOST_LINKS
-    if _HOST_LINKS is not None:
-        return _HOST_LINKS
+def _sendall_hb(sock, data: bytes, peer: int | None = None,
+                tag: str | None = None,
+                heartbeat: float | None = None) -> None:
+    """``sendall`` twin of ``_recv_exact``'s heartbeat mode.
+    ``heartbeat=None`` (always, when no sink is active) is
+    ``sock.sendall`` verbatim — the original hot path. With a
+    heartbeat, a send stalled on a full kernel buffer toward a wedged
+    peer emits rate-limited ``p2p_heartbeat`` events with ``direction:
+    send`` — previously a blocked SEND was invisible until the timeout
+    abort (only blocked recvs heartbeated). Timeout semantics mirror
+    the plain path's ``settimeout``: max SILENCE, the clock resets
+    whenever bytes move."""
+    if heartbeat is None:
+        sock.sendall(data)
+        return
+    import selectors
+
+    timeout_s = _p2p_timeout_s()
+    view = memoryview(data)
+    silent = 0.0
+    with selectors.DefaultSelector() as sel:
+        sel.register(sock, selectors.EVENT_WRITE)
+        while view:
+            t0 = time.perf_counter()
+            ready = sel.select(timeout=heartbeat)
+            if not ready:
+                silent += time.perf_counter() - t0
+                _emit_event(
+                    "p2p_heartbeat", peer=peer, tag=tag,
+                    blocked_s=silent, bytes_remaining=len(view),
+                    direction="send",
+                )
+                if timeout_s is not None and silent >= timeout_s:
+                    import socket as _socket
+
+                    raise _socket.timeout(
+                        f"exchange send to process {peer} blocked for "
+                        f"{silent:.1f}s (PHOTON_P2P_TIMEOUT_S)"
+                    )
+                continue
+            sent = sock.send(view)
+            if sent == 0:
+                raise ConnectionError(
+                    "exchange peer closed the connection"
+                )
+            silent = 0.0
+            view = view[sent:]
+
+
+def _send_frame(sock, payload: bytes, crc: bool,
+                peer: int | None = None, tag: str | None = None,
+                heartbeat: float | None = None,
+                corrupt_wire: bool = False) -> None:
+    """One framed payload: 8-byte length prefix + payload, plus (frame
+    protocol v1, negotiated per link at mesh build) a CRC32 trailer of
+    the payload. The length prefix never counts the trailer, so every
+    row-count validation downstream is protocol-independent.
+
+    ``corrupt_wire`` (fault injection only) flips a payload byte AFTER
+    the trailer is computed — modelling a wire/buffer fault, which is
+    exactly what the trailer exists to catch. A pre-CRC flip would be
+    faithfully checksummed and arrive "valid"."""
+    import struct
+
+    wire = payload
+    if corrupt_wire:
+        from photon_ml_tpu.parallel import faults
+
+        wire = faults._corrupt(payload)
+    _sendall_hb(sock, struct.pack("!q", len(payload)), peer, tag, heartbeat)
+    _sendall_hb(sock, wire, peer, tag, heartbeat)
+    if crc:
+        import zlib
+
+        _sendall_hb(
+            sock, struct.pack("!I", zlib.crc32(payload)),
+            peer, tag, heartbeat,
+        )
+
+
+def _recv_frame_payload(sock, n: int, crc: bool,
+                        peer: int | None = None, tag: str | None = None,
+                        heartbeat: float | None = None) -> bytes:
+    """The payload bytes of a frame whose length prefix was already
+    read, verifying the v1 CRC trailer when the link negotiated it. A
+    mismatch raises ``LinkCorruption`` — a DETECTED transient for the
+    retry layer, where the unchecked protocol would have handed
+    corrupt rows to the solver (or mis-framed every later exchange)."""
+    raw = _recv_exact(sock, n, peer, tag, heartbeat)
+    if crc:
+        import struct
+        import zlib
+
+        want = struct.unpack(
+            "!I", _recv_exact(sock, 4, peer, tag, heartbeat)
+        )[0]
+        got = zlib.crc32(raw)
+        if got != want:
+            raise LinkCorruption(
+                f"exchange frame from process {peer} tag {tag!r}: "
+                f"CRC32 mismatch (got {got:#010x}, trailer {want:#010x})"
+            )
+    return raw
+
+
+# completion-ACK magic for the reliable exchange mode: one byte per
+# link per exchange, confirming the peer finished its WHOLE exchange —
+# without it, one process's failure could leave peers believing the
+# exchange succeeded, and a later retry would resend frames into
+# streams whose counters no longer agree (silent mis-framing)
+_ACK_BYTE = b"\xa5"
+
+
+# addresses from the FIRST mesh build, cached process-wide: {orig_pid:
+# (ip_str, port)}. A REBUILD (retry after teardown, survivor mesh after
+# a peer loss) reuses them and re-binds this process's own recorded
+# port — no collective, so a rebuild is legal from the exchange worker
+# thread and from a degraded group the jax runtime can no longer span.
+_HOST_ADDRS: dict[int, tuple[str, int]] | None = None
+
+# serializes mesh builds across threads (the exchange worker may rebuild
+# mid-retry while the main thread bootstraps an async exchange)
+import threading as _threading
+
+_LINKS_BUILD_LOCK = _threading.RLock()
+
+
+def _hello_int(pid: int) -> int:
+    """The mesh hello: the sender's pid, with the advertised frame-
+    protocol version in the spare high bytes. Version 0 (CRC knob off)
+    leaves the int — and the wire bytes — exactly the original pid."""
+    proto = _FRAME_PROTO_CRC if _p2p_crc_enabled() else 0
+    return pid | (proto << 16)
+
+
+def _decode_hello(raw: int) -> tuple[int, int]:
+    return raw & 0xFFFF, raw >> 16
+
+
+def _close_quietly(sock) -> None:
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+def _gather_link_addrs() -> dict[int, tuple[str, int]]:
+    """First-build address bootstrap over the jax runtime (collective —
+    each process allgathers its (IPv4, port) as five small ints; the
+    only collective the mesh ever uses) with this process's listener
+    already bound. Cached for every later rebuild."""
+    import socket
+
+    from jax.experimental import multihost_utils as mhu
+
+    P_ = jax.process_count()
+    assert _HOST_ADDRS is not None  # own entry recorded by caller
+    ip = np.frombuffer(
+        socket.inet_aton(_HOST_ADDRS[jax.process_index()][0]), np.uint8
+    ).astype(np.int64)
+    port = _HOST_ADDRS[jax.process_index()][1]
+    addrs = np.asarray(
+        mhu.process_allgather(np.concatenate([ip, [port]]))
+    ).reshape(P_, 5)
+    return {
+        p: (
+            socket.inet_ntoa(addrs[p, :4].astype(np.uint8).tobytes()),
+            int(addrs[p, 4]),
+        )
+        for p in range(P_)
+    }
+
+
+def _build_host_links(peers: list[int], timeout_s, srv=None) -> dict:
+    """One full-mesh build over ``peers`` (original pids, this process
+    included): every ordered pair gets a dedicated unidirectional TCP
+    connection, so concurrent sends and receives never share a stream.
+    On ANY partial failure the already-established sockets are closed,
+    the listener is closed and the acceptor thread is JOINED before the
+    error propagates — a half-built mesh must never leak connected
+    sockets or a live acceptor into the next rebuild attempt (they
+    would accept/deliver stale hellos there and mis-key the mesh).
+
+    Returns ``{"send": {pid: sock}, "recv": {pid: sock},
+    "proto": {pid: negotiated version}}``."""
     import socket
     import struct
     import threading
 
-    from jax.experimental import multihost_utils as mhu
-
-    timeout_s = _p2p_timeout_s()
-    P_ = jax.process_count()
+    global _HOST_ADDRS
     pid = jax.process_index()
-    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    srv.settimeout(timeout_s)  # accept() must not hang on a dead peer
-    srv.bind(("0.0.0.0", 0))
-    srv.listen(P_)
-    port = srv.getsockname()[1]
-    ip = np.frombuffer(
-        socket.inet_aton(_local_ip()), np.uint8
-    ).astype(np.int64)
-    addrs = np.asarray(
-        mhu.process_allgather(np.concatenate([ip, [port]]))
-    ).reshape(P_, 5)
+    others = [p for p in peers if p != pid]
+    first_build = _HOST_ADDRS is None
+    if srv is None:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.settimeout(timeout_s)  # accept() must not hang on a dead peer
+        # rebuilds bind the RECORDED port (peers dial the cached
+        # address); the first build lets the OS pick
+        own_port = 0 if first_build else _HOST_ADDRS[pid][1]
+        try:
+            srv.bind(("0.0.0.0", own_port))
+        except OSError:
+            srv.close()
+            raise
+        srv.listen(max(len(peers), 1))
+    if first_build:
+        _HOST_ADDRS = {pid: (_local_ip(), srv.getsockname()[1])}
+        try:
+            _HOST_ADDRS = _gather_link_addrs()
+        except BaseException:
+            _HOST_ADDRS = None
+            srv.close()
+            raise
 
     recv_socks: dict[int, socket.socket] = {}
+    recv_protos: dict[int, int] = {}
+    accept_err: list[BaseException] = []
 
     def accept_all():
-        for _ in range(P_ - 1):
-            conn, _ = srv.accept()
-            _configure_link_socket(conn)
-            src = struct.unpack("!i", _recv_exact(conn, 4))[0]
-            recv_socks[src] = conn
+        try:
+            for _ in range(len(others)):
+                conn, _ = srv.accept()
+                _configure_link_socket(conn)
+                raw = struct.unpack("!i", _recv_exact(conn, 4))[0]
+                src, proto = _decode_hello(raw)
+                if src in recv_socks:
+                    # a peer re-dialed (its previous build attempt
+                    # aborted): the stale socket is dead — replace it
+                    _close_quietly(recv_socks[src])
+                recv_socks[src] = conn
+                recv_protos[src] = proto
+        except BaseException as e:
+            accept_err.append(e)
 
     acceptor = threading.Thread(target=accept_all, daemon=True)
     acceptor.start()
     send_socks: dict[int, socket.socket] = {}
-    for r in range(1, P_):
-        peer = (pid + r) % P_
-        peer_ip = socket.inet_ntoa(
-            addrs[peer, :4].astype(np.uint8).tobytes()
-        )
-        s = socket.create_connection(
-            (peer_ip, int(addrs[peer, 4])), timeout=timeout_s
-        )
-        _configure_link_socket(s)
-        s.sendall(struct.pack("!i", pid))
-        send_socks[peer] = s
-    acceptor.join(timeout=timeout_s)
-    if len(recv_socks) != P_ - 1:
-        raise RuntimeError(
-            f"host exchange mesh incomplete: accepted {len(recv_socks)} "
-            f"of {P_ - 1} peers"
-        )
+    send_protos: dict[int, int] = {}
+    try:
+        order = sorted(others, key=lambda p: (p - pid) % max(len(peers), 1))
+        for peer in order:
+            peer_ip, peer_port = _HOST_ADDRS[peer]
+            # dial with PATIENCE while our own listener stays up: on a
+            # concurrent rebuild both peers race listen-then-dial, and a
+            # refused connect only means the peer has not re-listened
+            # YET. Abandoning the whole build on first refusal would
+            # close our listener too — two rebuilding peers would then
+            # livelock, each dialing the other's closed port during the
+            # other's backoff sleep. So refusals retry in place until
+            # the per-build timeout budget; only then is the peer
+            # declared unreachable for this attempt.
+            deadline = time.monotonic() + (
+                timeout_s if timeout_s is not None else 30.0
+            )
+            while True:
+                try:
+                    s = socket.create_connection(
+                        (peer_ip, peer_port), timeout=timeout_s
+                    )
+                    break
+                except OSError as e:
+                    if time.monotonic() >= deadline:
+                        raise PeerUnreachable(
+                            peer,
+                            f"exchange mesh build: cannot connect to "
+                            f"process {peer} at {peer_ip}:{peer_port}: "
+                            f"{e}",
+                        ) from e
+                    time.sleep(0.05)
+            _configure_link_socket(s)
+            s.sendall(struct.pack("!i", _hello_int(pid)))
+            send_socks[peer] = s
+        acceptor.join(timeout=timeout_s)
+        if acceptor.is_alive() or len(recv_socks) != len(others):
+            missing = sorted(set(others) - set(recv_socks))
+            err = RuntimeError(
+                f"host exchange mesh incomplete: accepted "
+                f"{len(recv_socks)} of {len(others)} peers"
+                + (f" (missing {missing})" if missing else "")
+            )
+            if len(missing) == 1:
+                err = PeerUnreachable(missing[0], str(err))
+            raise err
+    except BaseException:
+        # partial-failure cleanup: closing the listener unblocks a
+        # still-alive acceptor (accept() raises), so the join below
+        # cannot hang; every established socket closes so nothing
+        # leaks into the next attempt
+        srv.close()
+        for s in send_socks.values():
+            _close_quietly(s)
+        for s in recv_socks.values():
+            _close_quietly(s)
+        acceptor.join(timeout=timeout_s)
+        raise
     srv.close()
-    _HOST_LINKS = {"send": send_socks, "recv": recv_socks}
-    return _HOST_LINKS
+    my_proto = _FRAME_PROTO_CRC if _p2p_crc_enabled() else 0
+    # per-link negotiation: the CRC trailer rides a link only when BOTH
+    # ends advertised it (the send side knows the peer's version from
+    # the recv-side hello — the mesh is symmetric, every pair has both
+    # links, and each process advertises ONE version to everyone)
+    proto = {
+        p: min(my_proto, recv_protos.get(p, 0)) for p in others
+    }
+    return {"send": send_socks, "recv": recv_socks, "proto": proto}
+
+
+def _host_links() -> dict:
+    """The (lazily built) socket mesh for this process's CURRENT group
+    — all processes normally, the survivors after a degraded-group
+    switch. First build must be called collectively (address
+    bootstrap); rebuilds are collective-free (cached addresses)."""
+    global _HOST_LINKS
+    with _LINKS_BUILD_LOCK:
+        if _HOST_LINKS is not None:
+            return _HOST_LINKS
+        if _DEGRADED is not None:
+            peers = list(_DEGRADED["survivors"])
+        else:
+            peers = list(range(jax.process_count()))
+        _HOST_LINKS = _build_host_links(peers, _p2p_timeout_s())
+        return _HOST_LINKS
 
 
 def _host_p2p_exchange(arrays, order, starts, counts_matrix=None,
@@ -754,16 +1415,60 @@ def _host_p2p_exchange(arrays, order, starts, counts_matrix=None,
     mis-frame every later exchange. Peers fail fast against the closed
     sockets on their next use and reset themselves, so retries rebuild
     the mesh instead of corrupting data.
+
+    ``PHOTON_P2P_RETRIES`` > 0 makes that retry AUTOMATIC: transient
+    link faults (connect refused, recv timeout, peer EOF, CRC
+    corruption) are retried here with bounded exponential backoff +
+    jitter through the cached-address mesh rebuild — collective-free,
+    so the retry is legal from the exchange worker thread too. The
+    reliable mode's per-exchange completion ACK guarantees every
+    process observes the same exchange outcome, so all peers retry the
+    SAME exchange and the rebuilt streams stay frame-matched. When the
+    budget exhausts against one unreachable peer, the error hardens
+    into ``PeerLost`` — the recovery layer's signal.
     """
-    try:
-        return _host_p2p_exchange_impl(
-            arrays, order, starts, counts_matrix, transport, tag
-        )
-    except BaseException:
-        # closing the sockets also unblocks a sender thread stuck in
-        # sendall against a stalled peer — it errors out and exits
-        _reset_host_links()
-        raise
+    retries = _p2p_retries()
+    attempt = 0
+    while True:
+        try:
+            return _host_p2p_exchange_impl(
+                arrays, order, starts, counts_matrix, transport, tag
+            )
+        except BaseException as e:
+            # closing the sockets also unblocks a sender thread stuck
+            # in sendall against a stalled peer — it errors out + exits
+            _reset_host_links()
+            transient = isinstance(e, OSError)
+            if transient and attempt < retries:
+                attempt += 1
+                backoff = _retry_backoff_sleep(attempt - 1)
+                from photon_ml_tpu.obs.metrics import REGISTRY
+
+                REGISTRY.counter_inc("p2p.retries")
+                _emit_event(
+                    "p2p_retry", attempt=attempt, max_attempts=retries,
+                    tag=tag, error=type(e).__name__,
+                    peer=getattr(e, "peer", None), backoff_s=backoff,
+                )
+                if backoff > 0.0:
+                    time.sleep(backoff)
+                continue
+            if retries and transient:
+                from photon_ml_tpu.obs.metrics import REGISTRY
+
+                REGISTRY.counter_inc("p2p.giveups")
+                _emit_event(
+                    "p2p_giveup", attempts=attempt, tag=tag,
+                    error=type(e).__name__,
+                    peer=getattr(e, "peer", None),
+                )
+                if isinstance(e, PeerUnreachable):
+                    raise PeerLost(
+                        e.peer,
+                        f"exchange retries exhausted ({retries}) against "
+                        f"unreachable process {e.peer}: {e}",
+                    ) from e
+            raise
 
 
 def _host_p2p_exchange_impl(arrays, order, starts, counts_matrix,
@@ -779,9 +1484,14 @@ def _host_p2p_exchange_impl(arrays, order, starts, counts_matrix,
     import struct
     import threading
 
-    P_ = jax.process_count()
-    pid = jax.process_index()
+    from photon_ml_tpu.parallel import faults
+
+    P_ = effective_process_count()
+    pid = effective_process_index()
     links = _host_links()
+    protos = links.get("proto", {})
+    reliable = _p2p_retries() > 0
+    plan = faults.active_plan()
     keys = sorted(arrays)
     parts: dict[str, dict[int, np.ndarray]] = {
         k: {pid: np.ascontiguousarray(
@@ -802,29 +1512,44 @@ def _host_p2p_exchange_impl(arrays, order, starts, counts_matrix,
         try:
             for r in range(1, P_):
                 peer = (pid + r) % P_
-                sock = links["send"][peer]
-                seq = _next_link_seq("send", peer)
+                o_pid, o_peer = _orig_pid(pid), _orig_pid(peer)
+                sock = links["send"][o_peer]
+                crc = protos.get(o_peer, 0) >= _FRAME_PROTO_CRC
+                seq = _next_link_seq("send", o_peer)
                 t_start = time.time()
                 t0 = time.perf_counter()
+                rows = order[starts[peer]:starts[peer + 1]]
+                bufs = [
+                    np.ascontiguousarray(arrays[k][rows]).tobytes()
+                    for k in keys
+                ]
+                corrupt_wire = False
+                if plan is not None:
+                    spec = plan.pop_send_fault(o_pid, o_peer, seq, tag)
+                    if spec is not None:
+                        bufs, corrupt_wire = faults.apply_send_fault(
+                            spec, bufs, sock
+                        )
                 peer_bytes = 0
-                for k in keys:
-                    rows = order[starts[peer]:starts[peer + 1]]
-                    buf = np.ascontiguousarray(arrays[k][rows]).tobytes()
-                    sock.sendall(struct.pack("!q", len(buf)))
-                    sock.sendall(buf)
-                    peer_bytes += len(buf)
+                if bufs is not None:  # None = the frame set was dropped
+                    for j, buf in enumerate(bufs):
+                        _send_frame(
+                            sock, buf, crc, o_peer, tag, heartbeat,
+                            corrupt_wire=corrupt_wire and j == 0,
+                        )
+                        peer_bytes += len(buf)
                 bytes_sent += peer_bytes
                 if telemetry:
                     # one event per (link, exchange): the frame-set, not
                     # per key — report fleet joins it with the peer's
                     # p2p_recv through the shared correlation id
                     _emit_event(
-                        "p2p_send", peer=peer,
+                        "p2p_send", peer=o_peer,
                         bytes=peer_bytes,
                         rows=int(starts[peer + 1] - starts[peer]),
                         dur_s=time.perf_counter() - t0,
                         t_start=t_start,
-                        corr=f"p2p:{pid}>{peer}#{seq}",
+                        corr=f"p2p:{o_pid}>{o_peer}#{seq}",
                         tag=tag, transport=transport,
                     )
         except BaseException as e:  # surfaced after join
@@ -834,8 +1559,10 @@ def _host_p2p_exchange_impl(arrays, order, starts, counts_matrix,
     sender.start()
     for r in range(1, P_):
         src = (pid - r) % P_
-        sock = links["recv"][src]
-        seq = _next_link_seq("recv", src)
+        o_pid, o_src = _orig_pid(pid), _orig_pid(src)
+        sock = links["recv"][o_src]
+        crc = protos.get(o_src, 0) >= _FRAME_PROTO_CRC
+        seq = _next_link_seq("recv", o_src)
         t_start = time.time()
         t0 = time.perf_counter()
         src_bytes = 0
@@ -847,20 +1574,20 @@ def _host_p2p_exchange_impl(arrays, order, starts, counts_matrix,
                 np.prod(a.shape[1:], dtype=np.int64)
             )
             got = struct.unpack(
-                "!q", _recv_exact(sock, 8, src, tag, heartbeat)
+                "!q", _recv_exact(sock, 8, o_src, tag, heartbeat)
             )[0]
             if counts_matrix is not None:
                 n = int(counts_matrix[src, pid])
                 want = n * row_bytes
                 if got != want:
                     raise RuntimeError(
-                        f"exchange size mismatch from process {src} key "
+                        f"exchange size mismatch from process {o_src} key "
                         f"{k!r}: expected {want} bytes ({n} rows), got {got}"
                     )
             else:
                 if row_bytes <= 0 or got % row_bytes:
                     raise RuntimeError(
-                        f"exchange frame from process {src} key {k!r}: "
+                        f"exchange frame from process {o_src} key {k!r}: "
                         f"{got} bytes is not a multiple of the "
                         f"{row_bytes}-byte row"
                     )
@@ -869,11 +1596,11 @@ def _host_p2p_exchange_impl(arrays, order, starts, counts_matrix,
                     n_src = n
                 elif n != n_src:
                     raise RuntimeError(
-                        f"exchange frames from process {src} disagree on "
+                        f"exchange frames from process {o_src} disagree on "
                         f"row count: key {k!r} carries {n} rows, earlier "
                         f"keys carried {n_src}"
                     )
-            raw = _recv_exact(sock, got, src, tag, heartbeat)
+            raw = _recv_frame_payload(sock, got, crc, o_src, tag, heartbeat)
             src_bytes += got
             src_rows = n
             parts[k][src] = np.frombuffer(raw, a.dtype).reshape(
@@ -881,16 +1608,39 @@ def _host_p2p_exchange_impl(arrays, order, starts, counts_matrix,
             ).copy()
         if telemetry:
             _emit_event(
-                "p2p_recv", peer=src,
+                "p2p_recv", peer=o_src,
                 bytes=src_bytes, rows=int(src_rows),
                 dur_s=time.perf_counter() - t0,
                 t_start=t_start,
-                corr=f"p2p:{src}>{pid}#{seq}",
+                corr=f"p2p:{o_src}>{o_pid}#{seq}",
                 tag=tag, transport=transport,
             )
     sender.join()
     if send_err:
         raise send_err[0]
+    if reliable:
+        # completion-ACK round (reliable mode only — one extra byte per
+        # link per exchange, absent from the knob-off wire format): a
+        # link's ACK arrives only after its peer finished its WHOLE
+        # exchange, so any single failure fails every process's
+        # exchange and the collective retry stays frame-matched
+        for r in range(1, P_):
+            peer = (pid + r) % P_
+            _sendall_hb(
+                links["send"][_orig_pid(peer)], _ACK_BYTE,
+                _orig_pid(peer), tag, heartbeat,
+            )
+        for r in range(1, P_):
+            src = (pid - r) % P_
+            o_src = _orig_pid(src)
+            got = _recv_exact(
+                links["recv"][o_src], 1, o_src, tag, heartbeat
+            )
+            if got != _ACK_BYTE:
+                raise RuntimeError(
+                    f"exchange completion ACK from process {o_src} "
+                    f"carries {got!r} (stream desync)"
+                )
     # this process's send counts: identical to counts_matrix[pid] when a
     # matrix was exchanged, and derivable locally when not (framed mode)
     counts_send = np.diff(starts)
@@ -996,8 +1746,10 @@ class ExchangeHandle:
                 )
             _, lock = _exchange_state()
             with lock:
-                if self._future in _PENDING_EXCHANGES:
-                    _PENDING_EXCHANGES.remove(self._future)
+                _PENDING_EXCHANGES[:] = [
+                    e for e in _PENDING_EXCHANGES
+                    if e[0] is not self._future
+                ]
         self._future = None
         self._value = out
         return out
@@ -1008,15 +1760,48 @@ def drain_async_exchanges() -> None:
     through their handles). A SYNCHRONOUS p2p exchange must not touch
     the sockets while the worker is mid-frame, and submission order is
     the cross-process consistency invariant — so the sync path drains
-    first, preserving one global socket-use order."""
+    first, preserving one global socket-use order.
+
+    A worker exception observed here is RECORDED (``exchange_drain_
+    error`` event + ``p2p.exchange_drain_errors`` counter) before being
+    left for the owner handle to re-raise — previously it was swallowed
+    bare, so a failed background exchange whose handle was never polled
+    was invisible in ``report fleet``. A failed entry is dropped from
+    the pending list on first observation (the handle keeps its own
+    future reference, so ``result()`` still re-raises) — otherwise
+    every later drain would re-wait and re-report the same failure."""
     _, lock = _exchange_state()
     with lock:
         pending = list(_PENDING_EXCHANGES)
-    for f in pending:
+    for entry in pending:
+        f, tag = entry
         try:
-            f.exception()  # waits; the owner handle re-raises on result()
-        except Exception:
-            pass
+            exc = f.exception()  # waits; the owner handle re-raises on
+            # result() — this is observation, not consumption
+        except Exception as e:
+            exc = e
+        if exc is not None:
+            with lock:
+                if entry in _PENDING_EXCHANGES:
+                    _PENDING_EXCHANGES.remove(entry)
+            from photon_ml_tpu.obs.metrics import REGISTRY
+
+            REGISTRY.counter_inc("p2p.exchange_drain_errors")
+            _emit_event(
+                "exchange_drain_error", tag=tag,
+                error=type(exc).__name__,
+                peer=getattr(exc, "peer", None),
+            )
+
+
+def reset_async_exchanges() -> None:
+    """Forget every pending async-exchange record without waiting.
+    Recovery calls this after a peer loss: the failed attempt's handles
+    are abandoned wholesale, and leaving their futures in the pending
+    list would make every later drain re-wait and re-report them."""
+    _, lock = _exchange_state()
+    with lock:
+        _PENDING_EXCHANGES.clear()
 
 
 def exchange_rows_async(
@@ -1031,7 +1816,7 @@ def exchange_rows_async(
     first use, so the collective stays in program order. Single process:
     completes inline (identity)."""
     arrays = {k: np.asarray(v) for k, v in arrays.items()}
-    P_ = jax.process_count()
+    P_ = effective_process_count()
     if P_ <= 1:
         LAST_EXCHANGE_STATS.update(
             bytes_sent=0, rows_sent=len(dest), padded_rows=len(dest),
@@ -1066,16 +1851,25 @@ def exchange_rows_async(
 
     fut = pool.submit(run)
     with lock:
-        _PENDING_EXCHANGES.append(fut)
+        _PENDING_EXCHANGES.append((fut, tag))
     return ExchangeHandle(future=fut, tag=tag)
 
 
 def allreduce_max_host(*arrays: np.ndarray):
-    """Elementwise max across ALL processes (identity on one process).
-    Used by the streamed feature summary for min/max statistics (min rides
-    as max of the negation)."""
-    if jax.process_count() <= 1:
+    """Elementwise max across ALL processes of the current group
+    (identity on one process). Used by the streamed feature summary for
+    min/max statistics (min rides as max of the negation)."""
+    if effective_process_count() <= 1:
         return arrays if len(arrays) > 1 else arrays[0]
+    if _DEGRADED is not None:
+        gathered = _p2p_allgather_obj(
+            tuple(np.asarray(a) for a in arrays), tag="allreduce_max"
+        )
+        maxed = tuple(
+            np.max(np.stack([g[i] for g in gathered]), axis=0)
+            for i in range(len(arrays))
+        )
+        return maxed if len(maxed) > 1 else maxed[0]
     from jax.experimental import multihost_utils
 
     stacked = multihost_utils.process_allgather(arrays)  # each: (P, ...)

@@ -172,6 +172,48 @@ def plan_entity_placement(
     )
 
 
+def replan_excluding(
+    plan: PlacementPlan,
+    lost_shards: Sequence[int],
+    row_counts: Sequence[float] | np.ndarray,
+    survivors: Sequence[int],
+    groups: Sequence[Sequence[int]] | None = None,
+    skew_aware: bool = True,
+) -> tuple[PlacementPlan, np.ndarray]:
+    """Re-plan around lost shards (the peer-loss recovery step): run the
+    SAME deterministic LPT planner over ``len(survivors)`` shards and
+    return ``(new_plan, migrated)`` where ``new_plan.owner`` is in
+    SURVIVOR-RANK space (rank = position in the sorted survivor list —
+    the degraded group's effective indices) and ``migrated`` flags the
+    items whose owner changed between the old plan (original shard ids,
+    mapped through the survivor ranks) and the new one.
+
+    Like the original plan, this is pure host arithmetic on globally-
+    identical inputs (the allreduced row counts every process already
+    holds), so all survivors compute the IDENTICAL new plan with zero
+    extra communication — the property that lets recovery re-shard
+    without a coordinator."""
+    survivors = sorted(int(s) for s in survivors)
+    lost = {int(s) for s in lost_shards}
+    if set(survivors) & lost:
+        raise ValueError(
+            f"survivors {survivors} and lost shards {sorted(lost)} overlap"
+        )
+    if not survivors:
+        raise ValueError("no surviving shards to re-plan onto")
+    new_plan = plan_shard_placement(
+        row_counts, len(survivors), groups=groups, skew_aware=skew_aware
+    )
+    # old owner (original shard id) -> survivor rank, lost -> -1
+    rank_of = np.full(int(plan.num_shards), -1, np.int64)
+    for r, s in enumerate(survivors):
+        if s < len(rank_of):
+            rank_of[s] = r
+    old_ranks = rank_of[plan.owner]
+    migrated = old_ranks != new_plan.owner
+    return new_plan, migrated
+
+
 def record_placement_metrics(
     plan: PlacementPlan, shard: int | None = None, prefix: str = "re_shard"
 ) -> None:

@@ -1,0 +1,114 @@
+#!/usr/bin/env bash
+# One-command chaos drill for the fault-tolerance layer (ISSUE 11):
+# gloo 2-process loopback runs under COMMITTED deterministic fault
+# plans, exercised end to end with no real network flakiness.
+#
+# Leg 1 — transient absorption: one dropped offsets frame set plus one
+#   CRC-detected corrupted scores frame set. The run must complete
+#   (the link layer retries through the teardown/rebuild path), every
+#   shard closes cleanly, the fleet shards carry p2p_retry +
+#   fault_injected events, and `report gate --fleet` passes against
+#   the committed BASELINE_chaos_cpu.json (retries gated loose —
+#   scheduler timing can split a backoff — giveups/peer-losses EXACT
+#   zero: a transient plan must never escalate).
+#
+# Leg 2 — peer loss: the same drop plus a hard kill of process 1 at
+#   its second-visit offsets send. The survivor must exhaust retries
+#   into PeerLost, roll-call the loss, degrade to one process and
+#   resume from the last atomic checkpoint; the script asserts the
+#   recovery events in the survivor's shard and renders the fleet
+#   report (not gated: a killed process's shard truncates at whatever
+#   record the sink last committed, so its byte counts are timing-
+#   dependent by nature).
+#
+# Lives OUTSIDE tier-1 next to the slow gloo harness (spawns real
+# process pairs; ~2 min on CPU).
+#
+# Usage:
+#   scripts/chaos_quick.sh                   # drill + gate vs baseline
+#   UPDATE_BASELINE=1 scripts/chaos_quick.sh # re-capture the baseline
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+baseline="BASELINE_chaos_cpu.json"
+workdir="$(mktemp -d)"
+trap 'rm -rf "$workdir"' EXIT
+
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$workdir" <<'PY'
+import importlib.util
+import json
+import os
+import sys
+
+workdir = sys.argv[1]
+spec = importlib.util.spec_from_file_location(
+    "chaos_tm", os.path.join("tests", "test_multihost.py")
+)
+tm = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(tm)
+
+# ---- leg 1: transient plan (drop + CRC-detected corruption) ----------------
+teldir = os.path.join(workdir, "tel-transient")
+plan = [
+    {"op": "drop", "link": [0, 1], "seq": 1, "tag": "offsets"},
+    {"op": "corrupt", "link": [1, 0], "seq": 2, "tag": "scores"},
+]
+mode = {"fault_plan": plan, "telemetry_dir": teldir}
+res = tm._run_chaos_workers(2, {0: mode, 1: mode})
+assert set(res) == {0, 1}, sorted(res)
+retries = sum(r["counters"].get("p2p.retries", 0.0) for r in res.values())
+assert retries >= 2, res[0]["counters"]
+for r in res.values():
+    assert r["counters"].get("p2p.giveups", 0.0) == 0, r["counters"]
+    assert "fleet.peer_lost" not in r["counters"], r["counters"]
+
+from photon_ml_tpu.obs.report import (
+    fleet_run_paths, format_fleet, summarize_fleet,
+)
+
+paths = fleet_run_paths(teldir)
+fs = summarize_fleet(paths)
+rec = fs["recovery"]
+assert rec["p2p_retries"] >= 2 and rec["faults_injected"] == 2, rec
+assert rec["p2p_giveups"] == 0 and not rec["peer_lost"], rec
+print("chaos_quick: transient leg OK "
+      f"({rec['p2p_retries']} retries, {rec['faults_injected']} faults)")
+with open(os.path.join(workdir, "transient_run"), "w") as f:
+    f.write(paths[0])
+
+# ---- leg 2: peer kill -> checkpoint-anchored recovery ----------------------
+teldir2 = os.path.join(workdir, "tel-kill")
+ckpt = os.path.join(workdir, "ckpt")
+plan2 = [
+    {"op": "drop", "link": [0, 1], "seq": 1, "tag": "offsets"},
+    {"op": "kill", "link": [1, 0], "seq": 3, "tag": "offsets"},
+]
+mode2 = {
+    "fault_plan": plan2, "telemetry_dir": teldir2,
+    "iterations": 2, "checkpoint_dir": ckpt,
+}
+res2 = tm._run_chaos_workers(2, {0: mode2, 1: mode2}, allow_kill=(1,))
+surv = res2[0]
+assert surv["resumed_from"] == [1, 0], surv["resumed_from"]
+assert surv["counters"].get("fleet.peer_lost") == 1.0, surv["counters"]
+assert surv["counters"].get("fleet.recoveries") == 1.0, surv["counters"]
+fs2 = summarize_fleet(fleet_run_paths(teldir2))
+rec2 = fs2["recovery"]
+assert [p["peer"] for p in rec2["peer_lost"]] == [1], rec2
+assert len(rec2["recoveries"]) == 1, rec2
+print("chaos_quick: peer-kill leg OK (survivor resumed from checkpoint)")
+print(format_fleet(fs2))
+PY
+
+transient_run="$(cat "$workdir/transient_run")"
+
+if [[ "${UPDATE_BASELINE:-0}" == "1" ]]; then
+    python -m photon_ml_tpu.cli.main report gate --fleet "$transient_run" \
+        --write-baseline "$baseline"
+    echo "chaos_quick: baseline re-captured to $baseline"
+    exit 0
+fi
+
+python -m photon_ml_tpu.cli.main report gate --fleet "$transient_run" \
+    --baseline "$baseline"
+echo "chaos_quick: PASS"

@@ -1,0 +1,684 @@
+"""Host-side tests for the fault-tolerance layer (ISSUE 11): the
+deterministic fault plan, the CRC32 frame protocol, the retry/backoff
+wrapper with its PeerLost hardening, the blocked-send heartbeat, the
+drain-error telemetry satellite, and the degraded-group helpers. The
+end-to-end 2-process chaos drills live in test_multihost.py (slow,
+gloo-loopback); everything here runs in-process on fake sockets."""
+
+import socket
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+import photon_ml_tpu.parallel.faults as faults
+import photon_ml_tpu.parallel.multihost as mh
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plans():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class FrameSock:
+    """Replays pre-framed bytes on recv; records sends."""
+
+    def __init__(self, frames=(), crc=False):
+        self.buf = b"".join(
+            struct.pack("!q", len(f)) + f
+            + (struct.pack("!I", zlib.crc32(f)) if crc else b"")
+            for f in frames
+        )
+        self.sent: list[bytes] = []
+        self.closed = False
+
+    def recv(self, n):
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+    def sendall(self, data):
+        if self.closed:
+            raise OSError("socket closed")
+        self.sent.append(bytes(data))
+
+    def close(self):
+        self.closed = True
+
+
+class TestFaultPlanGrammar:
+    def test_parse_valid_plan(self):
+        plan = faults.parse_plan(
+            '[{"op": "drop", "link": [0, 1], "seq": 2, "tag": "offsets"},'
+            ' {"op": "delay", "link": [1, 0], "seq": 1, "delay_s": 0.01}]'
+        )
+        assert plan.remaining == 2
+        assert plan.specs[0].op == "drop"
+        assert (plan.specs[0].src, plan.specs[0].dst) == (0, 1)
+
+    def test_parse_from_file(self, tmp_path):
+        p = tmp_path / "plan.json"
+        p.write_text('[{"op": "close", "link": [0, 1], "seq": 1}]')
+        plan = faults.parse_plan(f"@{p}")
+        assert plan.specs[0].op == "close"
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            '{"op": "drop"}',  # not a list
+            '[{"op": "explode", "link": [0, 1], "seq": 1}]',  # bad op
+            '[{"op": "drop", "link": [0], "seq": 1}]',  # bad link
+            '[{"op": "drop", "link": [0, 1], "seq": 0}]',  # bad seq
+            '[{"op": "drop", "link": [0, 1], "seq": 1, "x": 1}]',  # key
+            '[{"op": "delay", "link": [0, 1], "seq": 1}]',  # no delay_s
+        ],
+    )
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            faults.parse_plan(bad)
+
+    def test_specs_fire_once_and_match_tag(self):
+        plan = faults.parse_plan(
+            '[{"op": "drop", "link": [0, 1], "seq": 1, "tag": "offsets"}]'
+        )
+        assert plan.pop_send_fault(0, 1, 1, "scores") is None
+        assert plan.pop_send_fault(0, 2, 1, "offsets") is None
+        spec = plan.pop_send_fault(0, 1, 1, "offsets")
+        assert spec is not None and spec.op == "drop"
+        # consumed: the retried frame set goes through clean
+        assert plan.pop_send_fault(0, 1, 1, "offsets") is None
+        assert plan.remaining == 0
+
+    def test_two_specs_one_frame_set_fire_on_successive_attempts(self):
+        plan = faults.parse_plan(
+            '[{"op": "drop", "link": [0, 1], "seq": 1},'
+            ' {"op": "drop", "link": [0, 1], "seq": 1}]'
+        )
+        assert plan.pop_send_fault(0, 1, 1, "") is not None
+        assert plan.pop_send_fault(0, 1, 1, "") is not None
+        assert plan.pop_send_fault(0, 1, 1, "") is None
+
+    def test_active_plan_caches_and_no_plan_is_none(self, monkeypatch):
+        monkeypatch.delenv("PHOTON_FAULT_PLAN", raising=False)
+        assert faults.active_plan() is None
+        monkeypatch.setenv(
+            "PHOTON_FAULT_PLAN",
+            '[{"op": "drop", "link": [0, 1], "seq": 1}]',
+        )
+        plan = faults.active_plan()
+        assert plan is not None
+        assert faults.active_plan() is plan  # cached (fired state sticks)
+        with pytest.raises(ValueError):
+            monkeypatch.setenv("PHOTON_FAULT_PLAN", '{"op": "x"}')
+            faults.active_plan()
+
+
+class TestFrameProtocol:
+    def _recv_frame(self, sock, crc):
+        n = struct.unpack("!q", mh._recv_exact(sock, 8))[0]
+        return mh._recv_frame_payload(sock, n, crc)
+
+    def test_crc_roundtrip(self):
+        payload = np.arange(7, dtype=np.float32).tobytes()
+        sock = FrameSock()
+        mh._send_frame(sock, payload, crc=True)
+        # wire: length prefix + payload + 4-byte trailer
+        assert b"".join(sock.sent) == (
+            struct.pack("!q", len(payload)) + payload
+            + struct.pack("!I", zlib.crc32(payload))
+        )
+        echo = FrameSock([payload], crc=True)
+        assert self._recv_frame(echo, crc=True) == payload
+
+    def test_crc_off_wire_bytes_identical_to_plain_framing(self):
+        payload = b"abcdef"
+        sock = FrameSock()
+        mh._send_frame(sock, payload, crc=False)
+        assert b"".join(sock.sent) == struct.pack("!q", 6) + payload
+
+    def test_corruption_detected(self):
+        payload = b"x" * 64
+        bad = faults._corrupt(payload)
+        assert bad != payload and len(bad) == len(payload)
+        wire = FrameSock()
+        wire.buf = (
+            struct.pack("!q", len(bad)) + bad
+            + struct.pack("!I", zlib.crc32(payload))  # trailer of GOOD
+        )
+        with pytest.raises(mh.LinkCorruption):
+            self._recv_frame(wire, crc=True)
+
+    def test_hello_negotiation(self, monkeypatch):
+        monkeypatch.delenv("PHOTON_P2P_CRC", raising=False)
+        assert mh._hello_int(3) == 3  # knob off: the PR-10 hello verbatim
+        monkeypatch.setenv("PHOTON_P2P_CRC", "1")
+        raw = mh._hello_int(3)
+        assert mh._decode_hello(raw) == (3, mh._FRAME_PROTO_CRC)
+        # a v0 receiver's mask still reads the right pid
+        assert raw & 0xFFFF == 3
+
+
+class TestKnobsOffWireIdentity:
+    def test_exchange_wire_bytes_identical_to_pre_retry_protocol(
+        self, monkeypatch
+    ):
+        """The acceptance anchor: with no fault plan and every knob
+        unset, the framed exchange puts EXACTLY the PR-10 bytes on the
+        wire — 8-byte length prefix + payload per key, no CRC trailer,
+        no completion ACK — asserted byte-for-byte on a captured fake
+        link."""
+        import jax
+
+        for k in ("PHOTON_P2P_CRC", "PHOTON_P2P_RETRIES",
+                  "PHOTON_FAULT_PLAN", "PHOTON_P2P_HEARTBEAT_S"):
+            monkeypatch.delenv(k, raising=False)
+        payload_in = np.arange(2, dtype=np.float32).tobytes()
+        links = {
+            "send": {1: FrameSock()},
+            "recv": {1: FrameSock([payload_in])},
+        }
+        monkeypatch.setattr(mh, "_HOST_LINKS", links)
+        monkeypatch.setattr(mh, "_host_links", lambda: links)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        monkeypatch.setattr(mh, "_LINK_SEQ", {"send": {}, "recv": {}})
+        arrays = {"v": np.arange(4, dtype=np.float32)}
+        order = np.arange(4, dtype=np.int64)
+        starts = np.asarray([0, 2, 4], np.int64)
+        out = mh._host_p2p_exchange(arrays, order, starts, None, tag="t")
+        np.testing.assert_array_equal(
+            out["v"], np.concatenate([arrays["v"][:2], [0.0, 1.0]])
+        )
+        expect = arrays["v"][2:4].tobytes()
+        assert b"".join(links["send"][1].sent) == (
+            struct.pack("!q", len(expect)) + expect
+        )
+        # and the peer's stream was drained exactly — no trailing ACK
+        # read attempt against the recv link
+        assert links["recv"][1].buf == b""
+
+
+class TestSendFaults:
+    def test_drop_returns_none(self):
+        spec = faults.FaultSpec(op="drop", src=0, dst=1, seq=1)
+        bufs, corrupt = faults.apply_send_fault(
+            spec, [b"abc"], FrameSock()
+        )
+        assert bufs is None and not corrupt
+
+    def test_corrupt_is_a_wire_fault_the_crc_catches(self):
+        """The corrupt op flags WIRE corruption: the frame payloads are
+        untouched (the CRC trailer is computed over them), and the link
+        layer flips bytes after checksumming — so the receiver's CRC
+        check fires. A pre-CRC flip would be faithfully checksummed and
+        arrive 'valid' (the original injection bug this test pins)."""
+        spec = faults.FaultSpec(op="corrupt", src=0, dst=1, seq=1)
+        bufs, corrupt = faults.apply_send_fault(
+            spec, [b"aaaa", b"bbbb"], FrameSock()
+        )
+        assert bufs == [b"aaaa", b"bbbb"] and corrupt
+        payload = b"x" * 32
+        sock = FrameSock()
+        mh._send_frame(sock, payload, crc=True, corrupt_wire=True)
+        wire = b"".join(sock.sent)
+        sent_payload = wire[8:-4]
+        trailer = struct.unpack("!I", wire[-4:])[0]
+        assert sent_payload != payload  # wire bytes flipped...
+        assert trailer == zlib.crc32(payload)  # ...after checksumming
+        assert zlib.crc32(sent_payload) != trailer  # receiver detects
+
+    def test_close_closes_socket(self):
+        sock = FrameSock()
+        spec = faults.FaultSpec(op="close", src=0, dst=1, seq=1)
+        bufs, corrupt = faults.apply_send_fault(spec, [b"abc"], sock)
+        assert sock.closed and bufs == [b"abc"] and not corrupt
+        with pytest.raises(OSError):
+            sock.sendall(b"x")  # the natural error path fires next
+
+    def test_delay_sleeps(self):
+        import time
+
+        spec = faults.FaultSpec(
+            op="delay", src=0, dst=1, seq=1, delay_s=0.05
+        )
+        t0 = time.perf_counter()
+        faults.apply_send_fault(spec, [b"abc"], FrameSock())
+        assert time.perf_counter() - t0 >= 0.04
+
+
+class TestRetryWrapper:
+    def _call(self, monkeypatch, attempts_needed, error, retries):
+        calls = {"n": 0}
+
+        def impl(*a, **k):
+            calls["n"] += 1
+            if calls["n"] <= attempts_needed:
+                raise error
+            return {"ok": calls["n"]}
+
+        monkeypatch.setattr(mh, "_host_p2p_exchange_impl", impl)
+        monkeypatch.setattr(mh, "_reset_host_links", lambda: None)
+        monkeypatch.setenv("PHOTON_P2P_RETRIES", str(retries))
+        monkeypatch.setenv("PHOTON_P2P_BACKOFF_S", "0")
+        return calls, lambda: mh._host_p2p_exchange(
+            {}, np.zeros(0, np.int64), np.zeros(1, np.int64), tag="t"
+        )
+
+    def test_transient_fault_retried_to_success(self, monkeypatch):
+        from photon_ml_tpu.obs.metrics import REGISTRY
+
+        before = (
+            REGISTRY.snapshot().get("counters", {})
+            .get("p2p.retries", {}).get("value", 0.0)
+        )
+        calls, run = self._call(
+            monkeypatch, 2, ConnectionError("reset"), retries=3
+        )
+        assert run() == {"ok": 3}
+        assert calls["n"] == 3
+        after = (
+            REGISTRY.snapshot().get("counters", {})
+            .get("p2p.retries", {}).get("value", 0.0)
+        )
+        assert after - before == 2
+
+    def test_knob_off_raises_immediately(self, monkeypatch):
+        calls, run = self._call(
+            monkeypatch, 1, ConnectionError("reset"), retries=0
+        )
+        with pytest.raises(ConnectionError):
+            run()
+        assert calls["n"] == 1  # the pre-retry behavior bit-for-bit
+
+    def test_exhaustion_raises_original_error(self, monkeypatch):
+        calls, run = self._call(
+            monkeypatch, 10, socket.timeout("silent"), retries=2
+        )
+        with pytest.raises((socket.timeout, TimeoutError)):
+            run()
+        assert calls["n"] == 3  # 1 + 2 retries
+
+    def test_unreachable_peer_hardens_into_peer_lost(self, monkeypatch):
+        calls, run = self._call(
+            monkeypatch, 10, mh.PeerUnreachable(1, "refused"), retries=2
+        )
+        with pytest.raises(mh.PeerLost) as ei:
+            run()
+        assert ei.value.peer == 1
+
+    def test_non_transient_error_never_retried(self, monkeypatch):
+        calls, run = self._call(
+            monkeypatch, 10, RuntimeError("size mismatch"), retries=5
+        )
+        with pytest.raises(RuntimeError):
+            run()
+        assert calls["n"] == 1
+
+    def test_corruption_is_transient(self, monkeypatch):
+        calls, run = self._call(
+            monkeypatch, 1, mh.LinkCorruption("crc"), retries=1
+        )
+        assert run() == {"ok": 2}
+
+    def test_retry_events_ride_the_sink(self, tmp_path, monkeypatch):
+        import photon_ml_tpu.obs as obs
+
+        path = obs.configure(str(tmp_path / "tel"), run_id="retry")
+        try:
+            calls, run = self._call(
+                monkeypatch, 1, mh.LinkCorruption("crc"), retries=1
+            )
+            run()
+            calls2, run2 = self._call(
+                monkeypatch, 10, mh.PeerUnreachable(1, "x"), retries=1
+            )
+            with pytest.raises(mh.PeerLost):
+                run2()
+        finally:
+            obs.shutdown()
+        from photon_ml_tpu.obs.report import load_run
+
+        records = load_run(path)
+        retries = [r for r in records if r["event"] == "p2p_retry"]
+        giveups = [r for r in records if r["event"] == "p2p_giveup"]
+        assert len(retries) == 2 and len(giveups) == 1
+        assert retries[0]["error"] == "LinkCorruption"
+        assert retries[0]["tag"] == "t"
+        assert retries[0]["attempt"] == 1
+        assert giveups[0]["error"] == "PeerUnreachable"
+        assert giveups[0]["peer"] == 1
+
+    def test_backoff_deterministic_and_exponential(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_P2P_BACKOFF_S", "0.25")
+        a0, a1 = mh._retry_backoff_sleep(0), mh._retry_backoff_sleep(1)
+        assert a0 == mh._retry_backoff_sleep(0)  # deterministic
+        assert 0.25 <= a0 < 0.375  # base * (1 + jitter<0.5)
+        assert a1 >= 2 * 0.25  # exponential
+
+
+class TestSendHeartbeat:
+    def test_plain_path_is_sendall(self):
+        sock = FrameSock()
+        mh._sendall_hb(sock, b"abc")
+        assert sock.sent == [b"abc"]
+
+    def test_blocked_send_emits_direction_send_heartbeats(
+        self, tmp_path, monkeypatch
+    ):
+        import photon_ml_tpu.obs as obs
+
+        monkeypatch.setenv("PHOTON_P2P_TIMEOUT_S", "0.25")
+        path = obs.configure(str(tmp_path / "tel"), run_id="hb")
+        a, b = socket.socketpair()
+        try:
+            # fill a's kernel buffer so the next send blocks on the
+            # never-draining peer
+            a.setblocking(False)
+            try:
+                while True:
+                    a.send(b"x" * 65536)
+            except BlockingIOError:
+                pass
+            a.setblocking(True)
+            with pytest.raises((socket.timeout, TimeoutError)):
+                mh._sendall_hb(
+                    a, b"y" * (1 << 22), peer=1, tag="scores",
+                    heartbeat=0.05,
+                )
+        finally:
+            obs.shutdown()
+            a.close()
+            b.close()
+        from photon_ml_tpu.obs.report import load_run
+
+        beats = [
+            r for r in load_run(path) if r["event"] == "p2p_heartbeat"
+        ]
+        assert len(beats) >= 2
+        assert all(r["direction"] == "send" for r in beats)
+        assert all(r["peer"] == 1 and r["tag"] == "scores" for r in beats)
+        assert beats[-1]["blocked_s"] >= beats[0]["blocked_s"]
+
+    def test_blocking_mode_heartbeats_without_timeout(
+        self, tmp_path, monkeypatch
+    ):
+        """Satellite: PHOTON_P2P_TIMEOUT_S<=0 (blocking sockets) still
+        honors heartbeats — the recv polls and emits, and only data
+        ends the wait (no spurious timeout raise)."""
+        import threading
+        import time
+
+        import photon_ml_tpu.obs as obs
+
+        monkeypatch.setenv("PHOTON_P2P_TIMEOUT_S", "0")
+        path = obs.configure(str(tmp_path / "tel"), run_id="hb0")
+        a, b = socket.socketpair()
+        payload = b"z" * 8
+
+        def late_send():
+            time.sleep(0.3)
+            b.sendall(payload)
+
+        t = threading.Thread(target=late_send)
+        t.start()
+        try:
+            got = mh._recv_exact(a, 8, peer=1, tag="offsets",
+                                 heartbeat=0.05)
+            assert got == payload
+        finally:
+            t.join()
+            obs.shutdown()
+            a.close()
+            b.close()
+        beats = [
+            r for r in load_run_path(path)
+            if r["event"] == "p2p_heartbeat"
+        ]
+        assert len(beats) >= 2  # beat while blocked, then delivered
+
+
+def load_run_path(path):
+    from photon_ml_tpu.obs.report import load_run
+
+    return load_run(path)
+
+
+class TestDrainErrorTelemetry:
+    def test_drain_records_worker_exception(self, tmp_path, monkeypatch):
+        import photon_ml_tpu.obs as obs
+
+        pool, lock = mh._exchange_state()
+        path = obs.configure(str(tmp_path / "tel"), run_id="drain")
+        try:
+            fut = pool.submit(self._boom)
+            with lock:
+                mh._PENDING_EXCHANGES.append((fut, "offsets"))
+            mh.drain_async_exchanges()
+        finally:
+            obs.shutdown()
+            mh.reset_async_exchanges()
+        records = load_run_path(path)
+        errs = [
+            r for r in records if r["event"] == "exchange_drain_error"
+        ]
+        assert len(errs) == 1
+        assert errs[0]["tag"] == "offsets"
+        assert errs[0]["error"] == "PeerUnreachable"
+        assert errs[0]["peer"] == 1
+
+    @staticmethod
+    def _boom():
+        raise mh.PeerUnreachable(1, "refused")
+
+    def test_reset_clears_pending(self):
+        pool, lock = mh._exchange_state()
+        fut = pool.submit(lambda: None)
+        with lock:
+            mh._PENDING_EXCHANGES.append((fut, "t"))
+        mh.reset_async_exchanges()
+        with lock:
+            assert not mh._PENDING_EXCHANGES
+
+
+class TestDegradedGroup:
+    @pytest.fixture(autouse=True)
+    def _restore(self):
+        yield
+        mh._DEGRADED = None
+
+    def test_single_survivor_world(self, monkeypatch):
+        monkeypatch.setattr(
+            mh, "_DEGRADED", {"survivors": (0,), "rank": 0}
+        )
+        assert mh.effective_process_count() == 1
+        assert mh.effective_process_index() == 0
+        assert mh.is_output_process()
+        # group-shaped helpers collapse to identities — no jax
+        # collective (which would hang on the dead peer) is touched
+        mh.sync_processes("after-loss")
+        assert mh.allreduce_sum_host(np.asarray([3.0])) == [3.0]
+        out = mh.exchange_rows(
+            {"v": np.arange(3.0)}, np.zeros(3, np.int64)
+        )
+        np.testing.assert_array_equal(out["v"], np.arange(3.0))
+        assert mh.LAST_EXCHANGE_STATS["transport"] == "local"
+        tree = mh.broadcast_from_host0({"a": np.ones(2)})
+        np.testing.assert_array_equal(tree["a"], np.ones(2))
+
+    def test_rank_mapping(self, monkeypatch):
+        monkeypatch.setattr(
+            mh, "_DEGRADED", {"survivors": (0, 2, 3), "rank": 1}
+        )
+        assert mh.effective_process_count() == 3
+        assert mh.effective_process_index() == 1
+        assert mh._orig_pid(0) == 0
+        assert mh._orig_pid(1) == 2
+        assert mh._orig_pid(2) == 3
+        assert not mh.is_output_process()
+
+    def test_set_degraded_group_requires_membership(self, monkeypatch):
+        import jax
+
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        with pytest.raises(ValueError):
+            mh.set_degraded_group([1, 2])
+
+
+class TestMeshBuildCleanup:
+    """Satellites: a partial mesh-build failure must close everything
+    and leave the port rebindable, and ``_reset_host_links`` after a
+    mid-frame error must leave no listening socket behind."""
+
+    def test_partial_build_closes_sockets_and_joins_acceptor(
+        self, monkeypatch
+    ):
+        import threading
+
+        import jax
+
+        monkeypatch.setattr(jax, "process_count", lambda: 3)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+
+        def free_port():
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+            s.close()
+            return p
+
+        # peer addresses: freshly freed ports nothing listens on, so
+        # every connect is refused
+        monkeypatch.setattr(
+            mh, "_HOST_ADDRS",
+            {0: ("127.0.0.1", free_port()), 1: ("127.0.0.1", free_port()),
+             2: ("127.0.0.1", free_port())},
+        )
+        threads_before = {
+            t.ident for t in threading.enumerate() if t.is_alive()
+        }
+        with pytest.raises(mh.PeerUnreachable):
+            mh._build_host_links([0, 1, 2], timeout_s=0.5)
+        # no acceptor thread survives the failed build
+        leaked = [
+            t for t in threading.enumerate()
+            if t.is_alive() and t.ident not in threads_before
+        ]
+        assert not leaked
+        # and the recorded port is immediately rebindable: the failed
+        # build closed its listener (regression guard for the leaked-
+        # listener half of the satellite)
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        s.close()
+
+    def test_rebuild_binds_recorded_port_immediately(self, monkeypatch):
+        """After a teardown (mid-frame error path), rebuilding must be
+        able to bind the SAME recorded port at once — a leaked listener
+        would make bind fail with EADDRINUSE."""
+        import jax
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        probe2 = socket.socket()
+        probe2.bind(("127.0.0.1", 0))
+        dead_port = probe2.getsockname()[1]
+        probe2.close()
+        monkeypatch.setattr(
+            mh, "_HOST_ADDRS",
+            {0: ("127.0.0.1", port), 1: ("127.0.0.1", dead_port)},
+        )
+        monkeypatch.setattr(mh, "_HOST_LINKS", None)
+        for _ in range(2):  # two successive failed builds: no leak
+            with pytest.raises((mh.PeerUnreachable, OSError)):
+                mh._build_host_links([0, 1], timeout_s=0.3)
+        mh._reset_host_links()
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", port))  # bind succeeds immediately
+        s.close()
+
+
+class TestReplanExcluding:
+    def test_replan_matches_direct_plan_and_flags_migrations(self):
+        from photon_ml_tpu.parallel.placement import (
+            plan_entity_placement,
+            replan_excluding,
+        )
+
+        rng = np.random.default_rng(0)
+        counts = rng.integers(1, 100, size=32).astype(np.float64)
+        plan4 = plan_entity_placement(counts, 4)
+        new_plan, migrated = replan_excluding(
+            plan4, lost_shards=[2], row_counts=counts,
+            survivors=[0, 1, 3],
+        )
+        # the re-plan IS the deterministic 3-shard plan: every survivor
+        # computes it identically with zero communication
+        direct = plan_entity_placement(counts, 3)
+        np.testing.assert_array_equal(new_plan.owner, direct.owner)
+        # everything the dead shard owned migrated somewhere
+        assert migrated[plan4.owner == 2].all()
+        # migration flags compare via survivor ranks: 3 (rank 2) != 2
+        rank_of = {0: 0, 1: 1, 3: 2}
+        for i, m in enumerate(migrated):
+            old = plan4.owner[i]
+            expect = (
+                old == 2 or rank_of[int(old)] != int(new_plan.owner[i])
+            )
+            assert bool(m) == expect, i
+
+    def test_replan_rejects_overlap_and_empty(self):
+        from photon_ml_tpu.parallel.placement import (
+            plan_entity_placement,
+            replan_excluding,
+        )
+
+        plan = plan_entity_placement(np.ones(4), 2)
+        with pytest.raises(ValueError):
+            replan_excluding(plan, [0], np.ones(4), survivors=[0, 1])
+        with pytest.raises(ValueError):
+            replan_excluding(plan, [0, 1], np.ones(4), survivors=[])
+
+
+class TestCheckpointFingerprintCollection:
+    def test_load_accepts_any_listed_fingerprint(self, tmp_path):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+        from photon_ml_tpu.game.models import GameModel, RandomEffectModel
+        from photon_ml_tpu.types import TaskType
+
+        model = GameModel(
+            models={
+                "re": RandomEffectModel(
+                    coefficients=jnp.ones((2, 3)),
+                    variances=None,
+                    random_effect_type="eid",
+                    feature_shard_id="r",
+                    task_type=TaskType.LOGISTIC_REGRESSION,
+                )
+            },
+            task_type=TaskType.LOGISTIC_REGRESSION,
+        )
+        save_checkpoint(
+            str(tmp_path), model, next_iteration=2, fingerprint="pre-loss"
+        )
+        # the degraded layout's own fingerprint alone: rejected
+        assert load_checkpoint(str(tmp_path), fingerprint="degraded") is None
+        # recovery passes BOTH: accepted, resumes at the stored iteration
+        ck = load_checkpoint(
+            str(tmp_path), fingerprint=("degraded", "pre-loss")
+        )
+        assert ck is not None and ck.next_iteration == 2
+        # plain string still works (the pre-existing contract)
+        assert load_checkpoint(str(tmp_path), fingerprint="pre-loss") is not None

@@ -2148,3 +2148,289 @@ def test_fleet_telemetry_two_process_shards_and_report(tmp_path):
                     "--write-baseline", str(base)]) == 0
     assert run_cli(["gate", "--fleet", p0, "--baseline", str(base)]) == 0
     assert run_cli(["fleet", str(teldir)]) == 0
+
+
+# -- chaos drills: deterministic fault plans through the real 2-proc mesh ----
+
+_CHAOS_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    coordinator, pid, nproc = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    mode = json.loads(sys.argv[4])
+    if nproc > 1:
+        os.environ["PHOTON_RE_SHARD"] = "1"
+        os.environ.setdefault("PHOTON_P2P_CRC", "1")
+        os.environ.setdefault("PHOTON_P2P_RETRIES", "6")
+        os.environ.setdefault("PHOTON_P2P_BACKOFF_S", "0.1")
+        os.environ.setdefault("PHOTON_P2P_TIMEOUT_S", "3")
+        os.environ.setdefault("PHOTON_ROLLCALL_WINDOW_S", "1.5")
+    if mode.get("fault_plan"):
+        os.environ["PHOTON_FAULT_PLAN"] = json.dumps(mode["fault_plan"])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    if nproc > 1:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+    import numpy as np
+
+    if nproc > 1:
+        from photon_ml_tpu.parallel.multihost import initialize_multihost
+        initialize_multihost(coordinator, num_processes=nproc, process_id=pid)
+
+    run_path = None
+    if mode.get("telemetry_dir"):
+        import photon_ml_tpu.obs as obs
+        run_path = obs.configure(mode["telemetry_dir"])
+
+    from photon_ml_tpu.config import (
+        GameTrainingConfig, OptimizationConfig, OptimizerConfig,
+        RandomEffectCoordinateConfig, RegularizationContext,
+    )
+    from photon_ml_tpu.game.streaming import (
+        StreamedGameData, StreamedGameTrainer,
+    )
+    from photon_ml_tpu.types import (
+        RegularizationType, TaskType, VarianceComputationType,
+    )
+
+    # UNIFORM entity sizes: the ingest exchange stays balanced, so it
+    # rides the all_to_all transport and the framed-P2P link seq
+    # ordinals are exactly (offsets=1, scores=2) per visit — what the
+    # committed fault plans are written against
+    rng = np.random.default_rng(42)
+    E = 12
+    ids = np.repeat(np.arange(E), 6).astype(np.int64)
+    ids = ids[rng.permutation(len(ids))]
+    n = len(ids)
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    W_true = (rng.normal(size=(E, 3)) * 0.5).astype(np.float32)
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(
+        -np.sum(W_true[ids] * X, axis=1)))).astype(np.float32)
+    half = n // 2
+    if nproc > 1:
+        lo, hi = (0, half) if pid == 0 else (half, n)
+    else:
+        # single-process arms run over PROCESS 0's slice — the
+        # degraded-parity contract covers the surviving data
+        lo, hi = 0, half
+    opt = OptimizationConfig(
+        optimizer=OptimizerConfig(max_iterations=6, tolerance=1e-9),
+        regularization=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+    cfg = GameTrainingConfig(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinate_update_sequence=("per_entity",),
+        coordinate_descent_iterations=mode.get("iterations", 2),
+        fixed_effect_coordinates={},
+        random_effect_coordinates={
+            "per_entity": RandomEffectCoordinateConfig(
+                random_effect_type="eid", feature_shard_id="r",
+                optimization=opt,
+            )
+        },
+        variance_computation=VarianceComputationType.SIMPLE,
+    )
+    data = StreamedGameData(
+        labels=y[lo:hi], features={"r": X[lo:hi]},
+        id_tags={"eid": ids[lo:hi]},
+    )
+    trainer = StreamedGameTrainer(
+        cfg, chunk_rows=1 << 16, multihost=nproc > 1,
+        checkpoint_dir=mode.get("checkpoint_dir"),
+        num_entities={"eid": E},
+        sharded_checkpoints=False,
+    )
+    if mode.get("resume_fingerprint_from"):
+        with open(mode["resume_fingerprint_from"]) as f:
+            trainer.resume_fingerprints = [json.load(f)["fingerprint"]]
+        trainer.resume_row_base = int(mode.get("resume_row_base", 0))
+    model, info = trainer.fit(data)
+    if run_path is not None:
+        obs.shutdown()
+    from photon_ml_tpu.obs.metrics import REGISTRY
+    snap = REGISTRY.snapshot()
+    counters = {
+        k: v.get("value", 0.0)
+        for k, v in snap.get("counters", {}).items()
+        if k.startswith(("p2p.", "fleet."))
+    }
+    W = np.asarray(model.models["per_entity"].coefficients, np.float64)
+    V = np.asarray(model.models["per_entity"].variances, np.float64)
+    print("RESULT " + json.dumps({
+        "pid": pid,
+        "W": W.tolist(), "V": V.tolist(),
+        "resumed_from": trainer.resumed_from,
+        "counters": counters,
+        "run_path": run_path,
+    }), flush=True)
+    # a degraded survivor must not hang in the distributed runtime's
+    # shutdown handshake with a dead peer
+    sys.stdout.flush()
+    os._exit(0)
+    """
+)
+
+
+def _run_chaos_workers(nproc: int, modes: dict, allow_kill=()) -> dict:
+    """``modes``: pid -> mode dict (JSON-serializable). ``allow_kill``:
+    pids whose hard exit (fault-plan ``kill``) is expected."""
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    procs = {
+        pid: subprocess.Popen(
+            [sys.executable, "-c", _CHAOS_WORKER, coordinator, str(pid),
+             str(nproc), json.dumps(modes.get(pid, modes.get(0, {})))],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for pid in range(nproc)
+    }
+    results = {}
+    for pid, p in procs.items():
+        out, err = p.communicate(timeout=600)
+        if pid in allow_kill:
+            continue  # killed by its own fault plan, by design
+        assert p.returncode == 0, (
+            f"worker {pid} failed (rc {p.returncode}):\n{out}\n{err[-6000:]}"
+        )
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                results[pid] = json.loads(line[len("RESULT "):])
+    return results
+
+
+@pytest.mark.slow
+def test_transient_fault_retries_to_bitwise_identical_run(tmp_path):
+    """A dropped offsets frame set AND a corrupted scores frame set
+    (CRC-detected), injected by a deterministic fault plan: both
+    exchanges retry through the teardown/rebuild path and the run
+    completes with results BITWISE identical to the fault-free run,
+    with p2p_retry + fault_injected events in the fleet shards and the
+    retry/recovery section live in ``report fleet``."""
+    clean = _run_chaos_workers(2, {0: {}, 1: {}})
+    teldir = tmp_path / "tel"
+    plan = [
+        {"op": "drop", "link": [0, 1], "seq": 1, "tag": "offsets"},
+        # post-retry the counters restart with the rebuilt mesh, so the
+        # first visit's scores exchange is seq 2 again
+        {"op": "corrupt", "link": [1, 0], "seq": 2, "tag": "scores"},
+    ]
+    mode = {"fault_plan": plan, "telemetry_dir": str(teldir)}
+    faulted = _run_chaos_workers(2, {0: mode, 1: mode})
+    assert set(clean) == set(faulted) == {0, 1}
+    for pid in (0, 1):
+        np.testing.assert_array_equal(
+            np.asarray(faulted[pid]["W"]), np.asarray(clean[pid]["W"]),
+            err_msg=f"pid={pid}",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(faulted[pid]["V"]), np.asarray(clean[pid]["V"]),
+            err_msg=f"pid={pid}",
+        )
+    # both sides absorbed the transients in the link layer: retries,
+    # zero giveups, zero peer losses
+    total_retries = sum(
+        r["counters"].get("p2p.retries", 0.0) for r in faulted.values()
+    )
+    assert total_retries >= 2, faulted[0]["counters"]
+    for r in faulted.values():
+        assert r["counters"].get("p2p.giveups", 0.0) == 0
+        assert "fleet.peer_lost" not in r["counters"]
+
+    from photon_ml_tpu.obs.report import (
+        fleet_run_paths,
+        format_fleet,
+        summarize_fleet,
+    )
+
+    fs = summarize_fleet(fleet_run_paths(str(teldir)))
+    rec = fs["recovery"]
+    assert rec["p2p_retries"] >= 2, rec
+    assert rec["faults_injected"] == 2, rec
+    assert rec["p2p_giveups"] == 0 and not rec["peer_lost"], rec
+    text = format_fleet(fs)
+    assert "retry/recovery:" in text and "injected faults" in text
+
+
+@pytest.mark.slow
+def test_peer_kill_recovers_from_checkpoint_bitwise(tmp_path):
+    """The peer-loss drill: a fault plan hard-kills process 1 at its
+    second-visit offsets send. Process 0's retries exhaust into
+    PeerLost, the roll call confirms the loss, the placement re-plan
+    degrades the group to one process, and the fit resumes from the
+    last atomic checkpoint — producing a final model BITWISE identical
+    to a clean single-process run resumed from the same checkpoint."""
+    anchor_dir = tmp_path / "anchor-ckpt"
+    chaos_dir = tmp_path / "chaos-ckpt"
+    teldir = tmp_path / "tel"
+
+    # anchor arm: a clean 2-proc run of ONE outer iteration writes the
+    # same checkpoint state the chaos arm checkpoints before the kill
+    anchor_mode = {"iterations": 1, "checkpoint_dir": str(anchor_dir)}
+    _run_chaos_workers(2, {0: anchor_mode, 1: anchor_mode})
+    assert (anchor_dir / "ckpt.npz").exists()
+
+    # chaos arm: 2 iterations; process 1 dies at its visit-2 offsets
+    # send (link 1->0 frame set #3: visit-1 offsets=1, scores=2)
+    plan = [{"op": "kill", "link": [1, 0], "seq": 3, "tag": "offsets"}]
+    chaos_mode = {
+        "iterations": 2, "checkpoint_dir": str(chaos_dir),
+        "fault_plan": plan, "telemetry_dir": str(teldir),
+    }
+    chaos = _run_chaos_workers(
+        2, {0: chaos_mode, 1: chaos_mode}, allow_kill=(1,)
+    )
+    assert set(chaos) == {0}
+    survivor = chaos[0]
+    # the survivor recovered (resumed mid-fit) rather than restarting
+    assert survivor["resumed_from"] == [1, 0], survivor["resumed_from"]
+    assert survivor["counters"].get("fleet.peer_lost") == 1.0
+    assert survivor["counters"].get("fleet.recoveries") == 1.0
+    assert survivor["counters"].get("p2p.giveups") == 1.0
+
+    # clean arm: single process over the SURVIVOR'S data, resumed from
+    # the anchor checkpoint (the pre-loss fingerprint comes from the
+    # human-readable sidecar; row base 0 = process 0's slice)
+    clean_mode = {
+        "iterations": 2, "checkpoint_dir": str(anchor_dir),
+        "resume_fingerprint_from": str(anchor_dir / "ckpt.json"),
+        "resume_row_base": 0,
+    }
+    clean = _run_chaos_workers(1, {0: clean_mode})
+    assert clean[0]["resumed_from"] == [1, 0], clean[0]["resumed_from"]
+    np.testing.assert_array_equal(
+        np.asarray(survivor["W"]), np.asarray(clean[0]["W"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(survivor["V"]), np.asarray(clean[0]["V"])
+    )
+
+    # the survivor's shard carries the full recovery narrative, and the
+    # fleet report names the lost peer (process 1's shard necessarily
+    # truncates at the kill — a missing run_end, not an error)
+    from photon_ml_tpu.obs.report import (
+        fleet_run_paths,
+        format_fleet,
+        summarize_fleet,
+    )
+
+    fs = summarize_fleet(fleet_run_paths(str(teldir)))
+    rec = fs["recovery"]
+    assert rec["p2p_giveups"] >= 1, rec
+    assert [pl["peer"] for pl in rec["peer_lost"]] == [1], rec
+    assert len(rec["recoveries"]) == 1, rec
+    assert rec["recoveries"][0]["survivors"] == [0]
+    assert rec["recoveries"][0]["lost"] == [1]
+    assert rec["roll_calls"][0]["survivors"] == [0]
+    text = format_fleet(fs)
+    assert "peer_lost: p0 lost peer 1" in text
+    assert "degraded mid-flight" in text
