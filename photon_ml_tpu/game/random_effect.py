@@ -73,6 +73,15 @@ def _captured_jit_call(label, fn, *args, **kwargs):
 # identical to the knob-off run (asserted in tests/test_re_compaction.py).
 COMPACT_EVERY = 0  # outer iterations per chunk; 0 = single launch
 FUSE_BUCKETS = 0  # 1 = fuse same-geometry buckets into one launch
+# Cross-process combine transport for the owned-bucket schedule
+# (PHOTON_RE_SHARD=1 under a mesh): "allreduce" (default) is the dense
+# fixed-layout allgather — every process ships the whole (Σ lanes, d)
+# buffer, O(P·E·d)/visit; "segments" ships only each owner's packed
+# coefficient/variance/diagnostic segments over the framed-P2P ring,
+# O(E·d)/visit, bitwise identical results (asserted on the gloo
+# harness). The perf knob for the million-entity scale wall.
+RE_COMBINE = "allreduce"
+_RE_COMBINE_MODES = ("allreduce", "segments")
 
 
 def compact_every() -> int:
@@ -81,6 +90,20 @@ def compact_every() -> int:
     if env is not None and env != "":
         return max(int(env), 0)
     return max(int(COMPACT_EVERY), 0)
+
+
+def re_combine_mode() -> str:
+    """``PHOTON_RE_COMBINE`` (env > module global), strict parse naming
+    the valid modes — a typo fails loudly instead of silently benching
+    the dense path (same discipline as PHOTON_KERNEL_DTYPE)."""
+    env = os.environ.get("PHOTON_RE_COMBINE")
+    mode = env if (env is not None and env != "") else str(RE_COMBINE)
+    if mode not in _RE_COMBINE_MODES:
+        raise ValueError(
+            f"PHOTON_RE_COMBINE must be one of {_RE_COMBINE_MODES}, "
+            f"got {mode!r}"
+        )
+    return mode
 
 
 def fuse_buckets() -> bool:
@@ -217,7 +240,11 @@ class RandomEffectTrainingResult:
                 isinstance(x, jax.Array) and not x.is_fully_addressable
                 for t in refs for x in t
             ):
-                host = [tuple(_to_host(x) for x in t) for t in refs]
+                # multihost (lane-sharded mesh) refs: ONE framed-P2P
+                # segment allgather for every non-addressable array
+                # instead of one process_allgather per array (3 jax
+                # collectives per bucket, previously)
+                host = _gather_refs_host(refs)
             else:
                 host = jax.device_get(refs)
             for (ent_ids, *_), (f_h, it_h, reason_h) in zip(self.diag_refs, host):
@@ -1190,6 +1217,15 @@ def _train_prepared_core(
     return W, V, diag
 
 
+def _emit_re_event(event: str, **payload) -> None:
+    try:
+        from photon_ml_tpu.obs.spans import emit_event
+
+        emit_event(event, **payload)
+    except Exception:
+        pass  # telemetry must never take down the combine it observes
+
+
 def _combine_owned_results(
     prepared: list[PreparedBucket],
     W: Array,
@@ -1198,20 +1234,41 @@ def _combine_owned_results(
 ) -> tuple[Array, Array | None, list]:
     """Cross-process combine for the owned-bucket schedule: every process
     solved only its owned buckets, so each bucket's coefficient rows,
-    variances and diagnostics live on exactly ONE process. A single
-    fixed-layout allreduce (bucket order, ``num_real`` rows each; owners
-    fill their segments, everyone else contributes zeros — and x + 0.0
-    is exact, so the summed result is the owner's values BITWISE)
-    delivers every bucket everywhere; non-owned rows of the (E, d)
-    matrices are then overwritten and non-owned diagnostics filled in.
-    Entity ids partition across buckets, so the row writes are disjoint.
+    variances and diagnostics live on exactly ONE process and must be
+    delivered fleet-wide before the next visit. Transport is the
+    ``PHOTON_RE_COMBINE`` knob: ``allreduce`` (default) is the dense
+    fixed-layout path bit-for-bit, ``segments`` ships only owner
+    segments over framed P2P — O(E·d) per process instead of O(P·E·d),
+    bitwise-identical results (entity ids partition across buckets, so
+    every row is written by exactly one owner either way)."""
+    if re_combine_mode() == "segments":
+        return _combine_owned_segments(prepared, W, V, diag)
+    return _combine_owned_allreduce(prepared, W, V, diag)
 
-    Known scale limit (ROADMAP follow-up): the allgather moves the dense
-    (Σ lanes, d) buffer from EVERY process — O(P·E·d) traffic per visit
-    where owned segments (O(E·d) total) would do; at pod scale this
-    should ride the owner-segment framed-P2P exchange instead.
+
+def _combine_owned_allreduce(
+    prepared: list[PreparedBucket],
+    W: Array,
+    V: Array | None,
+    diag: list,
+) -> tuple[Array, Array | None, list]:
+    """Dense fixed-layout combine: a single allreduce (bucket order,
+    ``num_real`` rows each; owners fill their segments, everyone else
+    contributes zeros — and x + 0.0 is exact, so the summed result is
+    the owner's values BITWISE) delivers every bucket everywhere;
+    non-owned rows of the (E, d) matrices are then overwritten and
+    non-owned diagnostics filled in.
+
+    Known scale limit: the allgather moves the dense (Σ lanes, d)
+    buffer from EVERY process — O(P·E·d) traffic per visit where owned
+    segments (O(E·d) total) would do; ``PHOTON_RE_COMBINE=segments``
+    (``_combine_owned_segments``) is that owner-segment path.
     """
-    from photon_ml_tpu.parallel.multihost import allreduce_sum_host
+    from photon_ml_tpu.obs.metrics import REGISTRY
+    from photon_ml_tpu.parallel.multihost import (
+        allreduce_sum_host,
+        effective_process_count,
+    )
 
     pid = jax.process_index()
     ks = [pb.num_real for pb in prepared]
@@ -1236,10 +1293,25 @@ def _combine_owned_results(
         Fc[lo:hi] = np.asarray(f_h, np.float64)
         Ic[lo:hi] = np.asarray(it_h, np.int64)
         Rc[lo:hi] = np.asarray(r_h, np.int64)
+    # analytic byte accounting for the combine A/B (same definition as
+    # the segments arm's measured number: payload this process ships
+    # over the interconnect — an allgather must move the full dense
+    # buffer to each of the P−1 peers, the lower bound any algorithm
+    # pays in aggregate per process)
+    payload = Wc.nbytes + Fc.nbytes + Ic.nbytes + Rc.nbytes + (
+        Vc.nbytes if Vc is not None else 0
+    )
+    bytes_sent = payload * max(effective_process_count() - 1, 0)
+    REGISTRY.counter_inc("re_combine.exchanges")
+    REGISTRY.counter_inc("re_combine.bytes_sent", float(bytes_sent))
     if Vc is None:
         Wc, Fc, Ic, Rc = allreduce_sum_host(Wc, Fc, Ic, Rc)
     else:
         Wc, Vc, Fc, Ic, Rc = allreduce_sum_host(Wc, Vc, Fc, Ic, Rc)
+    _emit_re_event(
+        "re_combine", mode="allreduce", bytes_sent=int(bytes_sent),
+        buckets_owned=len(owned), buckets=len(prepared),
+    )
     diag = list(diag)
     for i, pb in enumerate(prepared):
         if pb.owner == pid:
@@ -1253,6 +1325,178 @@ def _combine_owned_results(
             jnp.asarray(Ic[lo:hi], jnp.int32),
             jnp.asarray(Rc[lo:hi], jnp.int32),
         )
+    W = jnp.asarray(W_h)
+    V = None if V_h is None else jnp.asarray(V_h)
+    return W, V, diag
+
+
+def _pack_wv_segments(
+    prepared: list[PreparedBucket],
+    W_h: np.ndarray,
+    V_h: np.ndarray | None,
+    owned: list[int],
+) -> dict:
+    """This owner's packed coefficient/variance segments: one
+    (Σ owned num_real, d) block per matrix in OWNED-BUCKET order, plus
+    the bucket index list that keys reassembly. Raw float32 rows — the
+    framed codec ships them without pickling."""
+    d = int(W_h.shape[1])
+    ent = [prepared[i].entity_ids for i in owned]
+    out = {
+        "buckets": np.asarray(owned, np.int64),
+        "W": (
+            np.concatenate([W_h[e] for e in ent])
+            if ent else np.zeros((0, d), np.float32)
+        ),
+    }
+    if V_h is not None:
+        out["V"] = (
+            np.concatenate([V_h[e] for e in ent])
+            if ent else np.zeros((0, d), np.float32)
+        )
+    return out
+
+
+def _pack_diag_segments(owned_diag: list) -> dict:
+    """Packed per-entity diagnostics for this owner's buckets, in the
+    same owned-bucket order as ``_pack_wv_segments``. Dtypes mirror the
+    dense combine's accumulators (f64/i64), so the float32/int32 casts
+    at reassembly produce the allreduce arm's bits exactly."""
+    return {
+        "F": (
+            np.concatenate(
+                [np.asarray(f, np.float64) for f, _, _ in owned_diag]
+            )
+            if owned_diag else np.zeros(0, np.float64)
+        ),
+        "I": (
+            np.concatenate(
+                [np.asarray(it, np.int64) for _, it, _ in owned_diag]
+            )
+            if owned_diag else np.zeros(0, np.int64)
+        ),
+        "R": (
+            np.concatenate(
+                [np.asarray(r, np.int64) for _, _, r in owned_diag]
+            )
+            if owned_diag else np.zeros(0, np.int64)
+        ),
+    }
+
+
+def _apply_owner_segments(
+    prepared: list[PreparedBucket],
+    W_h: np.ndarray,
+    V_h: np.ndarray | None,
+    diag: list,
+    wv_views: list,
+    diag_views: list,
+    pid: int,
+) -> list:
+    """Scatter every rank's owner segments back into the full (E, d)
+    matrices and the per-bucket diagnostics list (disjoint-row writes:
+    entity ids partition across buckets and each bucket has exactly one
+    owner). Locally-owned buckets are skipped — their device refs (and
+    W rows) are already in place, same as the allreduce arm."""
+    seen: set[int] = set()
+    for wv, dg in zip(wv_views, diag_views):
+        buckets = np.asarray(wv["buckets"], np.int64)
+        lo = 0
+        for b in buckets:
+            b = int(b)
+            if b in seen:
+                raise RuntimeError(
+                    f"owner-segment combine: bucket {b} shipped by two "
+                    "owners (placement plans disagree across processes)"
+                )
+            seen.add(b)
+            pb = prepared[b]
+            hi = lo + pb.num_real
+            if pb.owner != pid:
+                W_h[pb.entity_ids] = wv["W"][lo:hi]
+                if V_h is not None:
+                    V_h[pb.entity_ids] = wv["V"][lo:hi]
+                diag[b] = (
+                    jnp.asarray(dg["F"][lo:hi], jnp.float32),
+                    jnp.asarray(dg["I"][lo:hi], jnp.int32),
+                    jnp.asarray(dg["R"][lo:hi], jnp.int32),
+                )
+            lo = hi
+    if len(seen) != len(prepared):
+        missing = sorted(set(range(len(prepared))) - seen)
+        raise RuntimeError(
+            f"owner-segment combine: buckets {missing} shipped by no "
+            "owner (placement plans disagree across processes)"
+        )
+    return diag
+
+
+def _combine_owned_segments(
+    prepared: list[PreparedBucket],
+    W: Array,
+    V: Array | None,
+    diag: list,
+) -> tuple[Array, Array | None, list]:
+    """Owner-segment combine (``PHOTON_RE_COMBINE=segments``): each
+    owner ships ONLY its packed (Σ owned num_real, d) coefficient /
+    variance / diagnostic segments as raw ndarray frames over the
+    framed-P2P ring allgather — per-process traffic O(E·d) instead of
+    the dense arm's O(P·E·d). The (large) coefficient/variance frames
+    are issued on the PR-8 async-exchange worker FIRST, so their socket
+    sends overlap the diagnostics device readback + packing on the main
+    thread; the (small) diagnostics frames follow on the same worker in
+    submission order. Results are BITWISE the allreduce arm's (same
+    owner bits, same f64/i64 → f32/i32 casts; asserted on the 2/4-
+    process gloo harness)."""
+    import time as _time
+
+    from photon_ml_tpu.obs.metrics import REGISTRY
+    from photon_ml_tpu.parallel import multihost as mh
+
+    pid = jax.process_index()
+    W_h = np.asarray(jax.device_get(W)).copy()
+    V_h = None if V is None else np.asarray(jax.device_get(V)).copy()
+    owned = [i for i, pb in enumerate(prepared) if pb.owner == pid]
+    wv_stats: dict = {}
+    diag_stats: dict = {}
+    wv_handle = mh.allgather_obj_p2p_async(
+        _pack_wv_segments(prepared, W_h, V_h, owned),
+        tag="re_combine/wv", stats=wv_stats,
+    )
+    # overlapped under the coefficient-segment sends: the diagnostics
+    # readback (a device sync) and its packing
+    owned_diag = jax.device_get([diag[i] for i in owned])
+    diag_handle = mh.allgather_obj_p2p_async(
+        _pack_diag_segments(owned_diag),
+        tag="re_combine/diag", stats=diag_stats,
+    )
+    t0 = _time.perf_counter()
+    wv_views = wv_handle.result()
+    diag_views = diag_handle.result()
+    waited = _time.perf_counter() - t0
+    bytes_sent = int(
+        wv_stats.get("bytes_sent", 0) + diag_stats.get("bytes_sent", 0)
+    )
+    exchange_s = float(
+        wv_stats.get("exchange_s", 0.0) + diag_stats.get("exchange_s", 0.0)
+    )
+    REGISTRY.counter_inc("re_combine.exchanges")
+    REGISTRY.counter_inc("re_combine.bytes_sent", float(bytes_sent))
+    REGISTRY.timer_add("re_combine.exchange_s", exchange_s)
+    REGISTRY.timer_add("re_combine.wait_s", waited)
+    if exchange_s > 0.0:
+        REGISTRY.gauge_set(
+            "re_combine.overlap_ratio",
+            max(0.0, min(1.0, 1.0 - waited / exchange_s)),
+        )
+    _emit_re_event(
+        "re_combine", mode="segments", bytes_sent=bytes_sent,
+        exchange_s=exchange_s, wait_s=waited,
+        buckets_owned=len(owned), buckets=len(prepared),
+    )
+    diag = _apply_owner_segments(
+        prepared, W_h, V_h, list(diag), wv_views, diag_views, pid
+    )
     W = jnp.asarray(W_h)
     V = None if V_h is None else jnp.asarray(V_h)
     return W, V, diag
@@ -1457,12 +1701,71 @@ def _bucket_step_compacted(
 def _to_host(x) -> np.ndarray:
     """Host copy of a device array that may be sharded across PROCESSES
     (multi-host): non-fully-addressable arrays are allgathered first —
-    per-entity diagnostics are tiny, so the collective is cheap."""
+    per-entity diagnostics are tiny, so the collective is cheap.
+    Batch callers use ``_gather_refs_host`` (ONE collective for all
+    arrays) instead."""
     if isinstance(x, jax.Array) and not x.is_fully_addressable:
-        from jax.experimental import multihost_utils
-
-        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return _gather_unaddressable([x])[0]
     return np.asarray(x)
+
+
+def _gather_unaddressable(arrays: list) -> list[np.ndarray]:
+    """Full host copies of non-fully-addressable (cross-process
+    sharded) device arrays through ONE framed-P2P segment allgather:
+    every process ships its deduped addressable shards (start offsets +
+    raw data — the segment codec frames the ndarrays without pickling)
+    and reassembles each global array from the union. Collective: every
+    process must call with the same number of arrays at the same
+    program point — exactly the contract the per-array
+    ``process_allgather`` fallback already imposed."""
+    from photon_ml_tpu.parallel import multihost as mh
+
+    payload = []
+    for x in arrays:
+        segs = []
+        seen: set[tuple] = set()
+        for sh in x.addressable_shards:
+            starts = tuple(int(sl.start or 0) for sl in sh.index)
+            if starts in seen:
+                continue  # replicated across local devices: ship once
+            seen.add(starts)
+            segs.append((starts, np.asarray(sh.data)))
+        payload.append(segs)
+    views = mh.allgather_obj_p2p(payload, tag="re_diag_gather")
+    out = []
+    for k, x in enumerate(arrays):
+        full = np.zeros(x.shape, x.dtype)
+        for view in views:
+            for starts, data in view[k]:
+                sl = tuple(
+                    slice(s, s + n) for s, n in zip(starts, data.shape)
+                )
+                full[sl] = data
+        out.append(full)
+    return out
+
+
+def _gather_refs_host(refs: list[tuple]) -> list[tuple]:
+    """Host copies of the per-bucket diagnostic triples when some live
+    as cross-process sharded arrays: addressable arrays fetch in one
+    local ``jax.device_get``, and ALL non-addressable ones ride a
+    single segment allgather (previously one ``process_allgather`` per
+    array — 3 collectives per bucket)."""
+    flat = [x for t in refs for x in t]
+    na_idx = [
+        i for i, x in enumerate(flat)
+        if isinstance(x, jax.Array) and not x.is_fully_addressable
+    ]
+    na_set = set(na_idx)
+    local = jax.device_get([flat[i] for i in range(len(flat))
+                            if i not in na_set])
+    gathered = _gather_unaddressable([flat[i] for i in na_idx])
+    host: list = [None] * len(flat)
+    it_local = iter(local)
+    it_na = iter(gathered)
+    for i in range(len(flat)):
+        host[i] = next(it_na) if i in na_set else np.asarray(next(it_local))
+    return [tuple(host[3 * b:3 * b + 3]) for b in range(len(refs))]
 
 
 def random_effect_scores(features: Features, entity_ids: Array, W: Array) -> Array:

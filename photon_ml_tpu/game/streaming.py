@@ -282,6 +282,10 @@ class _ReShard:
     # None = the modular entity_id % P owner rule.
     entity_owner: np.ndarray | None = None  # (E,) int64
     owned_global: np.ndarray | None = None  # (E_local,) int64, sorted
+    # global per-entity row counts (the allreduced bincount the plan was
+    # computed from) — kept so the telemetry-driven re-planner can
+    # recalibrate costs without a fresh collective
+    entity_rows: np.ndarray | None = None  # (E,) int64
     # lane floor (placement mode): per-bucket dummy-lane pad (0/1). A
     # shard-local 1-entity bucket whose GLOBAL capacity class holds >= 2
     # entities pads to 2 lanes so its solve goes down the batched XLA
@@ -624,6 +628,7 @@ class StreamedGameTrainer:
         row_layout: tuple[int, ...],
         drop_unseen: bool = False,
         reuse_layout: _ReShard | None = None,
+        entity_owner_override: np.ndarray | None = None,
     ) -> _ReShard:
         """``drop_unseen``: rows whose entity id is -1 (validation rows for
         entities unseen at training) are excluded from the shard — they
@@ -635,7 +640,15 @@ class StreamedGameTrainer:
         per-entity coefficient matrix is laid out by the TRAINING plan's
         owned ranks, so a validation shard that re-planned from its own
         row counts would route rows to the wrong process and index the
-        wrong coefficient rows."""
+        wrong coefficient rows.
+
+        ``entity_owner_override``: a FORCED owner map (identical on
+        every process) instead of the row-count LPT plan — the
+        telemetry-driven re-planner's migration path, which already
+        computed the new plan from measured costs. Everything else
+        (the global capacity ladder, lane floor, routing) is derived
+        exactly as for a planned map, so bucket geometry — and every
+        solve, bitwise — is placement-independent."""
         c = self.config.random_effect_coordinates[cid]
         feats = data.feature_container(c.feature_shard_id)
         ids = np.asarray(data.id_tags[c.random_effect_type], np.int64)
@@ -677,6 +690,7 @@ class StreamedGameTrainer:
         # it and of the process count.
         entity_owner = owned_global = None
         global_caps = global_pops = None
+        counts_g = None
         if reuse_layout is not None and reuse_layout.entity_owner is not None:
             # follow the TRAINING plan verbatim — gated on the PREPARED
             # STATE, never a re-read of the knob (a flip between
@@ -703,6 +717,7 @@ class StreamedGameTrainer:
             from photon_ml_tpu.parallel.multihost import allreduce_sum_host
             from photon_ml_tpu.parallel.placement import (
                 plan_entity_placement,
+                plan_from_owner,
                 record_placement_metrics,
             )
 
@@ -713,8 +728,17 @@ class StreamedGameTrainer:
                     ).astype(np.int64)
                 )
             )
-            plan = plan_entity_placement(counts_g, P)
-            entity_owner = plan.owner
+            if entity_owner_override is not None:
+                # the re-planner already decided the map (from measured
+                # costs): adopt it verbatim, publishing the same gauges
+                # a planned map would
+                plan = plan_from_owner(
+                    entity_owner_override, counts_g, P
+                )
+                entity_owner = plan.owner
+            else:
+                plan = plan_entity_placement(counts_g, P)
+                entity_owner = plan.owner
             owned_global = np.flatnonzero(entity_owner == pid).astype(
                 np.int64
             )
@@ -853,6 +877,7 @@ class StreamedGameTrainer:
             subspace_cols=subspace_cols,
             entity_owner=entity_owner,
             owned_global=owned_global,
+            entity_rows=counts_g,
             lane_floor_pad=lane_pad,
         )
 
@@ -1188,6 +1213,13 @@ class StreamedGameTrainer:
         knobs change the launch schedule only — W/V, the aggregates and
         the per-bucket loss accumulation order are bitwise identical to
         the knob-off run (asserted in tests/test_re_compaction.py)."""
+        from photon_ml_tpu.parallel import faults
+
+        # synthetic straggler injection (PHOTON_RE_STRAGGLER): a real
+        # sleep here inflates this process's MEASURED solve wall — the
+        # re-planner drill reads genuine telemetry — without touching
+        # any math (the model stays bitwise the uninjected run's)
+        faults.maybe_straggle()
         loss = loss_for_task(self.config.task_type)
         l1 = opt.regularization.l1_weight(opt.regularization_weight)
         l2 = jnp.asarray(
@@ -1595,6 +1627,7 @@ class StreamedGameTrainer:
         n_val_global, val_base, val_layout = self._global_layout(n_val)
         state: dict[str, Any] = {
             "n": n_val, "n_global": n_val_global, "base": val_base,
+            "layout": val_layout,
             "re_shards": {}, "scores": {}, "labels": np.asarray(validation.labels),
             "weights": (
                 np.ones(n_val, np.float32) if validation.weights is None
@@ -2214,6 +2247,168 @@ class StreamedGameTrainer:
 
     # -- descent ------------------------------------------------------------
 
+    # -- telemetry-driven placement re-planning -----------------------------
+
+    def _maybe_replan_re_shards(
+        self,
+        re_shards: dict[str, _ReShard],
+        re_W: dict[str, np.ndarray],
+        re_V: dict[str, np.ndarray | None],
+        re_W_prior: dict[str, np.ndarray],
+        re_V_prior: dict[str, np.ndarray | None],
+        data: StreamedGameData,
+        validation: StreamedGameData | None,
+        vstate: dict[str, Any] | None,
+        row_base: int,
+        row_layout: tuple[int, ...],
+        re_E: dict[str, int],
+        iteration: int,
+    ) -> None:
+        """Close the telemetry → placement loop on a HEALTHY fleet: read
+        each process's measured random-effect solve wall for the descent
+        iteration that just finished (the same numbers ``report fleet``
+        renders as the straggler table), and when the max/mean imbalance
+        exceeds ``PHOTON_RE_REPLAN_IMBALANCE``, re-run the deterministic
+        LPT planner over MEASURED per-entity costs (row counts
+        calibrated by each owner's observed seconds-per-row) and migrate
+        entities to their new owners before the next iteration's visits.
+
+        Migration reuses the PR-11 recovery machinery end to end:
+        ``replan_excluding`` with an empty lost set computes the new
+        plan + migration mask, the shard rebuild is the same ingest
+        exchange recovery uses (the origin hosts still hold their rows),
+        and model state moves by gather-under-the-old-layout /
+        slice-under-the-new — pure copies, so the post-migration model
+        is BITWISE the unmigrated run's (bucket geometry is placement-
+        independent by the global capacity ladder). Every input is
+        globally identical (allgathered walls, allreduced row counts),
+        so all processes take the same decision with one tiny collective
+        per coordinate."""
+        from photon_ml_tpu.parallel.multihost import allgather_host
+        from photon_ml_tpu.parallel.placement import (
+            measured_entity_costs,
+            plan_from_owner,
+            replan_excluding,
+            replan_imbalance_threshold,
+        )
+
+        threshold = replan_imbalance_threshold()
+        if (
+            threshold <= 0.0
+            or not self._distributed()
+            or not _re_shard_enabled()
+        ):
+            self._re_solve_wall.clear()
+            return
+        pid, P = _num_processes()
+        for cid in self.config.random_effect_coordinates:
+            shard = re_shards[cid]
+            wall_local = self._re_solve_wall.pop(cid, 0.0)
+            if shard.entity_owner is None or shard.entity_rows is None:
+                continue  # modular-layout shard: nothing to re-plan
+            walls = allgather_host(
+                np.asarray([wall_local], np.float64)
+            ).reshape(-1)
+            mean = float(walls.mean())
+            imbalance = float(walls.max()) / mean if mean > 0 else 1.0
+            REGISTRY.counter_inc("re_replan.checks")
+            REGISTRY.gauge_set("re_replan.last_imbalance", imbalance)
+            emit_event(
+                "re_replan_check", coordinate=cid, iteration=iteration,
+                imbalance=imbalance, threshold=threshold,
+                walls=[round(float(w), 6) for w in walls],
+            )
+            if imbalance <= threshold:
+                continue
+            counts_g = shard.entity_rows
+            costs = measured_entity_costs(
+                counts_g, shard.entity_owner, walls
+            )
+            old_plan = plan_from_owner(shard.entity_owner, counts_g, P)
+            new_plan, migrated = replan_excluding(
+                old_plan, [], costs, survivors=range(P)
+            )
+            n_migrated = int(migrated.sum())
+            if n_migrated == 0:
+                emit_event(
+                    "re_replan", coordinate=cid, iteration=iteration,
+                    imbalance=imbalance, migrated=0,
+                )
+                continue
+            with span("replan/migrate", coordinate=cid,
+                      iteration=iteration):
+                E = re_E[cid]
+                old_owner = shard.entity_owner
+                W_full = self._full_re_matrix(
+                    re_W[cid], E, entity_owner=old_owner
+                )
+                V_full = (
+                    None if re_V.get(cid) is None
+                    else self._full_re_matrix(
+                        re_V[cid], E, entity_owner=old_owner
+                    )
+                )
+                Wp_full = (
+                    None if cid not in re_W_prior
+                    else self._full_re_matrix(
+                        re_W_prior[cid], E, entity_owner=old_owner
+                    )
+                )
+                Vp_full = (
+                    None if re_V_prior.get(cid) is None
+                    else self._full_re_matrix(
+                        re_V_prior[cid], E, entity_owner=old_owner
+                    )
+                )
+                new_shard = self._build_re_shard(
+                    cid, data, row_base, row_layout,
+                    entity_owner_override=new_plan.owner,
+                )
+                re_shards[cid] = new_shard
+                self._re_layouts[cid] = new_shard.entity_owner
+                re_W[cid] = _slice_owned_rows(new_shard, W_full, pid, P)
+                if V_full is not None:
+                    re_V[cid] = _slice_owned_rows(
+                        new_shard, V_full, pid, P
+                    )
+                if Wp_full is not None:
+                    re_W_prior[cid] = _slice_owned_rows(
+                        new_shard, Wp_full, pid, P
+                    )
+                if Vp_full is not None:
+                    re_V_prior[cid] = _slice_owned_rows(
+                        new_shard, Vp_full, pid, P
+                    )
+                if (
+                    vstate is not None
+                    and cid in vstate.get("re_shards", {})
+                    and validation is not None
+                ):
+                    # the validation shard routes rows by — and indexes
+                    # re_W through — the TRAINING owner layout, which
+                    # just changed
+                    vstate["re_shards"][cid] = self._build_re_shard(
+                        cid, validation, vstate["base"],
+                        vstate["layout"], drop_unseen=True,
+                        reuse_layout=new_shard,
+                    )
+            REGISTRY.counter_inc("re_replan.count")
+            REGISTRY.counter_inc("re_replan.migrations", float(n_migrated))
+            emit_event(
+                "re_replan", coordinate=cid, iteration=iteration,
+                imbalance=imbalance, threshold=threshold,
+                migrated=n_migrated,
+                old_balance=float(old_plan.balance),
+                new_balance=float(new_plan.balance),
+                walls=[round(float(w), 6) for w in walls],
+            )
+            self._log(
+                f"iter {iteration} coordinate {cid}: measured solve-wall "
+                f"imbalance {imbalance:.2f}x > {threshold:.2f}x — "
+                f"re-planned placement over measured costs, migrating "
+                f"{n_migrated} entities at the visit boundary"
+            )
+
     def fit(
         self,
         data: StreamedGameData,
@@ -2342,6 +2537,10 @@ class StreamedGameTrainer:
         self._fixed_objectives = {}
         self._down_sample_cache = {}
         self._projectors = {}
+        # per-coordinate measured solve wall, accumulated over the
+        # CURRENT descent iteration and consumed by the between-
+        # iterations re-planner (PHOTON_RE_REPLAN_IMBALANCE)
+        self._re_solve_wall: dict[str, float] = {}
 
         # entity layouts + the multi-host owner exchange, once (the shuffle)
         re_shards: dict[str, _ReShard] = {}
@@ -2693,6 +2892,9 @@ class StreamedGameTrainer:
                                 offs_re = self._offsets_to_owners(
                                     shard, offs, row_base
                                 )
+                            import time as _time
+
+                            t_solve = _time.perf_counter()
                             loss_sum, max_it, conv = self._solve_re_buckets(
                                 shard, offs_re, c.optimization, re_W[cid],
                                 None if cid in self._projectors
@@ -2705,6 +2907,16 @@ class StreamedGameTrainer:
                                 V=re_V[cid],
                                 W_prior=re_W_prior.get(cid),
                                 V_prior=re_V_prior.get(cid),
+                            )
+                            # per-process solve wall for THIS visit: the
+                            # telemetry the between-iterations re-planner
+                            # reads (and report fleet renders per shard)
+                            dt_solve = _time.perf_counter() - t_solve
+                            self._re_solve_wall[cid] = (
+                                self._re_solve_wall.get(cid, 0.0) + dt_solve
+                            )
+                            REGISTRY.timer_add(
+                                "re_solve.visit_wall_s", dt_solve
                             )
                             score_pending = None
                             if overlap:
@@ -2793,6 +3005,20 @@ class StreamedGameTrainer:
                                 model_state, scores, total, nxt_it, nxt_ci,
                                 fingerprint, digest, row_base, n_global,
                             )
+
+            if it + 1 < cfg.coordinate_descent_iterations:
+                # telemetry → placement feedback (between iterations, so
+                # migration lands exactly at a visit boundary): when the
+                # measured per-process solve wall is imbalanced past the
+                # knob threshold, re-plan over measured costs and migrate
+                # entities — the next iteration's visits run on the new
+                # layout. Matched collectively: the knob and all inputs
+                # are identical fleet-wide.
+                self._maybe_replan_re_shards(
+                    re_shards, re_W, re_V, re_W_prior, re_V_prior,
+                    data, validation, vstate, row_base, row_layout,
+                    re_E, it,
+                )
 
         model = self._assemble_model(
             {"fixed_w": fixed_w, "re_W": re_W, "re_E": re_E,

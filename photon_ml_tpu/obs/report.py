@@ -352,6 +352,46 @@ def summarize_run(path: str, records: list[dict] | None = None) -> dict:
             "re_exchange.exchange_s" in base_timers:
         out["exchange_s"] = timer_s("re_exchange.exchange_s")
         out["exchange_wait_s"] = timer_s("re_exchange.wait_s")
+    # owned-result combine accounting (re_combine.*, game/random_effect):
+    # bytes shipped per process by the cross-process combine — the
+    # O(P·E·d)-vs-O(E·d) axis of the PHOTON_RE_COMBINE A/B — plus, on
+    # the segments arm, the worker-side exchange wall vs the consumer's
+    # blocked wait. Present only on runs that combined.
+    if "re_combine.exchanges" in counters or \
+            "re_combine.exchanges" in base_counters:
+        out["re_combine"] = {
+            "exchanges": counter_v("re_combine.exchanges"),
+            "bytes_sent": counter_v("re_combine.bytes_sent"),
+            "exchange_s": timer_s("re_combine.exchange_s"),
+            "wait_s": timer_s("re_combine.wait_s"),
+            "mode": run_start.get("knobs", {}).get("re_combine"),
+        }
+    # telemetry-driven re-planning (re_replan.*, game/streaming): checks
+    # per iteration, re-plans fired, entities migrated — plus the event
+    # narrative report fleet renders
+    replan_events = [
+        {
+            k: r.get(k)
+            for k in ("iteration", "coordinate", "imbalance",
+                      "threshold", "migrated", "old_balance",
+                      "new_balance")
+        }
+        for r in records if r["event"] == "re_replan"
+    ]
+    if (
+        "re_replan.checks" in counters
+        or "re_replan.checks" in base_counters
+        or replan_events
+    ):
+        out["re_replan"] = {
+            "checks": counter_v("re_replan.checks"),
+            "replans": counter_v("re_replan.count"),
+            "migrations": counter_v("re_replan.migrations"),
+            "last_imbalance": metrics_gauges.get(
+                "re_replan.last_imbalance"
+            ),
+            "events": replan_events,
+        }
     if run_start.get("fleet"):
         out["fleet"] = run_start["fleet"]
     return out
@@ -432,6 +472,31 @@ def format_summary(s: dict) -> str:
             + (
                 f", exchange-overlap {overlap:.1%}"
                 if overlap is not None else ""
+            )
+        )
+    rc = s.get("re_combine") or {}
+    if rc.get("exchanges"):
+        seg = (
+            f"  re-combine: {int(rc['exchanges'])} combines, "
+            f"{_fmt_qty(rc['bytes_sent'])}B sent"
+            + (f" (mode {rc['mode']})" if rc.get("mode") else "")
+        )
+        if rc.get("exchange_s"):
+            seg += (
+                f", exch {_fmt_s(rc['exchange_s'])} / wait "
+                f"{_fmt_s(rc['wait_s'])}"
+            )
+        lines.append(seg)
+    rp = s.get("re_replan") or {}
+    if rp.get("checks") or rp.get("migrations"):
+        lines.append(
+            f"  re-plan: {int(rp.get('checks') or 0)} checks, "
+            f"{int(rp.get('replans') or 0)} re-plans, "
+            f"{int(rp.get('migrations') or 0)} entities migrated"
+            + (
+                f" (last imbalance {rp['last_imbalance']:.2f}x)"
+                if isinstance(rp.get("last_imbalance"), (int, float))
+                else ""
             )
         )
     if s.get("quality_parity"):
@@ -836,11 +901,38 @@ def summarize_fleet(paths: list[str]) -> dict:
         "faults_injected": 0, "peer_lost": [], "recoveries": [],
         "roll_calls": [],
     }
+    replans: list[dict] = []
     retry_by_error: dict[str, int] = {}
     for pidx, recs in records_by_process.items():
         for r in recs:
             ev = r.get("event")
-            if ev == "p2p_retry":
+            if ev == "re_replan":
+                # ONE fleet decision: every process emits the identical
+                # event (the re-plan is computed from allgathered walls),
+                # so dedup by (iteration, coordinate) and collect the
+                # emitting processes — P copies rendered as P distinct
+                # re-plans would read as P·migrated entities moved
+                key = (r.get("iteration"), r.get("coordinate"))
+                entry = next(
+                    (
+                        e for e in replans
+                        if (e["iteration"], e["coordinate"]) == key
+                    ),
+                    None,
+                )
+                if entry is None:
+                    replans.append(
+                        {
+                            "processes": [pidx],
+                            "iteration": r.get("iteration"),
+                            "coordinate": r.get("coordinate"),
+                            "imbalance": r.get("imbalance"),
+                            "migrated": r.get("migrated"),
+                        }
+                    )
+                else:
+                    entry["processes"].append(pidx)
+            elif ev == "p2p_retry":
                 recovery["p2p_retries"] += 1
                 err = str(r.get("error") or "?")
                 retry_by_error[err] = retry_by_error.get(err, 0) + 1
@@ -879,6 +971,28 @@ def summarize_fleet(paths: list[str]) -> dict:
         for k, s in processes.items()
         if "exchange_s" in s
     }
+    # owned-result combine traffic per process + fleet total (the
+    # PHOTON_RE_COMBINE A/B axis at fleet granularity)
+    combine_pp = {
+        k: (s.get("re_combine") or {})
+        for k, s in processes.items()
+        if s.get("re_combine")
+    }
+    combine = None
+    if combine_pp:
+        combine = {
+            "bytes_sent_total": float(
+                sum(c.get("bytes_sent") or 0 for c in combine_pp.values())
+            ),
+            "per_process": {
+                k: float(c.get("bytes_sent") or 0)
+                for k, c in combine_pp.items()
+            },
+            "mode": next(
+                (c.get("mode") for c in combine_pp.values()
+                 if c.get("mode")), None,
+            ),
+        }
     head = processes[str(pidxs[0])]
     return {
         "run_id": head["run_id"],
@@ -909,6 +1023,8 @@ def summarize_fleet(paths: list[str]) -> dict:
         "recovery": recovery,
         "overlap": overlap,
         "exchange": exchange,
+        "re_combine": combine,
+        "replans": replans,
         "processes": processes,
     }
 
@@ -1000,6 +1116,32 @@ def format_fleet(fs: dict) -> str:
             "  WARNING: unmatched correlated events — a torn exchange "
             "mesh, a missing shard file, or a truncated run"
         )
+    rc = fs.get("re_combine") or {}
+    if rc:
+        lines.append(
+            "  re-combine: "
+            f"{_fmt_qty(rc['bytes_sent_total'])}B total"
+            + (f" (mode {rc['mode']})" if rc.get("mode") else "")
+            + "  "
+            + "  ".join(
+                f"p{k} {_fmt_qty(v)}B"
+                for k, v in sorted(rc["per_process"].items())
+            )
+        )
+    for rp in fs.get("replans") or []:
+        procs = rp.get("processes") or []
+        lines.append(
+            f"  re-plan: iter {rp['iteration']} {rp['coordinate']}: "
+            "measured imbalance "
+            + (
+                f"{rp['imbalance']:.2f}x"
+                if isinstance(rp.get("imbalance"), (int, float))
+                else "?"
+            )
+            + f" → migrated {rp.get('migrated')} entities "
+            + f"(observed by {len(procs)} process"
+            + ("es)" if len(procs) != 1 else ")")
+        )
     rec = fs.get("recovery") or {}
     if any(
         rec.get(k)
@@ -1084,6 +1226,17 @@ DEFAULT_GATE_THRESHOLDS: dict[str, dict] = {
     # must trip the gate.
     "re_shard/": {"rel": 0.05},
     "re_shard/exchange_overlap_ratio": {"abs": 1.0},
+    # combine-traffic tier: bytes per process are deterministic for a
+    # given combine mode + placement, so near-tight — a 5% creep is a
+    # packing/layout regression, and a mode accidentally falling back
+    # to the dense arm shows up as a multiple, not a percent
+    "re_combine/": {"rel": 0.05},
+    # re-plan tier: exact headroom — like every gate this is ONE-SIDED
+    # (cur > baseline fails), so a SPONTANEOUS migration against a
+    # healthy baseline trips; the vanishing direction (a straggler
+    # drill that stops migrating) is covered by the slow gloo drill's
+    # own assertion, not the gate
+    "re_replan/migrations": {"rel": 0.0, "abs": 0.0},
     # fleet tiers (the merged cross-process view from ``report fleet``):
     # telemetry-health counts gate EXACT — one unmatched correlated
     # event or one missing shard is a broken instrument, not noise —
@@ -1174,6 +1327,14 @@ def gate_metrics_from_summary(s: dict) -> dict[str, float]:
     for k, v in (s.get("re_shard") or {}).items():
         if k in ("balance", "rows_max", "exchange_overlap_ratio"):
             m[f"re_shard/{k}"] = float(v)
+    rc = s.get("re_combine") or {}
+    if isinstance(rc.get("bytes_sent"), (int, float)):
+        m["re_combine/bytes_sent"] = float(rc["bytes_sent"])
+    rp = s.get("re_replan") or {}
+    if rp:
+        # exact one-sided tier: a migration APPEARING against the
+        # baseline is a planner-behavior change, not noise
+        m["re_replan/migrations"] = float(rp.get("migrations") or 0)
     m.update(_qp_metrics(s.get("quality_parity") or {}))
     o = s.get("optim") or {}
     if o.get("solves"):
@@ -1288,6 +1449,20 @@ def gate_metrics_from_fleet(fs: dict) -> dict[str, float]:
         vals = [float(v) for v in vals if isinstance(v, (int, float))]
         if vals:
             m[f"re_shard/{name}"] = max(vals)
+    # combine traffic gates the fleet TOTAL (near-tight: deterministic
+    # for a given mode + placement); migrations gate the fleet MAX of
+    # the per-process counter — every process counts the same global
+    # number, so one disagreeing shard can only look worse (exact tier)
+    rc = fs.get("re_combine") or {}
+    if isinstance(rc.get("bytes_sent_total"), (int, float)):
+        m["re_combine/bytes_sent"] = float(rc["bytes_sent_total"])
+    mig = [
+        (s.get("re_replan") or {}).get("migrations")
+        for s in (fs.get("processes") or {}).values()
+    ]
+    mig = [float(v) for v in mig if isinstance(v, (int, float))]
+    if mig:
+        m["re_replan/migrations"] = max(mig)
     return m
 
 

@@ -335,12 +335,16 @@ def _knob_snapshot() -> dict:
 
         knobs["re_compact_every"] = int(re_mod.compact_every())
         knobs["re_fuse_buckets"] = int(bool(re_mod.fuse_buckets()))
+        knobs["re_combine"] = str(re_mod.re_combine_mode())
     except Exception:
         pass
     try:
         from photon_ml_tpu.parallel import placement
 
         knobs["re_shard"] = int(bool(placement.re_shard_enabled()))
+        knobs["re_replan_imbalance"] = float(
+            placement.replan_imbalance_threshold()
+        )
     except Exception:
         pass
     return knobs

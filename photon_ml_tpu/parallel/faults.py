@@ -227,3 +227,54 @@ def _emit(spec: FaultSpec) -> None:
         )
     except Exception:
         pass
+
+
+# -- synthetic straggler (the re-planner's fault drill) ----------------------
+
+
+def straggler_spec() -> tuple[int, float] | None:
+    """``PHOTON_RE_STRAGGLER`` = ``"<process>:<delay_s>"`` — the
+    deterministic straggler injection for the telemetry-driven
+    re-planner (``PHOTON_RE_REPLAN_IMBALANCE``): the named process
+    sleeps ``delay_s`` at the start of every streamed random-effect
+    bucket-solve visit, so its MEASURED solve wall genuinely inflates
+    (the re-plan trigger reads real telemetry, not a faked gauge) while
+    the math — and therefore the model, bitwise — is untouched. Strict
+    parse, like every fault knob."""
+    env = os.environ.get("PHOTON_RE_STRAGGLER")
+    if not env:
+        return None
+    proc, sep, delay = env.partition(":")
+    if not sep:
+        raise ValueError(
+            f"PHOTON_RE_STRAGGLER must be '<process>:<delay_s>', "
+            f"got {env!r}"
+        )
+    return int(proc), float(delay)
+
+
+def maybe_straggle() -> float:
+    """Apply the straggler injection on the named process; returns the
+    seconds slept (0.0 on every other process / with the knob unset —
+    the production fast path is one env read)."""
+    spec = straggler_spec()
+    if spec is None:
+        return 0.0
+    proc, delay = spec
+    if delay <= 0.0:
+        return 0.0
+    import jax
+
+    if jax.process_index() != proc:
+        return 0.0
+    time.sleep(delay)
+    try:
+        from photon_ml_tpu.obs.spans import emit_event
+
+        emit_event(
+            "fault_injected", op="straggler", src=proc, dst=proc,
+            seq=0, tag="re_solve", delay_s=delay,
+        )
+    except Exception:
+        pass
+    return delay

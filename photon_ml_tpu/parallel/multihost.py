@@ -235,23 +235,185 @@ def allgather_row_chunks(arrays, chunk_rows: int, pad_values=None):
         yield {k: np.asarray(v) for k, v in gathered.items()}
 
 
+# host-collective payload wire formats: a 1-byte kind prefix selects
+# how the rest decodes. PICKLE is the original format (arbitrary host
+# objects); NDARRAY is the fast path for array-bearing payloads — the
+# container skeleton (dicts/lists/tuples with array leaves replaced by
+# position markers) plus per-array (dtype, shape) specs pickle small,
+# and the array bytes ride RAW after them, so the send side never
+# pickles (or copies) a row payload and the recv side reconstructs with
+# one ``np.frombuffer`` per array. Values round-trip byte-identically
+# (asserted in tests/test_re_combine.py); only the wire encoding
+# differs, and both ends of a mesh always run the same build.
+_PAYLOAD_PICKLE = 0
+_PAYLOAD_NDARRAY = 1
+
+
+class _NdRef:
+    """Skeleton placeholder for the i-th raw array of an NDARRAY-format
+    payload (module-level so the pickled skeleton resolves it)."""
+
+    __slots__ = ("i",)
+
+    def __init__(self, i: int):
+        self.i = i
+
+    def __reduce__(self):
+        return (_NdRef, (self.i,))
+
+
+def _encode_host_payload(obj) -> tuple[list, int]:
+    """``(wire_parts, total_bytes)`` for one host-collective payload.
+    ``wire_parts`` is a list of buffers (bytes / byte-cast memoryviews)
+    the sender streams in order — array payloads are zero-copy views of
+    the (contiguous) source arrays. Only plain ndarrays of simple
+    dtypes take the raw path; object/structured dtypes and ndarray
+    subclasses stay pickled (in the skeleton, or — when no raw-able
+    array exists at all — as a wholesale PICKLE-format payload)."""
+    import pickle
+    import struct
+
+    arrays: list[np.ndarray] = []
+    shapes: list[tuple] = []
+
+    def strip(x):
+        # raw fast path ONLY for plain ndarrays of simple dtypes:
+        # subclasses (MaskedArray carries a mask) and structured dtypes
+        # (dtype.str is lossy — '|V12' drops the fields) must keep the
+        # pickle round-trip the skeleton gives them
+        if (
+            type(x) is np.ndarray
+            and not x.dtype.hasobject
+            and x.dtype.names is None
+        ):
+            # record the ORIGINAL shape: ascontiguousarray promotes 0-d
+            # to 1-d, and the decode reshape must undo that
+            arrays.append(np.ascontiguousarray(x))
+            shapes.append(x.shape)
+            return _NdRef(len(arrays) - 1)
+        if isinstance(x, dict):
+            return {k: strip(v) for k, v in x.items()}
+        if isinstance(x, tuple):
+            vals = [strip(v) for v in x]
+            # preserve tuple subclasses (namedtuples) — the pickle
+            # format round-trips them, so this format must too
+            return type(x)(*vals) if hasattr(x, "_fields") else tuple(vals)
+        if isinstance(x, list):
+            return [strip(v) for v in x]
+        return x
+
+    skeleton = strip(obj)
+    if not arrays:
+        raw = bytes([_PAYLOAD_PICKLE]) + pickle.dumps(obj, protocol=4)
+        return [raw], len(raw)
+    specs = [
+        (a.dtype.str, shape) for a, shape in zip(arrays, shapes)
+    ]
+    head = pickle.dumps((skeleton, specs), protocol=4)
+    parts: list = [
+        bytes([_PAYLOAD_NDARRAY]) + struct.pack("!q", len(head)) + head
+    ]
+    total = len(parts[0])
+    for a in arrays:
+        if a.size == 0:
+            continue  # zero-size arrays have no bytes (and memoryview
+            # cannot cast shapes with zeros); the spec alone rebuilds them
+        m = memoryview(a).cast("B")
+        parts.append(m)
+        total += len(m)
+    return parts, total
+
+
+def _decode_host_payload(raw: bytes):
+    """Inverse of ``_encode_host_payload`` over the received frame
+    bytes. Arrays come back as fresh WRITABLE copies — the contract the
+    pickle format always gave callers (several mutate results in
+    place), and the one copy here replaces the decode copy pickle paid
+    anyway."""
+    import pickle
+    import struct
+
+    kind = raw[0]
+    body = memoryview(raw)[1:]
+    if kind == _PAYLOAD_PICKLE:
+        return pickle.loads(body)
+    if kind != _PAYLOAD_NDARRAY:
+        raise RuntimeError(
+            f"host collective payload: unknown wire format {kind}"
+        )
+    head_len = struct.unpack("!q", body[:8])[0]
+    skeleton, specs = pickle.loads(body[8:8 + head_len])
+    offset = 1 + 8 + head_len
+    arrays = []
+    for dt, shape in specs:
+        dtype = np.dtype(dt)
+        count = int(np.prod(shape, dtype=np.int64))
+        a = np.frombuffer(
+            raw, dtype, count=count, offset=offset
+        ).reshape(shape).copy()
+        offset += count * dtype.itemsize
+        arrays.append(a)
+
+    def restore(x):
+        if isinstance(x, _NdRef):
+            return arrays[x.i]
+        if isinstance(x, dict):
+            return {k: restore(v) for k, v in x.items()}
+        if isinstance(x, tuple):
+            vals = [restore(v) for v in x]
+            return type(x)(*vals) if hasattr(x, "_fields") else tuple(vals)
+        if isinstance(x, list):
+            return [restore(v) for v in x]
+        return x
+
+    return restore(skeleton)
+
+
+def _send_frame_parts(sock, parts: list, total: int, crc: bool,
+                      peer: int | None = None, tag: str | None = None,
+                      heartbeat: float | None = None) -> None:
+    """``_send_frame`` for a multi-buffer payload: one length prefix
+    covering the whole frame, each part streamed without concatenation
+    (the array fast path's zero-copy send), and — frame protocol v1 —
+    one CRC32 trailer computed incrementally over the parts (identical
+    to the single-buffer trailer over their concatenation)."""
+    import struct
+
+    _sendall_hb(sock, struct.pack("!q", total), peer, tag, heartbeat)
+    for p in parts:
+        _sendall_hb(sock, p, peer, tag, heartbeat)
+    if crc:
+        import zlib
+
+        c = 0
+        for p in parts:
+            c = zlib.crc32(p, c)
+        _sendall_hb(
+            sock, struct.pack("!I", c & 0xFFFFFFFF), peer, tag, heartbeat
+        )
+
+
 def _ring_allgather(
     links: dict, ordered_pids: list[int], rank: int, obj,
-    tag: str, heartbeat: float | None,
+    tag: str, heartbeat: float | None, stats: dict | None = None,
 ) -> list:
-    """One framed allgather of a picklable host object over an explicit
-    ring: ``ordered_pids[rank]`` is this process, links are keyed by
-    ORIGINAL pid. The single implementation behind both the degraded-
-    group collectives and the roll-call agreement round (two hand-
-    rolled copies of threaded socket code WILL drift). Bumps the
-    per-link frame-set counters like every framed user, so submission-
-    order correlation stays matched. Returns the per-rank list."""
-    import pickle
+    """One framed allgather of a host object over an explicit ring:
+    ``ordered_pids[rank]`` is this process, links are keyed by ORIGINAL
+    pid. The single implementation behind the degraded-group
+    collectives, the roll-call agreement round AND the owner-segment
+    combine (hand-rolled copies of threaded socket code WILL drift).
+    Array-bearing payloads ride the raw-ndarray wire format (no pickle
+    copy/overhead per array). Bumps the per-link frame-set counters
+    like every framed user, so submission-order correlation stays
+    matched. ``stats`` (optional) receives the byte accounting:
+    ``payload_bytes`` (this rank's encoded payload), ``bytes_sent``
+    (= payload × (P−1), the rotation schedule's send traffic) and
+    ``bytes_recv``. Returns the per-rank list."""
     import struct
     import threading
 
     protos = links.get("proto", {})
-    payload = pickle.dumps(obj, protocol=4)
+    parts, total = _encode_host_payload(obj)
     P_ = len(ordered_pids)
     out: dict[int, object] = {rank: obj}
     err: list[BaseException] = []
@@ -261,8 +423,8 @@ def _ring_allgather(
             for r in range(1, P_):
                 peer_pid = ordered_pids[(rank + r) % P_]
                 _next_link_seq("send", peer_pid)
-                _send_frame(
-                    links["send"][peer_pid], payload,
+                _send_frame_parts(
+                    links["send"][peer_pid], parts, total,
                     protos.get(peer_pid, 0) >= _FRAME_PROTO_CRC,
                     peer_pid, tag, heartbeat,
                 )
@@ -271,6 +433,7 @@ def _ring_allgather(
 
     t = threading.Thread(target=send_all)
     t.start()
+    bytes_recv = 0
     for r in range(1, P_):
         src_rank = (rank - r) % P_
         src_pid = ordered_pids[src_rank]
@@ -283,20 +446,31 @@ def _ring_allgather(
             sock, n, protos.get(src_pid, 0) >= _FRAME_PROTO_CRC,
             src_pid, tag, heartbeat,
         )
-        out[src_rank] = pickle.loads(raw)
+        bytes_recv += n
+        out[src_rank] = _decode_host_payload(raw)
     t.join()
     if err:
         raise err[0]
+    if stats is not None:
+        stats.update(
+            payload_bytes=total,
+            bytes_sent=total * (P_ - 1),
+            bytes_recv=bytes_recv,
+        )
     return [out[r] for r in range(P_)]
 
 
-def _p2p_allgather_obj(obj, tag: str = "host_collective") -> list:
-    """Allgather one picklable host object over the framed-P2P links of
-    the CURRENT group — the degraded world's replacement for
+def _p2p_allgather_obj(obj, tag: str = "host_collective",
+                       drain: bool = True, stats: dict | None = None) -> list:
+    """Allgather one host object over the framed-P2P links of the
+    CURRENT group — the degraded world's replacement for
     ``multihost_utils.process_allgather`` (which would hang on the dead
-    peer). Returns the per-rank list in ascending effective rank; a
-    sync collective drains the async queue first, like every other
-    synchronous socket user.
+    peer), and the transport behind the owner-segment collectives on a
+    HEALTHY mesh too. Returns the per-rank list in ascending effective
+    rank; a sync collective drains the async queue first, like every
+    other synchronous socket user (``drain=False`` is for the exchange
+    WORKER itself, which is the queue — draining there would wait on
+    its own future).
 
     A transient link fault here in a DEGRADED group hardens straight
     into ``PeerLost`` (peer ``-1`` when the failing link is unknown):
@@ -307,14 +481,17 @@ def _p2p_allgather_obj(obj, tag: str = "host_collective") -> list:
     P_ = effective_process_count()
     pid = effective_process_index()
     if P_ <= 1:
+        if stats is not None:
+            stats.update(payload_bytes=0, bytes_sent=0, bytes_recv=0)
         return [obj]
-    drain_async_exchanges()
+    if drain:
+        drain_async_exchanges()
     try:
         links = _host_links()
         heartbeat = _p2p_heartbeat_s() if _sink_active() else None
         return _ring_allgather(
             links, [_orig_pid(r) for r in range(P_)], pid, obj,
-            tag, heartbeat,
+            tag, heartbeat, stats=stats,
         )
     except BaseException as e:
         _reset_host_links()
@@ -324,6 +501,16 @@ def _p2p_allgather_obj(obj, tag: str = "host_collective") -> list:
                 f"degraded-group host collective {tag!r} failed: {e}",
             ) from e
         raise
+
+
+def allgather_obj_p2p(obj, tag: str = "host_collective",
+                      stats: dict | None = None) -> list:
+    """Public synchronous framed-P2P allgather of one host object over
+    the current group (healthy or degraded mesh): the owner-segment
+    collective the random-effect combine and the diagnostics gather
+    ride. Identity on a single process. Must be called collectively (at
+    the same program point on every process of the group)."""
+    return _p2p_allgather_obj(obj, tag=tag, stats=stats)
 
 
 def allgather_host(array: np.ndarray) -> np.ndarray:
@@ -1853,6 +2040,77 @@ def exchange_rows_async(
     with lock:
         _PENDING_EXCHANGES.append((fut, tag))
     return ExchangeHandle(future=fut, tag=tag)
+
+
+class ObjCollectiveHandle:
+    """A pending ``allgather_obj_p2p_async``. ``result()`` blocks until
+    the allgather lands and returns the per-rank list. Unlike
+    ``ExchangeHandle`` it records nothing into the ``re_exchange.*``
+    overlap accounting — owner-segment callers keep their own
+    ``re_combine.*`` books (mixing the two would skew the exchange
+    overlap gauge the sharded-solve sweeps gate on)."""
+
+    def __init__(self, future=None, value=None, tag: str = ""):
+        self._future = future
+        self._value = value
+        self._tag = tag
+
+    def result(self) -> list:
+        if self._future is None:
+            return self._value
+        try:
+            out = self._future.result()
+        finally:
+            _, lock = _exchange_state()
+            with lock:
+                _PENDING_EXCHANGES[:] = [
+                    e for e in _PENDING_EXCHANGES
+                    if e[0] is not self._future
+                ]
+        self._future = None
+        self._value = out
+        return out
+
+
+def allgather_obj_p2p_async(
+    obj, tag: str = "host_collective", stats: dict | None = None
+) -> ObjCollectiveHandle:
+    """Issue ``allgather_obj_p2p`` on the dedicated exchange worker:
+    the frames go on the wire while the caller keeps working (the
+    owner-segment combine overlaps its diagnostics readback under the
+    coefficient-segment send). Same discipline as
+    ``exchange_rows_async``: the mesh bootstrap (collective on first
+    use) happens on the CALLING thread in program order, the body runs
+    on the single worker in strict submission order, and the pending
+    entry keeps every synchronous socket user draining behind it.
+    ``stats`` is filled by the worker (byte accounting plus
+    ``exchange_s``, the worker-side wall) before the handle resolves.
+    Single process: completes inline (identity)."""
+    P_ = effective_process_count()
+    if P_ <= 1:
+        if stats is not None:
+            stats.update(
+                payload_bytes=0, bytes_sent=0, bytes_recv=0,
+                exchange_s=0.0,
+            )
+        return ObjCollectiveHandle(value=[obj], tag=tag)
+    _host_links()  # collective bootstrap HERE, in program order
+    pool, lock = _exchange_state()
+
+    def run():
+        t0 = time.perf_counter()
+        try:
+            return _p2p_allgather_obj(
+                obj, tag=tag, drain=False, stats=stats
+            )
+        finally:
+            if stats is not None:
+                stats["exchange_s"] = time.perf_counter() - t0
+
+    fut = pool.submit(run)
+    with lock:
+        _PENDING_EXCHANGES.append((fut, tag))
+    return ObjCollectiveHandle(future=fut, tag=tag)
 
 
 def allreduce_max_host(*arrays: np.ndarray):

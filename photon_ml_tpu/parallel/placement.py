@@ -42,6 +42,16 @@ import numpy as np
 # exchanges); 1 = skew-aware placement + overlapped P2P exchange.
 RE_SHARD = 0
 
+# Telemetry-driven re-planning (PHOTON_RE_REPLAN_IMBALANCE): when the
+# MEASURED per-process random-effect solve wall of a descent iteration
+# is more imbalanced than this max/mean ratio, the streamed trainer
+# re-runs the LPT planner over measured (wall-calibrated) entity costs
+# and migrates entities at the next visit boundary — the PR-11
+# peer-loss re-plan machinery driven by a telemetry trigger instead of
+# a PeerLost. 0 (default) = off; meaningful values are > 1 (e.g. 1.5 =
+# re-plan when the slowest shard runs 50% over the mean).
+REPLAN_IMBALANCE = 0.0
+
 
 def re_shard_enabled() -> bool:
     """``PHOTON_RE_SHARD`` (env > module global), strict parse like the
@@ -51,6 +61,18 @@ def re_shard_enabled() -> bool:
     if env is not None and env != "":
         return int(env) != 0
     return int(RE_SHARD) != 0
+
+
+def replan_imbalance_threshold() -> float:
+    """``PHOTON_RE_REPLAN_IMBALANCE`` (env > module global), strict
+    float parse; <= 0 disables (the knob convention). Must be set
+    consistently fleet-wide — the re-plan decision is computed from
+    allgathered walls on every process and a knob mismatch would
+    desync the collectives."""
+    env = os.environ.get("PHOTON_RE_REPLAN_IMBALANCE")
+    raw = env if (env is not None and env != "") else REPLAN_IMBALANCE
+    v = float(raw)
+    return v if v > 0.0 else 0.0
 
 
 @dataclass(frozen=True)
@@ -160,6 +182,25 @@ def _add_loads(loads: np.ndarray, counts: np.ndarray, owner: np.ndarray) -> np.n
     return loads
 
 
+def plan_from_owner(
+    owner: np.ndarray,
+    row_counts: Sequence[float] | np.ndarray,
+    num_shards: int,
+) -> PlacementPlan:
+    """Reconstruct a ``PlacementPlan`` from an existing owner map + row
+    counts (the load definition lives HERE, next to the planner — the
+    re-planner and the forced-map shard rebuild both need the old/forced
+    plan's loads and must agree with ``plan_shard_placement``'s)."""
+    owner = np.asarray(owner, np.int64)
+    counts = np.asarray(row_counts, np.float64)
+    loads = _add_loads(
+        np.zeros(int(num_shards), np.float64), counts, owner[: len(counts)]
+    )
+    return PlacementPlan(
+        owner=owner, loads=loads, num_shards=int(num_shards)
+    )
+
+
 def plan_entity_placement(
     entity_row_counts: np.ndarray, num_shards: int, skew_aware: bool = True
 ) -> PlacementPlan:
@@ -212,6 +253,40 @@ def replan_excluding(
     old_ranks = rank_of[plan.owner]
     migrated = old_ranks != new_plan.owner
     return new_plan, migrated
+
+
+def measured_entity_costs(
+    entity_row_counts: np.ndarray,
+    entity_owner: np.ndarray,
+    shard_walls: np.ndarray,
+) -> np.ndarray:
+    """Per-entity MEASURED costs for a telemetry-driven re-plan: each
+    entity's row count scaled by its current owner's measured
+    seconds-per-row (``wall_p / Σ rows owned by p``). Entities living
+    on a shard that measured slow cost proportionally more, so the LPT
+    re-plan spreads them off it — row counts alone would reproduce the
+    plan that produced the imbalance. Shards with no rows (or a zero
+    wall: clock resolution, or a shard that did no solve work) fall
+    back to the mean measured rate, keeping their entities
+    row-proportional instead of free (a zero cost would make LPT dump
+    every such entity onto one shard).
+
+    Deterministic pure-host arithmetic on globally-identical inputs
+    (allreduced row counts, allgathered walls) — every process computes
+    the IDENTICAL costs with zero extra communication, the same
+    property the original plan and ``replan_excluding`` rely on."""
+    counts = np.asarray(entity_row_counts, np.float64)
+    owner = np.asarray(entity_owner, np.int64)
+    walls = np.asarray(shard_walls, np.float64)
+    P = len(walls)
+    loads = np.zeros(P, np.float64)
+    np.add.at(loads, owner[: len(counts)], counts)
+    rate = np.zeros(P, np.float64)
+    ok = (loads > 0) & (walls > 0)
+    rate[ok] = walls[ok] / loads[ok]
+    fallback = float(rate[ok].mean()) if ok.any() else 1.0
+    rate[~ok] = fallback
+    return counts * rate[owner[: len(counts)]]
 
 
 def record_placement_metrics(

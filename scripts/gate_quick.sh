@@ -18,6 +18,14 @@
 # shard-loading / correlation-join / fleet-gate regression fails in the
 # same one command as a byte/flop regression.
 #
+# A COMBINE leg then validates the committed MULTICHIP_r08.json
+# (the PHOTON_RE_COMBINE owner-segment A/B): acceptance invariants
+# (bitwise across arms/processes, mean per-process byte reduction ≥
+# (P−1)/P·50%) plus a gate of its per-rung combine-byte metrics against
+# BASELINE_combine_cpu.json (re_combine/ tier, 5%). Re-capture with
+# `python bench.py --multichip-r08` when the combine/placement code
+# intentionally changes, then UPDATE_BASELINE=1 to re-bless.
+#
 # Usage:
 #   scripts/gate_quick.sh                      # gate vs BASELINE_cost_cpu.json
 #   scripts/gate_quick.sh MY_BASELINE.json     # gate vs another baseline
@@ -57,6 +65,14 @@ PY
     python -m photon_ml_tpu.cli.main report gate --fleet "$fleet_run" \
         --write-baseline "$fleet_baseline"
     echo "gate_quick: fleet baseline re-captured to $fleet_baseline"
+    python - <<'PY'
+import json
+doc = json.load(open("MULTICHIP_r08.json"))
+with open("BASELINE_combine_cpu.json", "w") as f:
+    json.dump(doc["gate_metrics"], f, indent=2)
+    f.write("\n")
+print("gate_quick: combine baseline re-captured to BASELINE_combine_cpu.json")
+PY
     exit 0
 fi
 
@@ -113,4 +129,26 @@ m = gate_metrics_from_fleet(fs)
 failures, _ = gate_run(m, m)
 assert not failures, failures
 print("gate_quick: synthetic 2-shard fleet fixture OK")
+PY
+
+# ---- combine leg: owner-segment A/B invariants + byte gate ----------------
+python - <<'PY'
+import json, sys
+
+from photon_ml_tpu.obs.report import gate_run
+
+doc = json.load(open("MULTICHIP_r08.json"))
+acc = doc["acceptance"]
+assert acc["bitwise_identical"], acc
+assert acc["reduction_ge_required"], acc
+baseline = json.load(open("BASELINE_combine_cpu.json"))
+failures, lines = gate_run(doc["gate_metrics"], baseline)
+if failures:
+    print("\n".join(lines))
+    sys.exit(f"gate_quick: combine byte gate FAILED: {failures}")
+print(
+    "gate_quick: combine leg OK (mean per-process reduction "
+    f"{acc['bytes_reduction_at_top_rung']:.1%} >= "
+    f"{acc['required_reduction']:.1%})"
+)
 PY
