@@ -558,7 +558,11 @@ class RandomEffectCoordinate:
         (``PHOTON_RE_SHARD=1``) a mesh no longer disables fusion: lanes
         are fully addressable per owned bucket, and placement is
         fusion-group-atomic, so every fusable set is co-owned."""
-        from photon_ml_tpu.game.random_effect import _fusion_units, fuse_buckets
+        from photon_ml_tpu.game.random_effect import (
+            _fusion_units,
+            _parent_units,
+            fuse_buckets,
+        )
 
         # gate on the PREPARED STATE, not a re-read of the knob: the
         # buckets were either staged owned (owner set, fully addressable
@@ -568,12 +572,23 @@ class RandomEffectCoordinate:
         lane_sharded = self.mesh is not None and not any(
             pb.owner is not None for pb in self._prepared
         )
-        if lane_sharded or not fuse_buckets() or len(self._prepared) < 2:
+        if lane_sharded or len(self._prepared) < 2:
             return None
-        units = self.__dict__.get("_fusion_units_cache")
+        # a PHOTON_RE_SPLIT prep re-concatenates same-parent sub-buckets
+        # per owner even with the fuse knob off (prepared-state gate
+        # again: parent markers were staged, or not, at prep time)
+        split_mode = any(pb.parent is not None for pb in self._prepared)
+        fuse = fuse_buckets()
+        if not fuse and not split_mode:
+            return None
+        cached = self.__dict__.get("_fusion_units_cache")
+        units = cached[1] if cached is not None and cached[0] == fuse else None
         if units is None:
-            units = _fusion_units(self._prepared)
-            object.__setattr__(self, "_fusion_units_cache", units)
+            units = (
+                _fusion_units(self._prepared) if fuse
+                else _parent_units(self._prepared)
+            )
+            object.__setattr__(self, "_fusion_units_cache", (fuse, units))
         return units
 
     def _fused_visit_parts(self):

@@ -395,6 +395,162 @@ def capacity_classes(
     )
 
 
+def _split_runs(weights: np.ndarray, cap: float) -> list[tuple[int, int]]:
+    """The SHARED sub-bucket split kernel: partition positions
+    ``[0, len(weights))`` into contiguous runs whose summed weight stays
+    at or under ``cap`` where possible, each run holding at least TWO
+    positions (XLA's batch-1 lowering is not bitwise-stable against the
+    batched one — the PR-5 caveat — so a placement atom must never
+    force a 1-lane launch the unsplit run would have batched). Returns
+    ``(lo, hi)`` half-open ranges covering every position in order.
+
+    Deterministic pure arithmetic on the weights alone: both split
+    sites (``placement_atoms`` for the streamed owner map,
+    ``split_entity_buckets`` for the in-memory prepared buckets) call
+    THIS function in the same ascending-entity order, so the partition
+    RULE can never drift between them. Each site weighs atoms by what
+    its planner balances — total rows on the streamed path, active
+    (capped) rows in-memory — so under ``active_data_upper_bound`` the
+    two ladders may legitimately cut a class at different entities;
+    each path is internally consistent, which is all its bitwise
+    contract needs (the two never share an owner map)."""
+    n = len(weights)
+    if n < 4 or cap <= 0:
+        # < 4 entities cannot form two >= 2-entity atoms: stay whole
+        return [(0, n)] if n else []
+    runs: list[tuple[int, int]] = []
+    lo = 0
+    acc = 0.0
+    for i in range(n):
+        w = float(weights[i])
+        if i > lo + 1 and acc + w > cap:
+            runs.append((lo, i))
+            lo, acc = i, w
+        else:
+            acc += w
+    runs.append((lo, n))
+    if len(runs) > 1 and runs[-1][1] - runs[-1][0] < 2:
+        # a trailing singleton merges back into its neighbor (the lane
+        # floor wins over the weight cap)
+        prev_lo, _ = runs[-2]
+        runs[-2:] = [(prev_lo, n)]
+    return runs
+
+
+def placement_atoms(
+    active_counts: np.ndarray,
+    weights: np.ndarray | None = None,
+    capacities: tuple[int, ...] | None = None,
+    target_buckets: int = 8,
+    max_padded_ratio: float = 0.5,
+    split: int = 0,
+) -> tuple[list[np.ndarray], tuple[int, ...], int]:
+    """The sub-bucket placement-atom ladder (``PHOTON_RE_SPLIT``):
+    partition the active entities into placement atoms — contiguous
+    ascending-entity-id runs WITHIN each capacity class — such that any
+    class whose total ``weights`` exceeds ``sum(weights) / split`` is
+    split into runs of at most that cap (each >= 2 entities). Returns
+    ``(atom_members, atom_capacities, split_class_count)`` where
+    ``atom_members[a]`` are atom ``a``'s entity indices.
+
+    ``split <= 0`` returns one atom per used capacity class — exactly
+    the bucket-atomic granularity. ``weights`` defaults to the active
+    counts (callers that balance TOTAL rows pass those instead).
+
+    Everything here is deterministic pure-host arithmetic on the GLOBAL
+    bincount and the knob value only — the process count never enters —
+    so every process and the single-process reference derive the
+    identical ladder with zero extra communication, keeping bucket
+    geometry process-count-independent (the PR-8 bitwise invariant)."""
+    counts = np.asarray(active_counts)
+    w = counts if weights is None else np.asarray(weights)
+    if len(w) != len(counts):
+        raise ValueError(
+            f"placement_atoms: weights length {len(w)} != "
+            f"active_counts length {len(counts)}"
+        )
+    active, slot, caps = _capacity_slots(
+        counts, capacities, target_buckets, max_padded_ratio
+    )
+    if len(active) == 0:
+        return [], (), 0
+    cap_w = float(w[active].sum()) / split if split > 0 else 0.0
+    atoms: list[np.ndarray] = []
+    atom_caps: list[int] = []
+    split_classes = 0
+    for b in np.flatnonzero(np.bincount(slot, minlength=len(caps))):
+        members = active[slot == b]  # ascending entity index
+        mw = np.asarray(w[members], np.float64)
+        runs = (
+            _split_runs(mw, cap_w)
+            if split > 0 and mw.sum() > cap_w
+            else [(0, len(members))]
+        )
+        if len(runs) > 1:
+            split_classes += 1
+        for lo, hi in runs:
+            atoms.append(members[lo:hi])
+            atom_caps.append(int(caps[b]))
+    return atoms, tuple(atom_caps), split_classes
+
+
+def split_entity_buckets(
+    buckets: EntityBuckets, split: int
+) -> tuple[EntityBuckets, tuple[int, ...] | None, int]:
+    """Apply the ``PHOTON_RE_SPLIT`` rule to an already-built
+    ``EntityBuckets`` (the in-memory owned-bucket path): each bucket
+    whose total active-row weight exceeds ``total_rows / split`` is
+    split into contiguous sub-buckets (same capacity, entity/row slices
+    — the ``_split_runs`` partition over the ascending-entity order
+    ``bucket_entities`` built, weighted by ACTIVE rows: what the
+    in-memory owner plan balances; ``placement_atoms`` computes the
+    identical partition whenever it is given the same weights).
+    Returns ``(buckets, parents, split_class_count)``: ``parents[b]``
+    is output bucket ``b``'s index in the INPUT bucket list, or
+    ``None`` in place of the whole tuple when nothing split (``split <=
+    0`` or no bucket over the cap) — callers key the knob-off
+    bit-for-bit path on that."""
+    if split <= 0 or not buckets.entity_ids:
+        return buckets, None, 0
+    per_bucket_w = [
+        np.asarray((rows >= 0).sum(axis=1), np.float64)
+        for rows in buckets.row_indices
+    ]
+    total = float(sum(w.sum() for w in per_bucket_w))
+    cap_w = total / split
+    ent_out: list[np.ndarray] = []
+    row_out: list[np.ndarray] = []
+    caps_out: list[int] = []
+    parents: list[int] = []
+    split_classes = 0
+    for b, (ents, rows, w) in enumerate(
+        zip(buckets.entity_ids, buckets.row_indices, per_bucket_w)
+    ):
+        runs = (
+            _split_runs(w, cap_w)
+            if float(w.sum()) > cap_w
+            else [(0, len(ents))]
+        )
+        if len(runs) > 1:
+            split_classes += 1
+        for lo, hi in runs:
+            ent_out.append(ents[lo:hi])
+            row_out.append(rows[lo:hi])
+            caps_out.append(int(buckets.capacities[b]))
+            parents.append(b)
+    if split_classes == 0:
+        return buckets, None, 0
+    return (
+        EntityBuckets(
+            capacities=tuple(caps_out),
+            entity_ids=ent_out,
+            row_indices=row_out,
+        ),
+        tuple(parents),
+        split_classes,
+    )
+
+
 def _merge_bucket_classes(
     slot: np.ndarray,
     caps: np.ndarray,

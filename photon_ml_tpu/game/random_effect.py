@@ -317,6 +317,14 @@ class PreparedBucket:
     # None (never gathered, never uploaded; the dispatch loop skips them
     # and the combine fills their results in).
     owner: int | None = None
+    # index of this bucket's PARENT in the pre-split bucket list when
+    # the PHOTON_RE_SPLIT rule produced sub-bucket placement atoms
+    # (set for EVERY bucket of a split prep, split or not). None = an
+    # unsplit prep (the bit-for-bit knob-off schedule). Within an
+    # owner, same-parent sub-buckets re-concatenate into one launch
+    # (``_parent_units``) so the launch geometry the unsplit run used
+    # is restored wherever co-ownership allows.
+    parent: int | None = None
 
 
 def prepare_buckets(
@@ -348,7 +356,10 @@ def prepare_buckets(
     owned bucket.
     """
     from photon_ml_tpu.game.projector import subspace_columns
-    from photon_ml_tpu.parallel.placement import re_shard_enabled
+    from photon_ml_tpu.parallel.placement import (
+        re_shard_enabled,
+        re_split_factor,
+    )
 
     owned_prep = mesh is not None and re_shard_enabled()
     n_dev = mesh.shape[axis_name] if (mesh is not None and not owned_prep) else 1
@@ -357,7 +368,22 @@ def prepare_buckets(
     # host→device transfer are O(owned shard), not O(total dataset).
     # Non-owned buckets keep host bookkeeping only (entity ids, lane
     # count, owner) — everything the post-solve combine needs.
-    owners = _plan_bucket_owners(buckets) if owned_prep else None
+    #
+    # PHOTON_RE_SPLIT > 0 first refines the placement units below
+    # bucket granularity: heavy capacity classes split into sub-bucket
+    # atoms (game.data.split_entity_buckets — deterministic on the
+    # global bucket contents, identical on every process), so the LPT
+    # below can spread the Zipf tail class across owners instead of
+    # pinning it whole on one. parents is None on an unsplit prep —
+    # the knob-off path is bit-for-bit the pre-split code.
+    owners = parents = None
+    if owned_prep:
+        from photon_ml_tpu.game.data import split_entity_buckets
+
+        buckets, parents, n_split = split_entity_buckets(
+            buckets, re_split_factor()
+        )
+        owners = _plan_bucket_owners(buckets, parents, n_split)
     own_pid = jax.process_index()
     zeros_off = np.zeros_like(np.asarray(labels))
     prepared: list[PreparedBucket] = []
@@ -365,12 +391,13 @@ def prepare_buckets(
         zip(buckets.entity_ids, buckets.row_indices)
     ):
         k = len(ent_ids)
+        parent = None if parents is None else int(parents[bi])
         if owners is not None and owners[bi] != own_pid:
             prepared.append(
                 PreparedBucket(
                     entity_ids=ent_ids, ids=None, static=None,
                     row_idx=None, mask=None, num_real=k,
-                    owner=int(owners[bi]),
+                    owner=int(owners[bi]), parent=parent,
                 )
             )
             continue
@@ -424,12 +451,17 @@ def prepare_buckets(
                 static=static, row_idx=idx, mask=mask,
                 num_real=k, columns=columns,
                 owner=None if owners is None else int(owners[bi]),
+                parent=parent,
             )
         )
     return prepared
 
 
-def _plan_bucket_owners(buckets: EntityBuckets) -> np.ndarray:
+def _plan_bucket_owners(
+    buckets: EntityBuckets,
+    parents: tuple[int, ...] | None = None,
+    split_classes: int = 0,
+) -> np.ndarray:
     """Skew-aware whole-bucket placement over the processes of the
     runtime, decided BEFORE any staging: balance shards by Σ active rows
     (NOT bucket or entity count — Zipf traffic puts most rows behind a
@@ -439,7 +471,14 @@ def _plan_bucket_owners(buckets: EntityBuckets) -> np.ndarray:
     type/width are constant within one coordinate — the same sets
     plan_fusion_groups forms at launch time, so every fusable set stays
     co-owned). Deterministic pure-host arithmetic on replicated inputs —
-    every process computes the identical plan with no communication."""
+    every process computes the identical plan with no communication.
+
+    ``parents`` marks a PHOTON_RE_SPLIT prep: the bucket list holds
+    sub-bucket placement atoms, and each atom places INDEPENDENTLY (the
+    capacity-keyed co-ownership grouping would glue a split class right
+    back into one unit — the geometry the fusion constraint protects is
+    instead restored per owner by ``_parent_units``/``_fusion_units``
+    re-concatenation, which is permutation-only and bit-preserving)."""
     from photon_ml_tpu.parallel.placement import (
         plan_shard_placement,
         record_placement_metrics,
@@ -447,13 +486,21 @@ def _plan_bucket_owners(buckets: EntityBuckets) -> np.ndarray:
 
     P_ = jax.process_count()
     lanes = [len(e) for e in buckets.entity_ids]
-    keys = [int(r.shape[1]) for r in buckets.row_indices]
-    groups = [idxs for idxs, _ in plan_fusion_groups(keys, lanes)]
     rows = [
         int(np.sum(np.asarray(r) >= 0)) for r in buckets.row_indices
     ]
+    if parents is None:
+        keys = [int(r.shape[1]) for r in buckets.row_indices]
+        groups = [idxs for idxs, _ in plan_fusion_groups(keys, lanes)]
+    else:
+        groups = None  # every sub-bucket atom is its own placement unit
     plan = plan_shard_placement(rows, P_, groups=groups)
-    record_placement_metrics(plan, shard=jax.process_index())
+    record_placement_metrics(
+        plan,
+        shard=jax.process_index(),
+        atoms=len(groups) if groups is not None else len(lanes),
+        split_classes=split_classes,
+    )
     return plan.owner
 
 
@@ -891,7 +938,8 @@ def _fusion_units(
     scatter into the (E, d) matrix touches the same disjoint rows in any
     order; single-member units pass through untouched. Callers gate on
     ``sharding is None`` (concatenation would break mesh lane padding)."""
-    plan = plan_fusion_groups(
+    return _concat_units(
+        prepared,
         [
             # remotely-owned buckets carry no staged tensors (and are
             # never dispatched here) — a unique key keeps each one a
@@ -899,8 +947,41 @@ def _fusion_units(
             ("__remote__", i) if pb.static is None else _bucket_geometry(pb)
             for i, pb in enumerate(prepared)
         ],
-        [pb.num_real for pb in prepared],
     )
+
+
+def _parent_units(
+    prepared: list[PreparedBucket],
+) -> list[tuple[PreparedBucket, list[tuple[int, int, int]]]]:
+    """PHOTON_RE_SPLIT's launch grouping when geometry fusion is OFF:
+    same-PARENT sub-buckets of one owner re-concatenate into a single
+    launch — sub-buckets are contiguous in-order slices of their parent,
+    so a fully co-owned parent launches with EXACTLY the unsplit lane
+    order and geometry (bit-for-bit trivially), and a partially-owned
+    one launches its owned lanes batched (per-lane vmapped solves are
+    lane-count/permutation-invariant above the batch-1 floor the split
+    rule enforces — the same invariant the sharded streamed path rests
+    on). Unsplit and remote buckets stay solo passthrough units."""
+    return _concat_units(
+        prepared,
+        [
+            ("__remote__", i) if pb.static is None
+            else (
+                ("__parent__", pb.parent) if pb.parent is not None
+                else ("__own_solo__", i)
+            )
+            for i, pb in enumerate(prepared)
+        ],
+    )
+
+
+def _concat_units(
+    prepared: list[PreparedBucket], keys: list
+) -> list[tuple[PreparedBucket, list[tuple[int, int, int]]]]:
+    """Shared unit builder for ``_fusion_units``/``_parent_units``:
+    concatenate each ``plan_fusion_groups`` group's staged tensors into
+    one launch unit, passing single-member groups through untouched."""
+    plan = plan_fusion_groups(keys, [pb.num_real for pb in prepared])
     units: list[tuple[PreparedBucket, list[tuple[int, int, int]]]] = []
     for idxs, members in plan:
         if len(idxs) == 1:
@@ -919,10 +1000,12 @@ def _fusion_units(
                 None if prepared[idxs[0]].columns is None
                 else cat(*(prepared[i].columns for i in idxs))
             ),
-            # placement is fusion-group-atomic (the same
-            # plan_fusion_groups bookkeeping drives both), so every
-            # member shares one owner — the fused unit inherits it
+            # every member shares one owner: placement is fusion-group-
+            # atomic on unsplit preps, and on split preps only LOCALLY
+            # staged buckets (owner == this process) ever group —
+            # remote ones key solo above — so the unit inherits it
             owner=prepared[idxs[0]].owner,
+            parent=prepared[idxs[0]].parent,
         )
         units.append((fused, members))
     return units
@@ -1003,7 +1086,9 @@ def train_prepared(
     repeatedly (the eager coordinate-descent visit loop) stage the fused
     concatenation once instead of re-concatenating every bucket tensor
     per call; it must be ``_fusion_units(prepared)`` for this exact list
-    and is only consulted when the fuse knob is on.
+    (or ``_parent_units(prepared)`` on a PHOTON_RE_SPLIT prep with the
+    fuse knob off) and is only consulted when a grouped launch schedule
+    applies (fuse knob on, or split sub-buckets present).
 
     ``norm`` applies the shard's normalization inside every entity's
     objective (coefficients are mapped back to the original feature space
@@ -1130,8 +1215,19 @@ def _train_prepared_core(
 
         chunked, _ = select_chunked_solver(config, l1_weight)
     fused = fuse_buckets() and sharding is None and len(prepared) > 1
+    # PHOTON_RE_SPLIT sub-buckets re-concatenate per owner even with the
+    # fuse knob off (parent-keyed instead of geometry-keyed): a fully
+    # co-owned parent then launches with exactly the unsplit lane order
+    # and geometry, so the split can only move WHERE lanes solve, never
+    # how many launches a co-owned class costs
+    split_mode = any(pb.parent is not None for pb in prepared)
     if fused:
         units = fusion_units if fusion_units is not None else _fusion_units(prepared)
+    elif split_mode and sharding is None:
+        units = (
+            fusion_units if fusion_units is not None
+            else _parent_units(prepared)
+        )
     else:
         units = [(pb, [(i, 0, pb.num_real)]) for i, pb in enumerate(prepared)]
     diag: list[tuple[Array, Array, Array]] = [None] * len(prepared)

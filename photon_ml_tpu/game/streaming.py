@@ -286,6 +286,12 @@ class _ReShard:
     # computed from) — kept so the telemetry-driven re-planner can
     # recalibrate costs without a fresh collective
     entity_rows: np.ndarray | None = None  # (E,) int64
+    # sub-bucket placement atoms (PHOTON_RE_SPLIT > 0): the entity-id
+    # groups the owner plan treated as indivisible units, kept so the
+    # measured-cost re-planner re-plans over the SAME atoms (derived
+    # from the global bincount — identical on every process). None =
+    # entity-granularity placement (the knob-off bit-for-bit rule).
+    placement_atoms: tuple | None = None
     # lane floor (placement mode): per-bucket dummy-lane pad (0/1). A
     # shard-local 1-entity bucket whose GLOBAL capacity class holds >= 2
     # entities pads to 2 lanes so its solve goes down the batched XLA
@@ -691,6 +697,7 @@ class StreamedGameTrainer:
         entity_owner = owned_global = None
         global_caps = global_pops = None
         counts_g = None
+        atoms = None
         if reuse_layout is not None and reuse_layout.entity_owner is not None:
             # follow the TRAINING plan verbatim — gated on the PREPARED
             # STATE, never a re-read of the knob (a flip between
@@ -713,11 +720,16 @@ class StreamedGameTrainer:
             # modular-layout training shard (reuse_layout given, no
             # owner map) must keep the modular rule below even when the
             # knob is on NOW
-            from photon_ml_tpu.game.data import capacity_classes
+            from photon_ml_tpu.game.data import (
+                capacity_classes,
+                placement_atoms,
+            )
             from photon_ml_tpu.parallel.multihost import allreduce_sum_host
             from photon_ml_tpu.parallel.placement import (
                 plan_entity_placement,
                 plan_from_owner,
+                plan_shard_placement,
+                re_split_factor,
                 record_placement_metrics,
             )
 
@@ -728,6 +740,28 @@ class StreamedGameTrainer:
                     ).astype(np.int64)
                 )
             )
+            active_g = counts_g
+            if c.active_data_upper_bound is not None:
+                active_g = np.minimum(counts_g, c.active_data_upper_bound)
+            # PHOTON_RE_SPLIT > 0: placement units are the sub-bucket
+            # atoms of the capacity-class ladder (each atom co-located,
+            # heavy classes split by the deterministic global-bincount
+            # rule) instead of individual entities — the SAME atom map
+            # the in-memory owned-bucket prep places by, and the unit
+            # the measured-cost re-planner keeps migrating. Knob off
+            # keeps the per-entity LPT bit-for-bit.
+            split = re_split_factor()
+            split_classes = None
+            if split > 0:
+                atom_members, _atom_caps, split_classes = placement_atoms(
+                    active_g,
+                    weights=counts_g,
+                    capacities=c.sample_bucket_sizes,
+                    target_buckets=c.bucket_target_count,
+                    max_padded_ratio=c.bucket_max_padded_ratio,
+                    split=split,
+                )
+                atoms = tuple(atom_members)
             if entity_owner_override is not None:
                 # the re-planner already decided the map (from measured
                 # costs): adopt it verbatim, publishing the same gauges
@@ -736,16 +770,23 @@ class StreamedGameTrainer:
                     entity_owner_override, counts_g, P
                 )
                 entity_owner = plan.owner
+            elif atoms is not None:
+                plan = plan_shard_placement(
+                    counts_g, P, groups=[list(a) for a in atoms]
+                )
+                entity_owner = plan.owner
             else:
                 plan = plan_entity_placement(counts_g, P)
                 entity_owner = plan.owner
             owned_global = np.flatnonzero(entity_owner == pid).astype(
                 np.int64
             )
-            record_placement_metrics(plan, shard=pid)
-            active_g = counts_g
-            if c.active_data_upper_bound is not None:
-                active_g = np.minimum(counts_g, c.active_data_upper_bound)
+            record_placement_metrics(
+                plan,
+                shard=pid,
+                atoms=None if atoms is None else len(atoms),
+                split_classes=split_classes,
+            )
             global_caps, global_pops = capacity_classes(
                 active_g,
                 c.sample_bucket_sizes,
@@ -879,6 +920,7 @@ class StreamedGameTrainer:
             owned_global=owned_global,
             entity_rows=counts_g,
             lane_floor_pad=lane_pad,
+            placement_atoms=atoms,
         )
 
     def _offsets_to_owners(
@@ -2325,8 +2367,15 @@ class StreamedGameTrainer:
                 counts_g, shard.entity_owner, walls
             )
             old_plan = plan_from_owner(shard.entity_owner, counts_g, P)
+            # a PHOTON_RE_SPLIT shard re-plans over the SAME sub-bucket
+            # atoms ingest placed by (groups co-locate each atom); an
+            # entity-granularity shard re-plans per entity as before
             new_plan, migrated = replan_excluding(
-                old_plan, [], costs, survivors=range(P)
+                old_plan, [], costs, survivors=range(P),
+                groups=(
+                    None if shard.placement_atoms is None
+                    else [list(a) for a in shard.placement_atoms]
+                ),
             )
             n_migrated = int(migrated.sum())
             if n_migrated == 0:

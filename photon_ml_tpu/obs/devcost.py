@@ -147,7 +147,10 @@ def _knob_raw_state() -> tuple:
         import sys
 
         pl_mod = sys.modules.get("photon_ml_tpu.parallel.placement")
-        shard_state = None if pl_mod is None else pl_mod.RE_SHARD
+        shard_state = (
+            None if pl_mod is None
+            else (pl_mod.RE_SHARD, pl_mod.RE_SPLIT)
+        )
     except Exception:
         shard_state = None
     return (
@@ -157,6 +160,7 @@ def _knob_raw_state() -> tuple:
         env.get("PHOTON_RE_COMPACT_EVERY"),
         env.get("PHOTON_RE_FUSE_BUCKETS"),
         env.get("PHOTON_RE_SHARD"),
+        env.get("PHOTON_RE_SPLIT"),
         pf.PREFETCH_DEPTH, pf.CHUNK_CACHE_BUDGET,
         len(pf._device_budget_memo),
         st.GROUPS_PER_RUN, st.PIPELINE_SEGMENTS, st.KERNEL_DTYPE,

@@ -463,12 +463,22 @@ def format_summary(s: dict) -> str:
     rsh = s.get("re_shard") or {}
     if rsh.get("shards"):
         overlap = rsh.get("exchange_overlap_ratio")
+        atoms = rsh.get("atoms")
+        split_classes = int(rsh.get("split_classes") or 0)
         lines.append(
             f"  re-shard: {int(rsh['shards'])} shards, rows "
             f"{rsh.get('rows', 0):.0f} "
             f"(max {rsh.get('rows_max', 0):.0f} / mean "
             f"{rsh.get('rows_mean', 0):.1f}), "
             f"balance {rsh.get('balance', 1.0):.3f}x"
+            + (
+                # placement granularity (PHOTON_RE_SPLIT): how many
+                # independently-placeable atoms the balance was achieved
+                # over, and how many capacity classes the rule split
+                f", atoms {int(atoms)}"
+                + (f" ({split_classes} split)" if split_classes else "")
+                if atoms is not None else ""
+            )
             + (
                 f", exchange-overlap {overlap:.1%}"
                 if overlap is not None else ""
@@ -1029,6 +1039,20 @@ def summarize_fleet(paths: list[str]) -> dict:
     }
 
 
+def _re_shard_fleet_max(fs: dict, name: str) -> float | None:
+    """The fleet MAX of one per-process ``re_shard`` gauge — the
+    readouts are identical on every process (deterministic planner on
+    replicated inputs), so a disagreeing shard (itself a bug) can only
+    look worse. ONE rule shared by the fleet render and the fleet
+    gate, so the two can never diverge."""
+    vals = [
+        (s.get("re_shard") or {}).get(name)
+        for s in (fs.get("processes") or {}).values()
+    ]
+    vals = [float(v) for v in vals if isinstance(v, (int, float))]
+    return max(vals) if vals else None
+
+
 def format_fleet(fs: dict) -> str:
     """The fleet-run tables (the human half of ``report fleet``)."""
     pidxs = sorted(int(k) for k in fs["processes"])
@@ -1070,6 +1094,22 @@ def format_fleet(fs: dict) -> str:
             if imb is not None else ""
         )
     )
+    # placement balance + granularity (the fleet MAX of each per-process
+    # gauge — same rule the fleet gate applies, one shared helper)
+    bal = _re_shard_fleet_max(fs, "balance")
+    if bal is not None:
+        rows_max = _re_shard_fleet_max(fs, "rows_max")
+        fatoms = _re_shard_fleet_max(fs, "atoms")
+        fsplit = int(_re_shard_fleet_max(fs, "split_classes") or 0)
+        lines.append(
+            f"  re-shard: balance {bal:.3f}x"
+            + (f", rows max {rows_max:.0f}" if rows_max is not None else "")
+            + (
+                f", atoms {int(fatoms)}"
+                + (f" ({fsplit} split)" if fsplit else "")
+                if fatoms is not None else ""
+            )
+        )
 
     if fs.get("overlap") or fs.get("exchange"):
         parts = []
@@ -1226,6 +1266,13 @@ DEFAULT_GATE_THRESHOLDS: dict[str, dict] = {
     # must trip the gate.
     "re_shard/": {"rel": 0.05},
     "re_shard/exchange_overlap_ratio": {"abs": 1.0},
+    # sub-bucket placement tiers (PHOTON_RE_SPLIT runs only — unsplit
+    # runs never emit these keys, so their thresholds are unchanged):
+    # the atom ladder is exact deterministic arithmetic on the global
+    # bincount, and at atom granularity the LPT balance has far less
+    # excuse to drift than the whole-class plan — tight tier
+    "re_shard/atoms": {"rel": 0.0, "abs": 0.0},
+    "re_shard/balance_split": {"rel": 0.02},
     # combine-traffic tier: bytes per process are deterministic for a
     # given combine mode + placement, so near-tight — a 5% creep is a
     # packing/layout regression, and a mode accidentally falling back
@@ -1324,9 +1371,18 @@ def gate_metrics_from_summary(s: dict) -> dict[str, float]:
         )
         if agg.get("peak_bytes"):
             m[f"devcost/{lab}/peak_bytes"] = float(agg["peak_bytes"])
-    for k, v in (s.get("re_shard") or {}).items():
+    rsh = s.get("re_shard") or {}
+    for k, v in rsh.items():
         if k in ("balance", "rows_max", "exchange_overlap_ratio"):
             m[f"re_shard/{k}"] = float(v)
+    if float(rsh.get("split_classes") or 0) > 0:
+        # sub-bucket placement (PHOTON_RE_SPLIT) ran: gate the atom
+        # count exactly and the balance on the TIGHT split tier — at
+        # atom granularity the planner has no excuse for a worse ratio.
+        # Unsplit runs never emit these keys, so their thresholds (and
+        # committed baselines) are unchanged.
+        m["re_shard/atoms"] = float(rsh.get("atoms") or 0)
+        m["re_shard/balance_split"] = float(rsh.get("balance") or 1.0)
     rc = s.get("re_combine") or {}
     if isinstance(rc.get("bytes_sent"), (int, float)):
         m["re_combine/bytes_sent"] = float(rc["bytes_sent"])
@@ -1374,6 +1430,15 @@ def gate_metrics_from_bench(doc: dict) -> dict[str, float]:
                 "re_shard.exchange_overlap_ratio",
             ):
                 m[f"{cfg}/re_shard/{g[len('re_shard.'):]}"] = float(v)
+        gauges = tmetrics.get("gauges") or {}
+        if float(gauges.get("re_shard.split_classes") or 0) > 0:
+            # split-granularity tier (mirrors gate_metrics_from_summary)
+            m[f"{cfg}/re_shard/atoms"] = float(
+                gauges.get("re_shard.atoms") or 0
+            )
+            m[f"{cfg}/re_shard/balance_split"] = float(
+                gauges.get("re_shard.balance") or 1.0
+            )
         timers = tmetrics.get("timers") or {}
         if "jax.compile_s" in timers:
             m[f"{cfg}/compile_s"] = float(
@@ -1442,13 +1507,15 @@ def gate_metrics_from_fleet(fs: dict) -> dict[str, float]:
     # placement readouts are identical on every process; gate the fleet
     # MAX so one disagreeing shard (itself a bug) can only look worse
     for name in ("balance", "rows_max"):
-        vals = [
-            (s.get("re_shard") or {}).get(name)
-            for s in (fs.get("processes") or {}).values()
-        ]
-        vals = [float(v) for v in vals if isinstance(v, (int, float))]
-        if vals:
-            m[f"re_shard/{name}"] = max(vals)
+        v = _re_shard_fleet_max(fs, name)
+        if v is not None:
+            m[f"re_shard/{name}"] = v
+    if (_re_shard_fleet_max(fs, "split_classes") or 0) > 0:
+        # split-granularity tier, fleet-wide (mirrors the per-run gate)
+        m["re_shard/atoms"] = float(_re_shard_fleet_max(fs, "atoms") or 0)
+        m["re_shard/balance_split"] = float(
+            _re_shard_fleet_max(fs, "balance") or 1.0
+        )
     # combine traffic gates the fleet TOTAL (near-tight: deterministic
     # for a given mode + placement); migrations gate the fleet MAX of
     # the per-process counter — every process counts the same global

@@ -342,6 +342,7 @@ def _knob_snapshot() -> dict:
         from photon_ml_tpu.parallel import placement
 
         knobs["re_shard"] = int(bool(placement.re_shard_enabled()))
+        knobs["re_split"] = int(placement.re_split_factor())
         knobs["re_replan_imbalance"] = float(
             placement.replan_imbalance_threshold()
         )

@@ -42,6 +42,22 @@ import numpy as np
 # exchanges); 1 = skew-aware placement + overlapped P2P exchange.
 RE_SHARD = 0
 
+# Sub-bucket placement atoms (PHOTON_RE_SPLIT): 0 (default) keeps the
+# bucket-atomic placement bit-for-bit — a whole capacity class is one
+# placement unit, so the Zipf tail class pins its owner's combine
+# segment and solve load at O(E) no matter how good LPT is. A positive
+# value N is the target ATOM COUNT of the split rule
+# (``game.data.placement_atoms``): any capacity class whose total row
+# weight exceeds total_rows / N is split into contiguous sub-bucket
+# atoms of at most that weight (each >= 2 entities — the batched-XLA
+# lane floor), so the max-owner load is bounded by
+# total/P + max-atom-weight instead of the heaviest class. The rule
+# reads ONLY the global bincount and the knob — never the process
+# count — so every process (and the single-process reference) derives
+# the identical sub-bucket ladder with zero extra communication. Like
+# every fleet knob it must be set identically on all processes.
+RE_SPLIT = 0
+
 # Telemetry-driven re-planning (PHOTON_RE_REPLAN_IMBALANCE): when the
 # MEASURED per-process random-effect solve wall of a descent iteration
 # is more imbalanced than this max/mean ratio, the streamed trainer
@@ -61,6 +77,17 @@ def re_shard_enabled() -> bool:
     if env is not None and env != "":
         return int(env) != 0
     return int(RE_SHARD) != 0
+
+
+def re_split_factor() -> int:
+    """``PHOTON_RE_SPLIT`` (env > module global), strict int parse like
+    the sibling RE knobs — a typo fails loudly instead of silently
+    benching bucket-atomic placement. <= 0 disables (the knob
+    convention); a positive value is the split rule's target atom
+    count (the per-atom weight cap is total_rows / value)."""
+    env = os.environ.get("PHOTON_RE_SPLIT")
+    raw = env if (env is not None and env != "") else RE_SPLIT
+    return max(int(raw), 0)
 
 
 def replan_imbalance_threshold() -> float:
@@ -190,15 +217,30 @@ def plan_from_owner(
     """Reconstruct a ``PlacementPlan`` from an existing owner map + row
     counts (the load definition lives HERE, next to the planner — the
     re-planner and the forced-map shard rebuild both need the old/forced
-    plan's loads and must agree with ``plan_shard_placement``'s)."""
+    plan's loads and must agree with ``plan_shard_placement``'s).
+
+    Validates shape and range instead of silently truncating: an owner
+    map that disagrees in length with the row counts, or that names a
+    shard outside ``[0, num_shards)``, is a desynced plan (the exact
+    failure the deterministic-replication design exists to prevent) and
+    must fail loudly with the offending value."""
     owner = np.asarray(owner, np.int64)
     counts = np.asarray(row_counts, np.float64)
-    loads = _add_loads(
-        np.zeros(int(num_shards), np.float64), counts, owner[: len(counts)]
-    )
-    return PlacementPlan(
-        owner=owner, loads=loads, num_shards=int(num_shards)
-    )
+    P = int(num_shards)
+    if len(owner) != len(counts):
+        raise ValueError(
+            f"plan_from_owner: owner map length {len(owner)} != "
+            f"row_counts length {len(counts)} — the map and the counts "
+            "must describe the same items"
+        )
+    if len(owner) and (owner.min() < 0 or owner.max() >= P):
+        bad = owner[(owner < 0) | (owner >= P)][0]
+        raise ValueError(
+            f"plan_from_owner: owner value {int(bad)} outside "
+            f"[0, {P}) — the map names a shard this plan does not have"
+        )
+    loads = _add_loads(np.zeros(P, np.float64), counts, owner)
+    return PlacementPlan(owner=owner, loads=loads, num_shards=P)
 
 
 def plan_entity_placement(
@@ -242,14 +284,22 @@ def replan_excluding(
         )
     if not survivors:
         raise ValueError("no surviving shards to re-plan onto")
+    out_of_range = [
+        s for s in survivors if not (0 <= s < int(plan.num_shards))
+    ]
+    if out_of_range:
+        raise ValueError(
+            f"replan_excluding: survivor {out_of_range[0]} outside the "
+            f"old plan's shard range [0, {int(plan.num_shards)}) — the "
+            "survivor list and the plan disagree about the topology"
+        )
     new_plan = plan_shard_placement(
         row_counts, len(survivors), groups=groups, skew_aware=skew_aware
     )
     # old owner (original shard id) -> survivor rank, lost -> -1
     rank_of = np.full(int(plan.num_shards), -1, np.int64)
     for r, s in enumerate(survivors):
-        if s < len(rank_of):
-            rank_of[s] = r
+        rank_of[s] = r
     old_ranks = rank_of[plan.owner]
     migrated = old_ranks != new_plan.owner
     return new_plan, migrated
@@ -279,26 +329,39 @@ def measured_entity_costs(
     owner = np.asarray(entity_owner, np.int64)
     walls = np.asarray(shard_walls, np.float64)
     P = len(walls)
+    if len(owner) != len(counts):
+        raise ValueError(
+            f"measured_entity_costs: owner map length {len(owner)} != "
+            f"row_counts length {len(counts)}"
+        )
     loads = np.zeros(P, np.float64)
-    np.add.at(loads, owner[: len(counts)], counts)
+    np.add.at(loads, owner, counts)
     rate = np.zeros(P, np.float64)
     ok = (loads > 0) & (walls > 0)
     rate[ok] = walls[ok] / loads[ok]
     fallback = float(rate[ok].mean()) if ok.any() else 1.0
     rate[~ok] = fallback
-    return counts * rate[owner[: len(counts)]]
+    return counts * rate[owner]
 
 
 def record_placement_metrics(
-    plan: PlacementPlan, shard: int | None = None, prefix: str = "re_shard"
+    plan: PlacementPlan,
+    shard: int | None = None,
+    prefix: str = "re_shard",
+    atoms: int | None = None,
+    split_classes: int | None = None,
 ) -> None:
     """Publish the plan's load gauges through the PR-4 registry:
     ``re_shard.rows`` (THIS shard's Σ rows when ``shard`` is given, else
     the max — the number that bounds the critical path either way),
     ``re_shard.rows_max`` / ``rows_mean``, ``re_shard.balance``
-    (max/mean) and ``re_shard.shards``. Pure gauges — safe to call from
-    every process (each publishes its own view; only process 0's sink
-    writes)."""
+    (max/mean), ``re_shard.shards``, and the placement-granularity
+    gauges ``re_shard.atoms`` (how many independently-placeable units
+    the plan distributed — defaults to the item count when the caller
+    does not group) / ``re_shard.split_classes`` (how many capacity
+    classes the ``PHOTON_RE_SPLIT`` rule split; 0 on an unsplit run).
+    Pure gauges — safe to call from every process (each publishes its
+    own view; only process 0's sink writes)."""
     from photon_ml_tpu.obs.metrics import REGISTRY
 
     loads = plan.loads
@@ -310,3 +373,10 @@ def record_placement_metrics(
     REGISTRY.gauge_set(f"{prefix}.rows_mean", rows_mean)
     REGISTRY.gauge_set(f"{prefix}.balance", plan.balance)
     REGISTRY.gauge_set(f"{prefix}.shards", float(plan.num_shards))
+    REGISTRY.gauge_set(
+        f"{prefix}.atoms",
+        float(len(plan.owner) if atoms is None else atoms),
+    )
+    REGISTRY.gauge_set(
+        f"{prefix}.split_classes", float(split_classes or 0)
+    )

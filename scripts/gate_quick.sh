@@ -26,6 +26,16 @@
 # `python bench.py --multichip-r08` when the combine/placement code
 # intentionally changes, then UPDATE_BASELINE=1 to re-bless.
 #
+# An R09 (SPLIT) leg finally validates the committed MULTICHIP_r09.json
+# (the PHOTON_RE_SPLIT sub-bucket placement A/B): acceptance invariants
+# (bitwise across arms/processes/vs the single-process reference,
+# max-owner combine-byte reduction ≥ 40%, atom-granularity balance ≤
+# 1.15, PHOTON_RE_SPLIT=0 reproducing the PR-12 wire bytes + launch
+# schedule) plus a gate of its per-rung byte/balance/atom metrics
+# against BASELINE_split_cpu.json. Re-capture with `python bench.py
+# --multichip-r09` when the split/placement code intentionally
+# changes, then UPDATE_BASELINE=1 to re-bless.
+#
 # Usage:
 #   scripts/gate_quick.sh                      # gate vs BASELINE_cost_cpu.json
 #   scripts/gate_quick.sh MY_BASELINE.json     # gate vs another baseline
@@ -72,6 +82,11 @@ with open("BASELINE_combine_cpu.json", "w") as f:
     json.dump(doc["gate_metrics"], f, indent=2)
     f.write("\n")
 print("gate_quick: combine baseline re-captured to BASELINE_combine_cpu.json")
+doc = json.load(open("MULTICHIP_r09.json"))
+with open("BASELINE_split_cpu.json", "w") as f:
+    json.dump(doc["gate_metrics"], f, indent=2)
+    f.write("\n")
+print("gate_quick: split baseline re-captured to BASELINE_split_cpu.json")
 PY
     exit 0
 fi
@@ -150,5 +165,31 @@ print(
     "gate_quick: combine leg OK (mean per-process reduction "
     f"{acc['bytes_reduction_at_top_rung']:.1%} >= "
     f"{acc['required_reduction']:.1%})"
+)
+PY
+
+# ---- r09 (split) leg: sub-bucket placement A/B invariants + gate ----------
+python - <<'PY'
+import json, sys
+
+from photon_ml_tpu.obs.report import gate_run
+
+doc = json.load(open("MULTICHIP_r09.json"))
+acc = doc["acceptance"]
+assert acc["bitwise_identical"], acc
+assert acc["reduction_ge_required"], acc
+assert acc["balance_le_1_15"], acc
+assert acc["unsplit_reproduces_r08_wire_bytes"], acc
+assert acc["unsplit_reproduces_legacy_launches"], acc
+baseline = json.load(open("BASELINE_split_cpu.json"))
+failures, lines = gate_run(doc["gate_metrics"], baseline)
+if failures:
+    print("\n".join(lines))
+    sys.exit(f"gate_quick: split placement gate FAILED: {failures}")
+print(
+    "gate_quick: r09 split leg OK (max-owner reduction "
+    f"{acc['max_owner_bytes_reduction_at_top_rung']:.1%} >= "
+    f"{acc['required_reduction']:.1%}, atom balance "
+    f"{acc['balance_split_at_top_rung']:.3f}x <= 1.15x)"
 )
 PY

@@ -122,7 +122,10 @@ class TestQuickMode:
             "re_useful_entity_iterations": 450.0,
             "re_wasted_lane_fraction": 0.625,
             "re_launches": 1.0,
-            "re_knobs": {"compact_every": 0, "fuse_buckets": 0},
+            "re_knobs": {
+                "compact_every": 0, "fuse_buckets": 0,
+                "re_shard": 0, "re_split": 0,
+            },
             "telemetry": {
                 "schema_version": 1,
                 "metrics": {
@@ -241,7 +244,10 @@ class TestQuickMode:
         # counters appear verbatim in the single JSON line, so the
         # compaction/fusion sweep is auditable from stdout alone
         r_cfg = payload["configs"]["R_re_skew"]
-        assert r_cfg["re_knobs"] == {"compact_every": 0, "fuse_buckets": 0}
+        assert r_cfg["re_knobs"] == {
+            "compact_every": 0, "fuse_buckets": 0,
+            "re_shard": 0, "re_split": 0,
+        }
         r_tel = r_cfg["telemetry"]
         assert (
             r_tel["metrics"]["counters"][
@@ -414,6 +420,35 @@ class TestQuickMode:
         knobs = _knob_snapshot()
         assert knobs["re_compact_every"] == 4
         assert knobs["re_fuse_buckets"] == 1
+
+    def test_retune_env_reaches_shard_knobs(self, monkeypatch):
+        """PHOTON_RE_SPLIT rides the RETUNE_ENV_SHARD surface next to
+        RE_SHARD: env → module global, call-time readers agree, and the
+        knob snapshot (telemetry block / run_start / devcost key)
+        reflects it."""
+        import photon_ml_tpu.parallel.placement as pl
+
+        monkeypatch.setattr(pl, "RE_SHARD", 0)
+        monkeypatch.setattr(pl, "RE_SPLIT", 0)
+        monkeypatch.setenv("PHOTON_RE_SHARD", "1")
+        monkeypatch.setenv("PHOTON_RE_SPLIT", "16")
+        bench._apply_retune_env()
+        assert pl.RE_SHARD == 1
+        assert pl.RE_SPLIT == 16
+        assert pl.re_shard_enabled() is True
+        assert pl.re_split_factor() == 16
+        from photon_ml_tpu.obs.sink import _knob_snapshot
+
+        knobs = _knob_snapshot()
+        assert knobs["re_shard"] == 1
+        assert knobs["re_split"] == 16
+        # the devcost capture key tracks the knob too (a split flip
+        # must re-capture, not reuse the unsplit executable's costs)
+        from photon_ml_tpu.obs import devcost
+
+        assert devcost.knob_key()["re_split"] == 16
+        monkeypatch.setenv("PHOTON_RE_SPLIT", "0")
+        assert devcost.knob_key()["re_split"] == 0
 
     def test_retune_env_reaches_prefetch_knobs(self, monkeypatch):
         import photon_ml_tpu.ops.prefetch as pf

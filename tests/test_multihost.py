@@ -1379,6 +1379,8 @@ _RE_SHARD_WORKER = textwrap.dedent(
     os.environ["PALLAS_AXON_POOL_IPS"] = ""
     coordinator, pid, nproc, knob = sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
     os.environ["PHOTON_RE_SHARD"] = knob
+    # optional 5th arg: the sub-bucket placement knob (PHOTON_RE_SPLIT)
+    os.environ["PHOTON_RE_SPLIT"] = sys.argv[5] if len(sys.argv) > 5 else "0"
     import jax
     jax.config.update("jax_platforms", "cpu")
     if nproc > 1:
@@ -1562,7 +1564,7 @@ _RE_SHARD_WORKER = textwrap.dedent(
 )
 
 
-def _run_re_shard_workers(nproc: int, knob: str) -> dict:
+def _run_re_shard_workers(nproc: int, knob: str, split: str = "0") -> dict:
     coordinator = f"127.0.0.1:{_free_port()}"
     env = {
         k: v for k, v in os.environ.items()
@@ -1571,7 +1573,7 @@ def _run_re_shard_workers(nproc: int, knob: str) -> dict:
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", _RE_SHARD_WORKER, coordinator,
-             str(pid), str(nproc), knob],
+             str(pid), str(nproc), knob, split],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env,
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -1647,6 +1649,21 @@ def test_entity_sharded_re_solve_bitwise_matches_single_process(tmp_path):
             assert r["gauges"].get("re_shard.balance", 99.0) <= 1.5, r["gauges"]
             # identical-shape exchange reuse: no executable-cache growth
             assert r["a2a_growth"] == 0, tag
+    # sub-bucket placement atoms (PHOTON_RE_SPLIT): the streamed owner
+    # map and the in-memory owned-bucket prep both place by the atom
+    # ladder — still BITWISE the single-process unsplit solve, with the
+    # placement gauges recording the finer granularity
+    got = _run_re_shard_workers(2, "1", split="12")
+    for pid, r in got.items():
+        tag = f"split nproc=2 pid={pid}"
+        for field in ("W", "V", "W_mem", "V_mem", "it_mem"):
+            np.testing.assert_array_equal(
+                np.asarray(r[field]), np.asarray(ref[field]), err_msg=tag
+            )
+        assert r["gauges"].get("re_shard.split_classes", 0.0) >= 1.0, (
+            r["gauges"]
+        )
+        assert r["gauges"]["re_shard.atoms"] > 2.0, r["gauges"]
 
 
 @pytest.mark.slow

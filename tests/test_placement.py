@@ -208,6 +208,228 @@ class TestCapacityClasses:
             capacity_classes(np.asarray([100]), capacities=(4, 16))
 
 
+class TestValidation:
+    """plan_from_owner / replan_excluding fail LOUDLY on desynced
+    inputs (they used to truncate/ignore silently): a mismatched owner
+    map or an out-of-range survivor is a fleet-desync bug, and the
+    error names the offending value."""
+
+    def test_plan_from_owner_rejects_length_mismatch(self):
+        from photon_ml_tpu.parallel.placement import plan_from_owner
+
+        with pytest.raises(ValueError, match=r"length 3 != .*length 2"):
+            plan_from_owner(np.array([0, 1, 0]), np.array([5.0, 5.0]), 2)
+
+    def test_plan_from_owner_rejects_out_of_range_owner(self):
+        from photon_ml_tpu.parallel.placement import plan_from_owner
+
+        with pytest.raises(ValueError, match=r"owner value 7"):
+            plan_from_owner(np.array([0, 7]), np.array([5.0, 5.0]), 2)
+        with pytest.raises(ValueError, match=r"owner value -1"):
+            plan_from_owner(np.array([0, -1]), np.array([5.0, 5.0]), 2)
+
+    def test_plan_from_owner_valid_roundtrip(self):
+        from photon_ml_tpu.parallel.placement import plan_from_owner
+
+        plan = plan_from_owner(np.array([1, 0, 1]), [2.0, 3.0, 4.0], 2)
+        assert plan.loads.tolist() == [3.0, 6.0]
+
+    def test_replan_rejects_out_of_range_survivor(self):
+        from photon_ml_tpu.parallel.placement import replan_excluding
+
+        plan = plan_entity_placement(np.ones(4), 2)
+        with pytest.raises(ValueError, match=r"survivor 5 outside"):
+            replan_excluding(plan, [0], np.ones(4), survivors=[1, 5])
+
+    def test_measured_costs_reject_length_mismatch(self):
+        from photon_ml_tpu.parallel.placement import measured_entity_costs
+
+        with pytest.raises(ValueError, match="length"):
+            measured_entity_costs(
+                np.ones(4), np.zeros(3, np.int64), np.ones(2)
+            )
+
+
+class TestSplitKnob:
+    def test_default_off(self, monkeypatch):
+        from photon_ml_tpu.parallel.placement import re_split_factor
+
+        monkeypatch.delenv("PHOTON_RE_SPLIT", raising=False)
+        assert re_split_factor() == 0
+
+    def test_env_wins_and_parses_strictly(self, monkeypatch):
+        from photon_ml_tpu.parallel.placement import re_split_factor
+
+        monkeypatch.setenv("PHOTON_RE_SPLIT", "16")
+        assert re_split_factor() == 16
+        monkeypatch.setenv("PHOTON_RE_SPLIT", "-3")
+        assert re_split_factor() == 0  # <= 0 disables, knob convention
+        monkeypatch.setenv("PHOTON_RE_SPLIT", "lots")
+        with pytest.raises(ValueError):
+            re_split_factor()
+
+    def test_module_global_fallback(self, monkeypatch):
+        import photon_ml_tpu.parallel.placement as pl
+
+        monkeypatch.delenv("PHOTON_RE_SPLIT", raising=False)
+        monkeypatch.setattr(pl, "RE_SPLIT", 8)
+        assert pl.re_split_factor() == 8
+
+
+def _zipf_active(E: int, seed: int = 0, alpha: float = 0.9):
+    """Zipf row counts over the whole entity range (the r08/r09 bench
+    shape: constant row mass per capacity octave, population doubling
+    toward the tail — the distribution whose tail class motivates the
+    split rule)."""
+    rng = np.random.default_rng(seed)
+    base = np.maximum(
+        ((E / (1.0 + np.arange(E))) ** alpha).astype(np.int64), 1
+    )
+    return np.maximum(base + rng.integers(0, 3, size=E), 1)
+
+
+class TestSplitRule:
+    """The PHOTON_RE_SPLIT sub-bucket atom ladder
+    (``game.data.placement_atoms`` / ``split_entity_buckets``): pure
+    deterministic arithmetic on the global bincount, never the process
+    count."""
+
+    def test_atoms_partition_classes_in_order(self):
+        from photon_ml_tpu.game.data import capacity_classes, placement_atoms
+
+        counts = _zipf_active(256)
+        atoms, atom_caps, n_split = placement_atoms(counts, split=16)
+        caps, pops = capacity_classes(counts)
+        # atoms refine the class ladder: concatenating same-capacity
+        # atoms in order reproduces each class's ascending member list
+        by_cap: dict[int, list] = {}
+        for a, c in zip(atoms, atom_caps):
+            by_cap.setdefault(c, []).append(a)
+        assert set(by_cap) == set(caps)
+        active = np.flatnonzero(counts > 0)
+        for c, pop in zip(caps, pops):
+            merged = np.concatenate(by_cap[c])
+            assert len(merged) == pop
+            assert (np.diff(merged) > 0).all()  # ascending, no dup
+        assert n_split >= 1  # the Zipf tail class split
+        # every SPLIT class's atoms respect the >= 2-entity lane floor
+        # (a 1-entity atom is legal only as a whole 1-entity class —
+        # the batch-1 launch the unsplit run would also have made)
+        for c, group in by_cap.items():
+            if len(group) > 1:
+                assert all(len(a) >= 2 for a in group), (c, group)
+
+    def test_split_zero_is_identity(self):
+        from photon_ml_tpu.game.data import capacity_classes, placement_atoms
+
+        counts = _zipf_active(128)
+        atoms, atom_caps, n_split = placement_atoms(counts, split=0)
+        caps, pops = capacity_classes(counts)
+        assert n_split == 0
+        assert atom_caps == caps
+        assert tuple(len(a) for a in atoms) == pops
+
+    @pytest.mark.parametrize("seed", [0, 3, 7])
+    def test_deterministic_and_process_count_independent(self, seed):
+        """Same global bincount ⇒ identical ladder, full stop: the rule
+        never reads the process count, so P ∈ {1, 2, 4} (or any other
+        fleet size) derive the same atoms — the PR-8 bitwise
+        invariant's placement analog."""
+        from photon_ml_tpu.game.data import placement_atoms
+
+        counts = _zipf_active(192, seed=seed)
+        ref_atoms, ref_caps, ref_split = placement_atoms(counts, split=12)
+        for P in (1, 2, 4):
+            # plan over the atoms at this fleet size — the ladder the
+            # plan consumed must be byte-identical to the reference
+            atoms, caps, n_split = placement_atoms(counts, split=12)
+            assert caps == ref_caps and n_split == ref_split
+            for a, r in zip(atoms, ref_atoms):
+                np.testing.assert_array_equal(a, r)
+            plan = plan_shard_placement(
+                counts, P, groups=[list(a) for a in atoms]
+            )
+            # atoms are indivisible placement units
+            for a in atoms:
+                assert len({int(plan.owner[i]) for i in a}) == 1
+
+    def test_split_entity_buckets_matches_placement_atoms(self):
+        """The two split sites (streamed owner map, in-memory prepared
+        buckets) derive the SAME ladder from the same population — the
+        shared ``_split_runs`` kernel, asserted end to end."""
+        from photon_ml_tpu.game.data import (
+            bucket_entities,
+            group_by_entity,
+            placement_atoms,
+            split_entity_buckets,
+        )
+
+        counts = _zipf_active(96)
+        ids = np.repeat(np.arange(96), counts)
+        grouping = group_by_entity(ids, num_entities=96)
+        buckets = bucket_entities(grouping)
+        split_b, parents, n_split_b = split_entity_buckets(buckets, 12)
+        atoms, atom_caps, n_split_a = placement_atoms(
+            grouping.active_counts, split=12
+        )
+        assert n_split_a == n_split_b >= 1
+        assert len(split_b.entity_ids) == len(atoms)
+        assert split_b.capacities == atom_caps
+        for ent_b, a in zip(split_b.entity_ids, atoms):
+            np.testing.assert_array_equal(np.sort(ent_b), np.sort(a))
+        # parents index the ORIGINAL bucket list, contiguously in order
+        assert parents is not None
+        assert sorted(set(parents)) == list(range(len(buckets.entity_ids)))
+
+    def test_split_entity_buckets_knob_off_identity(self):
+        from photon_ml_tpu.game.data import (
+            bucket_entities,
+            group_by_entity,
+            split_entity_buckets,
+        )
+
+        ids = np.repeat(np.arange(16), _zipf_active(16))
+        buckets = bucket_entities(group_by_entity(ids, num_entities=16))
+        same, parents, n_split = split_entity_buckets(buckets, 0)
+        assert same is buckets and parents is None and n_split == 0
+
+    @pytest.mark.parametrize("seed", [1, 5, 9, 13])
+    def test_lpt_quality_bound_under_atom_cap(self, seed):
+        """Property: LPT over the atom ladder meets the cap-adjusted
+        greedy bound max_load <= total/P + max_atom_weight on random
+        Zipf shapes — the guarantee that makes max-owner load O(E/P)
+        once no atom exceeds the cap."""
+        from photon_ml_tpu.game.data import placement_atoms
+
+        rng = np.random.default_rng(seed)
+        E = int(rng.integers(64, 512))
+        counts = _zipf_active(E, seed=seed, alpha=float(rng.uniform(0.7, 1.2)))
+        split = int(rng.integers(8, 33))
+        atoms, _, _ = placement_atoms(counts, split=split)
+        atom_w = np.array([counts[a].sum() for a in atoms], np.float64)
+        for P in (2, 4, 8):
+            plan = plan_shard_placement(
+                counts, P, groups=[list(a) for a in atoms]
+            )
+            bound = counts.sum() / P + atom_w.max()
+            assert plan.loads.max() <= bound + 1e-9, (
+                P, plan.loads, atom_w.max()
+            )
+
+    def test_record_placement_metrics_atom_gauges(self):
+        from photon_ml_tpu.obs.metrics import REGISTRY
+
+        plan = plan_entity_placement(_zipf_sizes(16), 4)
+        record_placement_metrics(plan, shard=1, atoms=5, split_classes=2)
+        g = REGISTRY.snapshot("re_shard.")["gauges"]
+        assert g["re_shard.atoms"] == 5.0
+        assert g["re_shard.split_classes"] == 2.0
+        record_placement_metrics(plan, shard=1)
+        g = REGISTRY.snapshot("re_shard.")["gauges"]
+        assert g["re_shard.atoms"] == 16.0  # defaults to the item count
+        assert g["re_shard.split_classes"] == 0.0
+
+
 class TestLaneFloorBitwise:
     """The sharded path's lane floor: a 1-real-lane launch padded with
     one all-masked dummy lane must give the real entity BITWISE the
@@ -343,6 +565,120 @@ class TestOwnedBucketMode:
         # the compacted chunk schedule actually ran (multiple launches
         # per fused unit), i.e. the knobs were NOT silently gated off
         assert launches() > before
+
+    def test_split_owned_mesh_solve_is_bitwise(self, problem, monkeypatch):
+        """PHOTON_RE_SPLIT under the owned-bucket mesh: sub-bucket atoms
+        re-concatenate per owner (one process here owns everything, so
+        the launch geometry — and the model, bit for bit — is exactly
+        the unsplit run's), warm starts and per-entity priors included."""
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.game.random_effect import train_random_effects
+        from photon_ml_tpu.parallel import data_mesh
+
+        feats, kwargs = problem
+        ref = train_random_effects(feats, **kwargs)
+        W = np.asarray(ref.coefficients)
+        V = np.asarray(ref.variances)
+        ref2 = train_random_effects(
+            feats,
+            initial_coefficients=jnp.asarray(W),
+            prior_coefficients=jnp.asarray(W),
+            prior_variances=jnp.asarray(V),
+            **kwargs,
+        )
+        monkeypatch.setenv("PHOTON_RE_SHARD", "1")
+        monkeypatch.setenv("PHOTON_RE_SPLIT", "6")
+        got = train_random_effects(feats, mesh=data_mesh(), **kwargs)
+        np.testing.assert_array_equal(np.asarray(got.coefficients), W)
+        np.testing.assert_array_equal(np.asarray(got.variances), V)
+        np.testing.assert_array_equal(got.iterations, ref.iterations)
+        # the warm+prior lanes remap through the sub-bucket permutation
+        got2 = train_random_effects(
+            feats,
+            mesh=data_mesh(),
+            initial_coefficients=jnp.asarray(W),
+            prior_coefficients=jnp.asarray(W),
+            prior_variances=jnp.asarray(V),
+            **kwargs,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got2.coefficients), np.asarray(ref2.coefficients)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got2.variances), np.asarray(ref2.variances)
+        )
+
+    def test_split_knob_off_reproduces_owner_map_and_launches(
+        self, problem, monkeypatch
+    ):
+        """PHOTON_RE_SPLIT=0 is the PR-12 schedule bit for bit: no
+        parent markers, the SAME owner map the legacy capacity-keyed
+        plan produces, and the legacy one-launch-per-bucket counter."""
+        from photon_ml_tpu.game.random_effect import (
+            _plan_bucket_owners,
+            prepare_buckets,
+            train_random_effects,
+        )
+        from photon_ml_tpu.obs.metrics import REGISTRY
+        from photon_ml_tpu.parallel import data_mesh
+
+        feats, kwargs = problem
+        monkeypatch.setenv("PHOTON_RE_SHARD", "1")
+        monkeypatch.delenv("PHOTON_RE_SPLIT", raising=False)
+        prepared = prepare_buckets(
+            feats, kwargs["labels"], kwargs["weights"], kwargs["buckets"],
+            data_mesh(),
+        )
+        assert all(pb.parent is None for pb in prepared)
+        legacy = _plan_bucket_owners(kwargs["buckets"])
+        np.testing.assert_array_equal(
+            [pb.owner for pb in prepared], np.asarray(legacy)
+        )
+
+        def launches():
+            return (
+                REGISTRY.snapshot("re_solve.")["counters"]
+                .get("re_solve.launches", {})
+                .get("value", 0.0)
+            )
+
+        before = launches()
+        train_random_effects(feats, mesh=data_mesh(), **kwargs)
+        assert launches() - before == len(kwargs["buckets"].entity_ids)
+
+    def test_split_prepared_buckets_carry_parents_and_owner_atoms(
+        self, problem, monkeypatch
+    ):
+        """Split prep: heavy classes appear as >= 2-lane sub-buckets
+        with parent markers, entity ids still partition, and the
+        placement gauges record the finer granularity."""
+        from photon_ml_tpu.game.random_effect import prepare_buckets
+        from photon_ml_tpu.obs.metrics import REGISTRY
+        from photon_ml_tpu.parallel import data_mesh
+
+        feats, kwargs = problem
+        monkeypatch.setenv("PHOTON_RE_SHARD", "1")
+        monkeypatch.setenv("PHOTON_RE_SPLIT", "6")
+        prepared = prepare_buckets(
+            feats, kwargs["labels"], kwargs["weights"], kwargs["buckets"],
+            data_mesh(),
+        )
+        assert len(prepared) > len(kwargs["buckets"].entity_ids)
+        assert all(pb.parent is not None for pb in prepared)
+        split_parents = {
+            pb.parent for pb in prepared
+            if sum(q.parent == pb.parent for q in prepared) > 1
+        }
+        assert split_parents  # at least one class actually split
+        for pb in prepared:
+            if pb.parent in split_parents:
+                assert pb.num_real >= 2  # the lane floor
+        all_ids = np.concatenate([pb.entity_ids for pb in prepared])
+        assert len(all_ids) == len(np.unique(all_ids))
+        g = REGISTRY.snapshot("re_shard.")["gauges"]
+        assert g["re_shard.atoms"] == float(len(prepared))
+        assert g["re_shard.split_classes"] >= 1.0
 
     def test_knob_off_mesh_keeps_lane_sharded_schedule(
         self, problem, monkeypatch
