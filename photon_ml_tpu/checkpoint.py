@@ -157,6 +157,29 @@ def save_checkpoint(
         json.dump(meta, f)
 
 
+def peek_fingerprint(directory: str) -> str | None:
+    """The fingerprint the stored checkpoint was written under, read
+    from the npz's embedded metadata WITHOUT materializing any model
+    arrays (``np.load`` is lazy per entry). None when there is no
+    checkpoint or no metadata. This is what a degraded or rejoining
+    restart feeds into its resume allow-list (``resume_fingerprints``)
+    so a foreign layout's checkpoint is accepted instead of silently
+    retraining — previously the drills scraped it from the
+    human-readable ``ckpt.json`` sidecar, which is documented as
+    informational-only and never read back."""
+    npz_path = os.path.join(directory, "ckpt.npz")
+    if not os.path.exists(npz_path):
+        return None
+    try:
+        with np.load(npz_path) as z:
+            if _META_KEY not in z.files:
+                return None
+            meta = json.loads(bytes(z[_META_KEY]).decode())
+    except Exception:
+        return None
+    return meta.get("fingerprint")
+
+
 def load_checkpoint(
     directory: str,
     fingerprint: str | Sequence[str] | None = None,

@@ -231,6 +231,30 @@ class FixedEffectCoordinate:
             return opt.matvec(model.model.coefficients.means)
         return model.score(self.batch)
 
+    def _reset_compiled_state(self) -> None:
+        """Drop every cached compiled program / staged device tensor so
+        the next visit rebuilds them from the (host-side) batch. The
+        in-place descent degrade calls this after shrinking the process
+        group: the cached executables/layouts were built for the old
+        topology. Frozen dataclass, so the caches live in ``__dict__``
+        via ``object.__setattr__`` — popping them re-arms the lazy
+        builders."""
+        for key in ("_visit_base", "_visit_fn", "_layout_cached"):
+            self.__dict__.pop(key, None)
+
+    def _degrade_blocker(self) -> str | None:
+        """Why this coordinate CANNOT survive an in-place group shrink,
+        or None when it can. A mesh-spanning fixed-effect solve compiles
+        programs over the full device mesh — a dead process's devices
+        cannot leave a live mesh in-process, so the only honest answer
+        is the restart-from-checkpoint abort."""
+        if self.mesh is not None:
+            return (
+                f"fixed-effect coordinate {self.coordinate_id!r} solves "
+                "over the full device mesh"
+            )
+        return None
+
     def _fused_visit_parts(self):
         """(make_static, apply, postprocess, advance) for fused execution,
         or None when this coordinate needs host-side staging per visit.
@@ -547,6 +571,45 @@ class RandomEffectCoordinate:
 
     def score(self, model: RandomEffectModel) -> Array:
         return model.score(self.batch)
+
+    def _reset_compiled_state(self) -> None:
+        """Degrade-in-place hook: drop the prepared bucket tensors, the
+        staged fusion units and the cached visit program. The next
+        ``train``/``visit`` re-prepares over the CURRENT (survivor)
+        group — ``prepare_buckets`` re-plans ownership with the degraded
+        ``effective_process_*`` shape, so each survivor stages exactly
+        the buckets it now owns."""
+        for key in (
+            "_prepared_cache", "_fusion_units_cache", "_visit_fn",
+            "_features_cache",
+        ):
+            self.__dict__.pop(key, None)
+
+    def _degrade_blocker(self) -> str | None:
+        """Why this coordinate cannot survive an in-place group shrink
+        (None = it can). Owned-bucket prep (``PHOTON_RE_SHARD=1`` under
+        a mesh) degrades cleanly: buckets are staged whole per process
+        and the combine is a host collective over the survivor mesh.
+        The legacy LANE-SHARDED prep spans the full device mesh — a
+        mesh cannot shrink in-process, so it keeps the abort."""
+        if self.mesh is None:
+            return None
+        prepared = self.__dict__.get("_prepared_cache")
+        if prepared is not None:
+            owned = any(pb.owner is not None for pb in prepared)
+        else:
+            from photon_ml_tpu.parallel.placement import re_shard_enabled
+
+            owned = re_shard_enabled()
+        if owned:
+            return None
+        return (
+            f"random-effect coordinate {self.coordinate_id!r} is "
+            "lane-sharded over the full device mesh (enable "
+            "PHOTON_RE_SHARD=1 owned-bucket placement — with the "
+            "PHOTON_RE_COMBINE=segments host-collective combine — for "
+            "a degradable in-memory solve)"
+        )
 
     def _staged_fusion_units(self):
         """Fused launch units for this coordinate's (cached) prepared

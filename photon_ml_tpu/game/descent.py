@@ -14,6 +14,7 @@ retrained — matching the reference's treatment of pre-trained coordinates.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Callable, Mapping, Sequence
@@ -129,6 +130,34 @@ def _build_fused_outer(coordinates: Mapping[str, Any], seq: Sequence[str]):
 # snapshots) while still amortizing dispatch latency R-fold
 _MAX_FUSED_CHUNK = 16
 
+# In-place degrade for the in-memory descent (PHOTON_DESCENT_DEGRADE;
+# bench RETUNE idiom: env > module global, strict int parse, call-time
+# read). 0 (default) keeps today's behavior byte-for-byte: a PeerLost
+# aborts with the actionable restart-from-checkpoint message. 1 catches
+# the loss at the OUTER-ITERATION boundary instead: roll call, shrink
+# to the degraded process group, re-plan random-effect ownership over
+# the survivors (prepare_buckets re-runs under the degraded
+# effective_process_* shape), drop the compiled programs keyed on the
+# old topology, and re-run the interrupted iteration from its start-of-
+# iteration state — run() returns normally, no process restarts.
+DESCENT_DEGRADE = 0
+
+# iteration-retry budget for roll calls that find every peer alive (a
+# link flap, not a loss): the ring collectives the in-memory combine
+# rides have no per-exchange retry, so the iteration re-run IS the
+# transient absorption — bounded, so a persistently flapping link still
+# surfaces as an error instead of an infinite loop
+_MAX_FLAP_RETRIES = 3
+
+
+def descent_degrade_enabled() -> bool:
+    """Strict parse like every sibling knob — a typo must fail the run
+    loudly, not silently keep the abort-on-loss behavior."""
+    env = os.environ.get("PHOTON_DESCENT_DEGRADE")
+    if env is not None and env != "":
+        return int(env) != 0
+    return int(DESCENT_DEGRADE) != 0
+
 
 def _pow2_floor(x: int) -> int:
     return 1 << (max(x, 1).bit_length() - 1)
@@ -136,10 +165,13 @@ def _pow2_floor(x: int) -> int:
 
 def _is_output_process() -> bool:
     """Multi-host: every process loads checkpoints (read-only); exactly one
-    writes them — concurrent writers to shared storage corrupt files."""
-    import jax
+    writes them — concurrent writers to shared storage corrupt files. In a
+    degraded group the lowest-ranked SURVIVOR writes (the multihost helper
+    already resolves that; identical to ``jax.process_index() == 0`` on a
+    healthy fleet)."""
+    from photon_ml_tpu.parallel.multihost import is_output_process
 
-    return jax.process_index() == 0
+    return is_output_process()
 
 
 @dataclass(frozen=True)
@@ -199,6 +231,7 @@ class CoordinateDescent:
         initial_model: GameModel | None = None,
         checkpoint_dir: str | None = None,
         checkpoint_fingerprint: str | None = None,
+        resume_fingerprints: Sequence[str] = (),
     ) -> CoordinateDescentResult:
         """``checkpoint_dir`` enables resumable descent: the model is
         checkpointed after every outer iteration, and an existing checkpoint
@@ -206,7 +239,11 @@ class CoordinateDescent:
         reference, which only supports whole-model warm start —
         SURVEY.md §5.4). ``checkpoint_fingerprint`` identifies the training
         setup; a stored checkpoint with a different fingerprint is ignored
-        rather than resumed."""
+        rather than resumed. ``resume_fingerprints`` extends the accepted
+        set (the ``load_checkpoint`` collection support the streamed
+        trainer already uses): a pre-loss layout's checkpoint — whose
+        fingerprint legitimately differs from the survivor layout's — can
+        resume a degraded restart instead of retraining from scratch."""
         for cid in update_sequence:
             if cid not in self.coordinates:
                 raise KeyError(f"update sequence names unknown coordinate {cid!r}")
@@ -214,6 +251,7 @@ class CoordinateDescent:
             return self._run_inner(
                 update_sequence, num_iterations, initial_model,
                 checkpoint_dir, checkpoint_fingerprint,
+                resume_fingerprints,
             )
         except BaseException as e:
             self._raise_if_peer_lost(e, checkpoint_dir)
@@ -255,6 +293,7 @@ class CoordinateDescent:
         initial_model: GameModel | None = None,
         checkpoint_dir: str | None = None,
         checkpoint_fingerprint: str | None = None,
+        resume_fingerprints: Sequence[str] = (),
     ) -> CoordinateDescentResult:
 
         start_iteration = 0
@@ -267,9 +306,15 @@ class CoordinateDescent:
             # ties restored residual scores to THIS batch: a checkpoint from
             # different data resumes the model but recomputes the scores
             digest = batch_digest(self.batch.labels, self.batch.weights)
+            # a None primary fingerprint keeps its accept-anything
+            # semantics; otherwise the allow-list is the primary plus the
+            # caller's resume collection (the degraded-restart path)
+            accepted: Any = checkpoint_fingerprint
+            if checkpoint_fingerprint is not None and resume_fingerprints:
+                accepted = (checkpoint_fingerprint, *resume_fingerprints)
             ckpt = load_checkpoint(
                 checkpoint_dir,
-                fingerprint=checkpoint_fingerprint,
+                fingerprint=accepted,
                 data_digest=digest,
             )
             if ckpt is not None:
@@ -335,7 +380,13 @@ class CoordinateDescent:
                     release()
             trackers[cid].append(tracker)
 
-        def end_of_iteration(it: int, iter_validation) -> None:
+        def end_of_iteration(
+            it: int, iter_validation, model, scores, total
+        ) -> None:
+            # the advanced state arrives as ARGUMENTS, not via closure:
+            # the eager iteration body runs in _run_one_iteration's own
+            # scope, where rebinding model/total would leave a closure
+            # over this scope reading the previous iteration's values
             validation_history.append(iter_validation)
             emit_event("descent_iteration", iteration=it)
             if checkpoint_dir is not None and _is_output_process():
@@ -378,7 +429,7 @@ class CoordinateDescent:
                     for cid in update_sequence:
                         append_tracker(cid, trackers_per_iter[j][cid])
                         self._log(f"iter {it + j} coordinate {cid}: trained")
-                    end_of_iteration(it + j, {})
+                    end_of_iteration(it + j, {}, model, scores, total)
                 it += r
             return CoordinateDescentResult(
                 model=model,
@@ -387,9 +438,77 @@ class CoordinateDescent:
                 training_scores=scores,
             )
 
-        for it in range(start_iteration, num_iterations):
+        from photon_ml_tpu.parallel.multihost import PeerLost
+
+        it = start_iteration
+        flap_retries = 0
+        while it < num_iterations:
             iter_validation: dict[str, EvaluationResults] = {}
-            with span("descent/iter", iteration=it):
+            # start-of-iteration rollback state for the in-place degrade
+            # (PHOTON_DESCENT_DEGRADE): device arrays are immutable, so
+            # holding the references IS the snapshot — every survivor
+            # re-runs the interrupted iteration from the identical state
+            snap_model, snap_scores, snap_total = model, dict(scores), total
+            snap_trackers = {cid: len(trackers[cid]) for cid in update_sequence}
+            snap_history = len(validation_history)
+            try:
+                self._run_one_iteration(
+                    it, update_sequence, iter_validation,
+                    # mutable iteration state rides a cell the body
+                    # writes back through
+                    state := {"model": model, "scores": scores,
+                              "total": total},
+                    append_tracker, end_of_iteration,
+                )
+            except PeerLost as e:
+                if not descent_degrade_enabled():
+                    raise
+                shrunk = self._degrade_in_place(e, it)
+                if not shrunk:
+                    flap_retries += 1
+                    if flap_retries > _MAX_FLAP_RETRIES:
+                        raise RuntimeError(
+                            f"in-memory descent iteration {it}: links "
+                            f"flapped {flap_retries} times with every "
+                            "peer alive — raise PHOTON_P2P_RETRIES/"
+                            "BACKOFF_S rather than retrying the "
+                            "iteration forever"
+                        ) from e
+                # roll back to the start-of-iteration state and re-run
+                # this iteration over the (possibly shrunk) group
+                model, scores, total = (
+                    snap_model, dict(snap_scores), snap_total
+                )
+                for cid in update_sequence:
+                    del trackers[cid][snap_trackers[cid]:]
+                del validation_history[snap_history:]
+                continue
+            model = state["model"]
+            scores = state["scores"]
+            total = state["total"]
+            flap_retries = 0
+            it += 1
+
+        return CoordinateDescentResult(
+            model=model,
+            validation_history=validation_history,
+            trackers=trackers,
+            training_scores=scores,
+        )
+
+    def _run_one_iteration(
+        self, it, update_sequence, iter_validation, state,
+        append_tracker, end_of_iteration,
+    ) -> None:
+        """One outer iteration of the eager (unfused) visit loop — the
+        body the degrade-in-place handler treats as a transaction:
+        either it completes (``state`` carries the advanced model/
+        scores/total) or the caller rolls back to its start-of-
+        iteration snapshot."""
+        model = state["model"]
+        scores = state["scores"]
+        total = state["total"]
+        with span("descent/iter", iteration=it):
                 for cid in update_sequence:
                     coord = self.coordinates[cid]
                     with span("descent/visit", iteration=it, coordinate=cid):
@@ -432,11 +551,89 @@ class CoordinateDescent:
                         self._log(f"iter {it} coordinate {cid}: {res}")
                     else:
                         self._log(f"iter {it} coordinate {cid}: trained")
-                end_of_iteration(it, iter_validation)
+                end_of_iteration(it, iter_validation, model, scores, total)
+        state["model"] = model
+        state["scores"] = scores
+        state["total"] = total
 
-        return CoordinateDescentResult(
-            model=model,
-            validation_history=validation_history,
-            trackers=trackers,
-            training_scores=scores,
+    def _degrade_in_place(self, err, iteration: int) -> bool:
+        """The PHOTON_DESCENT_DEGRADE handler: confirm the loss with a
+        barrier-tagged roll call, shrink the process group to the
+        survivors, re-plan random-effect ownership over them and drop
+        every compiled program keyed on the old topology — WITHOUT
+        leaving ``run()``. Returns True when the group shrank, False
+        when the roll call found every peer alive (a link flap: the
+        mesh was rebuilt by the roll call, the caller just re-runs the
+        iteration). Coordinates that cannot degrade (executables
+        genuinely spanning the device mesh) re-raise into the
+        existing actionable abort."""
+        from photon_ml_tpu.obs.metrics import REGISTRY
+        from photon_ml_tpu.parallel import multihost as mh
+
+        self._log(
+            f"iteration {iteration}: peer loss "
+            f"(process {getattr(err, 'peer', -1)}) — starting roll call"
         )
+        group, survivors, lost = mh.confirm_peer_loss(err)
+        if not lost:
+            emit_event(
+                "descent_retry", iteration=iteration, group=list(group),
+            )
+            self._log(
+                f"iteration {iteration}: roll call found every process "
+                "alive (links flapped) — re-running the iteration over "
+                "the rebuilt mesh"
+            )
+            return False
+        # degradability gate only once the roll call CONFIRMED a loss —
+        # a link flap needs no degradation, so a mesh-spanning
+        # coordinate must not turn a retryable flap into the abort. A
+        # mesh-spanning fixed effect (or a lane-sharded random effect)
+        # cannot shrink in-process: keep the restart-from-checkpoint
+        # abort for a real loss there.
+        blockers = [
+            getattr(coord, "_degrade_blocker", lambda: None)()
+            for coord in self.coordinates.values()
+        ]
+        if (
+            self.mesh is not None
+            and self.validation_batch is not None
+            and self.evaluators
+        ):
+            # validation scores/evaluates over the descent-level device
+            # mesh every visit — the dead process's devices cannot leave
+            # that mesh in-process any more than a coordinate's can
+            blockers.append(
+                "validation evaluates over the full device mesh"
+            )
+        for blocker in blockers:
+            if blocker is not None:
+                self._log(
+                    f"iteration {iteration}: lost processes {lost} with "
+                    f"PHOTON_DESCENT_DEGRADE=1, but {blocker} — falling "
+                    "back to the abort path"
+                )
+                raise err
+        mh.set_degraded_group(survivors)
+        # drop the dead topology's executables/staged tensors: the next
+        # visit re-prepares owned buckets over the survivor group (the
+        # re-plan itself runs inside prepare_buckets, on the degraded
+        # effective_process_* shape — deterministic pure-host
+        # arithmetic, identical on every survivor)
+        self._fused_outer_cache.clear()
+        for coord in self.coordinates.values():
+            reset = getattr(coord, "_reset_compiled_state", None)
+            if reset is not None:
+                reset()
+        REGISTRY.counter_inc("fleet.degraded_descents")
+        emit_event(
+            "degraded_descent", iteration=iteration,
+            survivors=[int(s) for s in survivors],
+            lost=[int(p) for p in lost],
+        )
+        self._log(
+            f"iteration {iteration}: lost processes {lost}, surviving "
+            f"group {survivors} — degraded in place, re-running the "
+            "iteration over the survivor set"
+        )
+        return True

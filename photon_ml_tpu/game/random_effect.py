@@ -384,7 +384,13 @@ def prepare_buckets(
             buckets, re_split_factor()
         )
         owners = _plan_bucket_owners(buckets, parents, n_split)
-    own_pid = jax.process_index()
+    # EFFECTIVE identity, not jax's: after an in-place descent degrade
+    # the owners above were planned over the survivor group, and this
+    # process dispatches under its survivor rank (identical to the jax
+    # index on a healthy fleet, so the knob-off path is bit-for-bit)
+    from photon_ml_tpu.parallel.multihost import effective_process_index
+
+    own_pid = effective_process_index()
     zeros_off = np.zeros_like(np.asarray(labels))
     prepared: list[PreparedBucket] = []
     for bi, (ent_ids, row_idx) in enumerate(
@@ -479,12 +485,18 @@ def _plan_bucket_owners(
     back into one unit — the geometry the fusion constraint protects is
     instead restored per owner by ``_parent_units``/``_fusion_units``
     re-concatenation, which is permutation-only and bit-preserving)."""
+    from photon_ml_tpu.parallel.multihost import (
+        effective_process_count,
+        effective_process_index,
+    )
     from photon_ml_tpu.parallel.placement import (
         plan_shard_placement,
         record_placement_metrics,
     )
 
-    P_ = jax.process_count()
+    # the CURRENT group's shape: survivor ranks after an in-place
+    # degrade, the jax runtime's processes otherwise (identical then)
+    P_ = effective_process_count()
     lanes = [len(e) for e in buckets.entity_ids]
     rows = [
         int(np.sum(np.asarray(r) >= 0)) for r in buckets.row_indices
@@ -497,7 +509,7 @@ def _plan_bucket_owners(
     plan = plan_shard_placement(rows, P_, groups=groups)
     record_placement_metrics(
         plan,
-        shard=jax.process_index(),
+        shard=effective_process_index(),
         atoms=len(groups) if groups is not None else len(lanes),
         split_classes=split_classes,
     )
@@ -1233,7 +1245,12 @@ def _train_prepared_core(
     diag: list[tuple[Array, Array, Array]] = [None] * len(prepared)
     accounting = _DeferredLaunchAccounting()
 
-    own_pid = jax.process_index() if owned_mode else 0
+    if owned_mode:
+        from photon_ml_tpu.parallel.multihost import effective_process_index
+
+        own_pid = effective_process_index()
+    else:
+        own_pid = 0
     for pb, members in units:
         if owned_mode and pb.owner is not None and pb.owner != own_pid:
             # another process owns this whole unit — its results arrive
@@ -1300,8 +1317,11 @@ def _train_prepared_core(
                 diag[orig_i] = (f_k[lo:hi], it_k[lo:hi], reason_k[lo:hi])
 
     accounting.flush()  # one batched readback, after every bucket enqueued
-    if owned_mode and jax.process_count() > 1:
-        W, V, diag = _combine_owned_results(prepared, W, V, diag)
+    if owned_mode:
+        from photon_ml_tpu.parallel.multihost import effective_process_count
+
+        if effective_process_count() > 1:
+            W, V, diag = _combine_owned_results(prepared, W, V, diag)
     if norm is not None:
         # back to the ORIGINAL feature space (W was held in normalized space
         # throughout so per-bucket warm starts stayed consistent)
@@ -1364,9 +1384,10 @@ def _combine_owned_allreduce(
     from photon_ml_tpu.parallel.multihost import (
         allreduce_sum_host,
         effective_process_count,
+        effective_process_index,
     )
 
-    pid = jax.process_index()
+    pid = effective_process_index()
     ks = [pb.num_real for pb in prepared]
     offs = np.concatenate([[0], np.cumsum(ks)]).astype(np.int64)
     total = int(offs[-1])
@@ -1549,7 +1570,7 @@ def _combine_owned_segments(
     from photon_ml_tpu.obs.metrics import REGISTRY
     from photon_ml_tpu.parallel import multihost as mh
 
-    pid = jax.process_index()
+    pid = mh.effective_process_index()
     W_h = np.asarray(jax.device_get(W)).copy()
     V_h = None if V is None else np.asarray(jax.device_get(V)).copy()
     owned = [i for i, pb in enumerate(prepared) if pb.owner == pid]

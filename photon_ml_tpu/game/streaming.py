@@ -203,6 +203,14 @@ def _num_processes() -> tuple[int, int]:
     return effective_process_index(), effective_process_count()
 
 
+class _RejoinResume(Exception):
+    """Control flow for the elastic-rejoin admission (PHOTON_REJOIN):
+    raised from a visit boundary after the expanded group agreed, so
+    ``fit`` re-enters ``_fit_inner`` — ingest re-plans placement over
+    the expanded group and the resume path restores the last atomic
+    checkpoint, exactly the machinery a degrade re-entry uses."""
+
+
 def _re_shard_enabled() -> bool:
     """PHOTON_RE_SHARD (lazy import — the parallel package pulls in the
     full distributed runtime, which this module otherwise defers)."""
@@ -508,6 +516,11 @@ class StreamedGameTrainer:
         # that restarts a degraded run from a foreign-layout checkpoint.
         self.resume_fingerprints: list[str] = []
         self.resume_row_base: int | None = None
+        # elastic rejoin (PHOTON_REJOIN): whether this degrade epoch
+        # already spent its PHOTON_REJOIN_WINDOW_S linger at a visit
+        # boundary, and whether a rejoin-booted process was admitted
+        self._rejoin_waited = False
+        self._rejoined = False
     # -- multi-host entity exchange (the ingest-time shuffle) ---------------
 
     def _global_layout(self, n_local: int) -> tuple[int, int, tuple[int, ...]]:
@@ -2471,7 +2484,7 @@ class StreamedGameTrainer:
         semantics. Entity rows must already be aligned to this dataset's
         dense entity ids (the driver re-uses the saved run's entity maps
         and pads new entities with zero rows)."""
-        from photon_ml_tpu.parallel.multihost import PeerLost
+        from photon_ml_tpu.parallel.multihost import PeerLost, rejoin_identity
 
         with span(
             "game/fit",
@@ -2479,6 +2492,11 @@ class StreamedGameTrainer:
             chunk_rows=int(self.chunk_rows),
             coordinates=list(self.config.coordinate_update_sequence),
         ):
+            if rejoin_identity() is not None and not self._rejoined:
+                # a rejoin-booted process (bootstrap_rejoin ran): wait
+                # for the surviving group's invite and seat into the
+                # expanded group BEFORE any collective work
+                self._join_as_rejoiner()
             while True:
                 try:
                     return self._fit_inner(data, validation, initial_model)
@@ -2491,6 +2509,12 @@ class StreamedGameTrainer:
                     # identical plan with zero extra comms) and the
                     # resume path restores the last atomic checkpoint
                     self._prepare_recovery(e)
+                except _RejoinResume:
+                    # the expanded group already agreed (roll call +
+                    # control broadcast in _maybe_admit_rejoin); ingest
+                    # re-plans over it and the resume path restores the
+                    # last checkpoint — migration by re-ingest
+                    continue
 
     def _prepare_recovery(self, err) -> None:
         """Turn a ``PeerLost`` into a degraded-group resume, or re-raise
@@ -2509,22 +2533,11 @@ class StreamedGameTrainer:
                 "mode and a checkpoint_dir to resume from; re-run with "
                 "checkpointing enabled or restart the whole job"
             ) from err
-        REGISTRY.counter_inc("fleet.peer_lost")
-        emit_event("peer_lost", peer=int(err.peer), error=str(err))
         self._log(
             f"peer loss: process {err.peer} unreachable after retries — "
             "starting roll call"
         )
-        # abandoned async exchanges from the failed attempt must not be
-        # re-polled (and re-reported) by every later drain
-        mh.reset_async_exchanges()
-        group = (
-            list(mh.degraded_group()["survivors"])
-            if mh.degraded_group() is not None
-            else list(range(jax.process_count()))
-        )
-        survivors = mh.roll_call()
-        lost = sorted(set(group) - set(survivors))
+        group, survivors, lost = mh.confirm_peer_loss(err)
         if not lost:
             raise RuntimeError(
                 f"roll call found every process alive after a reported "
@@ -2534,13 +2547,10 @@ class StreamedGameTrainer:
             ) from err
         # accept the pre-loss layout's checkpoints (this fit's stored
         # anchors) on the degraded resume
-        fp = getattr(self, "_last_fingerprint", None)
-        if fp is not None and fp not in self.resume_fingerprints:
-            self.resume_fingerprints.append(fp)
-        base = getattr(self, "_last_row_base", None)
-        if base is not None:
-            self.resume_row_base = int(base)
+        self._register_degrade_anchors()
         mh.set_degraded_group(survivors)
+        # a fresh degrade epoch re-arms the rejoin linger window
+        self._rejoin_waited = False
         REGISTRY.counter_inc("fleet.recoveries")
         emit_event(
             "recovery", survivors=[int(s) for s in survivors],
@@ -2552,6 +2562,243 @@ class StreamedGameTrainer:
             f"{survivors} — re-planning placement and resuming from the "
             "last checkpoint"
         )
+
+    def _register_degrade_anchors(self) -> None:
+        """Accept the CURRENT layout's checkpoints under whatever layout
+        the fit re-enters with — the shared bookkeeping of every
+        group-change path (`_prepare_recovery`, the rejoin admission,
+        and a rejoin roll call that dropped a survivor): the current
+        fingerprint joins the resume allow-list, and the foreign-resume
+        row base anchors to the layout that wrote any mid-epoch
+        checkpoint."""
+        fp = getattr(self, "_last_fingerprint", None)
+        if fp is not None and fp not in self.resume_fingerprints:
+            self.resume_fingerprints.append(fp)
+        base = getattr(self, "_last_row_base", None)
+        if base is not None:
+            self.resume_row_base = int(base)
+
+    # -- elastic rejoin (PHOTON_REJOIN) -------------------------------------
+
+    def _join_as_rejoiner(self) -> None:
+        """The re-exec'd (``bootstrap_rejoin``-booted) side of the
+        rejoin handshake: wait for the surviving group's invite on this
+        process's recorded mesh port, enter the SAME barrier-tagged
+        rejoin roll call the survivors run, seat into the agreed
+        (expanded) group, and receive the recovery anchors — the
+        fingerprint allow-list that makes the on-disk checkpoint
+        acceptable under this fresh interpreter. ``_fit_inner`` then
+        runs normally: ingest re-plans placement over the expanded
+        group and the resume path restores the checkpoint."""
+        from photon_ml_tpu.parallel import multihost as mh
+
+        if self.checkpoint_dir is None or not self.multihost:
+            raise RuntimeError(
+                "rejoin boot without a recovery substrate: rejoining "
+                "needs multihost mode and a checkpoint_dir to resume "
+                "from"
+            )
+        # the boot side waits LONGER than the per-boundary linger: the
+        # surviving group may still be mid-degrade (roll calls, the
+        # checkpoint re-entry, recompiles) when this process comes
+        # back, and it has nothing better to do than keep listening
+        invite = mh.rejoin_wait(window_s=4.0 * mh.rejoin_window_s())
+        if invite is None:
+            raise RuntimeError(
+                "rejoin: no invite arrived within 4x "
+                "PHOTON_REJOIN_WINDOW_S — the surviving group is not "
+                "probing (PHOTON_REJOIN unset there?) or this "
+                "process's recorded address is stale"
+            )
+        survivors = [int(s) for s in invite["survivors"]]
+        agreed = mh.roll_call(
+            candidates=invite["candidates"], guard_group=survivors,
+        )
+        mh.set_degraded_group(agreed)
+        ctrl = self._rejoin_ctrl_exchange(agreed, survivors, None)
+        for fp in ctrl.get("fingerprints") or []:
+            if fp not in self.resume_fingerprints:
+                self.resume_fingerprints.append(fp)
+        # no row base travels with the anchors: this process's rows were
+        # never in a degraded-written layout, so there is no valid base
+        # for it there — the foreign-resume guard in _fit_inner refuses
+        # that checkpoint loudly instead of mis-slicing
+        self._rejoined = True
+        REGISTRY.counter_inc("fleet.rejoins")
+        emit_event(
+            "rejoin",
+            rejoined=[int(mh.original_process_index())],
+            group=[int(p) for p in agreed],
+            role="rejoiner",
+        )
+        self._log(
+            f"rejoined the fleet as process "
+            f"{mh.original_process_index()}: group {sorted(agreed)}, "
+            f"resuming from the last checkpoint"
+        )
+
+    @staticmethod
+    def _rejoin_ctrl_exchange(agreed, survivors, payload) -> dict:
+        """One allgather of the recovery-anchor control payload over
+        the freshly-expanded group, rooted at the lowest LIVE survivor
+        (the plain rank-0 broadcast would root at the rejoiner whenever
+        process 0 is the one returning — the only process with nothing
+        to contribute; and the roll call may have DROPPED a survivor in
+        the same round, so the root must come from ``survivors`` ∩
+        ``agreed``, never from the stale survivor list alone)."""
+        from photon_ml_tpu.parallel import multihost as mh
+
+        agreed = sorted(int(p) for p in agreed)
+        live = sorted(int(s) for s in survivors if int(s) in set(agreed))
+        if not live:
+            # every pre-rejoin survivor vanished in the same roll call:
+            # no member holds the recovery anchors to broadcast
+            return {}
+        root = live[0]
+        views = mh.allgather_obj_p2p(
+            payload if mh.original_process_index() == root else None,
+            tag="rejoin_ctrl",
+        )
+        return views[agreed.index(root)] or {}
+
+    def _maybe_admit_rejoin(self, re_shards, iteration: int, ci: int) -> None:
+        """Survivor side of the rejoin handshake, called at every visit
+        boundary while the group is degraded: probe the lost peers'
+        cached mesh addresses (rank 0 only; the FIRST boundary after a
+        degrade lingers up to ``PHOTON_REJOIN_WINDOW_S`` so a promptly-
+        restarted peer is caught before any degraded-data visit
+        commits — later boundaries are instant), broadcast the verdict,
+        invite whoever answered, run ONE rejoin roll call over
+        survivors + rejoiners, and re-enter the fit over the expanded
+        group. The re-planner preview (``replan_excluding`` with an
+        empty lost set over the EXPANDED survivor range) records how
+        many entities migrate back — the identical deterministic LPT
+        plan the re-ingest then builds."""
+        from photon_ml_tpu.parallel import multihost as mh
+
+        if not self.multihost or not mh.rejoin_enabled():
+            return
+        dg = mh.degraded_group()
+        if dg is None:
+            return
+        world = mh.original_process_count()
+        survivors = sorted(int(s) for s in dg["survivors"])
+        lost = [p for p in range(world) if p not in survivors]
+        if not lost:
+            return
+        window = 0.0 if self._rejoin_waited else mh.rejoin_window_s()
+        self._rejoin_waited = True
+        rank0 = mh.effective_process_index() == 0
+        # the linger is ROUND-COUNTED, not deadline-based: every
+        # survivor runs the same number of probe+broadcast rounds (the
+        # broadcast is the per-round synchronizer), so rank 0 lingering
+        # on a wall-clock deadline can never park its peers in a ring
+        # recv past the socket timeout
+        poll_s = 0.5
+        rounds = max(1, int(np.ceil(window / poll_s))) if window > 0 else 1
+        present: list[int] = []
+        for r in range(rounds):
+            probed = mh.probe_rejoiners(lost, 0.0) if rank0 else []
+            present = [
+                int(p) for p in np.asarray(
+                    mh.broadcast_from_host0(np.asarray(probed, np.int64))
+                ).reshape(-1)
+            ]
+            if present:
+                break
+            if r + 1 < rounds:
+                import time as _time
+
+                _time.sleep(poll_s)
+        if not present:
+            return
+        candidates = sorted(set(survivors) | set(present))
+        if rank0:
+            mh.send_rejoin_invites(present, candidates, survivors)
+        agreed = mh.roll_call(candidates=candidates, guard_group=survivors)
+        mh.set_degraded_group(agreed)
+        rejoined = sorted(set(agreed) - set(survivors))
+        dropped = sorted(set(survivors) - set(agreed))
+        if not rejoined and not dropped:
+            # the probed peer vanished between probe and roll call:
+            # the group is unchanged, keep training on it
+            return
+        if not rejoined:
+            # the roll call DROPPED a survivor (it died between the
+            # probe broadcast and the roll call): the in-flight visit's
+            # shard plans are keyed on the OLD rank mapping, so this is
+            # a degrade — register the anchors and re-plan + resume
+            # from checkpoint exactly like _prepare_recovery
+            self._register_degrade_anchors()
+            self._rejoin_waited = False
+            REGISTRY.counter_inc("fleet.recoveries")
+            emit_event(
+                "recovery", survivors=[int(p) for p in agreed],
+                lost=[int(p) for p in dropped],
+                resume_fingerprints=len(self.resume_fingerprints),
+            )
+            self._log(
+                f"iter {iteration}: rejoin roll call dropped "
+                f"{dropped} — group {sorted(agreed)}, re-planning and "
+                "resuming from the last checkpoint"
+            )
+            raise _RejoinResume()
+        # re-planner preview: the migration the expanded re-ingest will
+        # perform, computed from the SAME deterministic planner inputs
+        migrated_by_cid: dict[str, int] = {}
+        try:
+            from photon_ml_tpu.parallel.placement import (
+                plan_from_owner,
+                replan_excluding,
+            )
+
+            for cid, shard in re_shards.items():
+                if shard.entity_owner is None or shard.entity_rows is None:
+                    continue
+                old_plan = plan_from_owner(
+                    shard.entity_owner, shard.entity_rows, len(survivors)
+                )
+                _, migrated = replan_excluding(
+                    old_plan, [], shard.entity_rows,
+                    survivors=range(len(agreed)),
+                    groups=(
+                        None if shard.placement_atoms is None
+                        else [list(a) for a in shard.placement_atoms]
+                    ),
+                )
+                migrated_by_cid[cid] = int(migrated.sum())
+        except Exception:
+            pass  # the preview is telemetry, never load-bearing
+        fps: list[str] = []
+        for fp in [
+            getattr(self, "_last_fingerprint", None),
+            *self.resume_fingerprints,
+        ]:
+            if fp and fp not in fps:
+                fps.append(fp)
+        self._rejoin_ctrl_exchange(agreed, survivors, {"fingerprints": fps})
+        # the survivors keep the same anchors they just broadcast:
+        # after the re-entry the EXPANDED layout's fingerprint differs
+        # from whichever layout wrote the last checkpoint (degraded or
+        # original) and every member must accept it identically; the
+        # row base anchors to the degraded layout that wrote any
+        # mid-degrade checkpoint (a non-foreign pre-loss checkpoint
+        # ignores it — ck_base falls back to the current row_base)
+        self._register_degrade_anchors()
+        self._rejoin_waited = False
+        REGISTRY.counter_inc("fleet.rejoins")
+        emit_event(
+            "rejoin", iteration=iteration, coordinate_index=ci,
+            rejoined=[int(p) for p in rejoined],
+            group=[int(p) for p in agreed],
+            migrated=migrated_by_cid, role="survivor",
+        )
+        self._log(
+            f"iter {iteration}: processes {rejoined} rejoined — group "
+            f"{sorted(agreed)}, re-planning placement and resuming "
+            "from the last checkpoint"
+        )
+        raise _RejoinResume()
 
     def _fit_inner(
         self,
@@ -2863,6 +3110,39 @@ class StreamedGameTrainer:
                         )
                         else row_base
                     )
+                    ck_rows = int(
+                        np.asarray(resume["total"]).shape[0]
+                    )
+                    rejoin_boot = False
+                    try:
+                        from photon_ml_tpu.parallel.multihost import (
+                            rejoin_identity,
+                        )
+
+                        rejoin_boot = rejoin_identity() is not None
+                    except Exception:
+                        pass
+                    if ck_base + n > ck_rows or (
+                        rejoin_boot and resume.get("foreign")
+                    ):
+                        # loud, not a silent mis-slice: the checkpoint's
+                        # gathered score state does not cover this
+                        # process's rows. A re-exec'd (rejoin-booted)
+                        # process hits this for ANY foreign checkpoint —
+                        # foreign here means a degraded layout wrote it,
+                        # and a degraded layout never held this
+                        # process's rows, so even an in-bounds slice
+                        # would copy another process's score state.
+                        raise RuntimeError(
+                            f"checkpoint score state covers {ck_rows} "
+                            f"global rows but this process expects rows "
+                            f"[{ck_base}, {ck_base + n}) of the writing "
+                            "layout — the checkpoint was written by a "
+                            "layout that did not hold this process's "
+                            "rows (e.g. a mid-degrade checkpoint resumed "
+                            "after rejoin); restart from a full-layout "
+                            "checkpoint or retrain"
+                        )
                     for cid in seq:
                         scores[cid] = np.asarray(
                             resume["scores"][cid], np.float32
@@ -2894,6 +3174,10 @@ class StreamedGameTrainer:
             with span("descent/iter", iteration=it):
                 for ci in range(ci0, len(seq)):
                     cid = seq[ci]
+                    # visit boundary: a degraded group probes for
+                    # returning peers here (collective; raises
+                    # _RejoinResume into fit's loop on admission)
+                    self._maybe_admit_rejoin(re_shards, it, ci)
                     with span("descent/visit", iteration=it, coordinate=cid):
                         offs = total - scores[cid]
                         if cid in cfg.fixed_effect_coordinates:

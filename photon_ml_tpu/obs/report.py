@@ -909,7 +909,7 @@ def summarize_fleet(paths: list[str]) -> dict:
     recovery: dict = {
         "p2p_retries": 0, "p2p_giveups": 0, "drain_errors": 0,
         "faults_injected": 0, "peer_lost": [], "recoveries": [],
-        "roll_calls": [],
+        "roll_calls": [], "degraded_descents": [], "rejoins": [],
     }
     replans: list[dict] = []
     retry_by_error: dict[str, int] = {}
@@ -970,6 +970,27 @@ def summarize_fleet(paths: list[str]) -> dict:
                         "process": pidx,
                         "survivors": r.get("survivors"),
                         "lost": r.get("lost"),
+                    }
+                )
+            elif ev == "degraded_descent":
+                # the in-memory descent degraded IN PLACE (no restart,
+                # no checkpoint re-entry): every survivor emits one
+                recovery["degraded_descents"].append(
+                    {
+                        "process": pidx,
+                        "iteration": r.get("iteration"),
+                        "survivors": r.get("survivors"),
+                        "lost": r.get("lost"),
+                    }
+                )
+            elif ev == "rejoin":
+                recovery["rejoins"].append(
+                    {
+                        "process": pidx,
+                        "role": r.get("role"),
+                        "rejoined": r.get("rejoined"),
+                        "group": r.get("group"),
+                        "migrated": r.get("migrated"),
                     }
                 )
     recovery["retry_errors"] = dict(sorted(retry_by_error.items()))
@@ -1188,6 +1209,7 @@ def format_fleet(fs: dict) -> str:
         for k in (
             "p2p_retries", "p2p_giveups", "drain_errors",
             "faults_injected", "peer_lost", "recoveries",
+            "degraded_descents", "rejoins",
         )
     ):
         seg = (
@@ -1216,7 +1238,26 @@ def format_fleet(fs: dict) -> str:
                 f"    recovery: p{rv['process']} resumed with "
                 f"survivors {rv['survivors']} (lost {rv['lost']})"
             )
-        if rec.get("recoveries"):
+        for dd in rec.get("degraded_descents") or []:
+            lines.append(
+                f"    degraded_descent: p{dd['process']} degraded IN "
+                f"PLACE at iteration {dd['iteration']} — survivors "
+                f"{dd['survivors']} (lost {dd['lost']}, no restart)"
+            )
+        for rj in rec.get("rejoins") or []:
+            mig = rj.get("migrated")
+            mig_s = (
+                "" if not mig
+                else " — migrated back: " + ", ".join(
+                    f"{c}:{n}" for c, n in sorted(mig.items())
+                )
+            )
+            lines.append(
+                f"    rejoin: p{rj['process']} ({rj.get('role')}) — "
+                f"{rj.get('rejoined')} rejoined, group {rj.get('group')}"
+                + mig_s
+            )
+        if rec.get("recoveries") or rec.get("degraded_descents"):
             lines.append(
                 "  WARNING: this run degraded mid-flight — wall/"
                 "imbalance rows mix pre- and post-recovery topologies"
@@ -1302,6 +1343,12 @@ DEFAULT_GATE_THRESHOLDS: dict[str, dict] = {
     "fleet/exchange_drain_errors": {"rel": 0.0, "abs": 0.0},
     "fleet/peer_lost": {"rel": 0.0, "abs": 0.0},
     "fleet/recoveries": {"rel": 0.0, "abs": 0.0},
+    # elastic-fleet tiers: in-place descent degrades and rejoins are
+    # deterministic for a committed fault plan — one extra of either is
+    # a new failure mode (or a spontaneous rejoin against a healthy
+    # baseline), never noise
+    "fleet/degraded_descents": {"rel": 0.0, "abs": 0.0},
+    "fleet/rejoins": {"rel": 0.0, "abs": 0.0},
     "/imbalance": {"rel": 1.0, "abs": 1.0},
     "exchange_wait_s": {"rel": 2.0, "abs": 5.0},
     "exchange_s": {"rel": 2.0, "abs": 5.0},
@@ -1495,6 +1542,10 @@ def gate_metrics_from_fleet(fs: dict) -> dict[str, float]:
         )
         m["fleet/peer_lost"] = float(len(rec.get("peer_lost") or []))
         m["fleet/recoveries"] = float(len(rec.get("recoveries") or []))
+        m["fleet/degraded_descents"] = float(
+            len(rec.get("degraded_descents") or [])
+        )
+        m["fleet/rejoins"] = float(len(rec.get("rejoins") or []))
     for ph, agg in (fs.get("phases") or {}).items():
         if agg.get("imbalance") is not None:
             m[f"fleet/phase/{ph}/imbalance"] = float(agg["imbalance"])

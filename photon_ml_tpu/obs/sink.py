@@ -169,6 +169,17 @@ def is_active() -> bool:
 
 
 def _process_index() -> int:
+    # a rejoin-booted process (fresh interpreter, original identity
+    # recorded by multihost.bootstrap_rejoin) must shard under its
+    # ORIGINAL index — jax.process_index() is 0 there, and a 0-index
+    # rejoiner would collide with the true canonical run file
+    try:
+        from photon_ml_tpu.parallel import multihost as mh
+
+        if mh.rejoin_identity() is not None:
+            return int(mh.original_process_index())
+    except Exception:
+        pass
     try:
         import jax
 
@@ -178,6 +189,13 @@ def _process_index() -> int:
 
 
 def _process_count() -> int:
+    try:
+        from photon_ml_tpu.parallel import multihost as mh
+
+        if mh.rejoin_identity() is not None:
+            return int(mh.original_process_count())
+    except Exception:
+        pass
     try:
         import jax
 

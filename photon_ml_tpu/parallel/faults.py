@@ -27,7 +27,14 @@ Plan grammar (JSON — a list of fault specs, or ``@/path/to/plan.json``):
   ``PHOTON_P2P_CRC`` negotiated, by size/row validation otherwise),
   ``delay`` (``delay_s`` sleep before send), ``close`` (the link socket
   is closed instead of sending — the peer sees EOF), ``kill`` (the
-  process exits hard at the send boundary — the peer-loss drill).
+  process exits hard at the send boundary — the peer-loss drill),
+  ``rejoin`` (the process exits hard AND re-execs itself ``delay_s``
+  seconds later as a rejoin boot — the elastic-rejoin drill; needs
+  ``PHOTON_REJOIN_CMD``, a JSON argv list naming the command to
+  relaunch, because a ``python -c`` worker's own command string is not
+  recoverable from ``sys.argv``. The child gets ``PHOTON_REJOIN_BOOT``
+  = the dying process's index and an EMPTY fault plan — a rejoined
+  process must not re-run the plan that killed it).
 - ``link``: ``[src, dst]`` ORIGINAL process indices. Send-side faults
   fire on the ``src`` process; every spec is matched on the side that
   performs the send (the injection boundary is the framed send path,
@@ -52,7 +59,7 @@ import os
 import time
 from dataclasses import dataclass, field
 
-VALID_OPS = ("drop", "corrupt", "delay", "close", "kill")
+VALID_OPS = ("drop", "corrupt", "delay", "close", "kill", "rejoin")
 
 
 @dataclass
@@ -137,8 +144,8 @@ def parse_plan(text: str) -> FaultPlan:
                 f"fault spec {i}: seq must be a 1-based frame-set "
                 f"ordinal, got {seq!r}"
             )
-        if op == "delay" and not d.get("delay_s"):
-            raise ValueError(f"fault spec {i}: delay requires delay_s > 0")
+        if op in ("delay", "rejoin") and not d.get("delay_s"):
+            raise ValueError(f"fault spec {i}: {op} requires delay_s > 0")
         specs.append(
             FaultSpec(
                 op=op, src=int(link[0]), dst=int(link[1]), seq=seq,
@@ -211,7 +218,46 @@ def apply_send_fault(
         # the drill is precisely that its shard ends mid-run and its
         # peers must cope. os._exit skips atexit/finally by design.
         os._exit(spec.exit_code)
+    if spec.op == "rejoin":
+        _spawn_rejoin_child(spec)
+        os._exit(spec.exit_code)
     raise AssertionError(f"unhandled fault op {spec.op!r}")
+
+
+def _spawn_rejoin_child(spec: FaultSpec) -> None:
+    """Launch the delayed re-exec for a ``rejoin`` spec, then let the
+    caller hard-exit. The child is a detached ``sh`` that sleeps
+    ``delay_s`` and execs the command from ``PHOTON_REJOIN_CMD`` (JSON
+    argv) with ``PHOTON_REJOIN_BOOT`` = this process's original index
+    and the fault plan CLEARED. stdout/stderr are inherited, so a
+    harness reading the dying worker's pipe also captures the
+    rejoiner's output — no extra plumbing."""
+    import subprocess
+
+    raw = os.environ.get("PHOTON_REJOIN_CMD")
+    if not raw:
+        raise RuntimeError(
+            "fault op 'rejoin' needs PHOTON_REJOIN_CMD (JSON argv list "
+            "of the command to relaunch)"
+        )
+    cmd = json.loads(raw)
+    if not isinstance(cmd, list) or not all(isinstance(c, str) for c in cmd):
+        raise RuntimeError(
+            f"PHOTON_REJOIN_CMD must be a JSON list of strings, got {raw!r}"
+        )
+    env = dict(os.environ)
+    env["PHOTON_REJOIN_BOOT"] = str(spec.src)
+    env.pop("PHOTON_FAULT_PLAN", None)
+    # sh -c 'sleep N; exec "$0" "$@"' <argv...>: $0/$@ carry the command
+    # verbatim (no quoting pitfalls), and the exec replaces the shell so
+    # the rejoiner is a direct child of init once this process dies
+    subprocess.Popen(
+        [
+            "/bin/sh", "-c",
+            f'sleep {float(spec.delay_s)}; exec "$0" "$@"', *cmd,
+        ],
+        env=env, start_new_session=True,
+    )
 
 
 def _emit(spec: FaultSpec) -> None:

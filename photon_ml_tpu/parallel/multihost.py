@@ -53,12 +53,46 @@ def initialize_multihost(
         num_processes = int(os.environ["JAX_NUM_PROCESSES"])
     if process_id is None and os.environ.get("JAX_PROCESS_ID"):
         process_id = int(os.environ["JAX_PROCESS_ID"])
+    # PHOTON_COORD_MAX_MISSING_HEARTBEATS (strict int parse, default =
+    # jax's own): how many 10 s heartbeats the coordination service /
+    # client tolerate missing before declaring a task dead and FATALing
+    # every member. An elastic fleet (PHOTON_DESCENT_DEGRADE /
+    # PHOTON_REJOIN) raises it so the repo's own roll-call tier — not
+    # the jax coordination service, which cannot degrade in place — is
+    # what decides who is dead.
+    hb = os.environ.get("PHOTON_COORD_MAX_MISSING_HEARTBEATS")
+    if hb is not None and hb != "":
+        hb = int(hb)  # strict parse OUTSIDE the init-error rewrap: a
+        # typo'd knob must name itself, not masquerade as a cluster
+        # configuration problem
+    else:
+        hb = None
     try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
+        if hb is not None:
+            # the public initialize() wrapper does not forward the
+            # heartbeat options — go through the same State the wrapper
+            # drives, with the same must-precede-backends check
+            from jax._src import distributed as _jax_distributed
+            from jax._src import xla_bridge as _xla_bridge
+
+            if _xla_bridge.backends_are_initialized():
+                raise RuntimeError(
+                    "initialize_multihost must be called before any JAX "
+                    "computations are executed"
+                )
+            _jax_distributed.global_state.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                service_max_missing_heartbeats=int(hb),
+                client_max_missing_heartbeats=int(hb),
+            )
+        else:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
     except (ValueError, RuntimeError) as e:
         raise RuntimeError(
             "multihost initialization failed — on non-auto-detected "
@@ -371,16 +405,26 @@ def _decode_host_payload(raw: bytes):
 
 def _send_frame_parts(sock, parts: list, total: int, crc: bool,
                       peer: int | None = None, tag: str | None = None,
-                      heartbeat: float | None = None) -> None:
+                      heartbeat: float | None = None,
+                      corrupt_wire: bool = False) -> None:
     """``_send_frame`` for a multi-buffer payload: one length prefix
     covering the whole frame, each part streamed without concatenation
     (the array fast path's zero-copy send), and — frame protocol v1 —
     one CRC32 trailer computed incrementally over the parts (identical
-    to the single-buffer trailer over their concatenation)."""
+    to the single-buffer trailer over their concatenation).
+
+    ``corrupt_wire`` (fault injection only) flips a byte of the FIRST
+    part on the wire AFTER the trailer is computed — the same
+    post-CRC discipline as ``_send_frame``'s."""
     import struct
 
+    wire = parts
+    if corrupt_wire and parts:
+        from photon_ml_tpu.parallel import faults
+
+        wire = [faults._corrupt(bytes(parts[0])), *parts[1:]]
     _sendall_hb(sock, struct.pack("!q", total), peer, tag, heartbeat)
-    for p in parts:
+    for p in wire:
         _sendall_hb(sock, p, peer, tag, heartbeat)
     if crc:
         import zlib
@@ -412,9 +456,13 @@ def _ring_allgather(
     import struct
     import threading
 
+    from photon_ml_tpu.parallel import faults
+
     protos = links.get("proto", {})
     parts, total = _encode_host_payload(obj)
     P_ = len(ordered_pids)
+    own_pid = ordered_pids[rank]
+    plan = faults.active_plan()
     out: dict[int, object] = {rank: obj}
     err: list[BaseException] = []
 
@@ -422,13 +470,28 @@ def _ring_allgather(
         try:
             for r in range(1, P_):
                 peer_pid = ordered_pids[(rank + r) % P_]
-                _next_link_seq("send", peer_pid)
+                seq = _next_link_seq("send", peer_pid)
+                wire_parts, corrupt_wire = parts, False
+                if plan is not None:
+                    # the ring collectives are framed users like the
+                    # row exchange — the deterministic fault plan can
+                    # name their frame sets too (the in-memory combine
+                    # is exactly where the descent-degrade drill kills)
+                    spec = plan.pop_send_fault(own_pid, peer_pid, seq, tag)
+                    if spec is not None:
+                        wire_parts, corrupt_wire = faults.apply_send_fault(
+                            spec, parts, links["send"][peer_pid]
+                        )
+                if wire_parts is None:
+                    continue  # the frame set was dropped
                 _send_frame_parts(
-                    links["send"][peer_pid], parts, total,
+                    links["send"][peer_pid], wire_parts, total,
                     protos.get(peer_pid, 0) >= _FRAME_PROTO_CRC,
                     peer_pid, tag, heartbeat,
+                    corrupt_wire=corrupt_wire,
                 )
         except BaseException as e:
+            e.peer = getattr(e, "peer", peer_pid)
             err.append(e)
 
     t = threading.Thread(target=send_all)
@@ -439,13 +502,19 @@ def _ring_allgather(
         src_pid = ordered_pids[src_rank]
         sock = links["recv"][src_pid]
         _next_link_seq("recv", src_pid)
-        n = struct.unpack(
-            "!q", _recv_exact(sock, 8, src_pid, tag, heartbeat)
-        )[0]
-        raw = _recv_frame_payload(
-            sock, n, protos.get(src_pid, 0) >= _FRAME_PROTO_CRC,
-            src_pid, tag, heartbeat,
-        )
+        try:
+            n = struct.unpack(
+                "!q", _recv_exact(sock, 8, src_pid, tag, heartbeat)
+            )[0]
+            raw = _recv_frame_payload(
+                sock, n, protos.get(src_pid, 0) >= _FRAME_PROTO_CRC,
+                src_pid, tag, heartbeat,
+            )
+        except BaseException as e:
+            # name the silent link: the suspected-loss hardening (and
+            # the roll call it triggers) wants a peer to start from
+            e.peer = getattr(e, "peer", src_pid)
+            raise
         bytes_recv += n
         out[src_rank] = _decode_host_payload(raw)
     t.join()
@@ -472,12 +541,17 @@ def _p2p_allgather_obj(obj, tag: str = "host_collective",
     WORKER itself, which is the queue — draining there would wait on
     its own future).
 
-    A transient link fault here in a DEGRADED group hardens straight
-    into ``PeerLost`` (peer ``-1`` when the failing link is unknown):
-    these collectives have no completion ACK, so a mid-collective
-    retry could desync peers — but the failure is symmetric (the
-    teardown kills every peer's links), so the right recovery is
-    another roll call from the fit-level handler, not an abort."""
+    A transient link fault here hardens straight into ``PeerLost``
+    (peer ``-1`` when the failing link is unknown) — in a DEGRADED
+    group always, and on a healthy mesh whenever the reliable mode is
+    armed (``PHOTON_P2P_RETRIES`` > 0): these collectives have no
+    completion ACK, so a mid-collective retry could desync peers — but
+    the failure is symmetric (the teardown kills every peer's links,
+    so every peer's collective fails too), and the right recovery is a
+    roll call from the caller's handler (the streamed fit, the
+    in-place-degrading descent), not an abort. With retries unset the
+    healthy-mesh error propagates raw — the pre-elastic behavior
+    byte-for-byte."""
     P_ = effective_process_count()
     pid = effective_process_index()
     if P_ <= 1:
@@ -495,11 +569,22 @@ def _p2p_allgather_obj(obj, tag: str = "host_collective",
         )
     except BaseException as e:
         _reset_host_links()
-        if _DEGRADED is not None and isinstance(e, OSError):
-            raise PeerLost(
-                getattr(e, "peer", -1),
-                f"degraded-group host collective {tag!r} failed: {e}",
-            ) from e
+        if isinstance(e, OSError):
+            if _DEGRADED is not None:
+                raise PeerLost(
+                    getattr(e, "peer", -1),
+                    f"degraded-group host collective {tag!r} failed: {e}",
+                ) from e
+            if _p2p_retries() > 0:
+                # suspected loss, not a verdict: the roll call in the
+                # caller's recovery path decides whether the peer is
+                # really gone (nobody lost -> the handler retries or
+                # aborts with the flapped-links message)
+                raise PeerLost(
+                    getattr(e, "peer", -1),
+                    f"host collective {tag!r} failed on the full "
+                    f"mesh: {e}",
+                ) from e
         raise
 
 
@@ -530,7 +615,29 @@ def allgather_host(array: np.ndarray) -> np.ndarray:
     return np.stack(_p2p_allgather_obj(array, tag="allgather_host"))
 
 
-def roll_call(window_s: float | None = None) -> list[int]:
+def _fragment_may_proceed(survivors, group) -> bool:
+    """The roll call's split-brain quorum, as a pure predicate (the
+    drills in tests/test_faults.py enumerate partitions against it): a
+    fragment survives iff it holds a STRICT majority of the group's
+    MEMBERS, or exactly half of them including the group's writer (its
+    lowest member). Membership counts the CURRENT group only — an
+    invited rejoiner in the agreed set is not yet a member, and letting
+    it pad a fragment's count would let two fragments (one holding the
+    rejoiner, one holding a member majority) both pass. At most one
+    fragment of any partition satisfies the predicate."""
+    group = sorted(group)
+    writer = group[0]
+    members = [s for s in survivors if s in group]
+    if 2 * len(members) > len(group):
+        return True
+    return 2 * len(members) == len(group) and writer in members
+
+
+def roll_call(
+    window_s: float | None = None,
+    candidates: Sequence[int] | None = None,
+    guard_group: Sequence[int] | None = None,
+) -> list[int]:
     """Survivor census after a suspected peer loss (the barrier-tagged
     roll call of the recovery tier). Every process that hit
     ``PeerLost`` on the same exchange calls this at the same program
@@ -543,47 +650,80 @@ def roll_call(window_s: float | None = None) -> list[int]:
     and agree on the INTERSECTION — a peer any survivor cannot reach
     is lost for everyone (a half-connected peer cannot participate in
     a full exchange mesh anyway). Returns the sorted surviving
-    ORIGINAL process indices (always including this process)."""
+    ORIGINAL process indices (always including this process).
+
+    ``candidates`` widens the census beyond the current group — the
+    elastic-rejoin roll call names the current survivors PLUS the
+    invited rejoiners, so one roll call can admit a returning process
+    and drop a freshly-dead one in the same round. ``guard_group`` is
+    the membership set the split-brain quorum is judged against (the
+    CURRENT group — a rejoiner is not a member until admitted); it
+    defaults to the current group."""
     if window_s is None:
         env = os.environ.get("PHOTON_ROLLCALL_WINDOW_S")
         window_s = float(env) if env else 10.0
     global _HOST_LINKS
     with _LINKS_BUILD_LOCK:
         _reset_host_links()
-        pid = jax.process_index()
-        if _DEGRADED is not None:
+        pid = _self_pid()
+        if guard_group is not None:
+            group = sorted(int(p) for p in guard_group)
+        elif _DEGRADED is not None:
             group = list(_DEGRADED["survivors"])
         else:
-            group = list(range(jax.process_count()))
-        candidates = list(group)
+            group = list(range(_world_size()))
+        candidates = (
+            list(group) if candidates is None
+            else sorted(int(p) for p in candidates)
+        )
         deadline = time.monotonic() + window_s
+        # survivors enter a roll call at times spread across their
+        # peers' retry budgets, and each unreachable-candidate removal
+        # needs one more agreement pass over the reduced set — so the
+        # loop keeps probing past the per-candidate patience window, up
+        # to a give-up that extends with every removal, before this
+        # process declares itself isolated
+        give_up = deadline + window_s
         probe_timeout = max(min(2.0, window_s / 4.0), 0.2)
-        links = None
+        survivors = None
         while len(candidates) > 1:
             try:
                 links = _build_host_links(candidates, probe_timeout)
-                break
             except PeerUnreachable as e:
                 if time.monotonic() >= deadline:
                     candidates.remove(e.peer)
+                    # the reduced set gets a fresh patience window: its
+                    # members may still be probing the removed peer in
+                    # their own (later-entered) roll calls
+                    deadline = time.monotonic() + window_s
+                    give_up = max(give_up, deadline + window_s)
                 else:
                     time.sleep(probe_timeout / 2.0)
+                continue
             except (OSError, RuntimeError):
                 # a build race (two peers mid-rebuild) — retry until
-                # the window closes, then give up on the stragglers
-                if time.monotonic() >= deadline:
+                # the give-up, then give up on the stragglers
+                if time.monotonic() >= give_up:
                     break
                 time.sleep(probe_timeout / 2.0)
-        if links is None or len(candidates) <= 1:
-            survivors = [pid]
-        else:
+                continue
             _HOST_LINKS = links
             # barrier-tagged agreement round: intersect everyone's view
-            rank = candidates.index(pid)
-            views = _ring_allgather(
-                links, candidates, rank, list(candidates),
-                "rollcall", None,
-            )
+            try:
+                rank = candidates.index(pid)
+                views = _ring_allgather(
+                    links, candidates, rank, list(candidates),
+                    "rollcall", None,
+                )
+            except OSError:
+                # the agreement raced a peer whose OWN build attempt
+                # failed after ours succeeded (it tears down the
+                # freshly-accepted sockets): rebuild and re-agree
+                _reset_host_links()
+                if time.monotonic() >= give_up:
+                    break
+                time.sleep(probe_timeout / 2.0)
+                continue
             agreed = set(candidates)
             for v in views:
                 agreed &= set(v)
@@ -604,16 +744,26 @@ def roll_call(window_s: float | None = None) -> list[int]:
                         candidates, _p2p_timeout_s()
                     )
             survivors = sorted(candidates)
+            break
+        if survivors is None:
+            _reset_host_links()
+            survivors = [pid]
         # split-brain guard: a roll call has no external arbiter, so a
         # network PARTITION (not a death) would let both halves "agree"
         # on themselves — and both halves' rank-0 would pass
         # is_output_process() and write checkpoints concurrently, the
-        # corruption the single-writer rule exists to prevent. Only the
-        # side holding the group's current writer (its lowest member),
-        # or a strict majority, may proceed; any other fragment aborts.
-        # At most one fragment can satisfy either condition.
-        writer = min(group)
-        if writer not in survivors and 2 * len(survivors) <= len(group):
+        # corruption the single-writer rule exists to prevent. A
+        # fragment may proceed iff it holds a STRICT majority of the
+        # group, or exactly half of it INCLUDING the group's current
+        # writer (its lowest member). At most one fragment can satisfy
+        # either condition: a strict majority is unique, the writer
+        # lives in one fragment, and a strict majority plus an exact
+        # half cannot coexist. (The earlier rule let ANY fragment
+        # holding the writer proceed — a 1-of-4 writer fragment and the
+        # 3-of-4 majority fragment would then BOTH survive a partition,
+        # exactly the double-writer scenario the guard exists for; the
+        # split-brain drill in tests/test_faults.py pins the fix.)
+        if not _fragment_may_proceed(survivors, group):
             _reset_host_links()
             _emit_event(
                 "roll_call_abort", survivors=survivors,
@@ -621,8 +771,9 @@ def roll_call(window_s: float | None = None) -> list[int]:
             )
             raise RuntimeError(
                 f"roll call reached only {survivors} of {sorted(group)}: "
-                f"a minority fragment without the writer (process "
-                f"{writer}) must abort rather than risk a split-brain "
+                f"a fragment without a strict member majority (or exactly "
+                f"half the group including the writer, process "
+                f"{min(group)}) must abort rather than risk a split-brain "
                 "second writer — restart this process and rejoin"
             )
         _emit_event(
@@ -1060,7 +1211,7 @@ def _retry_backoff_sleep(attempt: int) -> float:
         return 0.0
     # deterministic jitter in [0, 0.5): hash of (pid, attempt) — every
     # process backs off a slightly different amount without any RNG
-    pid = jax.process_index()
+    pid = _self_pid()
     jitter = ((pid * 2654435761 + attempt * 40503) % 512) / 1024.0
     return base * (2.0 ** attempt) * (1.0 + jitter)
 
@@ -1132,6 +1283,48 @@ class PeerLost(ConnectionError):
 
 _DEGRADED: dict | None = None
 
+# rejoin identity: a process RE-EXEC'D after a loss (the elastic-rejoin
+# half, knob PHOTON_REJOIN) cannot re-enter the original
+# ``jax.distributed`` cohort — its fresh runtime reports
+# ``process_index() == 0`` / ``process_count() == 1``. ``bootstrap_
+# rejoin`` records the process's ORIGINAL identity (pid + world size,
+# from the persisted mesh-address cache) here, and every identity read
+# in this module goes through ``_self_pid``/``_world_size`` so the
+# rejoined process keeps speaking the framed-P2P protocol under its
+# original name. None on every normally-initialized process — the
+# helpers then read the jax runtime exactly as before.
+_REJOIN_IDENTITY: dict | None = None
+
+
+def rejoin_identity() -> dict | None:
+    return _REJOIN_IDENTITY
+
+
+def _self_pid() -> int:
+    """This process's ORIGINAL process index (survives a rejoin
+    re-exec, where ``jax.process_index()`` resets to 0)."""
+    if _REJOIN_IDENTITY is not None:
+        return int(_REJOIN_IDENTITY["pid"])
+    return jax.process_index()
+
+
+def _world_size() -> int:
+    """The ORIGINAL fleet size (survives a rejoin re-exec, where
+    ``jax.process_count()`` resets to 1)."""
+    if _REJOIN_IDENTITY is not None:
+        return int(_REJOIN_IDENTITY["world"])
+    return jax.process_count()
+
+
+def original_process_index() -> int:
+    """Public twin of ``_self_pid`` for consumers outside this module
+    (the telemetry sink's shard index, the rejoin drills)."""
+    return _self_pid()
+
+
+def original_process_count() -> int:
+    return _world_size()
+
 
 def degraded_group() -> dict | None:
     return _DEGRADED
@@ -1140,12 +1333,19 @@ def degraded_group() -> dict | None:
 def effective_process_count() -> int:
     if _DEGRADED is not None:
         return len(_DEGRADED["survivors"])
+    if _REJOIN_IDENTITY is not None:
+        # a rejoiner BEFORE admission: group-shaped code must not
+        # mistake it for a healthy single-process world (it must not
+        # run collectives at all until the rejoin roll call seats it)
+        return _world_size()
     return jax.process_count()
 
 
 def effective_process_index() -> int:
     if _DEGRADED is not None:
         return _DEGRADED["rank"]
+    if _REJOIN_IDENTITY is not None:
+        return _self_pid()
     return jax.process_index()
 
 
@@ -1153,17 +1353,24 @@ def set_degraded_group(survivors) -> None:
     """Shrink this process's world to ``survivors`` (sorted original
     process indices; must include this process). Tears the socket mesh
     down — the next exchange rebuilds it over the survivor set from the
-    cached addresses."""
+    cached addresses. An EXPANDED group (elastic rejoin) goes through
+    here too: even at full original size the group keeps routing over
+    the framed-P2P mesh, because a rejoined process's fresh jax runtime
+    is not part of the original collective cohort."""
     global _DEGRADED
     survivors = tuple(sorted(int(s) for s in survivors))
-    pid = jax.process_index()
+    pid = _self_pid()
     if pid not in survivors:
         raise ValueError(
             f"process {pid} cannot join a degraded group {survivors} "
             "that excludes it"
         )
     _reset_host_links()
-    if len(survivors) == jax.process_count() and _DEGRADED is None:
+    if (
+        len(survivors) == _world_size()
+        and _DEGRADED is None
+        and _REJOIN_IDENTITY is None
+    ):
         return  # full group = not degraded
     _DEGRADED = {
         "survivors": survivors,
@@ -1450,7 +1657,7 @@ def _build_host_links(peers: list[int], timeout_s, srv=None) -> dict:
     import threading
 
     global _HOST_ADDRS
-    pid = jax.process_index()
+    pid = _self_pid()
     others = [p for p in peers if p != pid]
     first_build = _HOST_ADDRS is None
     if srv is None:
@@ -1474,6 +1681,7 @@ def _build_host_links(peers: list[int], timeout_s, srv=None) -> dict:
             _HOST_ADDRS = None
             srv.close()
             raise
+        _maybe_persist_mesh_addrs()
 
     recv_socks: dict[int, socket.socket] = {}
     recv_protos: dict[int, int] = {}
@@ -1541,7 +1749,13 @@ def _build_host_links(peers: list[int], timeout_s, srv=None) -> dict:
                 f"{len(recv_socks)} of {len(others)} peers"
                 + (f" (missing {missing})" if missing else "")
             )
-            if len(missing) == 1:
+            if missing:
+                # name the lowest missing peer even when several are
+                # missing: the retry/roll-call tier only needs ONE
+                # suspect to treat the failure as transient-then-
+                # PeerLost — a raw RuntimeError here would propagate
+                # past the retry loop and crash a survivor that merely
+                # raced its peers' own rebuild attempts
                 err = PeerUnreachable(missing[0], str(err))
             raise err
     except BaseException:
@@ -1580,7 +1794,7 @@ def _host_links() -> dict:
         if _DEGRADED is not None:
             peers = list(_DEGRADED["survivors"])
         else:
-            peers = list(range(jax.process_count()))
+            peers = list(range(_world_size()))
         _HOST_LINKS = _build_host_links(peers, _p2p_timeout_s())
         return _HOST_LINKS
 
@@ -1991,6 +2205,32 @@ def reset_async_exchanges() -> None:
         _PENDING_EXCHANGES.clear()
 
 
+def confirm_peer_loss(err) -> tuple[list[int], list[int], list[int]]:
+    """The loss-confirmation preamble every ``PeerLost`` recovery tier
+    shares (the streamed fit's checkpoint re-entry, the in-memory
+    descent's in-place degrade): count + emit the suspected loss, drop
+    the failed attempt's abandoned async exchanges, roll-call the
+    CURRENT group and return ``(group, survivors, lost)`` — an empty
+    ``lost`` means every peer answered (a link flap, not a death) and
+    the caller should retry rather than degrade. One shared helper so
+    the tiers cannot drift on what "confirming a loss" means."""
+    from photon_ml_tpu.obs.metrics import REGISTRY
+
+    REGISTRY.counter_inc("fleet.peer_lost")
+    _emit_event(
+        "peer_lost", peer=int(getattr(err, "peer", -1)), error=str(err)
+    )
+    reset_async_exchanges()
+    dg = degraded_group()
+    group = (
+        list(dg["survivors"]) if dg is not None
+        else list(range(original_process_count()))
+    )
+    survivors = roll_call()
+    lost = sorted(set(group) - set(survivors))
+    return group, survivors, lost
+
+
 def exchange_rows_async(
     arrays, dest: np.ndarray, tag: str = ""
 ) -> ExchangeHandle:
@@ -2111,6 +2351,278 @@ def allgather_obj_p2p_async(
     with lock:
         _PENDING_EXCHANGES.append((fut, tag))
     return ObjCollectiveHandle(future=fut, tag=tag)
+
+
+# -- elastic rejoin (knob PHOTON_REJOIN) -------------------------------------
+#
+# The degrade half shrinks the world in place; this half grows it back.
+# A process lost to the fleet re-execs (the ``rejoin`` fault spec, or an
+# operator restart), reloads its ORIGINAL identity and the cached mesh
+# addresses from the persisted mesh cache (knob ``PHOTON_MESH_CACHE``),
+# binds its recorded port and WAITS to be invited. The surviving group,
+# at a visit boundary, probes the lost peers' cached addresses; a
+# listening rejoiner gets an INVITE naming the candidate set, then both
+# sides run one barrier-tagged rejoin roll call (``roll_call`` with
+# ``candidates`` = survivors + rejoiners, quorum guarded by the CURRENT
+# group) and the agreed, expanded group continues over the framed-P2P
+# mesh — the jax collective cohort is never re-entered (a fresh runtime
+# cannot rejoin it), which is exactly why every group-shaped helper in
+# this module routes host-side once degraded.
+
+
+def rejoin_enabled() -> bool:
+    """``PHOTON_REJOIN`` (strict int parse; default 0 = lost peers stay
+    lost, today's behavior byte-for-byte)."""
+    env = os.environ.get("PHOTON_REJOIN")
+    if env is not None and env != "":
+        return int(env) != 0
+    return False
+
+
+def rejoin_window_s() -> float:
+    """``PHOTON_REJOIN_WINDOW_S`` (seconds, strict float parse; default
+    10): how long the fleet lingers for returning peers at the FIRST
+    visit boundary after a degrade (and how long a booting rejoiner
+    waits for its invite). Later boundaries use instant probes, so a
+    peer that never returns costs one connect-refused per boundary."""
+    env = os.environ.get("PHOTON_REJOIN_WINDOW_S")
+    if env is not None and env != "":
+        return max(float(env), 0.0)
+    return 10.0
+
+
+def _mesh_cache_path() -> str | None:
+    """``PHOTON_MESH_CACHE``: file path the first mesh build persists
+    its ``{pid: (ip, port)}`` table to (atomically), and a rejoin boot
+    reloads it from. Unset (default) = nothing is written — the
+    pre-rejoin behavior byte-for-byte."""
+    return os.environ.get("PHOTON_MESH_CACHE") or None
+
+
+def _maybe_persist_mesh_addrs() -> None:
+    """Persist the freshly-bootstrapped address table for future
+    rejoiners. Every process writes (atomic replace — on a shared
+    filesystem the copies are identical; on split filesystems each
+    host keeps its own). Never fatal: the cache is an enabler for
+    rejoin, not a correctness dependency of the healthy path."""
+    path = _mesh_cache_path()
+    if path is None or _HOST_ADDRS is None:
+        return
+    try:
+        import json
+
+        from photon_ml_tpu.utils.atomic_io import atomic_replace_bytes
+
+        doc = {
+            "world": _world_size(),
+            "addrs": {
+                str(p): [ip, int(port)]
+                for p, (ip, port) in sorted(_HOST_ADDRS.items())
+            },
+        }
+        atomic_replace_bytes(
+            os.path.dirname(path) or ".", path, json.dumps(doc).encode()
+        )
+    except Exception:
+        _emit_event("mesh_cache_write_failed", path=path)
+
+
+def bootstrap_rejoin(pid: int | None = None, path: str | None = None) -> dict:
+    """Adopt a lost process's ORIGINAL identity in a fresh interpreter:
+    load the persisted mesh-address cache, record ``(pid, world)`` as
+    this process's identity (``jax.process_index/count`` are 0/1 here —
+    the fresh runtime never joined the original cohort), and leave the
+    process ready for ``rejoin_wait``. ``pid`` defaults to the
+    ``PHOTON_REJOIN_BOOT`` env var the ``rejoin`` fault spec plants in
+    the re-exec'd child."""
+    global _HOST_ADDRS, _REJOIN_IDENTITY
+    import json
+
+    if pid is None:
+        env = os.environ.get("PHOTON_REJOIN_BOOT")
+        if not env:
+            raise RuntimeError(
+                "bootstrap_rejoin needs the original process index: pass "
+                "pid= or set PHOTON_REJOIN_BOOT"
+            )
+        pid = int(env)
+    path = path or _mesh_cache_path()
+    if path is None:
+        raise RuntimeError(
+            "bootstrap_rejoin needs the persisted mesh cache: set "
+            "PHOTON_MESH_CACHE (the same path the original fleet ran "
+            "with) or pass path="
+        )
+    with open(path) as f:
+        doc = json.load(f)
+    addrs = {
+        int(p): (str(ip), int(port))
+        for p, (ip, port) in doc["addrs"].items()
+    }
+    if pid not in addrs:
+        raise RuntimeError(
+            f"mesh cache {path!r} has no address for process {pid} "
+            f"(recorded: {sorted(addrs)})"
+        )
+    _reset_host_links()
+    _HOST_ADDRS = addrs
+    _REJOIN_IDENTITY = {"pid": int(pid), "world": int(doc["world"])}
+    _emit_event("rejoin_boot", pid=int(pid), world=int(doc["world"]))
+    return dict(_REJOIN_IDENTITY)
+
+
+# hello-int version values reserved for the rejoin rendezvous (the mesh
+# frame protocol uses 0/1, so these can never be mistaken for a build
+# hello's version — and a rejoiner can tell a roll-call dial from an
+# invite and stay out of a build it was not named in)
+_HELLO_PROBE = 0x7D
+_HELLO_INVITE = 0x7E
+
+
+def probe_rejoiners(
+    lost: Sequence[int], window_s: float = 0.0, poll_s: float = 0.25
+) -> list[int]:
+    """Which of the ``lost`` original pids are back and listening on
+    their recorded mesh address (rank-0 survivor side; the result must
+    be broadcast over the group before acting on it — probing is
+    per-process I/O, not a collective). A probe is one cheap connect +
+    2-word handshake; refused/timed out = not back yet. ``window_s``
+    lingers, re-polling every ``poll_s``, until at least one rejoiner
+    answers or the window closes."""
+    import socket
+    import struct
+
+    if _HOST_ADDRS is None:
+        return []
+    deadline = time.monotonic() + max(window_s, 0.0)
+    present: list[int] = []
+    while True:
+        for p in lost:
+            if p in present or p not in _HOST_ADDRS:
+                continue
+            try:
+                with socket.create_connection(
+                    _HOST_ADDRS[p], timeout=0.5
+                ) as s:
+                    s.settimeout(2.0)
+                    s.sendall(struct.pack(
+                        "!i", _self_pid() | (_HELLO_PROBE << 16)
+                    ))
+                    if _recv_exact(s, 1) == _ACK_BYTE:
+                        present.append(p)
+            except OSError:
+                continue
+        if present or time.monotonic() >= deadline:
+            return sorted(present)
+        time.sleep(poll_s)
+
+
+def send_rejoin_invites(
+    present: Sequence[int], candidates: Sequence[int],
+    survivors: Sequence[int],
+) -> list[int]:
+    """Deliver the rejoin invitation (candidate set + current survivor
+    set — everything a rejoiner needs to enter the SAME roll call the
+    survivors are about to run) to each probed-present rejoiner.
+    Returns the pids that ACKed; a rejoiner that died between probe and
+    invite simply drops out of the roll call like any unreachable
+    candidate."""
+    import pickle
+    import socket
+    import struct
+
+    invited: list[int] = []
+    payload = pickle.dumps(
+        {
+            "candidates": [int(c) for c in sorted(candidates)],
+            "survivors": [int(s) for s in sorted(survivors)],
+        },
+        protocol=4,
+    )
+    for p in present:
+        try:
+            with socket.create_connection(
+                _HOST_ADDRS[p], timeout=2.0
+            ) as s:
+                s.settimeout(5.0)
+                s.sendall(struct.pack(
+                    "!i", _self_pid() | (_HELLO_INVITE << 16)
+                ))
+                s.sendall(struct.pack("!q", len(payload)))
+                s.sendall(payload)
+                if _recv_exact(s, 1) == _ACK_BYTE:
+                    invited.append(int(p))
+        except OSError:
+            continue
+    return invited
+
+
+def rejoin_wait(window_s: float | None = None) -> dict | None:
+    """Rejoiner side of the rendezvous: bind this process's RECORDED
+    mesh port, answer probes, and wait up to ``window_s`` for an
+    invite. Returns the invite payload (``candidates`` + ``survivors``)
+    or None when the window closes uninvited.
+
+    A dial that is NOT a probe/invite — a degrade roll call racing this
+    boot reaches the same recorded port — is closed unanswered: the
+    rejoiner must not wedge a mesh build it was not named in (the
+    build's accept count then falls short and the roll call drops this
+    pid for that round; a later boundary re-invites it). The listener
+    is closed before returning, so the rejoin roll call can re-bind the
+    port."""
+    import pickle
+    import socket
+    import struct
+
+    if _REJOIN_IDENTITY is None or _HOST_ADDRS is None:
+        raise RuntimeError(
+            "rejoin_wait outside a rejoin boot: call bootstrap_rejoin "
+            "first"
+        )
+    if window_s is None:
+        window_s = rejoin_window_s()
+    own_port = _HOST_ADDRS[_self_pid()][1]
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+        srv.bind(("0.0.0.0", own_port))
+        srv.listen(8)
+        deadline = time.monotonic() + max(window_s, 0.0)
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            srv.settimeout(min(remaining, 1.0))
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            try:
+                conn.settimeout(5.0)
+                raw = struct.unpack("!i", _recv_exact(conn, 4))[0]
+                src, kind = _decode_hello(raw)
+                if kind == _HELLO_PROBE:
+                    conn.sendall(_ACK_BYTE)
+                    continue
+                if kind != _HELLO_INVITE:
+                    # a mesh/roll-call build dialing our recorded port:
+                    # close unanswered (see docstring)
+                    continue
+                n = struct.unpack("!q", _recv_exact(conn, 8))[0]
+                payload = pickle.loads(_recv_exact(conn, n))
+                conn.sendall(_ACK_BYTE)
+                _emit_event(
+                    "rejoin_invited", inviter=int(src),
+                    candidates=payload.get("candidates"),
+                    survivors=payload.get("survivors"),
+                )
+                return payload
+            except OSError:
+                continue
+            finally:
+                _close_quietly(conn)
+    finally:
+        _close_quietly(srv)
 
 
 def allreduce_max_host(*arrays: np.ndarray):

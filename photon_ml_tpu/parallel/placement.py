@@ -275,7 +275,15 @@ def replan_excluding(
     identical inputs (the allreduced row counts every process already
     holds), so all survivors compute the IDENTICAL new plan with zero
     extra communication — the property that lets recovery re-shard
-    without a coordinator."""
+    without a coordinator.
+
+    With an EMPTY lost set the survivor set may EXPAND past the old
+    plan's shard range — the elastic-rejoin signature: re-plan over the
+    current shards plus a returned one the degraded plan never had. A
+    joining shard has no old items, so every item it receives counts as
+    migrated — exactly the entities the re-planner moves back. With a
+    non-empty lost set (a genuine degrade) survivors outside the old
+    range remain a desynced plan and fail loudly, naming the value."""
     survivors = sorted(int(s) for s in survivors)
     lost = {int(s) for s in lost_shards}
     if set(survivors) & lost:
@@ -284,20 +292,28 @@ def replan_excluding(
         )
     if not survivors:
         raise ValueError("no surviving shards to re-plan onto")
+    bound = None if not lost else int(plan.num_shards)
     out_of_range = [
-        s for s in survivors if not (0 <= s < int(plan.num_shards))
+        s for s in survivors
+        if s < 0 or (bound is not None and s >= bound)
     ]
     if out_of_range:
         raise ValueError(
             f"replan_excluding: survivor {out_of_range[0]} outside the "
             f"old plan's shard range [0, {int(plan.num_shards)}) — the "
-            "survivor list and the plan disagree about the topology"
+            "survivor list and the plan disagree about the topology "
+            "(expansion past the range is legal only with an empty "
+            "lost set, the rejoin signature)"
         )
     new_plan = plan_shard_placement(
         row_counts, len(survivors), groups=groups, skew_aware=skew_aware
     )
-    # old owner (original shard id) -> survivor rank, lost -> -1
-    rank_of = np.full(int(plan.num_shards), -1, np.int64)
+    # old owner (original shard id) -> survivor rank, lost -> -1; sized
+    # for an EXPANDED survivor set too (rejoin: survivors the old plan
+    # never had simply map no old items)
+    rank_of = np.full(
+        max(int(plan.num_shards), survivors[-1] + 1), -1, np.int64
+    )
     for r, s in enumerate(survivors):
         rank_of[s] = r
     old_ranks = rank_of[plan.owner]

@@ -682,3 +682,875 @@ class TestCheckpointFingerprintCollection:
         assert ck is not None and ck.next_iteration == 2
         # plain string still works (the pre-existing contract)
         assert load_checkpoint(str(tmp_path), fingerprint="pre-loss") is not None
+
+
+# -- ISSUE 14: in-place degrade + elastic rejoin (host-side units) -----------
+
+
+class TestRejoinSpecGrammar:
+    def test_parse_valid_rejoin_spec(self):
+        plan = faults.parse_plan(
+            '[{"op": "rejoin", "link": [3, 0], "seq": 5, '
+            '"tag": "offsets", "delay_s": 2.0}]'
+        )
+        assert plan.specs[0].op == "rejoin"
+        assert plan.specs[0].delay_s == 2.0
+
+    def test_rejoin_requires_delay(self):
+        with pytest.raises(ValueError, match="rejoin requires delay_s"):
+            faults.parse_plan('[{"op": "rejoin", "link": [1, 0], "seq": 1}]')
+
+    def test_spawn_requires_cmd_env(self, monkeypatch):
+        monkeypatch.delenv("PHOTON_REJOIN_CMD", raising=False)
+        spec = faults.FaultSpec(op="rejoin", src=1, dst=0, seq=1, delay_s=0.1)
+        with pytest.raises(RuntimeError, match="PHOTON_REJOIN_CMD"):
+            faults._spawn_rejoin_child(spec)
+
+    def test_spawn_rejects_non_list_cmd(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_REJOIN_CMD", '"not-a-list"')
+        spec = faults.FaultSpec(op="rejoin", src=1, dst=0, seq=1, delay_s=0.1)
+        with pytest.raises(RuntimeError, match="JSON list"):
+            faults._spawn_rejoin_child(spec)
+
+    def test_spawn_child_env_and_argv(self, monkeypatch):
+        import json as _json
+        import subprocess
+
+        captured = {}
+
+        def fake_popen(argv, env=None, start_new_session=None, **kw):
+            captured.update(
+                argv=argv, env=env, start_new_session=start_new_session
+            )
+            class _P:  # noqa: N801
+                pass
+            return _P()
+
+        monkeypatch.setenv(
+            "PHOTON_REJOIN_CMD", _json.dumps(["python", "-c", "w", "arg"])
+        )
+        monkeypatch.setenv("PHOTON_FAULT_PLAN", "[]")
+        monkeypatch.setattr(subprocess, "Popen", fake_popen)
+        spec = faults.FaultSpec(op="rejoin", src=3, dst=0, seq=1, delay_s=1.5)
+        faults._spawn_rejoin_child(spec)
+        # the relaunch sleeps then execs the command verbatim
+        assert captured["argv"][:2] == ["/bin/sh", "-c"]
+        assert "sleep 1.5" in captured["argv"][2]
+        assert captured["argv"][3:] == ["python", "-c", "w", "arg"]
+        # the child adopts the dying process's identity and must NOT
+        # re-run the plan that killed it
+        assert captured["env"]["PHOTON_REJOIN_BOOT"] == "3"
+        assert "PHOTON_FAULT_PLAN" not in captured["env"]
+        assert captured["start_new_session"] is True
+
+
+class TestSplitBrainQuorum:
+    """The roll-call split-brain predicate, enumerated. The satellite's
+    named case — the exact-half fragment WITHOUT the writer — must
+    abort; probing partitions also found the writer-minority bug (a
+    1-of-4 writer fragment AND the 3-of-4 majority fragment both passed
+    the old rule), fixed by requiring majority-or-half-with-writer."""
+
+    def test_exact_half_without_writer_aborts(self):
+        assert not mh._fragment_may_proceed([2, 3], [0, 1, 2, 3])
+
+    def test_exact_half_with_writer_proceeds(self):
+        assert mh._fragment_may_proceed([0, 1], [0, 1, 2, 3])
+        # the 2-process kill drill's shape: one survivor holding the
+        # writer is exactly half of a 2-group
+        assert mh._fragment_may_proceed([0], [0, 1])
+        assert not mh._fragment_may_proceed([1], [0, 1])
+
+    def test_writer_minority_aborts(self):
+        # the found bug: the old rule passed ANY fragment with the writer
+        assert not mh._fragment_may_proceed([0], [0, 1, 2, 3])
+        assert mh._fragment_may_proceed([1, 2, 3], [0, 1, 2, 3])
+
+    def test_at_most_one_fragment_of_any_partition_proceeds(self):
+        import itertools
+
+        group = [0, 1, 2, 3]
+        for r in range(len(group) + 1):
+            for frag in itertools.combinations(group, r):
+                other = [p for p in group if p not in frag]
+                assert not (
+                    mh._fragment_may_proceed(list(frag), group)
+                    and mh._fragment_may_proceed(other, group)
+                ), (frag, other)
+
+    def test_rejoiner_does_not_pad_quorum(self):
+        # survivors include an admitted-candidate NON-member (pid 9):
+        # membership, not raw size, is what counts
+        assert not mh._fragment_may_proceed([2, 9], [0, 1, 2, 3])
+        assert mh._fragment_may_proceed([0, 1, 2, 9], [0, 1, 2])
+
+    def test_expanded_rejoin_set_proceeds(self):
+        assert mh._fragment_may_proceed([0, 1, 2, 3], [0, 1, 2])
+
+
+class TestRingAllgatherFaultInjection:
+    """The deterministic fault plan now reaches the ring collectives
+    (the in-memory combine's transport) — a corrupt spec must surface
+    as a DETECTED LinkCorruption on the CRC-negotiated link."""
+
+    def _pair_links(self, crc=True):
+        a01, b01 = socket.socketpair()
+        a10, b10 = socket.socketpair()
+        proto = {"proto": {0: 1, 1: 1}} if crc else {"proto": {}}
+        links0 = {"send": {1: a01}, "recv": {1: b10}, **proto}
+        links1 = {"send": {0: a10}, "recv": {0: b01}, **proto}
+        return links0, links1, (a01, b01, a10, b10)
+
+    def test_corrupt_spec_detected_by_crc(self, monkeypatch):
+        import threading
+
+        monkeypatch.setitem(mh._LINK_SEQ, "send", {})
+        monkeypatch.setitem(mh._LINK_SEQ, "recv", {})
+        monkeypatch.setenv(
+            "PHOTON_FAULT_PLAN",
+            '[{"op": "corrupt", "link": [0, 1], "seq": 1, "tag": "ring"}]',
+        )
+        faults.reset()
+        links0, links1, socks = self._pair_links(crc=True)
+        errs = {}
+
+        def run1():
+            try:
+                mh._ring_allgather(
+                    links1, [0, 1], 1,
+                    {"w": np.arange(4, dtype=np.float32)}, "ring", None,
+                )
+            except BaseException as e:
+                errs[1] = e
+
+        t = threading.Thread(target=run1)
+        t.start()
+        try:
+            mh._ring_allgather(
+                links0, [0, 1], 0,
+                {"w": np.ones(4, dtype=np.float32)}, "ring", None,
+            )
+        except BaseException as e:
+            errs[0] = e
+        t.join()
+        for s in socks:
+            s.close()
+        assert isinstance(errs.get(1), mh.LinkCorruption), errs
+        # the recv error names the silent/corrupt link's peer
+        assert getattr(errs[1], "peer", None) == 0
+        plan = faults.active_plan()
+        assert plan.remaining == 0  # the spec fired exactly once
+
+    def test_delay_spec_passes_payload_through(self, monkeypatch):
+        import threading
+
+        monkeypatch.setitem(mh._LINK_SEQ, "send", {})
+        monkeypatch.setitem(mh._LINK_SEQ, "recv", {})
+        monkeypatch.setenv(
+            "PHOTON_FAULT_PLAN",
+            '[{"op": "delay", "link": [0, 1], "seq": 1, "delay_s": 0.05}]',
+        )
+        faults.reset()
+        links0, links1, socks = self._pair_links(crc=False)
+        out = {}
+
+        def run1():
+            out[1] = mh._ring_allgather(
+                links1, [0, 1], 1, {"w": np.arange(2.0)}, "ring", None
+            )
+
+        t = threading.Thread(target=run1)
+        t.start()
+        out[0] = mh._ring_allgather(
+            links0, [0, 1], 0, {"w": np.ones(2)}, "ring", None
+        )
+        t.join()
+        for s in socks:
+            s.close()
+        np.testing.assert_array_equal(out[1][0]["w"], np.ones(2))
+        assert faults.active_plan().remaining == 0
+
+
+class TestHealthyMeshPeerLostHardening:
+    """With retries armed, a failed host collective on the FULL mesh
+    hardens into PeerLost (the descent-degrade / fit-recovery signal);
+    with retries unset it propagates raw — the pre-elastic behavior."""
+
+    def _degraded_none(self, monkeypatch):
+        monkeypatch.setattr(mh, "_DEGRADED", None)
+
+    def test_hardens_with_retries_armed(self, monkeypatch):
+        import jax
+
+        self._degraded_none(monkeypatch)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        monkeypatch.setenv("PHOTON_P2P_RETRIES", "2")
+
+        def boom():
+            e = ConnectionError("link down")
+            e.peer = 1
+            raise e
+
+        monkeypatch.setattr(mh, "_host_links", boom)
+        with pytest.raises(mh.PeerLost) as ei:
+            mh._p2p_allgather_obj("x", tag="combine")
+        assert ei.value.peer == 1
+
+    def test_raw_error_without_retries(self, monkeypatch):
+        import jax
+
+        self._degraded_none(monkeypatch)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        monkeypatch.delenv("PHOTON_P2P_RETRIES", raising=False)
+
+        def boom():
+            raise ConnectionError("link down")
+
+        monkeypatch.setattr(mh, "_host_links", boom)
+        with pytest.raises(ConnectionError):
+            mh._p2p_allgather_obj("x", tag="combine")
+
+
+class TestMeshCacheAndRejoinBootstrap:
+    @pytest.fixture(autouse=True)
+    def _restore_identity(self):
+        yield
+        mh._REJOIN_IDENTITY = None
+
+    def test_persist_and_bootstrap_roundtrip(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "mesh.json")
+        monkeypatch.setenv("PHOTON_MESH_CACHE", path)
+        monkeypatch.setattr(
+            mh, "_HOST_ADDRS",
+            {0: ("127.0.0.1", 4100), 1: ("127.0.0.1", 4101),
+             2: ("10.0.0.3", 4102)},
+        )
+        import jax
+
+        monkeypatch.setattr(jax, "process_count", lambda: 3)
+        mh._maybe_persist_mesh_addrs()
+        # a fresh interpreter (simulated: cleared globals) adopts its
+        # original identity from the cache
+        monkeypatch.setattr(mh, "_HOST_ADDRS", None)
+        ident = mh.bootstrap_rejoin(pid=2, path=path)
+        assert ident == {"pid": 2, "world": 3}
+        assert mh._HOST_ADDRS[2] == ("10.0.0.3", 4102)
+        assert mh.original_process_index() == 2
+        assert mh.original_process_count() == 3
+        # pre-admission a rejoiner reports its original identity, so it
+        # can never mistake itself for a healthy 1-process world (or
+        # the writer, unless it really was process 0)
+        assert mh.effective_process_index() == 2
+        assert mh.effective_process_count() == 3
+        assert not mh.is_output_process()
+
+    def test_bootstrap_rejects_unknown_pid(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "mesh.json")
+        monkeypatch.setenv("PHOTON_MESH_CACHE", path)
+        monkeypatch.setattr(mh, "_HOST_ADDRS", {0: ("127.0.0.1", 4100)})
+        import jax
+
+        monkeypatch.setattr(jax, "process_count", lambda: 1)
+        mh._maybe_persist_mesh_addrs()
+        with pytest.raises(RuntimeError, match="no address for process 7"):
+            mh.bootstrap_rejoin(pid=7, path=path)
+
+    def test_bootstrap_requires_cache_path(self, monkeypatch):
+        monkeypatch.delenv("PHOTON_MESH_CACHE", raising=False)
+        with pytest.raises(RuntimeError, match="PHOTON_MESH_CACHE"):
+            mh.bootstrap_rejoin(pid=1)
+
+    def test_sink_shard_index_follows_rejoin_identity(
+        self, tmp_path, monkeypatch
+    ):
+        from photon_ml_tpu.obs import sink as obs_sink
+
+        path = str(tmp_path / "mesh.json")
+        monkeypatch.setenv("PHOTON_MESH_CACHE", path)
+        monkeypatch.setattr(
+            mh, "_HOST_ADDRS",
+            {0: ("127.0.0.1", 4100), 1: ("127.0.0.1", 4101)},
+        )
+        import jax
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        mh._maybe_persist_mesh_addrs()
+        mh.bootstrap_rejoin(pid=1, path=path)
+        assert obs_sink._process_index() == 1
+        assert obs_sink._process_count() == 2
+
+
+class TestRejoinRendezvous:
+    """The probe → invite → wait handshake over real loopback sockets,
+    single process: the rejoiner answers probes, ignores a stray mesh
+    hello (the degrade-roll-call race), and returns the invite."""
+
+    @pytest.fixture(autouse=True)
+    def _restore_identity(self):
+        saved = mh._HOST_ADDRS
+        yield
+        mh._REJOIN_IDENTITY = None
+        mh._HOST_ADDRS = saved
+
+    def test_probe_invite_wait_roundtrip(self):
+        import threading
+
+        srv_probe = socket.socket()
+        srv_probe.bind(("127.0.0.1", 0))
+        port = srv_probe.getsockname()[1]
+        srv_probe.close()
+        mh._HOST_ADDRS = {
+            0: ("127.0.0.1", 1), 3: ("127.0.0.1", port),
+        }
+        mh._REJOIN_IDENTITY = {"pid": 3, "world": 4}
+        out = {}
+
+        def wait():
+            out["invite"] = mh.rejoin_wait(window_s=10.0)
+
+        t = threading.Thread(target=wait)
+        t.start()
+        try:
+            # a stray NON-invite dial first (a racing roll-call build):
+            # the waiter must ignore it and keep listening
+            deadline = __import__("time").monotonic() + 5
+            while True:
+                try:
+                    s = socket.create_connection(
+                        ("127.0.0.1", port), timeout=0.5
+                    )
+                    break
+                except OSError:
+                    if __import__("time").monotonic() > deadline:
+                        raise
+            s.sendall(struct.pack("!i", 0 | (1 << 16)))  # mesh hello v1
+            s.close()
+            # now the real probe + invite (the survivor side's calls)
+            mh._REJOIN_IDENTITY = None  # act as survivor pid 0 for send
+            import jax
+
+            present = []
+            deadline = __import__("time").monotonic() + 5
+            while not present:
+                present = mh.probe_rejoiners([3], window_s=0.0)
+                if __import__("time").monotonic() > deadline:
+                    break
+            assert present == [3]
+            invited = mh.send_rejoin_invites(
+                present, candidates=[0, 1, 3], survivors=[0, 1]
+            )
+            assert invited == [3]
+        finally:
+            t.join(timeout=10)
+        assert out["invite"] == {
+            "candidates": [0, 1, 3], "survivors": [0, 1]
+        }
+
+    def test_wait_times_out_uninvited(self):
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        port = srv.getsockname()[1]
+        srv.close()
+        mh._HOST_ADDRS = {2: ("127.0.0.1", port)}
+        mh._REJOIN_IDENTITY = {"pid": 2, "world": 3}
+        assert mh.rejoin_wait(window_s=0.2) is None
+
+    def test_probe_refused_is_absent(self):
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        port = srv.getsockname()[1]
+        srv.close()  # nothing listens
+        mh._HOST_ADDRS = {1: ("127.0.0.1", port)}
+        assert mh.probe_rejoiners([1], window_s=0.0) == []
+
+
+class TestExpandedReplan:
+    def test_empty_lost_set_may_expand(self):
+        from photon_ml_tpu.parallel.placement import (
+            plan_entity_placement,
+            replan_excluding,
+        )
+
+        counts = np.asarray([5.0, 4.0, 3.0, 2.0, 1.0, 1.0])
+        plan3 = plan_entity_placement(counts, 3)
+        new_plan, migrated = replan_excluding(
+            plan3, [], counts, survivors=range(4)
+        )
+        direct = plan_entity_placement(counts, 4)
+        np.testing.assert_array_equal(new_plan.owner, direct.owner)
+        # everything the joining shard received counts as migrated back
+        joined = new_plan.owner == 3
+        assert joined.any() and migrated[joined].all()
+
+    def test_non_empty_lost_still_validates_range(self):
+        from photon_ml_tpu.parallel.placement import (
+            plan_entity_placement,
+            replan_excluding,
+        )
+
+        plan = plan_entity_placement(np.ones(4), 2)
+        with pytest.raises(ValueError, match="survivor 5 outside"):
+            replan_excluding(plan, [0], np.ones(4), survivors=[1, 5])
+
+
+class TestDescentDegradeKnob:
+    def test_default_off_and_strict_parse(self, monkeypatch):
+        from photon_ml_tpu.game import descent
+
+        monkeypatch.delenv("PHOTON_DESCENT_DEGRADE", raising=False)
+        assert not descent.descent_degrade_enabled()
+        monkeypatch.setenv("PHOTON_DESCENT_DEGRADE", "1")
+        assert descent.descent_degrade_enabled()
+        monkeypatch.setenv("PHOTON_DESCENT_DEGRADE", "yes")
+        with pytest.raises(ValueError):
+            descent.descent_degrade_enabled()
+
+    def test_rejoin_knobs_strict_parse(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_REJOIN", "zz")
+        with pytest.raises(ValueError):
+            mh.rejoin_enabled()
+        monkeypatch.setenv("PHOTON_REJOIN", "1")
+        assert mh.rejoin_enabled()
+        monkeypatch.setenv("PHOTON_REJOIN_WINDOW_S", "2.5")
+        assert mh.rejoin_window_s() == 2.5
+
+
+class _FakeReCoord:
+    """Minimal coordinate for descent-level drills: deterministic solve
+    (coefficients = a pure function of the offsets), REAL
+    RandomEffectModel outputs so checkpointing works, and an optional
+    injected PeerLost at the n-th train call."""
+
+    coordinate_id = "c"
+
+    def __init__(self, n_rows, fail_at_call=None, fail_always=False):
+        import jax.numpy as jnp
+
+        self.n = n_rows
+        self.calls = 0
+        self.fail_at_call = fail_at_call
+        self.fail_always = fail_always
+        self._jnp = jnp
+
+    def train(self, offsets, initial=None):
+        from photon_ml_tpu.game.models import RandomEffectModel
+        from photon_ml_tpu.types import TaskType
+
+        self.calls += 1
+        if self.fail_always or (
+            self.fail_at_call is not None and self.calls == self.fail_at_call
+        ):
+            if not self.fail_always:
+                self.fail_at_call = None  # fire once
+            raise mh.PeerLost(1, "injected descent loss")
+        jnp = self._jnp
+        w = jnp.mean(offsets) * 0.5 + 1.0
+        sub = RandomEffectModel(
+            coefficients=jnp.full((2, 3), w),
+            variances=None,
+            random_effect_type="eid",
+            feature_shard_id="r",
+            task_type=TaskType.LOGISTIC_REGRESSION,
+        )
+        return sub, {"call": self.calls}
+
+    def score(self, sub):
+        jnp = self._jnp
+        return jnp.full((self.n,), jnp.mean(sub.coefficients))
+
+
+def _tiny_descent(coord):
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.data import GameBatch
+    from photon_ml_tpu.game.descent import CoordinateDescent
+    from photon_ml_tpu.types import TaskType
+
+    n = 4
+    batch = GameBatch(
+        labels=jnp.zeros(n), offsets=jnp.zeros(n),
+        weights=jnp.ones(n), features={}, id_tags={},
+    )
+    return CoordinateDescent(
+        coordinates={"c": coord}, batch=batch,
+        task_type=TaskType.LOGISTIC_REGRESSION,
+    )
+
+
+class TestDescentDegradeInPlace:
+    """The PHOTON_DESCENT_DEGRADE handler at the unit level: knob-off
+    keeps the abort message, knob-on rolls back to the start-of-
+    iteration snapshot, shrinks the group and finishes run() with a
+    result BITWISE equal to an uninterrupted run; an all-alive roll
+    call retries the iteration with a bounded budget."""
+
+    def _arm(self, monkeypatch, survivors, world=2):
+        calls = {"degraded": None}
+        monkeypatch.setattr(mh, "roll_call", lambda **kw: list(survivors))
+        monkeypatch.setattr(mh, "original_process_count", lambda: world)
+        monkeypatch.setattr(mh, "degraded_group", lambda: None)
+        monkeypatch.setattr(
+            mh, "set_degraded_group",
+            lambda s: calls.__setitem__("degraded", list(s)),
+        )
+        monkeypatch.setattr(mh, "reset_async_exchanges", lambda: None)
+        return calls
+
+    def test_knob_off_keeps_abort_message(self, monkeypatch):
+        monkeypatch.delenv("PHOTON_DESCENT_DEGRADE", raising=False)
+        cd = _tiny_descent(_FakeReCoord(4, fail_at_call=2))
+        with pytest.raises(RuntimeError, match="cannot degrade in place"):
+            cd.run(["c"], 3)
+
+    def test_degrades_in_place_and_matches_clean_run(self, monkeypatch):
+        import numpy as np
+
+        monkeypatch.setenv("PHOTON_DESCENT_DEGRADE", "1")
+        calls = self._arm(monkeypatch, survivors=[0], world=2)
+        clean = _tiny_descent(_FakeReCoord(4)).run(["c"], 3)
+        faulted_coord = _FakeReCoord(4, fail_at_call=2)
+        res = _tiny_descent(faulted_coord).run(["c"], 3)
+        # run() returned normally, the group shrank, and the
+        # interrupted iteration was rolled back + re-run: one extra
+        # train call, same results bitwise
+        assert calls["degraded"] == [0]
+        assert faulted_coord.calls == 4  # 3 iterations + 1 rolled back
+        np.testing.assert_array_equal(
+            np.asarray(res.model.models["c"].coefficients),
+            np.asarray(clean.model.models["c"].coefficients),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res.training_scores["c"]),
+            np.asarray(clean.training_scores["c"]),
+        )
+        # trackers rolled back: exactly one per completed iteration
+        assert [t["call"] for t in res.trackers["c"]] == [1, 3, 4]
+
+    def test_flap_retries_are_bounded(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_DESCENT_DEGRADE", "1")
+        # roll call finds everyone alive -> iteration retried, bounded
+        self._arm(monkeypatch, survivors=[0, 1], world=2)
+        cd = _tiny_descent(_FakeReCoord(4, fail_always=True))
+        with pytest.raises(RuntimeError, match="links flapped"):
+            cd.run(["c"], 2)
+
+    def test_mesh_blocker_falls_back_to_abort(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_DESCENT_DEGRADE", "1")
+        self._arm(monkeypatch, survivors=[0], world=2)
+        coord = _FakeReCoord(4, fail_at_call=1)
+        coord._degrade_blocker = lambda: "coordinate 'c' spans the mesh"
+        cd = _tiny_descent(coord)
+        with pytest.raises(RuntimeError, match="cannot degrade in place"):
+            cd.run(["c"], 2)
+
+    def test_mesh_blocker_still_retries_a_flap(self, monkeypatch):
+        # review-found: the degradability gate must run only after the
+        # roll call CONFIRMS a loss — a link flap needs no degradation,
+        # so a mesh-spanning coordinate must not turn it into the abort
+        monkeypatch.setenv("PHOTON_DESCENT_DEGRADE", "1")
+        self._arm(monkeypatch, survivors=[0, 1], world=2)  # all alive
+        coord = _FakeReCoord(4, fail_at_call=1)
+        coord._degrade_blocker = lambda: "coordinate 'c' spans the mesh"
+        cd = _tiny_descent(coord)
+        res = cd.run(["c"], 2)  # the flap is absorbed, run completes
+        assert coord.calls == 3  # failed call + retried it-0 + it-1
+        assert len(res.trackers["c"]) == 2
+
+    def test_validation_mesh_blocks_degrade(self, monkeypatch):
+        # review-found: per-visit validation scores/evaluates over the
+        # DESCENT-level device mesh — the dead process's devices cannot
+        # leave it in-process any more than a coordinate's can, so a
+        # confirmed loss must abort even when every coordinate degrades
+        monkeypatch.setenv("PHOTON_DESCENT_DEGRADE", "1")
+        self._arm(monkeypatch, survivors=[0], world=2)
+        cd = _tiny_descent(_FakeReCoord(4, fail_at_call=1))
+        cd.mesh = object()
+        cd.validation_batch = cd.batch
+        cd.evaluators = ["AUC"]
+        with pytest.raises(RuntimeError, match="cannot degrade in place"):
+            cd.run(["c"], 2)
+
+
+class TestDescentResumeFingerprints:
+    """The descent checkpoint-resume satellite: ``run`` accepts a
+    fingerprint COLLECTION, so a pre-loss layout's checkpoint resumes
+    under a degraded layout's differing fingerprint."""
+
+    def _save_pre_loss(self, d, batch):
+        import numpy as np
+
+        from photon_ml_tpu.checkpoint import batch_digest, save_checkpoint
+        from photon_ml_tpu.game.models import GameModel
+        from photon_ml_tpu.types import TaskType
+
+        digest = batch_digest(batch.labels, batch.weights)
+        save_checkpoint(
+            str(d),
+            GameModel(models={}, task_type=TaskType.LOGISTIC_REGRESSION),
+            next_iteration=1,
+            fingerprint="pre-loss-layout",
+            scores={"c": np.zeros(4, np.float32)},
+            total=np.zeros(4, np.float32),
+            data_digest=digest,
+        )
+
+    def test_resume_collection_accepts_pre_loss_checkpoint(self, tmp_path):
+        coord = _FakeReCoord(4)
+        cd = _tiny_descent(coord)
+        self._save_pre_loss(tmp_path, cd.batch)
+        cd.run(
+            ["c"], 2, checkpoint_dir=str(tmp_path),
+            checkpoint_fingerprint="degraded-layout",
+            resume_fingerprints=["pre-loss-layout"],
+        )
+        assert coord.calls == 1  # resumed at iteration 1 of 2
+
+    def test_without_collection_restarts_from_scratch(self, tmp_path):
+        coord = _FakeReCoord(4)
+        cd = _tiny_descent(coord)
+        self._save_pre_loss(tmp_path, cd.batch)
+        cd.run(
+            ["c"], 2, checkpoint_dir=str(tmp_path),
+            checkpoint_fingerprint="degraded-layout",
+        )
+        assert coord.calls == 2  # fingerprint mismatch -> full retrain
+
+    def test_peek_fingerprint_reads_without_arrays(self, tmp_path):
+        from photon_ml_tpu.checkpoint import peek_fingerprint
+
+        coord = _FakeReCoord(4)
+        cd = _tiny_descent(coord)
+        assert peek_fingerprint(str(tmp_path)) is None
+        self._save_pre_loss(tmp_path, cd.batch)
+        assert peek_fingerprint(str(tmp_path)) == "pre-loss-layout"
+
+
+class TestEagerCheckpointFreshness:
+    """Review-found regression: the eager visit loop's checkpoint must
+    carry the CURRENT iteration's model/total — after the body moved
+    into ``_run_one_iteration`` (the degrade transaction), a closure
+    over ``_run_inner``'s bindings read the PREVIOUS iteration's model,
+    so every checkpoint paired fresh scores with a stale model."""
+
+    def test_resume_matches_uninterrupted_run(self, tmp_path):
+        clean = _tiny_descent(_FakeReCoord(4)).run(["c"], 3)
+        cd = _tiny_descent(_FakeReCoord(4))
+        cd.run(
+            ["c"], 2, checkpoint_dir=str(tmp_path),
+            checkpoint_fingerprint="f",
+        )
+        resumed = _tiny_descent(_FakeReCoord(4)).run(
+            ["c"], 3, checkpoint_dir=str(tmp_path),
+            checkpoint_fingerprint="f",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(resumed.model.models["c"].coefficients),
+            np.asarray(clean.model.models["c"].coefficients),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(resumed.training_scores["c"]),
+            np.asarray(clean.training_scores["c"]),
+        )
+
+
+class TestRejoinRollCallShrinks:
+    """Review-found regression: a rejoin roll call that DROPS a
+    survivor (the probed rejoiner vanished and a survivor died between
+    probe and roll call) must re-plan + resume like a degrade — the
+    in-flight visit's shard plans are keyed on the old rank mapping."""
+
+    def _trainer(self):
+        from photon_ml_tpu.config import (
+            GameTrainingConfig,
+            OptimizationConfig,
+            OptimizerConfig,
+            RandomEffectCoordinateConfig,
+            RegularizationContext,
+        )
+        from photon_ml_tpu.game.streaming import StreamedGameTrainer
+        from photon_ml_tpu.types import (
+            RegularizationType,
+            TaskType,
+            VarianceComputationType,
+        )
+
+        opt = OptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=2, tolerance=1e-9),
+            regularization=RegularizationContext(RegularizationType.L2),
+            regularization_weight=1.0,
+        )
+        cfg = GameTrainingConfig(
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            coordinate_update_sequence=("per_entity",),
+            coordinate_descent_iterations=1,
+            fixed_effect_coordinates={},
+            random_effect_coordinates={
+                "per_entity": RandomEffectCoordinateConfig(
+                    random_effect_type="eid", feature_shard_id="r",
+                    optimization=opt,
+                )
+            },
+            variance_computation=VarianceComputationType.SIMPLE,
+        )
+        return StreamedGameTrainer(
+            cfg, chunk_rows=64, multihost=True,
+            num_entities={"eid": 4}, sharded_checkpoints=False,
+        )
+
+    def test_dropped_survivor_forces_replan_resume(self, monkeypatch):
+        from photon_ml_tpu.game.streaming import _RejoinResume
+        from photon_ml_tpu.obs.metrics import REGISTRY
+
+        trainer = self._trainer()
+        trainer._last_fingerprint = "pre-shrink"
+        trainer._last_row_base = 7
+        monkeypatch.setenv("PHOTON_REJOIN", "1")
+        monkeypatch.setattr(
+            mh, "degraded_group",
+            lambda: {"survivors": (0, 1, 2), "rank": 0},
+        )
+        monkeypatch.setattr(mh, "original_process_count", lambda: 4)
+        monkeypatch.setattr(mh, "rejoin_window_s", lambda: 0.0)
+        monkeypatch.setattr(mh, "effective_process_index", lambda: 0)
+        monkeypatch.setattr(mh, "effective_process_count", lambda: 3)
+        monkeypatch.setattr(mh, "probe_rejoiners", lambda lost, w: [3])
+        monkeypatch.setattr(mh, "broadcast_from_host0", lambda x: x)
+        monkeypatch.setattr(
+            mh, "send_rejoin_invites", lambda *a, **kw: [3]
+        )
+        degraded_to = []
+        monkeypatch.setattr(
+            mh, "set_degraded_group", lambda s: degraded_to.append(list(s))
+        )
+        # survivor 2 AND the probed rejoiner both die before the roll
+        # call: the agreed group shrinks past the current survivor set
+        monkeypatch.setattr(mh, "roll_call", lambda **kw: [0, 1])
+        before = (
+            REGISTRY.snapshot()
+            .get("counters", {})
+            .get("fleet.recoveries", {})
+            .get("value", 0.0)
+        )
+        with pytest.raises(_RejoinResume):
+            trainer._maybe_admit_rejoin({}, iteration=0, ci=0)
+        assert degraded_to == [[0, 1]]
+        assert "pre-shrink" in trainer.resume_fingerprints
+        # the foreign-resume row base re-anchors to the layout that
+        # wrote any mid-degrade checkpoint, like _prepare_recovery's
+        assert trainer.resume_row_base == 7
+        after = (
+            REGISTRY.snapshot()
+            .get("counters", {})
+            .get("fleet.recoveries", {})
+            .get("value", 0.0)
+        )
+        assert after == before + 1.0
+
+    def test_admitted_rejoin_reanchors_row_base(self, monkeypatch):
+        from photon_ml_tpu.game.streaming import _RejoinResume
+
+        trainer = self._trainer()
+        trainer._last_fingerprint = "degraded-layout"
+        trainer._last_row_base = 11
+        monkeypatch.setenv("PHOTON_REJOIN", "1")
+        monkeypatch.setattr(
+            mh, "degraded_group",
+            lambda: {"survivors": (0, 1, 2), "rank": 0},
+        )
+        monkeypatch.setattr(mh, "original_process_count", lambda: 4)
+        monkeypatch.setattr(mh, "original_process_index", lambda: 0)
+        monkeypatch.setattr(mh, "rejoin_window_s", lambda: 0.0)
+        monkeypatch.setattr(mh, "effective_process_index", lambda: 0)
+        monkeypatch.setattr(mh, "effective_process_count", lambda: 3)
+        monkeypatch.setattr(mh, "probe_rejoiners", lambda lost, w: [3])
+        monkeypatch.setattr(mh, "broadcast_from_host0", lambda x: x)
+        monkeypatch.setattr(
+            mh, "send_rejoin_invites", lambda *a, **kw: [3]
+        )
+        monkeypatch.setattr(mh, "set_degraded_group", lambda s: None)
+        monkeypatch.setattr(mh, "roll_call", lambda **kw: [0, 1, 2, 3])
+        monkeypatch.setattr(
+            mh, "allgather_obj_p2p",
+            lambda payload, tag=None, **kw: [payload, None, None, None],
+        )
+        with pytest.raises(_RejoinResume):
+            trainer._maybe_admit_rejoin({}, iteration=1, ci=0)
+        # the survivor accepts its own broadcast allow-list AND
+        # re-anchors the foreign row base to the degraded layout that
+        # wrote any mid-degrade checkpoint
+        assert "degraded-layout" in trainer.resume_fingerprints
+        assert trainer.resume_row_base == 11
+
+    def test_admit_and_drop_in_one_round_roots_at_live_survivor(
+        self, monkeypatch
+    ):
+        # review-found: roll_call supports admitting a rejoiner and
+        # dropping a freshly-dead survivor in ONE round — the ctrl
+        # exchange must root at the lowest LIVE survivor, not at the
+        # stale survivor list's minimum (a dead process), which raised
+        # ValueError('0 is not in list') fleet-wide
+        from photon_ml_tpu.game.streaming import _RejoinResume
+
+        trainer = self._trainer()
+        trainer._last_fingerprint = "degraded-layout"
+        trainer._last_row_base = 5
+        monkeypatch.setenv("PHOTON_REJOIN", "1")
+        monkeypatch.setattr(
+            mh, "degraded_group",
+            lambda: {"survivors": (0, 1, 2), "rank": 1},
+        )
+        monkeypatch.setattr(mh, "original_process_count", lambda: 4)
+        monkeypatch.setattr(mh, "original_process_index", lambda: 1)
+        monkeypatch.setattr(mh, "rejoin_window_s", lambda: 0.0)
+        monkeypatch.setattr(mh, "effective_process_index", lambda: 1)
+        monkeypatch.setattr(mh, "effective_process_count", lambda: 3)
+        monkeypatch.setattr(mh, "probe_rejoiners", lambda lost, w: [])
+        monkeypatch.setattr(
+            mh, "broadcast_from_host0",
+            lambda x: np.asarray([3], np.int64),
+        )
+        monkeypatch.setattr(
+            mh, "send_rejoin_invites", lambda *a, **kw: [3]
+        )
+        monkeypatch.setattr(mh, "set_degraded_group", lambda s: None)
+        # process 0 dies between the probe broadcast and the roll call:
+        # the agreed group admits 3 AND drops 0 in the same round
+        monkeypatch.setattr(mh, "roll_call", lambda **kw: [1, 2, 3])
+        sent = {}
+
+        def fake_allgather(payload, tag=None, **kw):
+            sent["payload"] = payload
+            return [payload, None, None]
+
+        monkeypatch.setattr(mh, "allgather_obj_p2p", fake_allgather)
+        with pytest.raises(_RejoinResume):
+            trainer._maybe_admit_rejoin({}, iteration=2, ci=0)
+        # we (pid 1) are the lowest LIVE survivor, so we rooted the
+        # ctrl payload; the anchors registered locally too
+        assert sent["payload"]["fingerprints"] == ["degraded-layout"]
+        assert "degraded-layout" in trainer.resume_fingerprints
+        assert trainer.resume_row_base == 5
+
+    def test_vanished_rejoiner_alone_keeps_training(self, monkeypatch):
+        trainer = self._trainer()
+        monkeypatch.setenv("PHOTON_REJOIN", "1")
+        monkeypatch.setattr(
+            mh, "degraded_group",
+            lambda: {"survivors": (0, 1, 2), "rank": 0},
+        )
+        monkeypatch.setattr(mh, "original_process_count", lambda: 4)
+        monkeypatch.setattr(mh, "rejoin_window_s", lambda: 0.0)
+        monkeypatch.setattr(mh, "effective_process_index", lambda: 0)
+        monkeypatch.setattr(mh, "effective_process_count", lambda: 3)
+        monkeypatch.setattr(mh, "probe_rejoiners", lambda lost, w: [3])
+        monkeypatch.setattr(mh, "broadcast_from_host0", lambda x: x)
+        monkeypatch.setattr(
+            mh, "send_rejoin_invites", lambda *a, **kw: [3]
+        )
+        monkeypatch.setattr(mh, "set_degraded_group", lambda s: None)
+        # the roll call re-agrees on exactly the current group: the
+        # vanished rejoiner costs nothing, training continues in place
+        monkeypatch.setattr(mh, "roll_call", lambda **kw: [0, 1, 2])
+        assert trainer._maybe_admit_rejoin({}, iteration=0, ci=0) is None

@@ -1004,3 +1004,92 @@ class TestFleetCLI:
         )
         out = capsys.readouterr().out
         assert rc == 1 and "fleet/missing_shards" in out
+
+
+class TestElasticFleetNarrative:
+    """ISSUE 14: the recovery narrative renders in-memory degrades and
+    rejoins next to the existing peer_lost/roll_call/recovery lines,
+    and ``gate --fleet`` grows the exact ``fleet/degraded_descents`` /
+    ``fleet/rejoins`` tiers."""
+
+    def _write(self, directory, degrade=True, rejoin=True):
+        from photon_ml_tpu.obs.sink import TelemetrySink
+
+        _write_fleet_fixture(directory)
+        # append the elastic events to the canonical file's process
+        # view via a second mini-run? No — rewrite a dedicated run with
+        # the events inline (simplest valid shard)
+        import json as _json
+
+        path = os.path.join(str(directory), "run-F1.jsonl")
+        recs = [
+            _json.loads(line) for line in open(path) if line.strip()
+        ]
+        extra = []
+        if degrade:
+            extra.append({
+                "event": "degraded_descent", "t": 1_001.0,
+                "iteration": 1, "survivors": [0], "lost": [1],
+            })
+        if rejoin:
+            extra.append({
+                "event": "rejoin", "t": 1_002.0, "iteration": 2,
+                "rejoined": [1], "group": [0, 1],
+                "migrated": {"per_entity": 7}, "role": "survivor",
+            })
+        out = recs[:-1] + extra + [recs[-1]]
+        with open(path, "w") as f:
+            for r in out:
+                f.write(_json.dumps(r) + "\n")
+
+    def test_narrative_renders_degrade_and_rejoin(self, tmp_path):
+        from photon_ml_tpu.obs.report import (
+            fleet_run_paths,
+            format_fleet,
+            summarize_fleet,
+        )
+
+        self._write(tmp_path)
+        fs = summarize_fleet(fleet_run_paths(str(tmp_path)))
+        rec = fs["recovery"]
+        assert rec["degraded_descents"] == [{
+            "process": 0, "iteration": 1, "survivors": [0], "lost": [1],
+        }]
+        assert rec["rejoins"][0]["rejoined"] == [1]
+        assert rec["rejoins"][0]["migrated"] == {"per_entity": 7}
+        text = format_fleet(fs)
+        assert "degraded_descent: p0 degraded IN PLACE at iteration 1" in text
+        assert "rejoin: p0 (survivor) — [1] rejoined" in text
+        assert "migrated back: per_entity:7" in text
+        # an in-place degrade warns like a checkpoint-anchored recovery
+        assert "degraded mid-flight" in text
+        json.dumps(fs)
+
+    def test_gate_tiers_are_exact(self, tmp_path):
+        from photon_ml_tpu.obs.report import (
+            fleet_run_paths,
+            gate_metrics_from_fleet,
+            gate_run,
+            summarize_fleet,
+        )
+
+        _write_fleet_fixture(tmp_path / "clean")
+        clean = gate_metrics_from_fleet(
+            summarize_fleet(fleet_run_paths(str(tmp_path / "clean")))
+        )
+        assert clean["fleet/degraded_descents"] == 0.0
+        assert clean["fleet/rejoins"] == 0.0
+        self._write(tmp_path / "elastic")
+        elastic = gate_metrics_from_fleet(
+            summarize_fleet(fleet_run_paths(str(tmp_path / "elastic")))
+        )
+        assert elastic["fleet/degraded_descents"] == 1.0
+        assert elastic["fleet/rejoins"] == 1.0
+        # self-gate passes; a spontaneous degrade/rejoin against the
+        # clean baseline trips the exact tier
+        failures, _ = gate_run(elastic, elastic)
+        assert not failures
+        failures, _ = gate_run(elastic, clean)
+        names = {f["metric"] for f in failures}
+        assert "fleet/degraded_descents" in names
+        assert "fleet/rejoins" in names
