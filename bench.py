@@ -1705,6 +1705,17 @@ def _apply_retune_env() -> None:
         (RETUNE_ENV_SHARD, "photon_ml_tpu.parallel.placement",
          "entity-shard knobs"),
     )
+    # runtime twin of the `photon-ml-tpu lint` knob pass: a sweep over a
+    # knob that is not registered (or not fully wired through its mirror
+    # surfaces) must fail BEFORE any config runs, not after a blind sweep
+    from photon_ml_tpu.analysis.registry import check_retune_tables
+
+    check_retune_tables({
+        "RETUNE_ENV": RETUNE_ENV,
+        "RETUNE_ENV_PREFETCH": RETUNE_ENV_PREFETCH,
+        "RETUNE_ENV_RE": RETUNE_ENV_RE,
+        "RETUNE_ENV_SHARD": RETUNE_ENV_SHARD,
+    })
     def _parse(var: str, raw: str):
         if var == "PHOTON_KERNEL_DTYPE":
             # the one string knob: strict-parse (reject unknown rungs

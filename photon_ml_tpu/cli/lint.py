@@ -1,0 +1,155 @@
+"""``photon-ml-tpu lint`` — the AST invariant checker driver.
+
+Runs the five analysis passes (``photon_ml_tpu/analysis``) over the repo
+and exits 1 on any finding not covered by an inline waiver or the
+committed baseline (``lint_baseline.json``). The JSON mode is the CI
+contract (one document on stdout); ``--write-baseline`` triages the
+CURRENT findings into the baseline (review the diff — a baseline entry is
+a debt record, not a fix); ``--write-docs`` regenerates the README knob
+table from the registry.
+
+Usage:
+    photon-ml-tpu lint                       # human-readable, exit 1 on findings
+    photon-ml-tpu lint --json                # machine-readable (CI)
+    photon-ml-tpu lint --select knobs,telemetry
+    photon-ml-tpu lint --baseline my.json
+    photon-ml-tpu lint --write-baseline      # triage current findings
+    photon-ml-tpu lint --write-docs          # regenerate README knob table
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+
+def _read_pyproject_config(root: str) -> dict:
+    """The ``[tool.photon-ml-tpu-lint]`` table of pyproject.toml.
+    Python 3.10 has no tomllib, so this reads only the simple
+    ``key = "value"`` lines the table actually uses."""
+    path = os.path.join(root, "pyproject.toml")
+    cfg: dict = {}
+    if not os.path.exists(path):
+        return cfg
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return cfg
+    m = re.search(
+        r"^\[tool\.photon-ml-tpu-lint\]\s*$(.*?)(?=^\[|\Z)",
+        text, re.MULTILINE | re.DOTALL,
+    )
+    if not m:
+        return cfg
+    for line in m.group(1).splitlines():
+        kv = re.match(r'\s*([\w-]+)\s*=\s*"([^"]*)"', line)
+        if kv:
+            cfg[kv.group(1)] = kv.group(2)
+    return cfg
+
+
+def _write_docs(root: str) -> int:
+    from photon_ml_tpu.analysis.registry import (
+        KNOB_TABLE_END, render_knob_table,
+    )
+
+    readme = os.path.join(root, "README.md")
+    if not os.path.exists(readme):
+        print(f"lint --write-docs: no README.md under {root}",
+              file=sys.stderr)
+        return 2
+    with open(readme, encoding="utf-8") as f:
+        text = f.read()
+    begin = text.find("<!-- knob-table:begin")
+    end = text.find(KNOB_TABLE_END)
+    if begin < 0 or end < 0 or end < begin:
+        print(
+            "lint --write-docs: README.md has no knob-table markers; add "
+            "a '<!-- knob-table:begin ... -->' / '<!-- knob-table:end -->' "
+            "pair where the table should live",
+            file=sys.stderr,
+        )
+        return 2
+    end += len(KNOB_TABLE_END)
+    new_text = text[:begin] + render_knob_table() + text[end:]
+    if new_text != text:
+        with open(readme, "w", encoding="utf-8") as f:
+            f.write(new_text)
+        print(f"lint --write-docs: regenerated knob table in {readme}")
+    else:
+        print("lint --write-docs: knob table already current")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(
+        prog="photon-ml-tpu lint",
+        description="AST invariant checker (knob discipline, jit cache "
+                    "keys, concurrency, exception discipline, telemetry "
+                    "surfaces)",
+    )
+    p.add_argument("--root", default=None,
+                   help="repo root (default: auto-discover from cwd / the "
+                        "installed package)")
+    p.add_argument("--json", action="store_true",
+                   help="one machine-readable JSON document on stdout")
+    p.add_argument("--select", default=None,
+                   help="comma-separated pass subset "
+                        "(knobs,jit-keys,concurrency,exceptions,telemetry)")
+    p.add_argument("--baseline", default=None,
+                   help="suppression baseline path "
+                        "(default: <root>/lint_baseline.json, overridable "
+                        "via [tool.photon-ml-tpu-lint] in pyproject.toml)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="triage the current ACTIVE findings into the "
+                        "baseline file and exit 0")
+    p.add_argument("--write-docs", action="store_true",
+                   help="regenerate the README knob table from the "
+                        "registry and exit")
+    args = p.parse_args(argv)
+
+    from photon_ml_tpu.analysis.core import write_baseline
+    from photon_ml_tpu.analysis.runner import (
+        discover_root, lint, render_text,
+    )
+
+    root = os.path.abspath(args.root) if args.root else discover_root()
+    if args.write_docs:
+        raise SystemExit(_write_docs(root))
+
+    baseline = args.baseline
+    if baseline is None:
+        cfg = _read_pyproject_config(root)
+        baseline = os.path.join(root, cfg.get("baseline",
+                                              "lint_baseline.json"))
+    select = (
+        [s.strip() for s in args.select.split(",") if s.strip()]
+        if args.select else None
+    )
+    doc = lint(root, select=select, baseline_path=baseline)
+
+    if args.write_baseline:
+        findings = doc["_active"] + doc["_suppressed_findings"]
+        write_baseline(baseline, findings)
+        print(
+            f"lint: wrote {len(findings)} suppression(s) to {baseline} — "
+            f"each entry is triaged debt; review the diff before "
+            f"committing"
+        )
+        raise SystemExit(0)
+
+    active = doc.pop("_active")
+    doc.pop("_suppressed_findings")
+    if args.json:
+        print(json.dumps(doc))
+    else:
+        print(render_text({**doc, "_active": active}))
+    raise SystemExit(doc["exit"])
+
+
+if __name__ == "__main__":
+    main()

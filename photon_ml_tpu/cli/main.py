@@ -20,6 +20,7 @@ def _commands() -> dict:
         "index-features": "photon_ml_tpu.cli.index_features",
         "name-term-bags": "photon_ml_tpu.cli.name_term_bags",
         "report": "photon_ml_tpu.cli.report",
+        "lint": "photon_ml_tpu.cli.lint",
     }
 
 

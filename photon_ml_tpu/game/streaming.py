@@ -2253,6 +2253,7 @@ class StreamedGameTrainer:
                     for k in z.files if k.startswith("s__")
                 }
                 return scores, np.asarray(z["total"], np.float32)
+        # lint: waive(except-swallow) an absent/torn scores shard is a cache miss: the caller recomputes from data, never serves partial scores
         except Exception:
             return None
 
@@ -2500,6 +2501,7 @@ class StreamedGameTrainer:
             while True:
                 try:
                     return self._fit_inner(data, validation, initial_model)
+                # lint: waive(except-swallow) handled by delegation: _prepare_recovery runs the roll-call recovery and emits peer_lost/recovery telemetry
                 except PeerLost as e:
                     # checkpoint-anchored peer-loss recovery: confirm the
                     # lost set, shrink the process group to the
@@ -2509,6 +2511,7 @@ class StreamedGameTrainer:
                     # identical plan with zero extra comms) and the
                     # resume path restores the last atomic checkpoint
                     self._prepare_recovery(e)
+                # lint: waive(except-swallow) control-flow resume: the rejoin roll call already emitted the rejoin event before raising
                 except _RejoinResume:
                     # the expanded group already agreed (roll call +
                     # control broadcast in _maybe_admit_rejoin); ingest
@@ -2767,6 +2770,7 @@ class StreamedGameTrainer:
                     ),
                 )
                 migrated_by_cid[cid] = int(migrated.sum())
+        # lint: waive(except-swallow) the migration preview is telemetry decoration; failing it must never fail the admit
         except Exception:
             pass  # the preview is telemetry, never load-bearing
         fps: list[str] = []
@@ -3120,6 +3124,7 @@ class StreamedGameTrainer:
                         )
 
                         rejoin_boot = rejoin_identity() is not None
+                    # lint: waive(except-swallow) optional-probe of multihost state: absent module means not a rejoin boot, the safe default
                     except Exception:
                         pass
                     if ck_base + n > ck_rows or (

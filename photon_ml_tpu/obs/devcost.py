@@ -104,6 +104,7 @@ def capture_enabled() -> bool:
             return bool(int(env))
         except ValueError:
             if not _warned_bad_env[0]:
+                # lint: waive(conc-unlocked-mutation) benign-race once-flag: worst case is a duplicate warning
                 _warned_bad_env[0] = True
                 import warnings
 
@@ -124,7 +125,9 @@ def capture_enabled() -> bool:
 # a knob flip invalidates immediately). A knob added to _knob_snapshot
 # must be added here too; the failure mode of forgetting is one missed
 # re-capture on a mid-process flip of only that knob, never a wrong
-# number.
+# number. That wiring is no longer a memory exercise: the lint knob pass
+# (photon_ml_tpu/analysis, code knob-devcost-missing) parses this
+# function and fails when a snapshot-carried knob is not fingerprinted.
 _knob_memo: list = []  # [raw_fingerprint, knobs_dict, sorted_items_tuple]
 
 
@@ -139,7 +142,8 @@ def _knob_raw_state() -> tuple:
         re_mod = sys.modules.get("photon_ml_tpu.game.random_effect")
         re_state = (
             None if re_mod is None
-            else (re_mod.COMPACT_EVERY, re_mod.FUSE_BUCKETS)
+            else (re_mod.COMPACT_EVERY, re_mod.FUSE_BUCKETS,
+                  re_mod.RE_COMBINE)
         )
     except Exception:
         re_state = None
@@ -149,7 +153,8 @@ def _knob_raw_state() -> tuple:
         pl_mod = sys.modules.get("photon_ml_tpu.parallel.placement")
         shard_state = (
             None if pl_mod is None
-            else (pl_mod.RE_SHARD, pl_mod.RE_SPLIT)
+            else (pl_mod.RE_SHARD, pl_mod.RE_SPLIT,
+                  pl_mod.REPLAN_IMBALANCE)
         )
     except Exception:
         shard_state = None
@@ -159,10 +164,13 @@ def _knob_raw_state() -> tuple:
         env.get("PHOTON_KERNEL_DTYPE"),
         env.get("PHOTON_RE_COMPACT_EVERY"),
         env.get("PHOTON_RE_FUSE_BUCKETS"),
+        env.get("PHOTON_RE_COMBINE"),
         env.get("PHOTON_RE_SHARD"),
         env.get("PHOTON_RE_SPLIT"),
+        env.get("PHOTON_RE_REPLAN_IMBALANCE"),
         pf.PREFETCH_DEPTH, pf.CHUNK_CACHE_BUDGET,
         len(pf._device_budget_memo),
+        st.GROUPS_PER_STEP, st.SEGMENTS_PER_DMA,
         st.GROUPS_PER_RUN, st.PIPELINE_SEGMENTS, st.KERNEL_DTYPE,
         re_state,
         shard_state,
@@ -178,6 +186,7 @@ def _knob_items() -> tuple:
         return memo[2]
     knobs = _sink_mod._knob_snapshot()
     items = tuple(sorted(knobs.items()))
+    # lint: waive(conc-unlocked-mutation) deliberately lock-free memo: sits on capture()'s repeat path; a racing rewrite recomputes the same value
     _knob_memo[:] = [fp, knobs, items]
     return items
 

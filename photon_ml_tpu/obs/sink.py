@@ -343,6 +343,8 @@ def _knob_snapshot() -> dict:
     try:
         from photon_ml_tpu.ops import sparse_tiled as st
 
+        knobs["groups_per_step"] = int(st.GROUPS_PER_STEP)
+        knobs["segments_per_dma"] = int(st.SEGMENTS_PER_DMA)
         knobs["groups_per_run"] = int(st.GROUPS_PER_RUN)
         knobs["pipeline_segments"] = int(st.PIPELINE_SEGMENTS)
         knobs["kernel_dtype"] = st.kernel_dtype()

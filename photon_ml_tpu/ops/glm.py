@@ -442,6 +442,20 @@ def make_objective(
     )
 
 
+def fused_disabled() -> bool:
+    """``PHOTON_DISABLE_FUSED`` veto for :func:`auto_fused`, strict int
+    parse like every sibling knob. The previous truthiness read made
+    ``PHOTON_DISABLE_FUSED=0`` DISABLE fusion — ``"0"`` is a truthy
+    string — which is exactly the inversion the lint knob pass now
+    rejects repo-wide (``knob-truthy-parse``)."""
+    import os
+
+    env = os.environ.get("PHOTON_DISABLE_FUSED")
+    if env is not None and env != "":
+        return int(env) != 0
+    return False
+
+
 def auto_fused(batch: Batch) -> bool:
     """Should this (concrete) batch use the one-pass Pallas kernels?
     True on TPU for dense, lane-aligned, VMEM-feasible shapes. Callers that
@@ -450,15 +464,13 @@ def auto_fused(batch: Batch) -> bool:
     returns False (pallas under vmap batching rules is untested; under
     ``shard_map`` pass the pre-computed answer through a static arg, as
     ``parallel/distributed.py`` does with per-device row counts)."""
-    import os
-
     from photon_ml_tpu.ops.fused import supports_fused
 
     return (
         isinstance(batch, DenseBatch)
         and not isinstance(batch.X, jax.core.Tracer)
         and jax.default_backend() == "tpu"
-        and not os.environ.get("PHOTON_DISABLE_FUSED")
+        and not fused_disabled()
         and supports_fused(batch.num_rows, batch.num_features, batch.X.dtype)
     )
 

@@ -114,6 +114,7 @@ def chunk_cache_budget_bytes() -> int:
 
         # ``default`` is the no-memory-stats fallback (CPU test backends)
         # and is NOT scaled by ``fraction`` — pass the already-scaled value
+        # lint: waive(conc-unlocked-mutation) memoize-once of an immutable backend quote: racing appends store the same value and only [0] is read
         _device_budget_memo.append(int(device_hbm_budget_bytes(
             default=2e9, fraction=_DEFAULT_HBM_FRACTION,
         )))

@@ -56,6 +56,12 @@ fleet_baseline="BASELINE_fleet_cpu.json"
 out="$(mktemp)"
 trap 'rm -f "$out"' EXIT
 
+# ---- lint leg: the AST invariant checker runs FIRST (cheapest, and a
+# knob/telemetry-surface drift makes every later number suspect); plain
+# mode so a failure PRINTS its findings instead of dying silently --------
+python -m photon_ml_tpu.cli.main lint
+echo "gate_quick: lint leg OK (no non-suppressed findings)"
+
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --quick > "$out"
 
 if [[ "${UPDATE_BASELINE:-0}" == "1" ]]; then

@@ -95,6 +95,27 @@ class TestCapture:
         rbf = devcost.capture("t.knob", _small_prog, (x,))
         assert rbf is not None and rbf["knobs"]["kernel_dtype"] == "bf16"
 
+    def test_knob_memo_invalidates_on_combine_and_replan_flips(
+        self, telemetry, monkeypatch
+    ):
+        """Regression for the lint-found fingerprint gap
+        (knob-devcost-missing): ``_knob_raw_state`` did not cover
+        ``PHOTON_RE_COMBINE`` / ``PHOTON_RE_REPLAN_IMBALANCE``, so a
+        mid-process flip of only one of them reused a stale memoized
+        snapshot in capture keys. The memo must now re-key on both."""
+        # the snapshot only reports re_combine once the module is loaded
+        import photon_ml_tpu.game.random_effect  # noqa: F401
+
+        monkeypatch.delenv("PHOTON_RE_COMBINE", raising=False)
+        monkeypatch.delenv("PHOTON_RE_REPLAN_IMBALANCE", raising=False)
+        base = devcost.knob_key()
+        assert base["re_combine"] == "allreduce"
+        monkeypatch.setenv("PHOTON_RE_COMBINE", "segments")
+        flipped = devcost.knob_key()
+        assert flipped["re_combine"] == "segments"
+        monkeypatch.setenv("PHOTON_RE_REPLAN_IMBALANCE", "1.5")
+        assert devcost.knob_key()["re_replan_imbalance"] == 1.5
+
     def test_capture_skips_under_trace(self, telemetry):
         """Tracer leaves skip capture — the enclosing executable is the
         one that gets captured, at its own boundary."""

@@ -212,3 +212,23 @@ def test_supports_fused_gates():
     assert not supports_fused(1024, 500, jnp.float32)  # lane-unaligned d
     assert not supports_fused(1024, 512, jnp.int8)
     assert not supports_fused(1024, 1 << 17, jnp.float32)  # tile over budget
+
+
+def test_disable_fused_knob_strict_parse(monkeypatch):
+    """Regression for the PHOTON_DISABLE_FUSED truthiness bug (found by
+    the lint knob pass): '0' is a truthy string, so the old
+    ``not os.environ.get(...)`` read made ``PHOTON_DISABLE_FUSED=0``
+    DISABLE fusion. The knob now strict-parses like its siblings."""
+    from photon_ml_tpu.ops.glm import fused_disabled
+
+    monkeypatch.delenv("PHOTON_DISABLE_FUSED", raising=False)
+    assert fused_disabled() is False
+    monkeypatch.setenv("PHOTON_DISABLE_FUSED", "0")
+    assert fused_disabled() is False  # the =0 case: fusion stays enabled
+    monkeypatch.setenv("PHOTON_DISABLE_FUSED", "1")
+    assert fused_disabled() is True
+    monkeypatch.setenv("PHOTON_DISABLE_FUSED", "")
+    assert fused_disabled() is False  # empty = unset, the knob convention
+    monkeypatch.setenv("PHOTON_DISABLE_FUSED", "nope")
+    with pytest.raises(ValueError):
+        fused_disabled()  # a typo fails loudly, never silently un-fuses
