@@ -105,6 +105,14 @@ RETUNE_ENV_SHARD = {
     "PHOTON_RE_SHARD": "RE_SHARD",
     "PHOTON_RE_SPLIT": "RE_SPLIT",
     "PHOTON_RE_REPLAN_IMBALANCE": "REPLAN_IMBALANCE",
+    # RE_DEVICE_SPLIT = 1 adds the second LPT level: each process's
+    # owned atoms are placed over its LOCAL devices (0 = the
+    # single-unit-per-process schedule bit-for-bit). RE_SPLIT_WEIGHT
+    # picks the split/placement weight axis: "rows" (default) or
+    # "bytes" (combine-segment lane bytes — closes the r09 max-owner-
+    # bytes gap to the row-balance ratio).
+    "PHOTON_RE_DEVICE_SPLIT": "RE_DEVICE_SPLIT",
+    "PHOTON_RE_SPLIT_WEIGHT": "RE_SPLIT_WEIGHT",
 }
 # No TPU generation exceeds this HBM bandwidth (v5p ~2.8 TB/s); a
 # measurement implying more is a timing artifact, not a fast solve.
@@ -1617,8 +1625,10 @@ def bench_r_re_skew(jax, jnp):
         from photon_ml_tpu.parallel.multihost import exchange_rows_async
         from photon_ml_tpu.parallel.placement import (
             plan_entity_placement,
+            re_device_split_enabled,
             re_shard_enabled,
             re_split_factor,
+            re_split_weight,
             record_placement_metrics,
         )
 
@@ -1659,6 +1669,8 @@ def bench_r_re_skew(jax, jnp):
                 "fuse_buckets": int(bool(re_mod.fuse_buckets())),
                 "re_shard": int(bool(re_shard_enabled())),
                 "re_split": int(re_split_factor()),
+                "re_device_split": int(bool(re_device_split_enabled())),
+                "re_split_weight": str(re_split_weight()),
             },
             "converged_fraction": conv_frac,
             "quality_ok": bool(conv_frac == 1.0),
@@ -1734,6 +1746,15 @@ def _apply_retune_env() -> None:
             return raw
         if var == "PHOTON_RE_REPLAN_IMBALANCE":
             return float(raw)
+        if var == "PHOTON_RE_SPLIT_WEIGHT":
+            from photon_ml_tpu.parallel.placement import _SPLIT_WEIGHT_MODES
+
+            if raw not in _SPLIT_WEIGHT_MODES:
+                raise ValueError(
+                    f"PHOTON_RE_SPLIT_WEIGHT must be one of "
+                    f"{_SPLIT_WEIGHT_MODES}, got {raw!r}"
+                )
+            return raw
         return int(raw)
 
     for env_map, module_name, label in surfaces:
@@ -2883,6 +2904,406 @@ def run_multichip_r09(
     return doc
 
 
+# -- MULTICHIP_r10: device-granularity placement A/B (PHOTON_RE_DEVICE_SPLIT)
+#
+# `python bench.py --multichip-r10` spawns the gloo loopback harness (4
+# processes) with each worker FORCING 4 host-platform CPU devices
+# (XLA_FLAGS=--xla_force_host_platform_device_count=4 — the parent
+# harness strips XLA_FLAGS from the child env, so the worker sets it
+# before its first jax use) and runs the r09 in-memory owned-bucket
+# recipe on the same Zipf ladder across four arms, all on the
+# owner-segment combine:
+#
+#   off      PHOTON_RE_SPLIT=16, DEVICE_SPLIT=0 — exactly the PR-13
+#            split schedule; its per-process segments wire bytes are
+#            asserted bit-for-bit against the committed
+#            MULTICHIP_r09.json split arm
+#   device   same split, DEVICE_SPLIT=1 — owned atoms placed per LOCAL
+#            device; coefficients/variances/iterations AND per-process
+#            wire bytes must be bit-for-bit the off arm's (the device
+#            level changes WHERE owned solves run, never what crosses
+#            the process transport)
+#   device64 PHOTON_RE_SPLIT=64, DEVICE_SPLIT=1 — the balance arm:
+#            finer atoms give the per-device LPT enough units to bound
+#            re_shard.device_balance <= 1.15 across 4 local devices
+#   bytes    PHOTON_RE_SPLIT=16, SPLIT_WEIGHT=bytes — the weight-axis
+#            arm: lane-count (combine-byte) weighted split+placement;
+#            its MAX owner's combine bytes must improve on the off
+#            arm's (the r09 capture's known limit: row balance 1.044
+#            but max/mean combine bytes ~2.0x)
+#
+# Every arm runs the cold solve plus the warm+prior pass, and every
+# arm's model hashes are asserted bitwise identical across processes
+# AND across arms (split factor, weight axis and device placement are
+# all schedule-only). Writes MULTICHIP_r10.json with a flat
+# gate_metrics section `scripts/gate_quick.sh` gates against
+# BASELINE_device_cpu.json.
+
+MULTICHIP_R10_NDEV = 4
+MULTICHIP_R10_SPLIT = 64
+MULTICHIP_R10_NPROC = MULTICHIP_R08_NPROC
+
+
+def _multichip_r10_worker(coordinator: str, pid: int, nproc: int) -> None:
+    """One harness process of the device-placement A/B (child mode):
+    the r09 worker's contract under a FORCED 4-local-device CPU
+    topology, with the PHOTON_RE_DEVICE_SPLIT / PHOTON_RE_SPLIT_WEIGHT
+    arm toggles and the per-device placement gauges
+    (re_shard.device_balance / re_shard.devices /
+    re_shard.device_rows.<d>) read into the capture."""
+    # before any jax import: the parent strips XLA_FLAGS from the child
+    # env, and the backend reads it once at first use
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count="
+        f"{MULTICHIP_R10_NDEV}"
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["PHOTON_RE_SHARD"] = "1"
+    os.environ["PHOTON_RE_COMBINE"] = "segments"
+    import hashlib
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    from photon_ml_tpu.parallel.multihost import initialize_multihost
+
+    initialize_multihost(coordinator, num_processes=nproc, process_id=pid)
+    if jax.local_device_count() != MULTICHIP_R10_NDEV:
+        raise RuntimeError(
+            f"forced host device count did not take: "
+            f"{jax.local_device_count()} != {MULTICHIP_R10_NDEV}"
+        )
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.config import OptimizerConfig
+    from photon_ml_tpu.game import bucket_entities, group_by_entity
+    from photon_ml_tpu.game.data import DenseFeatures, split_entity_buckets
+    from photon_ml_tpu.game.random_effect import (
+        _plan_bucket_owners,
+        train_random_effects,
+    )
+    from photon_ml_tpu.obs.metrics import REGISTRY
+    from photon_ml_tpu.ops.losses import loss_for_task
+    from photon_ml_tpu.parallel import data_mesh
+    from photon_ml_tpu.parallel.placement import re_split_weight
+    from photon_ml_tpu.types import TaskType, VarianceComputationType
+
+    mesh = data_mesh()
+    loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+
+    def counter(name: str) -> float:
+        return float(
+            REGISTRY.snapshot().get("counters", {})
+            .get(name, {}).get("value", 0.0)
+        )
+
+    def gauge(name: str) -> float:
+        return float(
+            REGISTRY.snapshot().get("gauges", {}).get(name, 0.0)
+        )
+
+    def sha(a) -> str:
+        return hashlib.sha256(
+            np.ascontiguousarray(np.asarray(a)).tobytes()
+        ).hexdigest()
+
+    # (arm, PHOTON_RE_SPLIT, PHOTON_RE_DEVICE_SPLIT, PHOTON_RE_SPLIT_WEIGHT)
+    arms = (
+        ("off", MULTICHIP_R09_SPLIT, 0, "rows"),
+        ("device", MULTICHIP_R09_SPLIT, 1, "rows"),
+        ("device64", MULTICHIP_R10_SPLIT, 1, "rows"),
+        ("bytes", MULTICHIP_R09_SPLIT, 0, "bytes"),
+    )
+    results: dict[str, dict] = {}
+    for E in MULTICHIP_R08_LADDER:
+        ids, X, y = _multichip_r08_dataset(E)
+        n = len(ids)
+        buckets = bucket_entities(group_by_entity(ids, num_entities=E))
+        for arm, split, dev_split, weight in arms:
+            os.environ["PHOTON_RE_SPLIT"] = str(split)
+            os.environ["PHOTON_RE_DEVICE_SPLIT"] = str(dev_split)
+            os.environ["PHOTON_RE_SPLIT_WEIGHT"] = weight
+            # the deterministic owner map this arm will place by (pure
+            # host arithmetic — same inputs on every process)
+            b2, parents, n_split = split_entity_buckets(
+                buckets, split, weight=re_split_weight()
+            )
+            owners = _plan_bucket_owners(b2, parents, n_split)
+            common = dict(
+                features=DenseFeatures(X=jnp.asarray(X)),
+                labels=y,
+                offsets=np.zeros(n, np.float32),
+                weights=np.ones(n, np.float32),
+                buckets=buckets,
+                num_entities=E,
+                loss=loss,
+                config=OptimizerConfig(max_iterations=4, tolerance=1e-8),
+                l2_weight=1.0,
+                variance_computation=VarianceComputationType.SIMPLE,
+                mesh=mesh,
+            )
+            b0 = counter("re_combine.bytes_sent")
+            l0 = counter("re_solve.launches")
+            t0 = time.perf_counter()
+            res = train_random_effects(**common)
+            W = np.asarray(jax.device_get(res.coefficients), np.float32)
+            V = np.asarray(jax.device_get(res.variances), np.float32)
+            it = np.asarray(res.iterations, np.int64)
+            cold_bytes = counter("re_combine.bytes_sent") - b0
+            cold_launches = counter("re_solve.launches") - l0
+            # warm + prior pass: device placement must carry warm-start
+            # and per-entity prior lanes through the same permutation
+            b1 = counter("re_combine.bytes_sent")
+            res2 = train_random_effects(
+                initial_coefficients=jnp.asarray(W),
+                prior_coefficients=jnp.asarray(W),
+                prior_variances=jnp.asarray(V),
+                **common,
+            )
+            W2 = np.asarray(jax.device_get(res2.coefficients), np.float32)
+            V2 = np.asarray(jax.device_get(res2.variances), np.float32)
+            wall = time.perf_counter() - t0
+            rec = {
+                "wall_s": round(wall, 4),
+                "combine_bytes_sent": cold_bytes,
+                "combine_bytes_sent_prior": (
+                    counter("re_combine.bytes_sent") - b1
+                ),
+                "launches": cold_launches,
+                "owner_sha256": sha(np.asarray(owners, np.int64)),
+                "balance": gauge("re_shard.balance"),
+                "atoms": gauge("re_shard.atoms"),
+                "W_sha256": sha(W),
+                "V_sha256": sha(V),
+                "it_sha256": sha(it),
+                "W_prior_sha256": sha(W2),
+                "V_prior_sha256": sha(V2),
+            }
+            if dev_split:
+                # the second-level placement gauges, set by THIS
+                # process's own device plan during prepare
+                rec["device_balance"] = gauge("re_shard.device_balance")
+                rec["devices"] = gauge("re_shard.devices")
+                rec["device_rows"] = [
+                    gauge(f"re_shard.device_rows.{d}")
+                    for d in range(MULTICHIP_R10_NDEV)
+                ]
+            results[f"E{E}/{arm}"] = rec
+    print("RESULT " + json.dumps({"pid": pid, "results": results}))
+
+
+def run_multichip_r10(
+    out_path: str = "MULTICHIP_r10.json", nproc: int = MULTICHIP_R10_NPROC
+) -> dict:
+    """Drive the device-placement A/B (parent mode) and write
+    MULTICHIP_r10.json. Asserts, in-harness: bitwise-identical model
+    hashes across processes AND across all four arms; the device arm
+    reproducing the off arm's per-process wire bytes exactly (the
+    device level never changes what crosses the process transport);
+    the off arm reproducing the committed MULTICHIP_r09.json split-arm
+    segments wire bytes bit-for-bit; and the acceptance bounds
+    (device balance <= 1.15 at the top rung, bytes-weighted split
+    improving the MAX owner's combine bytes over the rows-weighted
+    off arm)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    raw = _spawn_loopback_workers(
+        lambda coordinator, pid: (
+            ["--multichip-r10-worker", coordinator, str(pid), str(nproc)]
+        ),
+        nproc, "multichip_r10", timeout_s=1800,
+    )
+    per_pid = {pid: r["results"] for pid, r in raw.items()}
+    if set(per_pid) != set(range(nproc)):
+        raise RuntimeError(f"missing worker results: have {sorted(per_pid)}")
+
+    try:
+        with open(os.path.join(here, "MULTICHIP_r09.json")) as f:
+            r09 = json.load(f)
+    except FileNotFoundError:
+        r09 = None
+
+    arm_names = ("off", "device", "device64", "bytes")
+    hash_fields = (
+        "W_sha256", "V_sha256", "it_sha256",
+        "W_prior_sha256", "V_prior_sha256",
+    )
+    rungs: dict[str, dict] = {}
+    gate_metrics: dict[str, float] = {}
+    problems: list[str] = []
+    for E in MULTICHIP_R08_LADDER:
+        rung: dict = {"entities": E,
+                      "rows_total": int(_multichip_r08_sizes(E).sum())}
+        anchor = per_pid[0][f"E{E}/off"]
+        for arm in arm_names:
+            key = f"E{E}/{arm}"
+            bts = [per_pid[p][key]["combine_bytes_sent"]
+                   for p in range(nproc)]
+            bts_prior = [per_pid[p][key]["combine_bytes_sent_prior"]
+                         for p in range(nproc)]
+            for field in hash_fields:
+                vals = {per_pid[p][key][field] for p in range(nproc)}
+                if len(vals) != 1:
+                    problems.append(f"{key}: {field} differs across processes")
+                elif vals != {anchor[field]}:
+                    # split factor, weight axis and device placement are
+                    # schedule-only: every arm must match the off arm
+                    problems.append(f"{key}: {field} != off arm")
+            if len({per_pid[p][key]["owner_sha256"]
+                    for p in range(nproc)}) != 1:
+                problems.append(f"{key}: owner maps differ across processes")
+            arm_rec = {
+                "wall_s_max": max(
+                    per_pid[p][key]["wall_s"] for p in range(nproc)
+                ),
+                "combine_bytes_per_process_mean": sum(bts) / nproc,
+                "combine_bytes_per_process_max": max(bts),
+                "combine_bytes_per_process": {
+                    str(p): bts[p] for p in range(nproc)
+                },
+                "combine_bytes_prior_per_process_max": max(bts_prior),
+                "launches_per_process": {
+                    str(p): per_pid[p][key]["launches"]
+                    for p in range(nproc)
+                },
+                "balance": per_pid[0][key]["balance"],
+                "atoms": per_pid[0][key]["atoms"],
+            }
+            if "device_balance" in per_pid[0][key]:
+                # fleet MAX: each process plans its own owned atoms over
+                # its local devices, the worst host bounds the win
+                arm_rec["device_balance_max"] = max(
+                    per_pid[p][key]["device_balance"] for p in range(nproc)
+                )
+                arm_rec["devices"] = per_pid[0][key]["devices"]
+                arm_rec["device_rows_per_process"] = {
+                    str(p): per_pid[p][key]["device_rows"]
+                    for p in range(nproc)
+                }
+                gate_metrics[f"E{E}/re_shard/device_balance/{arm}"] = float(
+                    arm_rec["device_balance_max"]
+                )
+            rung[arm] = arm_rec
+            gate_metrics[f"E{E}/re_combine/bytes_sent_max/{arm}"] = float(
+                max(bts)
+            )
+            gate_metrics[f"E{E}/re_combine/bytes_sent_mean/{arm}"] = float(
+                sum(bts) / nproc
+            )
+            gate_metrics[f"E{E}/re_shard/balance/{arm}"] = float(
+                per_pid[0][key]["balance"]
+            )
+            gate_metrics[f"E{E}/re_shard/atoms/{arm}"] = float(
+                per_pid[0][key]["atoms"]
+            )
+            gate_metrics[f"E{E}/re_solve/launches/{arm}"] = float(
+                max(per_pid[p][key]["launches"] for p in range(nproc))
+            )
+        # the device level never changes what crosses the process
+        # transport: per-process wire bytes must be EXACTLY the off
+        # arm's (same split factor, same owner map, same owned rows)
+        off_b = rung["off"]["combine_bytes_per_process"]
+        dev_b = rung["device"]["combine_bytes_per_process"]
+        if off_b != dev_b:
+            problems.append(
+                f"E{E}: device arm wire bytes {dev_b} != off arm {off_b}"
+            )
+        # PR-13 reproduction: the off arm's cold-pass segments wire
+        # bytes must be BIT-FOR-BIT the committed r09 split capture's
+        if r09 is not None:
+            want = r09["ladder"][str(E)]["split"][
+                "combine_bytes_per_process"
+            ]
+            if {k: float(v) for k, v in off_b.items()} != {
+                k: float(v) for k, v in want.items()
+            }:
+                problems.append(
+                    f"E{E}: off arm segments bytes {off_b} != committed "
+                    f"MULTICHIP_r09.json split arm {want}"
+                )
+        b_off = rung["off"]["combine_bytes_per_process_max"]
+        b_byt = rung["bytes"]["combine_bytes_per_process_max"]
+        rung["bytes_weight_max_owner_reduction_fraction"] = (
+            1.0 - b_byt / b_off if b_off else 0.0
+        )
+        rungs[str(E)] = rung
+    top = rungs[str(MULTICHIP_R08_LADDER[-1])]
+    dev_balance = top["device64"]["device_balance_max"]
+    byte_gain = top["bytes_weight_max_owner_reduction_fraction"]
+    acceptance = {
+        "bitwise_identical": not problems,
+        "device_balance_at_top_rung": round(dev_balance, 4),
+        "device_balance_le_1_15": dev_balance <= 1.15,
+        "bytes_weight_max_owner_reduction_at_top_rung": round(byte_gain, 4),
+        "required_bytes_weight_reduction": 0.25,
+        "bytes_weight_reduction_ge_required": byte_gain >= 0.25,
+        "device_arm_reproduces_off_wire_bytes": not any(
+            "device arm wire bytes" in p for p in problems
+        ),
+        "off_reproduces_r09_wire_bytes": r09 is not None and not any(
+            "MULTICHIP_r09" in p for p in problems
+        ),
+    }
+    doc = {
+        "round": 10,
+        "what": (
+            "device-granularity placement A/B for entity-sharded "
+            "in-memory random-effect solves under a forced "
+            f"{MULTICHIP_R10_NDEV}-local-device CPU topology: "
+            "PHOTON_RE_DEVICE_SPLIT=0 (the PR-13 single-unit-per-"
+            "process schedule) vs =1 (owned atoms LPT-placed per LOCAL "
+            f"device), at PHOTON_RE_SPLIT={MULTICHIP_R09_SPLIT} and "
+            f"={MULTICHIP_R10_SPLIT}, plus a PHOTON_RE_SPLIT_WEIGHT="
+            "bytes arm (lane-count weighted split+placement), all on "
+            f"the owner-segment combine, {nproc}-process loopback CPU "
+            "harness (gloo collectives)"
+        ),
+        "nproc": nproc,
+        "ndev": MULTICHIP_R10_NDEV,
+        "d": MULTICHIP_R08_D,
+        "split": MULTICHIP_R09_SPLIT,
+        "split_device_arm": MULTICHIP_R10_SPLIT,
+        "ladder": rungs,
+        "acceptance": acceptance,
+        "gate_metrics": gate_metrics,
+        "problems": problems,
+        "note": (
+            "CPU wall at toy scale is dispatch/exchange-latency bound "
+            "(recorded per the BASELINE protocol); the load-bearing "
+            "measurements are (1) re_shard.device_balance — the "
+            "second-level LPT bound over each process's local devices, "
+            "needing the finer split to have enough atoms per process "
+            "— and (2) the bytes-weighted split's MAX-owner combine "
+            "bytes: the r09 capture's known limit (row balance 1.044 "
+            "but max/mean combine bytes ~2.0x — lane-heavy capacity "
+            "classes carry few rows), which the lane-count weight axis "
+            "closes without touching the solve schedule"
+        ),
+    }
+    if problems:
+        raise RuntimeError(
+            f"MULTICHIP_r10: bitwise/reproduction contract violated: "
+            f"{problems}"
+        )
+    with open(os.path.join(here, out_path), "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    _log(
+        f"[bench] MULTICHIP_r10 capture written to {out_path} "
+        f"(device balance {dev_balance:.3f}x vs required 1.15x, "
+        f"bytes-weight max-owner reduction {byte_gain:.1%})"
+    )
+    return doc
+
+
 _BASELINE_BEGIN = "<!-- BEGIN MEASURED (generated by `python bench.py --update-baseline` from BENCH_DETAIL.json; do not hand-edit) -->"
 _BASELINE_END = "<!-- END MEASURED -->"
 
@@ -3005,11 +3426,18 @@ if __name__ == "__main__":
         run_multichip_r09(
             nproc=int(args[1]) if len(args) > 1 else MULTICHIP_R09_NPROC,
         )
+    elif args and args[0] == "--multichip-r10-worker":
+        _multichip_r10_worker(args[1], int(args[2]), int(args[3]))
+    elif args and args[0] == "--multichip-r10":
+        run_multichip_r10(
+            nproc=int(args[1]) if len(args) > 1 else MULTICHIP_R10_NPROC,
+        )
     elif not args:
         main(telemetry_dir=telemetry_dir)
     else:
         _log(f"usage: bench.py [--quick | --update-baseline | "
              f"--config NAME [--quick] | --multichip-r07 [NPROC] | "
-             f"--multichip-r08 [NPROC] | --multichip-r09 [NPROC]] "
+             f"--multichip-r08 [NPROC] | --multichip-r09 [NPROC] | "
+             f"--multichip-r10 [NPROC]] "
              f"[--telemetry-dir DIR]; got {args}")
         sys.exit(2)

@@ -214,6 +214,22 @@ KNOBS: tuple[Knob, ...] = (
         retune_global="REPLAN_IMBALANCE", retune_table="RETUNE_ENV_SHARD",
         sink_key="re_replan_imbalance",
     ),
+    Knob(
+        name="PHOTON_RE_DEVICE_SPLIT", kind="flag", parse="strict_int",
+        default="0", owner="photon_ml_tpu/parallel/placement.py",
+        doc="1 = second-level LPT: owned atoms placed per LOCAL device",
+        accessors=("re_device_split_enabled",),
+        retune_global="RE_DEVICE_SPLIT", retune_table="RETUNE_ENV_SHARD",
+        sink_key="re_device_split",
+    ),
+    Knob(
+        name="PHOTON_RE_SPLIT_WEIGHT", kind="enum", parse="enum",
+        default="rows", owner="photon_ml_tpu/parallel/placement.py",
+        doc="atom split/placement weight axis: rows | bytes",
+        accessors=("re_split_weight",),
+        retune_global="RE_SPLIT_WEIGHT", retune_table="RETUNE_ENV_SHARD",
+        sink_key="re_split_weight",
+    ),
     # -- observability / selection toggles ---------------------------------
     Knob(
         name="PHOTON_RE_ITER_ACCOUNTING", kind="flag", parse="strict_int",

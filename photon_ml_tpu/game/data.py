@@ -395,7 +395,12 @@ def capacity_classes(
     )
 
 
-def _split_runs(weights: np.ndarray, cap: float) -> list[tuple[int, int]]:
+def _split_runs(
+    weights: np.ndarray,
+    cap: float,
+    byte_weights: np.ndarray | None = None,
+    byte_cap: float = 0.0,
+) -> list[tuple[int, int]]:
     """The SHARED sub-bucket split kernel: partition positions
     ``[0, len(weights))`` into contiguous runs whose summed weight stays
     at or under ``cap`` where possible, each run holding at least TWO
@@ -403,6 +408,13 @@ def _split_runs(weights: np.ndarray, cap: float) -> list[tuple[int, int]]:
     batched one — the PR-5 caveat — so a placement atom must never
     force a 1-lane launch the unsplit run would have batched). Returns
     ``(lo, hi)`` half-open ranges covering every position in order.
+
+    ``byte_weights``/``byte_cap`` add the SECOND weight axis
+    (``PHOTON_RE_SPLIT_WEIGHT=bytes``): a run also closes when its
+    summed lane BYTES would exceed ``byte_cap``, so atoms come out
+    bounded on both the compute (rows) and the wire (per-lane segment
+    bytes) axis. ``None`` (the default) keeps the single-axis rule
+    bit-for-bit.
 
     Deterministic pure arithmetic on the weights alone: both split
     sites (``placement_atoms`` for the streamed owner map,
@@ -421,13 +433,19 @@ def _split_runs(weights: np.ndarray, cap: float) -> list[tuple[int, int]]:
     runs: list[tuple[int, int]] = []
     lo = 0
     acc = 0.0
+    acc_b = 0.0
     for i in range(n):
         w = float(weights[i])
-        if i > lo + 1 and acc + w > cap:
+        b = 0.0 if byte_weights is None else float(byte_weights[i])
+        over = acc + w > cap or (
+            byte_weights is not None and acc_b + b > byte_cap
+        )
+        if i > lo + 1 and over:
             runs.append((lo, i))
-            lo, acc = i, w
+            lo, acc, acc_b = i, w, b
         else:
             acc += w
+            acc_b += b
     runs.append((lo, n))
     if len(runs) > 1 and runs[-1][1] - runs[-1][0] < 2:
         # a trailing singleton merges back into its neighbor (the lane
@@ -444,6 +462,7 @@ def placement_atoms(
     target_buckets: int = 8,
     max_padded_ratio: float = 0.5,
     split: int = 0,
+    byte_weights: np.ndarray | None = None,
 ) -> tuple[list[np.ndarray], tuple[int, ...], int]:
     """The sub-bucket placement-atom ladder (``PHOTON_RE_SPLIT``):
     partition the active entities into placement atoms — contiguous
@@ -456,6 +475,11 @@ def placement_atoms(
     ``split <= 0`` returns one atom per used capacity class — exactly
     the bucket-atomic granularity. ``weights`` defaults to the active
     counts (callers that balance TOTAL rows pass those instead).
+    ``byte_weights`` (``PHOTON_RE_SPLIT_WEIGHT=bytes``) adds the lane-
+    byte axis: a class also splits when its summed byte weight exceeds
+    ``sum(byte_weights) / split``, and each run respects both caps —
+    atoms come out bounded in compute AND wire bytes. ``None`` (the
+    default) keeps the single-axis ladder bit-for-bit.
 
     Everything here is deterministic pure-host arithmetic on the GLOBAL
     bincount and the knob value only — the process count never enters —
@@ -469,21 +493,35 @@ def placement_atoms(
             f"placement_atoms: weights length {len(w)} != "
             f"active_counts length {len(counts)}"
         )
+    bw = None if byte_weights is None else np.asarray(byte_weights)
+    if bw is not None and len(bw) != len(counts):
+        raise ValueError(
+            f"placement_atoms: byte_weights length {len(bw)} != "
+            f"active_counts length {len(counts)}"
+        )
     active, slot, caps = _capacity_slots(
         counts, capacities, target_buckets, max_padded_ratio
     )
     if len(active) == 0:
         return [], (), 0
     cap_w = float(w[active].sum()) / split if split > 0 else 0.0
+    cap_b = (
+        float(bw[active].sum()) / split
+        if split > 0 and bw is not None else 0.0
+    )
     atoms: list[np.ndarray] = []
     atom_caps: list[int] = []
     split_classes = 0
     for b in np.flatnonzero(np.bincount(slot, minlength=len(caps))):
         members = active[slot == b]  # ascending entity index
         mw = np.asarray(w[members], np.float64)
+        mb = None if bw is None else np.asarray(bw[members], np.float64)
+        over = split > 0 and (
+            mw.sum() > cap_w or (mb is not None and mb.sum() > cap_b)
+        )
         runs = (
-            _split_runs(mw, cap_w)
-            if split > 0 and mw.sum() > cap_w
+            _split_runs(mw, cap_w, byte_weights=mb, byte_cap=cap_b)
+            if over
             else [(0, len(members))]
         )
         if len(runs) > 1:
@@ -495,7 +533,7 @@ def placement_atoms(
 
 
 def split_entity_buckets(
-    buckets: EntityBuckets, split: int
+    buckets: EntityBuckets, split: int, weight: str = "rows"
 ) -> tuple[EntityBuckets, tuple[int, ...] | None, int]:
     """Apply the ``PHOTON_RE_SPLIT`` rule to an already-built
     ``EntityBuckets`` (the in-memory owned-bucket path): each bucket
@@ -509,15 +547,31 @@ def split_entity_buckets(
     is output bucket ``b``'s index in the INPUT bucket list, or
     ``None`` in place of the whole tuple when nothing split (``split <=
     0`` or no bucket over the cap) — callers key the knob-off
-    bit-for-bit path on that."""
+    bit-for-bit path on that.
+
+    ``weight="bytes"`` (``PHOTON_RE_SPLIT_WEIGHT``) adds the lane-byte
+    axis: each LANE carries one combine segment row (coefficients +
+    variances + diag) regardless of its row count, so the byte weight
+    is 1 per lane and a bucket also splits when its lane count exceeds
+    ``total_lanes / split`` — bounding the per-atom wire bytes the
+    row-weighted rule leaves unbounded on a Zipf tail class."""
     if split <= 0 or not buckets.entity_ids:
         return buckets, None, 0
+    if weight not in ("rows", "bytes"):
+        raise ValueError(
+            f"split_entity_buckets: unknown weight axis {weight!r}"
+        )
     per_bucket_w = [
         np.asarray((rows >= 0).sum(axis=1), np.float64)
         for rows in buckets.row_indices
     ]
     total = float(sum(w.sum() for w in per_bucket_w))
     cap_w = total / split
+    by_bytes = weight == "bytes"
+    cap_b = 0.0
+    if by_bytes:
+        total_lanes = float(sum(len(w) for w in per_bucket_w))
+        cap_b = total_lanes / split
     ent_out: list[np.ndarray] = []
     row_out: list[np.ndarray] = []
     caps_out: list[int] = []
@@ -526,9 +580,13 @@ def split_entity_buckets(
     for b, (ents, rows, w) in enumerate(
         zip(buckets.entity_ids, buckets.row_indices, per_bucket_w)
     ):
+        bw = np.ones(len(w), np.float64) if by_bytes else None
+        over = float(w.sum()) > cap_w or (
+            by_bytes and float(len(w)) > cap_b
+        )
         runs = (
-            _split_runs(w, cap_w)
-            if float(w.sum()) > cap_w
+            _split_runs(w, cap_w, byte_weights=bw, byte_cap=cap_b)
+            if over
             else [(0, len(ents))]
         )
         if len(runs) > 1:

@@ -325,6 +325,14 @@ class PreparedBucket:
     # (``_parent_units``) so the launch geometry the unsplit run used
     # is restored wherever co-ownership allows.
     parent: int | None = None
+    # owning LOCAL DEVICE ordinal under device-granularity placement
+    # (PHOTON_RE_DEVICE_SPLIT=1, the second LPT level): this bucket's
+    # staged tensors are committed to jax.local_devices()[device], its
+    # solves thread through that device's (E, d) coefficient copy, and
+    # a device-local combine folds its rows back before the process
+    # combine. None = the single-unit-per-process schedule (knob off,
+    # single-device host, or a bucket owned elsewhere).
+    device: int | None = None
 
 
 def prepare_buckets(
@@ -359,6 +367,7 @@ def prepare_buckets(
     from photon_ml_tpu.parallel.placement import (
         re_shard_enabled,
         re_split_factor,
+        re_split_weight,
     )
 
     owned_prep = mesh is not None and re_shard_enabled()
@@ -376,14 +385,19 @@ def prepare_buckets(
     # below can spread the Zipf tail class across owners instead of
     # pinning it whole on one. parents is None on an unsplit prep —
     # the knob-off path is bit-for-bit the pre-split code.
-    owners = parents = None
+    owners = parents = devices = None
     if owned_prep:
         from photon_ml_tpu.game.data import split_entity_buckets
 
         buckets, parents, n_split = split_entity_buckets(
-            buckets, re_split_factor()
+            buckets, re_split_factor(), weight=re_split_weight()
         )
         owners = _plan_bucket_owners(buckets, parents, n_split)
+        # second placement level (PHOTON_RE_DEVICE_SPLIT): this
+        # process's owned buckets onto its LOCAL devices — None when
+        # the knob is off or the host has one device (the knob-off
+        # staging below is then bit-for-bit the single-level prep)
+        devices = _plan_bucket_devices(buckets, parents, owners)
     # EFFECTIVE identity, not jax's: after an in-place descent degrade
     # the owners above were planned over the survivor group, and this
     # process dispatches under its survivor rank (identical to the jax
@@ -450,14 +464,29 @@ def prepare_buckets(
             mask = jax.device_put(mask, sharding)
             if columns is not None:
                 columns = jax.device_put(columns, sharding)
+        ids = jnp.asarray(ent_ids, jnp.int32)
+        dev = None
+        if devices is not None and int(devices[bi]) >= 0:
+            # device-granularity staging: commit this owned bucket's
+            # tensors to its assigned LOCAL device, so its solves (and
+            # their donated (E, d) coefficient copy) run there — the
+            # knob-off path never commits, keeping default placement
+            dev = int(devices[bi])
+            target = jax.local_devices()[dev]
+            put = lambda a: jax.device_put(a, target)
+            static = jax.tree.map(put, static)
+            idx, mask, ids = put(idx), put(mask), put(ids)
+            if columns is not None:
+                columns = put(columns)
         prepared.append(
             PreparedBucket(
                 entity_ids=ent_ids,
-                ids=jnp.asarray(ent_ids, jnp.int32),
+                ids=ids,
                 static=static, row_idx=idx, mask=mask,
                 num_real=k, columns=columns,
                 owner=None if owners is None else int(owners[bi]),
                 parent=parent,
+                device=dev,
             )
         )
     return prepared
@@ -491,6 +520,7 @@ def _plan_bucket_owners(
     )
     from photon_ml_tpu.parallel.placement import (
         plan_shard_placement,
+        re_split_weight,
         record_placement_metrics,
     )
 
@@ -498,9 +528,15 @@ def _plan_bucket_owners(
     # degrade, the jax runtime's processes otherwise (identical then)
     P_ = effective_process_count()
     lanes = [len(e) for e in buckets.entity_ids]
-    rows = [
-        int(np.sum(np.asarray(r) >= 0)) for r in buckets.row_indices
-    ]
+    # PHOTON_RE_SPLIT_WEIGHT selects the balance axis: active rows
+    # (default — solve compute) or lane count (combine wire bytes: one
+    # segment row per lane regardless of its row count)
+    if re_split_weight() == "bytes":
+        rows = [float(k) for k in lanes]
+    else:
+        rows = [
+            int(np.sum(np.asarray(r) >= 0)) for r in buckets.row_indices
+        ]
     if parents is None:
         keys = [int(r.shape[1]) for r in buckets.row_indices]
         groups = [idxs for idxs, _ in plan_fusion_groups(keys, lanes)]
@@ -514,6 +550,55 @@ def _plan_bucket_owners(
         split_classes=split_classes,
     )
     return plan.owner
+
+
+def _plan_bucket_devices(
+    buckets: EntityBuckets,
+    parents: tuple[int, ...] | None,
+    owners: np.ndarray,
+) -> np.ndarray | None:
+    """The SECOND placement level (``PHOTON_RE_DEVICE_SPLIT``): assign
+    THIS process's owned buckets to its local devices with the same
+    deterministic LPT rule and the same atomicity contract as the
+    process level — fusion groups stay on one device on an unsplit
+    prep (so same-device launch fusion reproduces the single-device
+    launch geometry exactly) and sub-bucket atoms place independently
+    on a split prep (``_parent_units`` re-concatenates per owner AND
+    device; every atom is >= 2 lanes, so the lane-count-invariance
+    that makes partial co-ownership bitwise covers partial
+    co-residency too). Returns local-device ordinals (-1 for buckets
+    owned elsewhere), or ``None`` when the knob is off or the host has
+    a single local device — the knob-off prep is then bit-for-bit."""
+    from photon_ml_tpu.parallel.multihost import effective_process_index
+    from photon_ml_tpu.parallel.placement import (
+        plan_device_placement,
+        re_device_split_enabled,
+        re_split_weight,
+        record_device_placement_metrics,
+    )
+
+    if not re_device_split_enabled():
+        return None
+    n_dev = jax.local_device_count()
+    if n_dev < 2:
+        return None
+    lanes = [len(e) for e in buckets.entity_ids]
+    if re_split_weight() == "bytes":
+        rows = [float(k) for k in lanes]
+    else:
+        rows = [
+            int(np.sum(np.asarray(r) >= 0)) for r in buckets.row_indices
+        ]
+    if parents is None:
+        keys = [int(r.shape[1]) for r in buckets.row_indices]
+        groups = [idxs for idxs, _ in plan_fusion_groups(keys, lanes)]
+    else:
+        groups = None  # every sub-bucket atom is its own placement unit
+    device, plan = plan_device_placement(
+        rows, owners, effective_process_index(), n_dev, groups=groups
+    )
+    record_device_placement_metrics(plan)
+    return device
 
 
 @partial(
@@ -955,8 +1040,16 @@ def _fusion_units(
         [
             # remotely-owned buckets carry no staged tensors (and are
             # never dispatched here) — a unique key keeps each one a
-            # passthrough solo unit instead of touching pb.static
-            ("__remote__", i) if pb.static is None else _bucket_geometry(pb)
+            # passthrough solo unit instead of touching pb.static.
+            # Device-granularity placement folds the device into the
+            # key so only co-resident buckets concatenate (committed
+            # tensors cannot mix devices); device placement is
+            # fusion-group-atomic, so on an unsplit prep the device
+            # key never changes which groups form — only where they run
+            ("__remote__", i) if pb.static is None else (
+                _bucket_geometry(pb) if pb.device is None
+                else (_bucket_geometry(pb), pb.device)
+            )
             for i, pb in enumerate(prepared)
         ],
     )
@@ -977,9 +1070,17 @@ def _parent_units(
     return _concat_units(
         prepared,
         [
+            # the device joins the parent key under device-granularity
+            # placement: same-parent atoms re-concatenate per (owner,
+            # device) — each atom is >= 2 lanes, so the partial-
+            # co-residency launch is covered by the same lane-count
+            # invariance partial co-ownership already rests on
             ("__remote__", i) if pb.static is None
             else (
-                ("__parent__", pb.parent) if pb.parent is not None
+                (
+                    ("__parent__", pb.parent) if pb.device is None
+                    else ("__parent__", pb.parent, pb.device)
+                ) if pb.parent is not None
                 else ("__own_solo__", i)
             )
             for i, pb in enumerate(prepared)
@@ -1016,8 +1117,11 @@ def _concat_units(
             # atomic on unsplit preps, and on split preps only LOCALLY
             # staged buckets (owner == this process) ever group —
             # remote ones key solo above — so the unit inherits it
+            # (and its device: both unit keys fold the device in, so
+            # members are co-resident by construction)
             owner=prepared[idxs[0]].owner,
             parent=prepared[idxs[0]].parent,
+            device=prepared[idxs[0]].device,
         )
         units.append((fused, members))
     return units
@@ -1251,16 +1355,56 @@ def _train_prepared_core(
         own_pid = effective_process_index()
     else:
         own_pid = 0
+    # device-granularity dispatch (PHOTON_RE_DEVICE_SPLIT): each local
+    # device threads its OWN full (E, d) coefficient/variance copy —
+    # committed inputs cannot mix devices, and a full device_put copy
+    # carries the warm-start rows bitwise — so each device's queued
+    # launches execute asynchronously while the host loop races ahead.
+    # The device-local combine below folds the owned rows back into the
+    # canonical matrix (permutation-only row copies, bit-preserving)
+    # before the unchanged process-level combine. Knob off: no bucket
+    # carries a device and this whole block is inert.
+    dev_state: dict[int, dict] = {}
+    if eager and any(pb.device is not None for pb in prepared):
+        local_devs = jax.local_devices()
+        for dv in sorted(
+            {pb.device for pb in prepared if pb.device is not None}
+        ):
+            target = local_devs[dv]
+
+            def put(a, _t=target):
+                if a is None:
+                    return None
+                # force a DISTINCT buffer: device_put is a no-op when
+                # the canonical array already lives on this device, and
+                # the solver DONATES its W/V operands — donating an
+                # alias of the canonical matrix would delete it out
+                # from under the device-local combine below
+                return jax.device_put(jnp.copy(jnp.asarray(a)), _t)
+
+            dev_state[dv] = {
+                "W": put(W), "V": put(V), "offsets": put(offsets),
+                "prior_mu": put(prior_mu), "prior_var": put(prior_var),
+            }
     for pb, members in units:
         if owned_mode and pb.owner is not None and pb.owner != own_pid:
             # another process owns this whole unit — its results arrive
             # through the combine below; nothing is dispatched here
             continue
+        st = dev_state.get(pb.device) if pb.device is not None else None
+        if st is not None:
+            W_in, V_in = st["W"], st["V"]
+            off_in = st["offsets"]
+            mu_in, pv_in = st["prior_mu"], st["prior_var"]
+        else:
+            W_in, V_in, off_in, mu_in, pv_in = (
+                W, V, offsets, prior_mu, prior_var
+            )
         if chunked is not None:
-            W, V, f_k, it_k, reason_k = _bucket_step_compacted(
-                W,
-                V,
-                offsets,
+            W_out, V_out, f_k, it_k, reason_k = _bucket_step_compacted(
+                W_in,
+                V_in,
+                off_in,
                 pb.static,
                 pb.row_idx,
                 pb.mask,
@@ -1268,8 +1412,8 @@ def _train_prepared_core(
                 pb.columns,
                 l2,
                 norm,
-                prior_mu,
-                prior_var,
+                mu_in,
+                pv_in,
                 chunked=chunked,
                 loss=loss,
                 config=config,
@@ -1280,12 +1424,12 @@ def _train_prepared_core(
                 **extra,
             )
         else:
-            W, V, f_k, it_k, reason_k = _captured_jit_call(
+            W_out, V_out, f_k, it_k, reason_k = _captured_jit_call(
                 "re_solve.bucket_step",
                 _bucket_step,
-                W,
-                V,
-                offsets,
+                W_in,
+                V_in,
+                off_in,
                 pb.static,
                 pb.row_idx,
                 pb.mask,
@@ -1293,8 +1437,8 @@ def _train_prepared_core(
                 pb.columns,
                 l2,
                 norm,
-                prior_mu,
-                prior_var,
+                mu_in,
+                pv_in,
                 minimize_fn=minimize_fn,
                 loss=loss,
                 config=config,
@@ -1309,6 +1453,10 @@ def _train_prepared_core(
                 # invariant (the donate comment on _bucket_step) must
                 # survive an active telemetry sink
                 accounting.add(it_k, lanes=int(pb.static.labels.shape[0]))
+        if st is not None:
+            st["W"], st["V"] = W_out, V_out
+        else:
+            W, V = W_out, V_out
         total = pb.num_real
         for orig_i, lo, hi in members:
             if lo == 0 and hi == total:
@@ -1317,6 +1465,12 @@ def _train_prepared_core(
                 diag[orig_i] = (f_k[lo:hi], it_k[lo:hi], reason_k[lo:hi])
 
     accounting.flush()  # one batched readback, after every bucket enqueued
+    if dev_state:
+        # device-local combine: fold each device's threaded copy back
+        # into the canonical matrix BEFORE the process-level transport
+        # (which then runs unchanged — it reads exactly the rows this
+        # process owns, wherever they solved)
+        W, V = _combine_device_local(prepared, W, V, dev_state, own_pid)
     if owned_mode:
         from photon_ml_tpu.parallel.multihost import effective_process_count
 
@@ -1331,6 +1485,46 @@ def _train_prepared_core(
             V = norm.factors**2 * V
 
     return W, V, diag
+
+
+def _combine_device_local(
+    prepared: list[PreparedBucket],
+    W: Array,
+    V: Array | None,
+    dev_state: dict[int, dict],
+    own_pid: int,
+) -> tuple[Array, Array | None]:
+    """Intra-host combine for the device-split schedule: each local
+    device threaded its own full (E, d) copy, so every owned bucket's
+    coefficient/variance rows live on exactly one device and fold back
+    into the canonical matrix by PERMUTATION-ONLY row copies (entity
+    ids partition across buckets — disjoint rows, any order, bitwise).
+    Host numpy on device_get'd arrays, the same transport discipline as
+    ``_combine_owned_allreduce``; per-bucket diagnostics stay on their
+    devices (readers device_get them lazily, wherever they live)."""
+    W_h = np.array(jax.device_get(W))  # writable copy: the owned-row
+    V_h = None if V is None else np.array(jax.device_get(V))  # folds below
+    got: dict[int, tuple[np.ndarray, np.ndarray | None]] = {
+        dv: (
+            np.asarray(jax.device_get(st["W"])),
+            None if st["V"] is None
+            else np.asarray(jax.device_get(st["V"])),
+        )
+        for dv, st in dev_state.items()
+    }
+    for pb in prepared:
+        if pb.device is None or (
+            pb.owner is not None and pb.owner != own_pid
+        ):
+            continue
+        Wd, Vd = got[pb.device]
+        W_h[pb.entity_ids] = Wd[pb.entity_ids]
+        if V_h is not None and Vd is not None:
+            V_h[pb.entity_ids] = Vd[pb.entity_ids]
+    return (
+        jnp.asarray(W_h),
+        None if V_h is None else jnp.asarray(V_h),
+    )
 
 
 def _emit_re_event(event: str, **payload) -> None:

@@ -306,6 +306,15 @@ class _ReShard:
     # lowering — the one the single-process run used for that entity
     # (batch-1 lowering is not bitwise-stable against it; PR-5 caveat).
     lane_floor_pad: tuple | None = None
+    # device-granularity placement (PHOTON_RE_DEVICE_SPLIT=1): each
+    # LOCAL bucket's assigned local-device ordinal — the second LPT
+    # level over this process's owned buckets, fusion-group-atomic so
+    # same-device launch fusion reproduces the single-device launch
+    # geometry. Recomputed on every shard (re)build, so a degrade/
+    # re-plan re-derives it from the surviving topology. None = the
+    # single-unit-per-process schedule bit-for-bit (knob off or a
+    # single local device).
+    bucket_device: tuple[int, ...] | None = None
 
 
 def _offsets_payload(shard: _ReShard, offs_local: np.ndarray, row_base: int):
@@ -910,6 +919,59 @@ class StreamedGameTrainer:
                     )
                 )
             subspace_cols = tuple(cols_list)
+        # second placement level (PHOTON_RE_DEVICE_SPLIT): this
+        # process's LOCAL buckets onto its local devices, fusion-group-
+        # atomic (same keys the launch grouping in _solve_re_buckets
+        # uses, so every fusable set stays co-resident and the launch
+        # geometry is exactly the single-device schedule's). Recomputed
+        # on every shard (re)build — a degrade or re-plan re-derives it
+        # from the surviving topology with no extra state. Training
+        # shards only: validation shards never solve.
+        bucket_device = None
+        if not drop_unseen:
+            from photon_ml_tpu.parallel.placement import (
+                plan_device_placement,
+                re_device_split_enabled,
+                re_split_weight,
+                record_device_placement_metrics,
+            )
+
+            n_ldev = jax.local_device_count()
+            if re_device_split_enabled() and n_ldev > 1:
+                from photon_ml_tpu.game.random_effect import (
+                    plan_fusion_groups,
+                )
+
+                lanes = [len(e) for e in buckets.entity_ids]
+                if re_split_weight() == "bytes":
+                    wts = [float(k) for k in lanes]
+                else:
+                    wts = [
+                        float((rows >= 0).sum())
+                        for rows in buckets.row_indices
+                    ]
+                sub_cols_l = subspace_cols or (None,) * len(lanes)
+                keys = [
+                    (
+                        int(rows.shape[1]),
+                        None if cols is None else int(cols.shape[1]),
+                    )
+                    for rows, cols in zip(
+                        buckets.row_indices, sub_cols_l
+                    )
+                ]
+                groups = [
+                    idxs for idxs, _ in plan_fusion_groups(keys, lanes)
+                ]
+                device, dplan = plan_device_placement(
+                    wts,
+                    np.zeros(len(lanes), np.int64),
+                    0,
+                    n_ldev,
+                    groups=groups,
+                )
+                record_device_placement_metrics(dplan)
+                bucket_device = tuple(int(d) for d in device)
         return _ReShard(
             ent_local=ent_local,
             labels=labels,
@@ -934,6 +996,7 @@ class StreamedGameTrainer:
             entity_rows=counts_g,
             lane_floor_pad=lane_pad,
             placement_atoms=atoms,
+            bucket_device=bucket_device,
         )
 
     def _offsets_to_owners(
@@ -1353,17 +1416,28 @@ class StreamedGameTrainer:
         # classic schedule bit-for-bit. Lane-floor-padded buckets are
         # always 1-real-lane, which plan_fusion_groups keeps solo.
         units: list[tuple[list[tuple[int, int, int]], tuple]] = []
+        bdevs = shard.bucket_device
         if _re_fuse_buckets() and len(bucket_args) > 1:
             from photon_ml_tpu.game.random_effect import plan_fusion_groups
 
+            fusion_keys = [
+                (
+                    rows_i.shape[1],
+                    None if cols_i is None else cols_i.shape[1],
+                )
+                for _, rows_i, cols_i in bucket_args
+            ]
+            if bdevs is not None:
+                # device-granularity placement: only co-resident
+                # buckets concatenate (committed tensors cannot mix
+                # devices). The device plan is fusion-group-atomic, so
+                # the key addition never changes which groups form —
+                # only which device runs them.
+                fusion_keys = [
+                    (k, bdevs[i]) for i, k in enumerate(fusion_keys)
+                ]
             plan = plan_fusion_groups(
-                [
-                    (
-                        rows_i.shape[1],
-                        None if cols_i is None else cols_i.shape[1],
-                    )
-                    for _, rows_i, cols_i in bucket_args
-                ],
+                fusion_keys,
                 [len(ent) for ent, _, _ in bucket_args],
             )
             for idxs, members in plan:
@@ -1397,6 +1471,17 @@ class StreamedGameTrainer:
             lambda: offs_re
         )
 
+        # device-granularity dispatch (PHOTON_RE_DEVICE_SPLIT): each
+        # launch unit runs on its buckets' assigned local device — the
+        # gathered batch and the per-unit w0/prior rows are committed
+        # there, so the per-device queues drain asynchronously while
+        # the host loop races ahead. None = the default-device
+        # schedule bit-for-bit (no device_put anywhere on the path).
+        unit_device = None
+        if bdevs is not None:
+            unit_device = [bdevs[members[0][0]] for members, _ in units]
+            local_devs = jax.local_devices()
+
         def gather(i):
             # bucket INGEST (host row gather + padding + upload) for bucket
             # i+k runs on prefetch workers while bucket i's device solve is
@@ -1405,10 +1490,16 @@ class StreamedGameTrainer:
             # collect() below writes — so preparation order is free while
             # solve/collect order (and thus every result) stays identical
             _, rows_i, cols_i = units[i][1]
-            return gather_bucket(
+            b = gather_bucket(
                 shard.features, shard.labels, _offs(), shard.weights,
                 rows_i, columns=cols_i,
             )
+            if unit_device is not None:
+                target = local_devs[unit_device[i]]
+                b = jax.tree.map(
+                    lambda a: jax.device_put(a, target), b
+                )
+            return b
 
         for i, bucket in enumerate(
             prefetch.prefetch_iter(len(units), gather)
@@ -1465,6 +1556,17 @@ class StreamedGameTrainer:
             w0 = jnp.asarray(w0_rows, jnp.float32)
             if norm is not None:
                 w0 = jax.vmap(norm.model_from_original_space)(w0)
+            if unit_device is not None:
+                # co-commit the per-unit inputs with the gathered batch
+                # — a committed-device mismatch is an error, and an
+                # uncommitted w0 would pull the solve to the default
+                # device
+                target = local_devs[unit_device[i]]
+                w0 = jax.device_put(w0, target)
+                if prior_mu is not None:
+                    prior_mu = jax.device_put(prior_mu, target)
+                if prior_var is not None:
+                    prior_var = jax.device_put(prior_var, target)
             out = solve_bucket_lanes(
                 bucket,
                 w0,

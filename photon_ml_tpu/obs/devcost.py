@@ -154,7 +154,8 @@ def _knob_raw_state() -> tuple:
         shard_state = (
             None if pl_mod is None
             else (pl_mod.RE_SHARD, pl_mod.RE_SPLIT,
-                  pl_mod.REPLAN_IMBALANCE)
+                  pl_mod.REPLAN_IMBALANCE, pl_mod.RE_DEVICE_SPLIT,
+                  pl_mod.RE_SPLIT_WEIGHT)
         )
     except Exception:
         shard_state = None
@@ -168,6 +169,8 @@ def _knob_raw_state() -> tuple:
         env.get("PHOTON_RE_SHARD"),
         env.get("PHOTON_RE_SPLIT"),
         env.get("PHOTON_RE_REPLAN_IMBALANCE"),
+        env.get("PHOTON_RE_DEVICE_SPLIT"),
+        env.get("PHOTON_RE_SPLIT_WEIGHT"),
         pf.PREFETCH_DEPTH, pf.CHUNK_CACHE_BUDGET,
         len(pf._device_budget_memo),
         st.GROUPS_PER_STEP, st.SEGMENTS_PER_DMA,

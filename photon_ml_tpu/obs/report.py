@@ -484,6 +484,14 @@ def format_summary(s: dict) -> str:
                 if overlap is not None else ""
             )
         )
+        # second placement level (PHOTON_RE_DEVICE_SPLIT): this
+        # process's owned atoms spread over its LOCAL devices
+        dbal = rsh.get("device_balance")
+        if dbal is not None:
+            lines.append(
+                f"  re-shard devices: {int(rsh.get('devices') or 0)} local, "
+                f"device balance {dbal:.3f}x"
+            )
     rc = s.get("re_combine") or {}
     if rc.get("exchanges"):
         seg = (
@@ -1131,6 +1139,29 @@ def format_fleet(fs: dict) -> str:
                 if fatoms is not None else ""
             )
         )
+    # second placement level: per-device rows. Unlike the process-level
+    # gauges (identical everywhere — deterministic planner on replicated
+    # inputs), device loads are PROCESS-LOCAL: each process plans its
+    # OWN owned atoms over its OWN local devices. So the table is
+    # device x process, same column order as the phase table above.
+    dbal = _re_shard_fleet_max(fs, "device_balance")
+    if dbal is not None:
+        ndev = int(_re_shard_fleet_max(fs, "devices") or 0)
+        lines.append(
+            f"  re-shard devices: {ndev}/process, "
+            f"device balance {dbal:.3f}x (fleet max)"
+        )
+        for d in range(ndev):
+            vals = []
+            for c in cols:
+                v = (fs["processes"][c].get("re_shard") or {}).get(
+                    f"device_rows.{d}"
+                )
+                vals.append("-" if v is None else f"{v:.0f}")
+            lines.append(
+                f"  {'device ' + str(d):<16}"
+                + "".join(f" {v:>9}" for v in vals)
+            )
 
     if fs.get("overlap") or fs.get("exchange"):
         parts = []
@@ -1314,6 +1345,13 @@ DEFAULT_GATE_THRESHOLDS: dict[str, dict] = {
     # excuse to drift than the whole-class plan — tight tier
     "re_shard/atoms": {"rel": 0.0, "abs": 0.0},
     "re_shard/balance_split": {"rel": 0.02},
+    # device-granularity placement tiers (PHOTON_RE_DEVICE_SPLIT runs
+    # only): the per-device LPT is deterministic on the owned-atom
+    # weights, so the balance gates tight like balance_split; the
+    # launch schedule is exact deterministic fusion-unit arithmetic —
+    # one extra launch is a schedule regression, not noise
+    "re_shard/device_balance": {"rel": 0.02},
+    "re_solve/launches": {"rel": 0.0, "abs": 0.0},
     # combine-traffic tier: bytes per process are deterministic for a
     # given combine mode + placement, so near-tight — a 5% creep is a
     # packing/layout regression, and a mode accidentally falling back
@@ -1420,7 +1458,8 @@ def gate_metrics_from_summary(s: dict) -> dict[str, float]:
             m[f"devcost/{lab}/peak_bytes"] = float(agg["peak_bytes"])
     rsh = s.get("re_shard") or {}
     for k, v in rsh.items():
-        if k in ("balance", "rows_max", "exchange_overlap_ratio"):
+        if k in ("balance", "rows_max", "exchange_overlap_ratio",
+                 "device_balance"):
             m[f"re_shard/{k}"] = float(v)
     if float(rsh.get("split_classes") or 0) > 0:
         # sub-bucket placement (PHOTON_RE_SPLIT) ran: gate the atom
@@ -1475,6 +1514,7 @@ def gate_metrics_from_bench(doc: dict) -> dict[str, float]:
                 "re_shard.rows_max",
                 "re_shard.round_robin_balance",
                 "re_shard.exchange_overlap_ratio",
+                "re_shard.device_balance",
             ):
                 m[f"{cfg}/re_shard/{g[len('re_shard.'):]}"] = float(v)
         gauges = tmetrics.get("gauges") or {}
@@ -1561,6 +1601,12 @@ def gate_metrics_from_fleet(fs: dict) -> dict[str, float]:
         v = _re_shard_fleet_max(fs, name)
         if v is not None:
             m[f"re_shard/{name}"] = v
+    # device-level sub-plan: loads are process-LOCAL, so the gateable
+    # scalar is the fleet MAX of the per-process intra-host balance
+    # (the worst host is the one a placement regression hides in)
+    v = _re_shard_fleet_max(fs, "device_balance")
+    if v is not None:
+        m["re_shard/device_balance"] = v
     if (_re_shard_fleet_max(fs, "split_classes") or 0) > 0:
         # split-granularity tier, fleet-wide (mirrors the per-run gate)
         m["re_shard/atoms"] = float(_re_shard_fleet_max(fs, "atoms") or 0)

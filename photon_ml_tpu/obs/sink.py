@@ -366,6 +366,10 @@ def _knob_snapshot() -> dict:
         knobs["re_replan_imbalance"] = float(
             placement.replan_imbalance_threshold()
         )
+        knobs["re_device_split"] = int(
+            bool(placement.re_device_split_enabled())
+        )
+        knobs["re_split_weight"] = str(placement.re_split_weight())
     except Exception:
         pass
     return knobs

@@ -771,12 +771,13 @@ def _tile_kernel(
     static_argnames=(
         "out_pad", "src_pad", "square_vals",
         "groups", "segs", "run_groups", "seg_batched", "pipeline",
-        "storage", "interpret",
+        "storage", "interpret", "topology",
     ),
 )
 def _tiled_apply_jit(
     layout_arrays, src, out_pad, src_pad, square_vals,
     groups, segs, run_groups, seg_batched, pipeline, storage, interpret,
+    topology=None,
 ):
     packed, wslab, rslab, rrun, srun = layout_arrays
     step_groups = segs * groups
@@ -869,10 +870,16 @@ def _tiled_apply(layout_arrays, src, out_pad, src_pad, square_vals=False):
     kernel executable's XLA flops/bytes once — calls under an outer
     trace (the optimizer/scoring jits) skip, and THAT enclosing
     executable is captured at its own boundary instead."""
+    from photon_ml_tpu.parallel.multihost import effective_topology
+
     args = (
         layout_arrays, src, out_pad, src_pad, square_vals,
         GROUPS_PER_STEP, SEGMENTS_PER_DMA, GROUPS_PER_RUN, SEGMENT_BATCHED,
         bool(PIPELINE_SEGMENTS), kernel_dtype(), _interpret(),
+        # effective topology rides as a static key: a degrade-in-place
+        # must never re-enter a pre-loss executable by shape coincidence,
+        # and a same-topology re-entry compiles nothing new
+        effective_topology(),
     )
     from photon_ml_tpu.obs import devcost
 

@@ -85,7 +85,19 @@ def tuned_constants() -> tuple:
         # bf16 i16x3 / int8 i32x1 + scales): a stale hit across a toggle
         # would hand the kernel streams of the wrong width
         st.kernel_dtype(),
+        # effective device topology: a degrade-in-place shrinks the
+        # group without restarting the process, and entries whose
+        # device-resident streams predate the loss must miss by key —
+        # while a same-topology re-entry hits everything it already
+        # packed (the cheap-abort zero-growth contract)
+        _effective_topology(),
     )
+
+
+def _effective_topology() -> tuple:
+    from photon_ml_tpu.parallel.multihost import effective_topology
+
+    return effective_topology()
 
 
 def structure_fingerprint(indices, values) -> tuple:

@@ -1349,6 +1349,25 @@ def effective_process_index() -> int:
     return jax.process_index()
 
 
+def effective_topology() -> tuple:
+    """The EFFECTIVE device topology this process computes under, as a
+    hashable cache-key component: ``(backend, local device count,
+    effective process count)``. The executable caches (``_tiled_apply``'s
+    jit statics, the tile-layout cache's tuned-constants key) carry this
+    so a degrade-in-place — which changes the effective group without
+    restarting the process — can never re-enter an executable compiled
+    for the pre-loss topology by shape coincidence, while a SAME-topology
+    re-entry (the cheap-abort restart at survivor count, or plain
+    repeated visits) hits every cache it already filled: zero growth,
+    zero recompiles. Read at CALL time, the same discipline as every
+    tuned constant."""
+    return (
+        jax.default_backend(),
+        len(jax.local_devices()),
+        effective_process_count(),
+    )
+
+
 def set_degraded_group(survivors) -> None:
     """Shrink this process's world to ``survivors`` (sorted original
     process indices; must include this process). Tears the socket mesh

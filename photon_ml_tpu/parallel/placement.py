@@ -58,6 +58,35 @@ RE_SHARD = 0
 # every fleet knob it must be set identically on all processes.
 RE_SPLIT = 0
 
+# Device-granularity placement (PHOTON_RE_DEVICE_SPLIT): 0 (default)
+# keeps the single-unit-per-process schedule bit-for-bit — a process
+# solves all of its owned buckets on its default device, exactly the
+# PR-13/PR-15 dispatch. 1 adds a SECOND LPT level: each process's owned
+# placement atoms are assigned to its LOCAL devices
+# (``plan_device_placement``), the consumers stage each owned bucket on
+# its assigned device, and same-device buckets keep fusing through the
+# existing permutation bookkeeping (device placement is fusion-group /
+# atom atomic, so launch geometry — and bits — are preserved vs the
+# single-device schedule; devices execute their queued launches
+# asynchronously, which is the intra-host win). Results re-enter the
+# canonical matrix through a device-local combine (permutation-only row
+# copies) BEFORE the process-level PHOTON_RE_COMBINE transport, which
+# is unchanged. Like every fleet knob it must be set identically on all
+# processes.
+RE_DEVICE_SPLIT = 0
+
+# Placement weight axis (PHOTON_RE_SPLIT_WEIGHT): "rows" (default)
+# balances Σ per-entity ROWS — the solve-compute axis, bit-for-bit the
+# PR-13 rule. "bytes" balances per-lane WIRE bytes instead (each active
+# entity contributes one coefficient/variance/diag segment row to the
+# combine, so lane bytes are proportional to LANE COUNT, not row
+# count), and the split rule caps BOTH axes so atoms stay bounded in
+# compute too. The r09 capture shows why the axis exists: row-balanced
+# placement reached 1.04x row balance while the MAX owner's combine
+# bytes ran ~2x the mean (the Zipf tail's many tiny entities all carry
+# the same per-lane segment cost no matter how few rows they have).
+RE_SPLIT_WEIGHT = "rows"
+
 # Telemetry-driven re-planning (PHOTON_RE_REPLAN_IMBALANCE): when the
 # MEASURED per-process random-effect solve wall of a descent iteration
 # is more imbalanced than this max/mean ratio, the streamed trainer
@@ -88,6 +117,36 @@ def re_split_factor() -> int:
     env = os.environ.get("PHOTON_RE_SPLIT")
     raw = env if (env is not None and env != "") else RE_SPLIT
     return max(int(raw), 0)
+
+
+def re_device_split_enabled() -> bool:
+    """``PHOTON_RE_DEVICE_SPLIT`` (env > module global), strict parse
+    like the sibling RE knobs — a typo fails loudly instead of silently
+    benching the single-device-per-process schedule."""
+    env = os.environ.get("PHOTON_RE_DEVICE_SPLIT")
+    if env is not None and env != "":
+        return int(env) != 0
+    return int(RE_DEVICE_SPLIT) != 0
+
+
+_SPLIT_WEIGHT_MODES = ("rows", "bytes")
+
+
+def re_split_weight() -> str:
+    """``PHOTON_RE_SPLIT_WEIGHT`` (env > module global), strict
+    membership parse — an unknown axis name fails loudly instead of
+    silently benching the row-weighted rule. ``rows`` (default)
+    balances solve compute; ``bytes`` balances combine wire bytes
+    (per-lane segment rows), with the split rule capping both axes."""
+    env = os.environ.get("PHOTON_RE_SPLIT_WEIGHT")
+    raw = env if (env is not None and env != "") else RE_SPLIT_WEIGHT
+    mode = str(raw)
+    if mode not in _SPLIT_WEIGHT_MODES:
+        raise ValueError(
+            f"PHOTON_RE_SPLIT_WEIGHT must be one of "
+            f"{_SPLIT_WEIGHT_MODES}, got {mode!r}"
+        )
+    return mode
 
 
 def replan_imbalance_threshold() -> float:
@@ -319,6 +378,89 @@ def replan_excluding(
     old_ranks = rank_of[plan.owner]
     migrated = old_ranks != new_plan.owner
     return new_plan, migrated
+
+
+def plan_device_placement(
+    row_counts: Sequence[float] | np.ndarray,
+    owner: np.ndarray,
+    shard: int,
+    num_devices: int,
+    groups: Sequence[Sequence[int]] | None = None,
+    skew_aware: bool = True,
+) -> tuple[np.ndarray, PlacementPlan]:
+    """The SECOND placement level (``PHOTON_RE_DEVICE_SPLIT``): assign
+    the items ``shard`` owns under the process-level ``owner`` map to
+    its ``num_devices`` LOCAL devices, with the same deterministic LPT
+    rule (and the same group-atomicity contract: ``groups`` lists index
+    sets — fusion groups on an unsplit prep — that must stay on ONE
+    device, so same-device launch fusion reproduces the single-device
+    launch geometry exactly). Returns ``(device, plan)`` where
+    ``device[i]`` is item ``i``'s local device ordinal for owned items
+    and ``-1`` elsewhere, and ``plan`` is the device-space sub-plan
+    (its ``balance`` is the ``re_shard.device_balance`` gauge).
+
+    Group members must be wholly owned or wholly un-owned by ``shard``
+    — the process-level plan is group-atomic too, so a straddling group
+    is a desynced plan and fails loudly. Like the first level this is
+    pure host arithmetic: recomputing it from a SURVIVOR topology's
+    owner map (after an in-place degrade re-plan) needs no extra
+    communication."""
+    counts = np.asarray(row_counts, np.float64)
+    owner = np.asarray(owner, np.int64)
+    if len(owner) != len(counts):
+        raise ValueError(
+            f"plan_device_placement: owner map length {len(owner)} != "
+            f"row_counts length {len(counts)}"
+        )
+    D = int(num_devices)
+    if D < 1:
+        raise ValueError(f"num_devices must be >= 1, got {num_devices}")
+    owned = np.flatnonzero(owner == int(shard))
+    device = np.full(len(owner), -1, np.int64)
+    # owned item index -> dense position in the sub-problem
+    pos = np.full(len(owner), -1, np.int64)
+    pos[owned] = np.arange(len(owned))
+    sub_groups = None
+    if groups is not None:
+        sub_groups = []
+        for g in groups:
+            g = list(g)
+            mine = [i for i in g if owner[i] == int(shard)]
+            if mine and len(mine) != len(g):
+                raise ValueError(
+                    "plan_device_placement: group "
+                    f"{g} straddles the owner boundary of shard "
+                    f"{int(shard)} — the process-level plan is "
+                    "group-atomic, so a straddling group is a desynced "
+                    "plan"
+                )
+            if mine:
+                sub_groups.append([int(pos[i]) for i in mine])
+    plan = plan_shard_placement(
+        counts[owned], D, groups=sub_groups, skew_aware=skew_aware
+    )
+    device[owned] = plan.owner
+    return device, plan
+
+
+def record_device_placement_metrics(
+    plan: PlacementPlan, prefix: str = "re_shard"
+) -> None:
+    """Publish the device-level sub-plan's gauges:
+    ``re_shard.device_balance`` (max/mean over THIS process's device
+    loads — the intra-host twin of ``re_shard.balance``),
+    ``re_shard.devices``, and per-device loads
+    ``re_shard.device_rows.<d>`` (the per-device rows ``report fleet``
+    renders). Pure gauges, published per process like the level-1
+    metrics."""
+    from photon_ml_tpu.obs.metrics import REGISTRY
+
+    REGISTRY.gauge_set(f"{prefix}.device_balance", plan.balance)
+    REGISTRY.gauge_set(f"{prefix}.devices", float(plan.num_shards))
+    for d in range(plan.num_shards):
+        REGISTRY.gauge_set(
+            f"{prefix}.device_rows.{d}", float(plan.loads[d])
+        )
 
 
 def measured_entity_costs(

@@ -26,7 +26,18 @@
 # `python bench.py --multichip-r08` when the combine/placement code
 # intentionally changes, then UPDATE_BASELINE=1 to re-bless.
 #
-# An R09 (SPLIT) leg finally validates the committed MULTICHIP_r09.json
+# An R10 (DEVICE) leg closes the file: the committed MULTICHIP_r10.json
+# (the PHOTON_RE_DEVICE_SPLIT / PHOTON_RE_SPLIT_WEIGHT A/B under a
+# forced 4-local-device CPU topology): acceptance invariants (bitwise
+# across arms/processes, device balance ≤ 1.15 at the top rung,
+# bytes-weighted split cutting the MAX owner's combine bytes ≥ 25%,
+# knob-off reproducing the r09 split wire bytes, the device arm
+# reproducing the off arm's wire bytes exactly) plus a gate of its
+# per-rung byte/balance/launch metrics against BASELINE_device_cpu.json.
+# Re-capture with `python bench.py --multichip-r10` when the device
+# placement code intentionally changes, then UPDATE_BASELINE=1.
+#
+# An R09 (SPLIT) leg then validates the committed MULTICHIP_r09.json
 # (the PHOTON_RE_SPLIT sub-bucket placement A/B): acceptance invariants
 # (bitwise across arms/processes/vs the single-process reference,
 # max-owner combine-byte reduction ≥ 40%, atom-granularity balance ≤
@@ -93,6 +104,11 @@ with open("BASELINE_split_cpu.json", "w") as f:
     json.dump(doc["gate_metrics"], f, indent=2)
     f.write("\n")
 print("gate_quick: split baseline re-captured to BASELINE_split_cpu.json")
+doc = json.load(open("MULTICHIP_r10.json"))
+with open("BASELINE_device_cpu.json", "w") as f:
+    json.dump(doc["gate_metrics"], f, indent=2)
+    f.write("\n")
+print("gate_quick: device baseline re-captured to BASELINE_device_cpu.json")
 PY
     exit 0
 fi
@@ -197,5 +213,32 @@ print(
     f"{acc['max_owner_bytes_reduction_at_top_rung']:.1%} >= "
     f"{acc['required_reduction']:.1%}, atom balance "
     f"{acc['balance_split_at_top_rung']:.3f}x <= 1.15x)"
+)
+PY
+
+# ---- r10 (device) leg: device-granularity placement A/B invariants + gate --
+python - <<'PY'
+import json, sys
+
+from photon_ml_tpu.obs.report import gate_run
+
+doc = json.load(open("MULTICHIP_r10.json"))
+acc = doc["acceptance"]
+assert acc["bitwise_identical"], acc
+assert acc["device_balance_le_1_15"], acc
+assert acc["bytes_weight_reduction_ge_required"], acc
+assert acc["device_arm_reproduces_off_wire_bytes"], acc
+assert acc["off_reproduces_r09_wire_bytes"], acc
+baseline = json.load(open("BASELINE_device_cpu.json"))
+failures, lines = gate_run(doc["gate_metrics"], baseline)
+if failures:
+    print("\n".join(lines))
+    sys.exit(f"gate_quick: device placement gate FAILED: {failures}")
+print(
+    "gate_quick: r10 device leg OK (device balance "
+    f"{acc['device_balance_at_top_rung']:.3f}x <= 1.15x, bytes-weight "
+    "max-owner reduction "
+    f"{acc['bytes_weight_max_owner_reduction_at_top_rung']:.1%} >= "
+    f"{acc['required_bytes_weight_reduction']:.1%})"
 )
 PY

@@ -125,6 +125,7 @@ class TestQuickMode:
             "re_knobs": {
                 "compact_every": 0, "fuse_buckets": 0,
                 "re_shard": 0, "re_split": 0,
+                "re_device_split": 0, "re_split_weight": "rows",
             },
             "telemetry": {
                 "schema_version": 1,
@@ -247,6 +248,7 @@ class TestQuickMode:
         assert r_cfg["re_knobs"] == {
             "compact_every": 0, "fuse_buckets": 0,
             "re_shard": 0, "re_split": 0,
+            "re_device_split": 0, "re_split_weight": "rows",
         }
         r_tel = r_cfg["telemetry"]
         assert (
@@ -430,18 +432,28 @@ class TestQuickMode:
 
         monkeypatch.setattr(pl, "RE_SHARD", 0)
         monkeypatch.setattr(pl, "RE_SPLIT", 0)
+        monkeypatch.setattr(pl, "RE_DEVICE_SPLIT", 0)
+        monkeypatch.setattr(pl, "RE_SPLIT_WEIGHT", "rows")
         monkeypatch.setenv("PHOTON_RE_SHARD", "1")
         monkeypatch.setenv("PHOTON_RE_SPLIT", "16")
+        monkeypatch.setenv("PHOTON_RE_DEVICE_SPLIT", "1")
+        monkeypatch.setenv("PHOTON_RE_SPLIT_WEIGHT", "bytes")
         bench._apply_retune_env()
         assert pl.RE_SHARD == 1
         assert pl.RE_SPLIT == 16
+        assert pl.RE_DEVICE_SPLIT == 1
+        assert pl.RE_SPLIT_WEIGHT == "bytes"
         assert pl.re_shard_enabled() is True
         assert pl.re_split_factor() == 16
+        assert pl.re_device_split_enabled() is True
+        assert pl.re_split_weight() == "bytes"
         from photon_ml_tpu.obs.sink import _knob_snapshot
 
         knobs = _knob_snapshot()
         assert knobs["re_shard"] == 1
         assert knobs["re_split"] == 16
+        assert knobs["re_device_split"] == 1
+        assert knobs["re_split_weight"] == "bytes"
         # the devcost capture key tracks the knob too (a split flip
         # must re-capture, not reuse the unsplit executable's costs)
         from photon_ml_tpu.obs import devcost
@@ -449,6 +461,17 @@ class TestQuickMode:
         assert devcost.knob_key()["re_split"] == 16
         monkeypatch.setenv("PHOTON_RE_SPLIT", "0")
         assert devcost.knob_key()["re_split"] == 0
+        assert devcost.knob_key()["re_device_split"] == 1
+        monkeypatch.setenv("PHOTON_RE_DEVICE_SPLIT", "0")
+        assert devcost.knob_key()["re_device_split"] == 0
+        assert devcost.knob_key()["re_split_weight"] == "bytes"
+        monkeypatch.setenv("PHOTON_RE_SPLIT_WEIGHT", "rows")
+        assert devcost.knob_key()["re_split_weight"] == "rows"
+
+    def test_split_weight_retune_rejects_unknown_mode(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_RE_SPLIT_WEIGHT", "lanes")
+        with pytest.raises(ValueError, match="PHOTON_RE_SPLIT_WEIGHT"):
+            bench._apply_retune_env()
 
     def test_retune_env_reaches_prefetch_knobs(self, monkeypatch):
         import photon_ml_tpu.ops.prefetch as pf

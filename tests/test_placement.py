@@ -708,3 +708,273 @@ class TestOwnedBucketMode:
         assert all(
             pb.static.labels.shape[0] == pb.num_real for pb in owned
         )
+
+    def test_device_split_owned_mesh_solve_is_bitwise(
+        self, problem, monkeypatch
+    ):
+        """PHOTON_RE_DEVICE_SPLIT under the owned-bucket mesh (the test
+        process runs 8 forced CPU devices): per-device dispatch with the
+        device-local combine is bitwise the knob-off solve — and on the
+        unsplit prep the fusion-group-atomic device plan keeps the
+        launch schedule counter for counter."""
+        from photon_ml_tpu.game.random_effect import train_random_effects
+        from photon_ml_tpu.obs.metrics import REGISTRY
+        from photon_ml_tpu.parallel import data_mesh
+
+        feats, kwargs = problem
+        monkeypatch.setenv("PHOTON_RE_SHARD", "1")
+
+        def launches():
+            return (
+                REGISTRY.snapshot("re_solve.")["counters"]
+                .get("re_solve.launches", {})
+                .get("value", 0.0)
+            )
+
+        b0 = launches()
+        ref = train_random_effects(feats, mesh=data_mesh(), **kwargs)
+        ref_launches = launches() - b0
+        monkeypatch.setenv("PHOTON_RE_DEVICE_SPLIT", "1")
+        b1 = launches()
+        got = train_random_effects(feats, mesh=data_mesh(), **kwargs)
+        np.testing.assert_array_equal(
+            np.asarray(got.coefficients), np.asarray(ref.coefficients)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.variances), np.asarray(ref.variances)
+        )
+        np.testing.assert_array_equal(got.iterations, ref.iterations)
+        assert launches() - b1 == ref_launches
+        g = REGISTRY.snapshot("re_shard.")["gauges"]
+        assert g["re_shard.devices"] >= 2.0
+        assert g["re_shard.device_balance"] >= 1.0
+
+    def test_device_split_atoms_warm_prior_and_bytes_weight_bitwise(
+        self, problem, monkeypatch
+    ):
+        """Device placement over sub-bucket atoms (independent atom
+        placement, per-owner-AND-device re-concatenation) with warm
+        starts and per-entity MAP priors, plus the bytes weight axis —
+        all bitwise vs the knob-off run."""
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.game.random_effect import train_random_effects
+        from photon_ml_tpu.parallel import data_mesh
+
+        feats, kwargs = problem
+        monkeypatch.setenv("PHOTON_RE_SHARD", "1")
+        monkeypatch.setenv("PHOTON_RE_SPLIT", "6")
+        cold = train_random_effects(feats, mesh=data_mesh(), **kwargs)
+        W = np.asarray(cold.coefficients)
+        V = np.asarray(cold.variances)
+        warm_kwargs = dict(
+            initial_coefficients=jnp.asarray(W),
+            prior_coefficients=jnp.asarray(W),
+            prior_variances=jnp.asarray(V),
+        )
+        ref = train_random_effects(
+            feats, mesh=data_mesh(), **warm_kwargs, **kwargs
+        )
+        monkeypatch.setenv("PHOTON_RE_DEVICE_SPLIT", "1")
+        got = train_random_effects(
+            feats, mesh=data_mesh(), **warm_kwargs, **kwargs
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.coefficients), np.asarray(ref.coefficients)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.variances), np.asarray(ref.variances)
+        )
+        # the bytes weight axis changes WHERE atoms go, never the model
+        monkeypatch.setenv("PHOTON_RE_SPLIT_WEIGHT", "bytes")
+        got2 = train_random_effects(
+            feats, mesh=data_mesh(), **warm_kwargs, **kwargs
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got2.coefficients), np.asarray(ref.coefficients)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got2.variances), np.asarray(ref.variances)
+        )
+
+    def test_device_plan_rederives_from_survivor_topology(
+        self, problem, monkeypatch
+    ):
+        """Degrade drill: after an in-place degrade the owner map plans
+        over the SURVIVOR group and the device level re-derives from
+        this process's survivor rank — pure host arithmetic, with no
+        input besides the effective topology."""
+        import jax
+
+        import photon_ml_tpu.parallel.multihost as mh
+        from photon_ml_tpu.game.random_effect import (
+            _plan_bucket_devices,
+            _plan_bucket_owners,
+        )
+
+        feats, kwargs = problem
+        buckets = kwargs["buckets"]
+        monkeypatch.setenv("PHOTON_RE_DEVICE_SPLIT", "1")
+
+        # healthy 4-process fleet, this process is original pid 2
+        monkeypatch.setattr(jax, "process_count", lambda: 4)
+        monkeypatch.setattr(jax, "process_index", lambda: 2)
+        owners_h = np.asarray(_plan_bucket_owners(buckets))
+        dev_h = np.asarray(_plan_bucket_devices(buckets, None, owners_h))
+        assert np.all(dev_h[owners_h == 2] >= 0)
+        assert np.all(dev_h[owners_h != 2] == -1)
+
+        # degrade in place: pid 1 lost, survivors (0, 2, 3) — this
+        # process's EFFECTIVE rank is 1, the owner map re-plans over 3
+        # shards, and the device plan follows the survivor topology
+        monkeypatch.setattr(
+            mh, "_DEGRADED", {"survivors": (0, 2, 3), "rank": 1}
+        )
+        owners_d = np.asarray(_plan_bucket_owners(buckets))
+        assert int(owners_d.max()) <= 2  # planned over 3 survivors
+        dev_d = np.asarray(_plan_bucket_devices(buckets, None, owners_d))
+        assert np.all(dev_d[owners_d == 1] >= 0)
+        assert np.all(dev_d[owners_d != 1] == -1)
+        # the two plans disagree about this process's owned set — the
+        # device level really did recompute, not reuse
+        assert set(np.flatnonzero(owners_h == 2)) != set(
+            np.flatnonzero(owners_d == 1)
+        )
+
+
+class TestDevicePlacementPlanner:
+    """The second-level LPT (``plan_device_placement``): one shard's
+    owned items onto its local devices — same determinism, balance and
+    group-atomicity contracts as the process level, pure numpy."""
+
+    def test_unowned_items_get_minus_one(self):
+        from photon_ml_tpu.parallel.placement import plan_device_placement
+
+        device, plan = plan_device_placement(
+            [5.0, 7.0, 9.0, 11.0], np.array([0, 1, 0, 1]), 1, 2
+        )
+        assert device[0] == -1 and device[2] == -1
+        assert set(device[[1, 3]].tolist()) <= {0, 1}
+        assert plan.loads.sum() == 18.0
+
+    def test_owned_partition_complete_and_deterministic(self):
+        from photon_ml_tpu.parallel.placement import plan_device_placement
+
+        sizes = _zipf_sizes(48)
+        owner = plan_entity_placement(sizes, 3).owner
+        d1, p1 = plan_device_placement(sizes, owner, 2, 4)
+        d2, _ = plan_device_placement(sizes, owner, 2, 4)
+        np.testing.assert_array_equal(d1, d2)
+        owned = np.flatnonzero(owner == 2)
+        assert np.all(d1[owned] >= 0) and np.all(d1[owned] < 4)
+        assert np.all(d1[np.flatnonzero(owner != 2)] == -1)
+        for dev in range(4):
+            assert p1.loads[dev] == sizes[np.flatnonzero(d1 == dev)].sum()
+
+    def test_balance_bound_at_atom_granularity(self):
+        """The acceptance bound one level down: at ATOM granularity
+        (max item weight capped at total/16, the split rule's job) the
+        intra-host LPT meets the same 1.15x max/mean bound on every
+        shard — whole-bucket granularity can't (the Zipf head entity
+        alone exceeds a device's fair share)."""
+        from photon_ml_tpu.parallel.placement import plan_device_placement
+
+        sizes = _zipf_sizes(64)
+        cap = sizes.sum() / 16
+        atoms: list[float] = []
+        for s in sizes.astype(np.float64):
+            while s > cap:
+                atoms.append(cap)
+                s -= cap
+            atoms.append(s)
+        owner = plan_entity_placement(np.asarray(atoms), 2).owner
+        for shard in range(2):
+            _, plan = plan_device_placement(atoms, owner, shard, 4)
+            assert plan.balance <= 1.15, plan.loads
+
+    def test_group_members_stay_on_one_device(self):
+        from photon_ml_tpu.parallel.placement import plan_device_placement
+
+        device, _ = plan_device_placement(
+            [10.0] * 6, np.zeros(6, np.int64), 0, 4,
+            groups=[[0, 1, 2], [3, 4, 5]],
+        )
+        assert len({int(device[i]) for i in (0, 1, 2)}) == 1
+        assert len({int(device[i]) for i in (3, 4, 5)}) == 1
+
+    def test_straddling_group_raises(self):
+        from photon_ml_tpu.parallel.placement import plan_device_placement
+
+        with pytest.raises(ValueError, match="straddles"):
+            plan_device_placement(
+                [5.0, 5.0], np.array([0, 1]), 0, 2, groups=[[0, 1]]
+            )
+
+    def test_validation(self):
+        from photon_ml_tpu.parallel.placement import plan_device_placement
+
+        with pytest.raises(ValueError, match="num_devices"):
+            plan_device_placement([1.0], np.zeros(1, np.int64), 0, 0)
+        with pytest.raises(ValueError, match="length"):
+            plan_device_placement([1.0, 2.0], np.zeros(1, np.int64), 0, 2)
+
+    def test_record_device_placement_metrics_gauges(self):
+        from photon_ml_tpu.obs.metrics import REGISTRY
+        from photon_ml_tpu.parallel.placement import (
+            plan_device_placement,
+            record_device_placement_metrics,
+        )
+
+        sizes = _zipf_sizes(32)
+        owner = plan_entity_placement(sizes, 2).owner
+        _, plan = plan_device_placement(sizes, owner, 0, 4)
+        record_device_placement_metrics(plan)
+        g = REGISTRY.snapshot("re_shard.")["gauges"]
+        assert g["re_shard.device_balance"] == plan.balance
+        assert g["re_shard.devices"] == 4.0
+        for d in range(4):
+            assert g[f"re_shard.device_rows.{d}"] == float(plan.loads[d])
+
+
+class TestDeviceSplitKnob:
+    def test_default_off(self, monkeypatch):
+        from photon_ml_tpu.parallel.placement import re_device_split_enabled
+
+        monkeypatch.delenv("PHOTON_RE_DEVICE_SPLIT", raising=False)
+        assert re_device_split_enabled() is False
+
+    def test_env_wins_and_parses_strictly(self, monkeypatch):
+        from photon_ml_tpu.parallel.placement import re_device_split_enabled
+
+        monkeypatch.setenv("PHOTON_RE_DEVICE_SPLIT", "1")
+        assert re_device_split_enabled() is True
+        monkeypatch.setenv("PHOTON_RE_DEVICE_SPLIT", "0")
+        assert re_device_split_enabled() is False
+        monkeypatch.setenv("PHOTON_RE_DEVICE_SPLIT", "yes")
+        with pytest.raises(ValueError):
+            re_device_split_enabled()
+
+    def test_module_global_fallback(self, monkeypatch):
+        import photon_ml_tpu.parallel.placement as pl
+
+        monkeypatch.delenv("PHOTON_RE_DEVICE_SPLIT", raising=False)
+        monkeypatch.setattr(pl, "RE_DEVICE_SPLIT", 1)
+        assert pl.re_device_split_enabled() is True
+
+    def test_weight_default_and_strict_enum(self, monkeypatch):
+        from photon_ml_tpu.parallel.placement import re_split_weight
+
+        monkeypatch.delenv("PHOTON_RE_SPLIT_WEIGHT", raising=False)
+        assert re_split_weight() == "rows"
+        monkeypatch.setenv("PHOTON_RE_SPLIT_WEIGHT", "bytes")
+        assert re_split_weight() == "bytes"
+        monkeypatch.setenv("PHOTON_RE_SPLIT_WEIGHT", "lanes")
+        with pytest.raises(ValueError):
+            re_split_weight()
+
+    def test_weight_module_global_fallback(self, monkeypatch):
+        import photon_ml_tpu.parallel.placement as pl
+
+        monkeypatch.delenv("PHOTON_RE_SPLIT_WEIGHT", raising=False)
+        monkeypatch.setattr(pl, "RE_SPLIT_WEIGHT", "bytes")
+        assert pl.re_split_weight() == "bytes"

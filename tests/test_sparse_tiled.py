@@ -884,3 +884,83 @@ def test_layout_tracks_retuned_segment_constants(rng, monkeypatch):
         np.asarray(tb.rmatvec(r)), np.asarray(b.rmatvec(r)),
         rtol=2e-3, atol=2e-3,
     )
+
+
+class TestTopologyKeyedCaches:
+    """Executable and layout caches key on the EFFECTIVE device topology
+    (backend, local device count, effective process count): re-entering
+    the same topology grows nothing, and a degrade-in-place — which
+    changes the effective group without a process restart — misses by
+    key instead of reusing a stale executable by luck."""
+
+    def test_tuned_constants_carry_effective_topology(self, monkeypatch):
+        import jax
+
+        import photon_ml_tpu.parallel.multihost as mh
+        from photon_ml_tpu.ops import tile_cache
+
+        t1 = tile_cache.tuned_constants()
+        assert t1[-1] == (
+            jax.default_backend(), len(jax.local_devices()), 1,
+        )
+        # same-topology re-entry: the IDENTICAL key, read at call time
+        assert tile_cache.tuned_constants() == t1
+        monkeypatch.setattr(
+            mh, "_DEGRADED", {"survivors": (0, 1), "rank": 0}
+        )
+        t2 = tile_cache.tuned_constants()
+        assert t2[:-1] == t1[:-1]
+        assert t2[-1][2] == 2 and t2 != t1
+
+    def test_tiled_apply_zero_growth_then_topology_miss(
+        self, rng, monkeypatch
+    ):
+        import photon_ml_tpu.ops.sparse_tiled as st
+        import photon_ml_tpu.parallel.multihost as mh
+
+        monkeypatch.setattr(st, "GROUPS_PER_STEP", 8)
+        monkeypatch.setattr(st, "SEGMENTS_PER_DMA", 2)
+        monkeypatch.setattr(st, "GROUPS_PER_RUN", 2)
+        n, d, k = 1024, 1024, 1
+        idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        val = rng.normal(size=(n, k)).astype(np.float32)
+        batch = SparseBatch(
+            indices=jnp.asarray(idx), values=jnp.asarray(val),
+            labels=jnp.zeros(n, jnp.float32),
+            offsets=jnp.zeros(n, jnp.float32),
+            weights=jnp.ones(n, jnp.float32), num_features=d,
+        )
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        tb = tile_sparse_batch(batch)
+        tb.matvec(w)
+        size0 = st._tiled_apply_jit._cache_size()
+        tb.matvec(w)  # same topology: ZERO executable-cache growth
+        assert st._tiled_apply_jit._cache_size() == size0
+        monkeypatch.setattr(
+            mh, "_DEGRADED", {"survivors": (0, 1), "rank": 0}
+        )
+        tb.matvec(w)  # degraded topology: new static key, fresh compile
+        assert st._tiled_apply_jit._cache_size() == size0 + 1
+
+    def test_topology_change_misses_layout_cache(self, rng, monkeypatch):
+        import photon_ml_tpu.parallel.multihost as mh
+        from photon_ml_tpu.ops import tile_cache
+
+        tile_cache.clear()
+        n, d, k = 2048, 4096, 4
+        idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        val = rng.normal(size=(n, k)).astype(np.float32)
+        b = SparseBatch(
+            indices=jnp.asarray(idx), values=jnp.asarray(val),
+            labels=jnp.zeros(n, jnp.float32),
+            offsets=jnp.zeros(n, jnp.float32),
+            weights=jnp.ones(n, jnp.float32), num_features=d,
+        )
+        tile_cache.tiled_layout_for(b)
+        monkeypatch.setattr(
+            mh, "_DEGRADED", {"survivors": (0, 1), "rank": 0}
+        )
+        tile_cache.tiled_layout_for(b)
+        s = tile_cache.stats()
+        assert (s["hits"], s["misses"]) == (0, 2)
+        tile_cache.clear()
