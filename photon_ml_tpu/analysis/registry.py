@@ -188,6 +188,23 @@ KNOBS: tuple[Knob, ...] = (
         retune_global="RE_COMBINE", retune_table="RETUNE_ENV_RE",
         sink_key="re_combine",
     ),
+    Knob(
+        name="PHOTON_RE_PROJECT", kind="enum", parse="enum",
+        default="0", owner="photon_ml_tpu/game/projector.py",
+        doc="per-entity feature projection: 0 | support | hash",
+        accessors=("re_project_mode",),
+        retune_global="RE_PROJECT", retune_table="RETUNE_ENV_RE",
+        sink_key="re_project",
+    ),
+    Knob(
+        name="PHOTON_RE_PROJECT_DIM", kind="int", parse="strict_int",
+        default="32", owner="photon_ml_tpu/game/projector.py",
+        doc="signed-hash fold width (pow2) for classes whose support "
+            "exceeds it (hash mode only)",
+        accessors=("re_project_dim",),
+        retune_global="RE_PROJECT_DIM", retune_table="RETUNE_ENV_RE",
+        sink_key="re_project_dim",
+    ),
     # -- entity-shard placement (RETUNE_ENV_SHARD) --------------------------
     Knob(
         name="PHOTON_RE_SHARD", kind="flag", parse="strict_int",

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Mapping
+from typing import Mapping, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -533,7 +533,10 @@ def placement_atoms(
 
 
 def split_entity_buckets(
-    buckets: EntityBuckets, split: int, weight: str = "rows"
+    buckets: EntityBuckets,
+    split: int,
+    weight: str = "rows",
+    byte_dims: "Sequence[float] | None" = None,
 ) -> tuple[EntityBuckets, tuple[int, ...] | None, int]:
     """Apply the ``PHOTON_RE_SPLIT`` rule to an already-built
     ``EntityBuckets`` (the in-memory owned-bucket path): each bucket
@@ -554,7 +557,15 @@ def split_entity_buckets(
     variances + diag) regardless of its row count, so the byte weight
     is 1 per lane and a bucket also splits when its lane count exceeds
     ``total_lanes / split`` — bounding the per-atom wire bytes the
-    row-weighted rule leaves unbounded on a Zipf tail class."""
+    row-weighted rule leaves unbounded on a Zipf tail class.
+
+    ``byte_dims`` (``PHOTON_RE_PROJECT``) reweighs the byte axis by the
+    PROJECTED payload: entry ``b`` is input bucket ``b``'s per-lane
+    segment width (its capacity class's solved dimension d_e), so a
+    projected tail class — whose lanes ship d_e-wide segments — weighs
+    proportionally less than an unprojected one. ``None`` (the default,
+    and always when the projection knob is off) keeps the 1-per-lane
+    rule bit-for-bit."""
     if split <= 0 or not buckets.entity_ids:
         return buckets, None, 0
     if weight not in ("rows", "bytes"):
@@ -565,12 +576,23 @@ def split_entity_buckets(
         np.asarray((rows >= 0).sum(axis=1), np.float64)
         for rows in buckets.row_indices
     ]
+    if byte_dims is not None and len(byte_dims) != len(per_bucket_w):
+        raise ValueError(
+            f"split_entity_buckets: byte_dims length {len(byte_dims)} != "
+            f"bucket count {len(per_bucket_w)}"
+        )
     total = float(sum(w.sum() for w in per_bucket_w))
     cap_w = total / split
     by_bytes = weight == "bytes"
     cap_b = 0.0
     if by_bytes:
-        total_lanes = float(sum(len(w) for w in per_bucket_w))
+        lane_w = (
+            [1.0] * len(per_bucket_w) if byte_dims is None
+            else [float(x) for x in byte_dims]
+        )
+        total_lanes = float(
+            sum(len(w) * lw for w, lw in zip(per_bucket_w, lane_w))
+        )
         cap_b = total_lanes / split
     ent_out: list[np.ndarray] = []
     row_out: list[np.ndarray] = []
@@ -580,9 +602,9 @@ def split_entity_buckets(
     for b, (ents, rows, w) in enumerate(
         zip(buckets.entity_ids, buckets.row_indices, per_bucket_w)
     ):
-        bw = np.ones(len(w), np.float64) if by_bytes else None
+        bw = np.full(len(w), lane_w[b], np.float64) if by_bytes else None
         over = float(w.sum()) > cap_w or (
-            by_bytes and float(len(w)) > cap_b
+            by_bytes and float(bw.sum()) > cap_b
         )
         runs = (
             _split_runs(w, cap_w, byte_weights=bw, byte_cap=cap_b)

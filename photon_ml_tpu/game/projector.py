@@ -24,12 +24,66 @@ TPU-native redesign:
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 
 import jax.numpy as jnp
 import numpy as np
 
 Array = jnp.ndarray
+
+# Per-entity feature projection (PHOTON_RE_PROJECT): "0" (default) keeps
+# the full-width random-effect solves bit-for-bit. "support" derives each
+# capacity class's active-column set from the GLOBAL per-entity column
+# activity (the same global-bincount discipline as ``capacity_classes`` /
+# ``placement_atoms`` — deterministic pure-host arithmetic, identical on
+# every process) and solves every bucket of that class in the d_e-wide
+# subspace, scattering coefficients back to full d for scoring — exact
+# for L2-at-zero regularization (inactive columns receive only the
+# penalty and stay at their zero init). "hash" additionally folds any
+# class whose support still exceeds PHOTON_RE_PROJECT_DIM down to that
+# cap with signed feature hashing — the genuine model change, gated by
+# the quality-parity protocol like the int8 rung. Like every fleet knob
+# it must be set identically on all processes.
+RE_PROJECT = "0"
+
+# Signed-hash target width (PHOTON_RE_PROJECT_DIM, power of two >= 2):
+# the per-class cap the "hash" mode folds over-wide supports down to.
+# The last slot is reserved for the intercept (framework convention:
+# intercept at the last column), so hashed classes solve at exactly this
+# width with the intercept exempt from collisions.
+RE_PROJECT_DIM = 32
+
+_RE_PROJECT_MODES = ("0", "support", "hash")
+
+
+def re_project_mode() -> str:
+    """``PHOTON_RE_PROJECT`` (env > module global), strict membership
+    parse — an unknown mode fails loudly instead of silently benching
+    the full-width solve."""
+    env = os.environ.get("PHOTON_RE_PROJECT")
+    raw = env if (env is not None and env != "") else RE_PROJECT
+    mode = str(raw)
+    if mode not in _RE_PROJECT_MODES:
+        raise ValueError(
+            f"PHOTON_RE_PROJECT must be one of {_RE_PROJECT_MODES}, "
+            f"got {mode!r}"
+        )
+    return mode
+
+
+def re_project_dim() -> int:
+    """``PHOTON_RE_PROJECT_DIM`` (env > module global), strict int parse
+    requiring a power of two >= 2 (the hash fold reserves the last slot
+    for the intercept, so width 1 would leave no hash range)."""
+    env = os.environ.get("PHOTON_RE_PROJECT_DIM")
+    raw = env if (env is not None and env != "") else RE_PROJECT_DIM
+    m = int(raw)
+    if m < 2 or (m & (m - 1)) != 0:
+        raise ValueError(
+            f"PHOTON_RE_PROJECT_DIM must be a power of two >= 2, got {m}"
+        )
+    return m
 
 
 def subspace_columns(
@@ -69,6 +123,166 @@ def entity_top_columns(
     # stable top-p: sort by (-count, index)
     order = np.argsort(-counts, axis=1, kind="stable")[:, :p]  # (k, p)
     return np.sort(order, axis=1)
+
+
+# Knuth multiplicative hash constants — any fixed mixing function of the
+# ORIGINAL column index works; what matters is that every process computes
+# the identical (slot, sign) pair from pure arithmetic on the index alone.
+_HASH_MULT = np.uint64(2654435761)
+_SIGN_MULT = np.uint64(0x9E3779B1)
+
+
+@dataclass(frozen=True)
+class ClassProjection:
+    """One capacity class's projection spec (``PHOTON_RE_PROJECT``).
+
+    ``columns`` is the class's support — the ascending original-column
+    indices any entity of this capacity activates anywhere in the fleet
+    (global union, so the spec is process-count-independent). Support
+    mode solves at width ``len(columns)``; hash mode additionally folds
+    those columns onto ``hash_dim`` slots with signs (``hash_slots`` /
+    ``hash_signs``), reserving slot ``hash_dim - 1`` for the intercept.
+    Derived once per class by ``projection_ladder`` and shared by every
+    bucket of the class — same capacity ⇒ same class ⇒ same spec, which
+    is what keeps the spec safe under same-geometry launch fusion."""
+
+    capacity: int
+    full_dim: int
+    columns: np.ndarray  # (d_e,) int64, ascending
+    hash_slots: np.ndarray | None = None  # (d_e,) int64 in [0, hash_dim)
+    hash_signs: np.ndarray | None = None  # (d_e,) float32, ±1
+    hash_dim: int | None = None
+
+    @property
+    def support_dim(self) -> int:
+        return int(len(self.columns))
+
+    @property
+    def dim(self) -> int:
+        """The width the solver actually runs at (and the per-lane
+        combine-segment width — the byte-denominated planners' unit)."""
+        return int(self.hash_dim) if self.hash_dim is not None else self.support_dim
+
+    def hash_matrix(self) -> np.ndarray:
+        """The signed fold as a dense (d_e, m) float32 matrix S with
+        ``S[j, hash_slots[j]] = hash_signs[j]`` — one tiny matmul folds
+        features/warm-starts and its transpose expands coefficients
+        (score-preserving on the support: (X S) w_h = X (S w_h))."""
+        if self.hash_dim is None:
+            raise ValueError("hash_matrix: spec has no hash fold")
+        S = np.zeros((self.support_dim, int(self.hash_dim)), np.float32)
+        S[np.arange(self.support_dim), self.hash_slots] = self.hash_signs
+        return S
+
+
+def _hash_fold(
+    columns: np.ndarray, hash_dim: int, intercept_index: int | None
+) -> tuple[np.ndarray, np.ndarray]:
+    """Deterministic (slot, sign) per support column: Knuth-mix the
+    ORIGINAL column index into ``[0, m-1)`` (slot ``m-1`` is reserved so
+    the intercept never collides); signs come from an independent mix.
+    Pure arithmetic on the indices — identical on every process."""
+    cols = np.asarray(columns, np.uint64)
+    m = int(hash_dim)
+    mixed = (cols * _HASH_MULT) % np.uint64(2**32)
+    slots = (mixed % np.uint64(m - 1)).astype(np.int64)
+    signs = np.where(
+        ((cols * _SIGN_MULT) >> np.uint64(16)) & np.uint64(1),
+        np.float32(1.0),
+        np.float32(-1.0),
+    ).astype(np.float32)
+    if intercept_index is not None:
+        at = np.flatnonzero(np.asarray(columns) == intercept_index)
+        slots[at] = m - 1
+        signs[at] = 1.0
+    return slots, signs
+
+
+def projection_ladder(
+    capacities: tuple[int, ...] | list[int],
+    activity: np.ndarray,  # (n_classes, d) nonzero-row counts per column
+    full_dim: int,
+    mode: str,
+    hash_dim: int,
+    intercept_index: int | None,
+) -> dict[int, ClassProjection | None]:
+    """The per-class projection specs (``PHOTON_RE_PROJECT``), keyed by
+    bucket capacity. ``activity[i, j]`` counts the rows with a nonzero
+    in column ``j`` over ALL entities of capacity class ``i`` —
+    fleet-global (callers allreduce before calling), so like the
+    capacity ladder itself the projection ladder is deterministic
+    pure-host arithmetic on globally-identical inputs: every process
+    derives the identical spec with zero extra communication.
+
+    A class whose support is the full width maps to ``None`` — no
+    projection, the untouched (bitwise) full-width path. An empty
+    support (a class whose rows are all-zero) keeps one forced column
+    (the intercept if present, else column 0) so the solve geometry
+    stays valid; the lone coefficient stays at its zero init. ``hash``
+    mode folds any support wider than ``hash_dim`` down to it."""
+    if mode not in ("support", "hash"):
+        raise ValueError(f"projection_ladder: unexpected mode {mode!r}")
+    if intercept_index is not None and intercept_index != full_dim - 1:
+        raise ValueError(
+            "feature projection requires the intercept at the last "
+            "column (framework convention)"
+        )
+    activity = np.asarray(activity)
+    if activity.shape != (len(capacities), full_dim):
+        raise ValueError(
+            f"projection_ladder: activity shape {activity.shape} != "
+            f"({len(capacities)}, {full_dim})"
+        )
+    ladder: dict[int, ClassProjection | None] = {}
+    for i, cap in enumerate(capacities):
+        cols = np.flatnonzero(activity[i] > 0).astype(np.int64)
+        if intercept_index is not None and intercept_index not in cols:
+            cols = np.sort(np.append(cols, np.int64(intercept_index)))
+        if len(cols) == 0:
+            cols = np.asarray([intercept_index if intercept_index is not None else 0], np.int64)
+        if len(cols) >= full_dim:
+            ladder[int(cap)] = None
+            continue
+        spec = ClassProjection(
+            capacity=int(cap), full_dim=int(full_dim), columns=cols
+        )
+        if mode == "hash" and len(cols) > hash_dim:
+            slots, signs = _hash_fold(cols, hash_dim, intercept_index)
+            spec = ClassProjection(
+                capacity=int(cap),
+                full_dim=int(full_dim),
+                columns=cols,
+                hash_slots=slots,
+                hash_signs=signs,
+                hash_dim=int(hash_dim),
+            )
+        ladder[int(cap)] = spec
+    return ladder
+
+
+def class_activity(
+    X: np.ndarray,  # (n, d) host feature matrix
+    capacities: tuple[int, ...] | list[int],
+    row_indices: list[np.ndarray],  # per-bucket (k, C) row maps, -1 pad
+) -> tuple[tuple[int, ...], np.ndarray]:
+    """Per-capacity-class column-activity counts from bucketed row maps
+    (the in-memory consumer's half of the ladder input): returns
+    ``(classes, activity)`` where ``classes`` is the ascending distinct
+    capacity set and ``activity[i, j]`` counts this process's rows with
+    a nonzero in column ``j`` over all buckets of capacity
+    ``classes[i]``. Data-parallel callers hold the full replicated
+    batch, so the counts are already global; sharded callers allreduce
+    before building the ladder."""
+    X = np.asarray(X)
+    d = X.shape[-1]
+    classes = tuple(sorted(set(int(c) for c in capacities)))
+    pos = {c: i for i, c in enumerate(classes)}
+    activity = np.zeros((len(classes), d), np.int64)
+    for cap, rows in zip(capacities, row_indices):
+        r = rows[rows >= 0]
+        if len(r):
+            activity[pos[int(cap)]] += (X[r] != 0).sum(axis=0).astype(np.int64)
+    return classes, activity
 
 
 @dataclass(frozen=True)

@@ -22,7 +22,7 @@ import dataclasses
 import os
 from dataclasses import dataclass
 from functools import partial
-from typing import Any
+from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -39,7 +39,13 @@ from photon_ml_tpu.game.data import (
 from photon_ml_tpu.ops.batch import Batch, DenseBatch
 from photon_ml_tpu.ops.glm import make_objective
 from photon_ml_tpu.ops.losses import PointwiseLoss
-from photon_ml_tpu.optim.common import select_minimize_fn
+from photon_ml_tpu.optim.common import (
+    hash_expand_coefficients,
+    hash_expand_variances,
+    hash_fold_prior,
+    hash_fold_warm_start,
+    select_minimize_fn,
+)
 from photon_ml_tpu.types import VarianceComputationType
 
 Array = jnp.ndarray
@@ -333,6 +339,22 @@ class PreparedBucket:
     # combine. None = the single-unit-per-process schedule (knob off,
     # single-device host, or a bucket owned elsewhere).
     device: int | None = None
+    # capacity-class projection spec (PHOTON_RE_PROJECT, host metadata:
+    # game.projector.ClassProjection). Set on EVERY bucket of a
+    # projected prep — including remotely-owned ones, whose spec the
+    # owner-segment combine needs to reconstruct full-width rows from
+    # the d_e-wide payload. None = the full-width (bitwise knob-off)
+    # path for this bucket, either because the knob is off or because
+    # the class's support is the full feature set.
+    project: Any = None
+    # the signed hash fold (PHOTON_RE_PROJECT=hash) as a staged (d_e, m)
+    # device matrix — set only on locally-staged buckets whose class
+    # folds (support wider than PHOTON_RE_PROJECT_DIM). The static
+    # features are already folded to width m at prepare time; the
+    # bucket step folds warm starts/priors through it and expands the
+    # solved coefficients/variances back to the support before the
+    # column scatter.
+    hash_S: Array | None = None
 
 
 def prepare_buckets(
@@ -362,13 +384,52 @@ def prepare_buckets(
     addressable, which is exactly what lifts the "compaction/fusion gate
     off under mesh sharding" restriction — the PR-5 knobs apply per
     owned bucket.
+
+    ``PHOTON_RE_PROJECT`` (support/hash) derives one projection spec per
+    capacity class from the per-class column activity
+    (``game.projector.projection_ladder``) and solves every bucket of
+    the class in its d_e-wide support subspace through the SAME column
+    machinery the ratio knob uses — the in-memory batch is replicated on
+    every process, so the activity counts are already fleet-global and
+    the ladder is process-count-independent by the same argument as the
+    capacity ladder itself. Mutually exclusive with
+    ``features_to_samples_ratio`` (two competing column maps); dense
+    features only.
     """
-    from photon_ml_tpu.game.projector import subspace_columns
+    from photon_ml_tpu.game.projector import (
+        class_activity,
+        projection_ladder,
+        re_project_dim,
+        re_project_mode,
+        subspace_columns,
+    )
     from photon_ml_tpu.parallel.placement import (
         re_shard_enabled,
         re_split_factor,
         re_split_weight,
+        record_projection_metrics,
     )
+
+    project_mode = re_project_mode()
+    ladder = None
+    if project_mode != "0":
+        if features_to_samples_ratio is not None:
+            raise ValueError(
+                "PHOTON_RE_PROJECT and features_to_samples_ratio are "
+                "mutually exclusive (two competing per-entity column maps)"
+            )
+        if not isinstance(features, DenseFeatures):
+            raise ValueError(
+                "PHOTON_RE_PROJECT requires dense features (sparse rows "
+                "are already width-bounded)"
+            )
+        classes, activity = class_activity(
+            np.asarray(features.X), buckets.capacities, buckets.row_indices
+        )
+        ladder = projection_ladder(
+            classes, activity, features.num_features, project_mode,
+            re_project_dim(), intercept_index,
+        )
 
     owned_prep = mesh is not None and re_shard_enabled()
     n_dev = mesh.shape[axis_name] if (mesh is not None and not owned_prep) else 1
@@ -385,19 +446,37 @@ def prepare_buckets(
     # below can spread the Zipf tail class across owners instead of
     # pinning it whole on one. parents is None on an unsplit prep —
     # the knob-off path is bit-for-bit the pre-split code.
+    # projected payload width per bucket (solved width: d_e, or m once
+    # hashed), keyed off the capacity class — None when the projection
+    # is off so every placement weight below stays bit-for-bit
+    def _bucket_dims(bks: EntityBuckets) -> list[float] | None:
+        if ladder is None:
+            return None
+        d_full = float(features.num_features)
+        return [
+            d_full if (s := ladder.get(int(c))) is None else float(s.dim)
+            for c in bks.capacities
+        ]
+
     owners = parents = devices = None
     if owned_prep:
         from photon_ml_tpu.game.data import split_entity_buckets
 
         buckets, parents, n_split = split_entity_buckets(
-            buckets, re_split_factor(), weight=re_split_weight()
+            buckets, re_split_factor(), weight=re_split_weight(),
+            byte_dims=_bucket_dims(buckets),
         )
-        owners = _plan_bucket_owners(buckets, parents, n_split)
+        lane_dims = _bucket_dims(buckets)
+        owners = _plan_bucket_owners(
+            buckets, parents, n_split, lane_dims=lane_dims
+        )
         # second placement level (PHOTON_RE_DEVICE_SPLIT): this
         # process's owned buckets onto its LOCAL devices — None when
         # the knob is off or the host has one device (the knob-off
         # staging below is then bit-for-bit the single-level prep)
-        devices = _plan_bucket_devices(buckets, parents, owners)
+        devices = _plan_bucket_devices(
+            buckets, parents, owners, lane_dims=lane_dims
+        )
     # EFFECTIVE identity, not jax's: after an in-place descent degrade
     # the owners above were planned over the survivor group, and this
     # process dispatches under its survivor rank (identical to the jax
@@ -412,12 +491,14 @@ def prepare_buckets(
     ):
         k = len(ent_ids)
         parent = None if parents is None else int(parents[bi])
+        spec = None if ladder is None else ladder.get(int(row_idx.shape[1]))
         if owners is not None and owners[bi] != own_pid:
             prepared.append(
                 PreparedBucket(
                     entity_ids=ent_ids, ids=None, static=None,
                     row_idx=None, mask=None, num_real=k,
                     owner=int(owners[bi]), parent=parent,
+                    project=spec,
                 )
             )
             continue
@@ -425,6 +506,32 @@ def prepare_buckets(
         idx = jnp.asarray(np.maximum(row_idx, 0), jnp.int32)
         mask = jnp.asarray((row_idx >= 0).astype(np.float32))
         columns = None
+        hash_S = None
+        if spec is not None and isinstance(static, DenseBatch):
+            # gather the static features to the class support (the same
+            # take-along/columns machinery the ratio knob drives, but one
+            # shared column set per capacity class instead of a
+            # per-entity top-p), optionally folding through the signed
+            # hash to PHOTON_RE_PROJECT_DIM — the solve itself, the
+            # zero-then-scatter writeback and the fusion geometry key
+            # all run on the projected width from here on
+            cols = np.broadcast_to(
+                spec.columns, (k, spec.support_dim)
+            )  # (k, d_e) — identical rows; intercept (=d-1) at d_e-1
+            Xs = np.take_along_axis(
+                np.asarray(static.X), cols[:, None, :], axis=2
+            )  # (k, C, d_e)
+            if spec.hash_dim is not None:
+                S = spec.hash_matrix()  # (d_e, m) dense signed fold
+                Xs = Xs.astype(np.float32) @ S  # (k, C, m)
+                hash_S = jnp.asarray(S)
+            static = DenseBatch(
+                X=jnp.asarray(Xs),
+                labels=static.labels,
+                offsets=static.offsets,
+                weights=static.weights,
+            )
+            columns = jnp.asarray(cols, jnp.int32)
         if (
             features_to_samples_ratio is not None
             and isinstance(static, DenseBatch)
@@ -478,6 +585,8 @@ def prepare_buckets(
             idx, mask, ids = put(idx), put(mask), put(ids)
             if columns is not None:
                 columns = put(columns)
+            if hash_S is not None:
+                hash_S = put(hash_S)
         prepared.append(
             PreparedBucket(
                 entity_ids=ent_ids,
@@ -487,7 +596,35 @@ def prepare_buckets(
                 owner=None if owners is None else int(owners[bi]),
                 parent=parent,
                 device=dev,
+                project=spec,
+                hash_S=hash_S,
             )
+        )
+    if ladder is not None:
+        d_full = int(features.num_features)
+        record_projection_metrics(
+            [
+                (pb.num_real,
+                 d_full if pb.project is None else int(pb.project.dim))
+                for pb in prepared
+            ],
+            d_full,
+        )
+        _emit_re_event(
+            "re_project",
+            mode=project_mode,
+            full_dim=d_full,
+            classes=[
+                {
+                    "capacity": int(c),
+                    "support_dim": (
+                        d_full if s is None else int(s.support_dim)
+                    ),
+                    "dim": d_full if s is None else int(s.dim),
+                    "hashed": bool(s is not None and s.hash_dim is not None),
+                }
+                for c, s in sorted(ladder.items())
+            ],
         )
     return prepared
 
@@ -496,6 +633,7 @@ def _plan_bucket_owners(
     buckets: EntityBuckets,
     parents: tuple[int, ...] | None = None,
     split_classes: int = 0,
+    lane_dims: "Sequence[float] | None" = None,
 ) -> np.ndarray:
     """Skew-aware whole-bucket placement over the processes of the
     runtime, decided BEFORE any staging: balance shards by Σ active rows
@@ -530,9 +668,16 @@ def _plan_bucket_owners(
     lanes = [len(e) for e in buckets.entity_ids]
     # PHOTON_RE_SPLIT_WEIGHT selects the balance axis: active rows
     # (default — solve compute) or lane count (combine wire bytes: one
-    # segment row per lane regardless of its row count)
+    # segment row per lane regardless of its row count). With a
+    # projection ladder the segment row is d_e wide, not d — lane_dims
+    # carries the per-bucket width so bytes-mode LPT balances the
+    # PROJECTED payload (lane_dims is None on an unprojected prep,
+    # keeping the knob-off weights bit-for-bit).
     if re_split_weight() == "bytes":
-        rows = [float(k) for k in lanes]
+        if lane_dims is not None:
+            rows = [float(k) * float(w) for k, w in zip(lanes, lane_dims)]
+        else:
+            rows = [float(k) for k in lanes]
     else:
         rows = [
             int(np.sum(np.asarray(r) >= 0)) for r in buckets.row_indices
@@ -556,6 +701,7 @@ def _plan_bucket_devices(
     buckets: EntityBuckets,
     parents: tuple[int, ...] | None,
     owners: np.ndarray,
+    lane_dims: "Sequence[float] | None" = None,
 ) -> np.ndarray | None:
     """The SECOND placement level (``PHOTON_RE_DEVICE_SPLIT``): assign
     THIS process's owned buckets to its local devices with the same
@@ -584,7 +730,10 @@ def _plan_bucket_devices(
         return None
     lanes = [len(e) for e in buckets.entity_ids]
     if re_split_weight() == "bytes":
-        rows = [float(k) for k in lanes]
+        if lane_dims is not None:
+            rows = [float(k) * float(w) for k, w in zip(lanes, lane_dims)]
+        else:
+            rows = [float(k) for k in lanes]
     else:
         rows = [
             int(np.sum(np.asarray(r) >= 0)) for r in buckets.row_indices
@@ -991,6 +1140,12 @@ def _bucket_geometry(pb: PreparedBucket):
         static_leaves,
         pb.row_idx.shape[1:],
         None if pb.columns is None else pb.columns.shape[1],
+        # hash-fold width (PHOTON_RE_PROJECT=hash): same capacity class
+        # ⇒ same fold matrix, so equal keys still share one S — this
+        # element just refuses to fuse a hashed bucket with an unhashed
+        # one that happens to match on the shapes above (constant None
+        # when the projection is off: grouping is unchanged)
+        None if pb.hash_S is None else tuple(pb.hash_S.shape),
     )
 
 
@@ -1122,6 +1277,11 @@ def _concat_units(
             owner=prepared[idxs[0]].owner,
             parent=prepared[idxs[0]].parent,
             device=prepared[idxs[0]].device,
+            # members share one capacity class (capacity is in both unit
+            # keys via geometry/parent), hence one projection spec and
+            # one staged fold matrix
+            project=prepared[idxs[0]].project,
+            hash_S=prepared[idxs[0]].hash_S,
         )
         units.append((fused, members))
     return units
@@ -1154,7 +1314,10 @@ def train_random_effects(
     XLA partitions the batched solve with no collectives — the TPU analog of
     the reference's ``RandomEffectDatasetPartitioner`` balancing.
     """
-    prepared = prepare_buckets(features, labels, weights, buckets, mesh, axis_name)
+    prepared = prepare_buckets(
+        features, labels, weights, buckets, mesh, axis_name,
+        intercept_index=intercept_index,
+    )
     return train_prepared(
         prepared,
         jnp.asarray(offsets),
@@ -1410,6 +1573,7 @@ def _train_prepared_core(
                 pb.mask,
                 pb.ids,
                 pb.columns,
+                pb.hash_S,
                 l2,
                 norm,
                 mu_in,
@@ -1435,6 +1599,7 @@ def _train_prepared_core(
                 pb.mask,
                 pb.ids,
                 pb.columns,
+                pb.hash_S,
                 l2,
                 norm,
                 mu_in,
@@ -1650,9 +1815,41 @@ def _pack_wv_segments(
     """This owner's packed coefficient/variance segments: one
     (Σ owned num_real, d) block per matrix in OWNED-BUCKET order, plus
     the bucket index list that keys reassembly. Raw float32 rows — the
-    framed codec ships them without pickling."""
+    framed codec ships them without pickling.
+
+    On a projected prep (any bucket carries a ``PHOTON_RE_PROJECT``
+    spec) the packing switches to VARIABLE-WIDTH: each owned bucket
+    ships only its class-support columns, flattened into one 1-D frame
+    (``num_real · d_e`` floats per bucket) — this is the tentpole's
+    wire-byte cut, Σ k·d_e instead of Σ k·d per process. Receivers
+    rebuild full rows from the spec every bucket carries; the zeros
+    outside the support are bitwise the owner's (the solve's
+    zero-then-scatter epilogue wrote exactly those zeros). Both sides
+    branch on the same replicated metadata, so the wire format agrees
+    by construction."""
     d = int(W_h.shape[1])
     ent = [prepared[i].entity_ids for i in owned]
+    if any(pb.project is not None for pb in prepared):
+        def pack(M):
+            parts = [
+                np.ascontiguousarray(
+                    M[prepared[i].entity_ids]
+                    if prepared[i].project is None
+                    else M[prepared[i].entity_ids][
+                        :, prepared[i].project.columns
+                    ],
+                    dtype=np.float32,
+                ).ravel()
+                for i in owned
+            ]
+            return (
+                np.concatenate(parts) if parts else np.zeros(0, np.float32)
+            )
+
+        out = {"buckets": np.asarray(owned, np.int64), "W": pack(W_h)}
+        if V_h is not None:
+            out["V"] = pack(V_h)
+        return out
     out = {
         "buckets": np.asarray(owned, np.int64),
         "W": (
@@ -1709,10 +1906,13 @@ def _apply_owner_segments(
     entity ids partition across buckets and each bucket has exactly one
     owner). Locally-owned buckets are skipped — their device refs (and
     W rows) are already in place, same as the allreduce arm."""
+    d = int(W_h.shape[1])
+    projected = any(pb.project is not None for pb in prepared)
     seen: set[int] = set()
     for wv, dg in zip(wv_views, diag_views):
         buckets = np.asarray(wv["buckets"], np.int64)
-        lo = 0
+        lo = 0  # row offset (dense frames) / flat offset (projected)
+        dlo = 0  # diagnostics row offset (always one row per lane)
         for b in buckets:
             b = int(b)
             if b in seen:
@@ -1722,6 +1922,34 @@ def _apply_owner_segments(
                 )
             seen.add(b)
             pb = prepared[b]
+            if projected:
+                # variable-width frame: reconstruct full rows from the
+                # spec this (replicated) bucket metadata carries — zeros
+                # outside the support are bitwise the owner's zeros
+                spec = pb.project
+                width = d if spec is None else int(spec.support_dim)
+                n = pb.num_real * width
+                dhi = dlo + pb.num_real
+                if pb.owner != pid:
+                    def unpack(flat):
+                        block = flat[lo:lo + n].reshape(pb.num_real, width)
+                        if spec is None:
+                            return block
+                        rows = np.zeros((pb.num_real, d), np.float32)
+                        rows[:, spec.columns] = block
+                        return rows
+
+                    W_h[pb.entity_ids] = unpack(wv["W"])
+                    if V_h is not None:
+                        V_h[pb.entity_ids] = unpack(wv["V"])
+                    diag[b] = (
+                        jnp.asarray(dg["F"][dlo:dhi], jnp.float32),
+                        jnp.asarray(dg["I"][dlo:dhi], jnp.int32),
+                        jnp.asarray(dg["R"][dlo:dhi], jnp.int32),
+                    )
+                lo += n
+                dlo = dhi
+                continue
             hi = lo + pb.num_real
             if pb.owner != pid:
                 W_h[pb.entity_ids] = wv["W"][lo:hi]
@@ -1853,6 +2081,24 @@ def _scatter_lanes(W, V, ids, columns, w_b, var_b, k):
     return W, V
 
 
+def _hash_fold_lanes(w0, mu_l, var_l, hash_S):
+    """Fold a bucket's extracted (support-width) warm-start and MAP-prior
+    lanes down to the hash width — SHARED by ``_bucket_step`` and the
+    compacted ``_lane_prologue`` so the fold rules can't drift between
+    the schedules. The prior mean/variance pair folds jointly
+    (precision-weighted) so the folded Gaussian penalty equals the full
+    penalty restricted to the subspace."""
+    w0 = hash_fold_warm_start(w0, hash_S)
+    if mu_l is not None and var_l is not None:
+        mu_l, var_l = hash_fold_prior(mu_l, var_l, hash_S)
+    elif mu_l is not None:
+        # no prior variances (uninformative, precision 1 per column):
+        # fold the means alone; variances stay None so the solver keeps
+        # its plain-L2-strength prior semantics
+        mu_l = hash_fold_warm_start(mu_l, hash_S)
+    return w0, mu_l, var_l
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -1873,6 +2119,7 @@ def _bucket_step(
     mask: Array,
     ids: Array,  # (k,) this bucket's entity ids (device)
     columns: Array | None,
+    hash_S: Array | None,  # (d_e, m) signed fold (PHOTON_RE_PROJECT=hash)
     l2_weight: Array,
     norm: Any,
     prior_mu: Array | None,  # (E, d) per-entity prior means, or None
@@ -1901,6 +2148,8 @@ def _bucket_step(
         return _extract_lanes(M, ids, columns, k, k_pad, d, pad_value, sharding)
 
     w0 = lane(W)
+    mu_l = lane(prior_mu)
+    var_l = lane(prior_var, pad_value=1.0)  # padded lanes: harmless unit variance
     solve_intercept = intercept_index
     if columns is not None:
         # subspace projection solves at width p over each entity's own
@@ -1908,14 +2157,22 @@ def _bucket_step(
         # framework convention) lands at slot p-1
         if intercept_index is not None:
             solve_intercept = columns.shape[1] - 1
+    if hash_S is not None:
+        # hash-folded class: the solve runs at width m — fold the warm
+        # start and MAP prior through the same signed matrix the static
+        # features were folded through at prepare time (the intercept
+        # owns slot m-1 alone by construction, so it stays addressable)
+        w0, mu_l, var_l = _hash_fold_lanes(w0, mu_l, var_l, hash_S)
+        if intercept_index is not None:
+            solve_intercept = hash_S.shape[1] - 1
 
     w_b, f_b, it_b, reason_b, var_b = _solve_bucket(
         bucket_batch,
         w0,
         l2_weight,
         norm,
-        lane(prior_mu),
-        lane(prior_var, pad_value=1.0),  # padded lanes: harmless unit variance
+        mu_l,
+        var_l,
         minimize_fn=minimize_fn,
         loss=loss,
         config=config,
@@ -1923,19 +2180,27 @@ def _bucket_step(
         variance_computation=variance_computation,
         **minimize_kwargs,
     )
+    if hash_S is not None:
+        # expand the folded solution back to the support width before
+        # the column scatter: each support column takes its slot's
+        # coefficient (times its sign); variances propagate through the
+        # same linear map with |S| (diagonal approximation)
+        w_b = hash_expand_coefficients(w_b, hash_S)
+        var_b = hash_expand_variances(var_b, hash_S)
     W, V = _scatter_lanes(W, V, ids, columns, w_b, var_b, k)
     return W, V, f_b[:k], it_b[:k], reason_b[:k]
 
 
 @partial(jax.jit, static_argnames=("k",))
 def _lane_prologue(
-    W, offsets, static_batch, row_idx, mask, ids, columns, prior_mu, prior_var,
-    *, k,
+    W, offsets, static_batch, row_idx, mask, ids, columns, hash_S,
+    prior_mu, prior_var, *, k,
 ):
     """Eager-path twin of ``_bucket_step``'s prologue (offset gather +
-    warm-start/prior lane extraction), as its own compiled program so the
-    host-driven compaction loop pays one dispatch, not ~6. Same ops as
-    the fused prologue with ``sharding=None`` — identical values."""
+    warm-start/prior lane extraction, plus the hash fold when the class
+    is folded), as its own compiled program so the host-driven compaction
+    loop pays one dispatch, not ~6. Same ops as the fused prologue with
+    ``sharding=None`` — identical values."""
     d = W.shape[1]
     off_b = offsets[row_idx] * mask
     bucket_batch = dataclasses.replace(static_batch, offsets=off_b)
@@ -1944,15 +2209,24 @@ def _lane_prologue(
     def lane(M, pad_value=0.0):
         return _extract_lanes(M, ids, columns, k, k_pad, d, pad_value)
 
-    return bucket_batch, lane(W), lane(prior_mu), lane(prior_var, pad_value=1.0)
+    w0 = lane(W)
+    mu_l = lane(prior_mu)
+    var_l = lane(prior_var, pad_value=1.0)
+    if hash_S is not None:
+        w0, mu_l, var_l = _hash_fold_lanes(w0, mu_l, var_l, hash_S)
+    return bucket_batch, w0, mu_l, var_l
 
 
 # W/V donation: same O(1)-coefficient-copies HBM discipline as _bucket_step —
 # the compacted caller rebinds both, so holding the old (E, d) buffers alive
 # through the scatter would double peak coefficient memory versus knob-off
 @partial(jax.jit, static_argnames=("k",), donate_argnums=(0, 1))
-def _lane_scatter(W, V, ids, columns, w_b, var_b, *, k):
-    """Eager-path twin of ``_bucket_step``'s (E, d) scatter epilogue."""
+def _lane_scatter(W, V, ids, columns, w_b, var_b, hash_S=None, *, k):
+    """Eager-path twin of ``_bucket_step``'s (E, d) scatter epilogue
+    (including the hash expansion back to the support width)."""
+    if hash_S is not None:
+        w_b = hash_expand_coefficients(w_b, hash_S)
+        var_b = hash_expand_variances(var_b, hash_S)
     return _scatter_lanes(W, V, ids, columns, w_b, var_b, k)
 
 
@@ -1965,6 +2239,7 @@ def _bucket_step_compacted(
     mask: Array,
     ids: Array,
     columns: Array | None,
+    hash_S: Array | None,
     l2_weight: Array,
     norm: Any,
     prior_mu: Array | None,
@@ -1984,12 +2259,14 @@ def _bucket_step_compacted(
     chunked schedule (which needs the host between launches, so the whole
     step cannot live inside one jit). Eager, unsharded callers only."""
     bucket_batch, w0, mu_l, var_l = _lane_prologue(
-        W, offsets, static_batch, row_idx, mask, ids, columns,
+        W, offsets, static_batch, row_idx, mask, ids, columns, hash_S,
         prior_mu, prior_var, k=k,
     )
     solve_intercept = intercept_index
     if columns is not None and intercept_index is not None:
         solve_intercept = columns.shape[1] - 1
+    if hash_S is not None and intercept_index is not None:
+        solve_intercept = hash_S.shape[1] - 1
     w_b, f_b, it_b, reason_b, var_b = _solve_bucket_compacted(
         bucket_batch,
         w0,
@@ -2005,7 +2282,7 @@ def _bucket_step_compacted(
         compact_every_n=compact_every_n,
         **minimize_kwargs,
     )
-    W, V = _lane_scatter(W, V, ids, columns, w_b, var_b, k=k)
+    W, V = _lane_scatter(W, V, ids, columns, w_b, var_b, hash_S, k=k)
     return W, V, f_b[:k], it_b[:k], reason_b[:k]
 
 

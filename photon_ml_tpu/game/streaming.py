@@ -97,7 +97,13 @@ from photon_ml_tpu.ops.streaming import (
     sparse_chunks,
     stream_scores,
 )
-from photon_ml_tpu.optim.common import select_minimize_fn
+from photon_ml_tpu.optim.common import (
+    hash_expand_coefficients,
+    hash_expand_variances,
+    hash_fold_prior,
+    hash_fold_warm_start,
+    select_minimize_fn,
+)
 from photon_ml_tpu.types import NormalizationType, VarianceComputationType
 
 Array = jnp.ndarray
@@ -315,6 +321,14 @@ class _ReShard:
     # single-unit-per-process schedule bit-for-bit (knob off or a
     # single local device).
     bucket_device: tuple[int, ...] | None = None
+    # per-capacity-class feature projection (PHOTON_RE_PROJECT): one
+    # ``game.projector.ClassProjection`` (or None = full width) per
+    # bucket, derived at shard-build time. Support mode rides the
+    # ``subspace_cols`` machinery wholesale (the class columns are tiled
+    # per lane); this field is what the solve loop folds hashed classes
+    # through and what telemetry reports widths from. None = the
+    # projection knob is off (the bit-for-bit path).
+    project: tuple | None = None
 
 
 def _offsets_payload(shard: _ReShard, offs_local: np.ndarray, row_base: int):
@@ -680,6 +694,34 @@ class StreamedGameTrainer:
         c = self.config.random_effect_coordinates[cid]
         feats = data.feature_container(c.feature_shard_id)
         ids = np.asarray(data.id_tags[c.random_effect_type], np.int64)
+        from photon_ml_tpu.game.projector import re_project_mode
+
+        project_mode = re_project_mode()
+        if project_mode != "0" and not drop_unseen:
+            # fail FAST at shard build, before any exchange or solve
+            if c.features_to_samples_ratio_upper_bound is not None:
+                raise ValueError(
+                    "PHOTON_RE_PROJECT and features_to_samples_ratio_"
+                    "upper_bound are mutually exclusive (two competing "
+                    "per-entity column maps)"
+                )
+            if c.random_projection_dim is not None:
+                raise ValueError(
+                    "PHOTON_RE_PROJECT and random_projection_dim are "
+                    "mutually exclusive (the random projection already "
+                    "re-bases the feature axis)"
+                )
+            if self.config.normalization is not NormalizationType.NONE:
+                raise NotImplementedError(
+                    "normalization is not supported together with "
+                    "per-entity feature projection — same contract as "
+                    "the subspace-ratio knob"
+                )
+            if not isinstance(feats, DenseFeatures):
+                raise ValueError(
+                    "PHOTON_RE_PROJECT requires dense features (sparse "
+                    "rows are already width-bounded)"
+                )
         if drop_unseen and len(ids) and ids.min() < 0:
             keep_rows = np.flatnonzero(ids >= 0)
             import dataclasses as _dc
@@ -720,6 +762,7 @@ class StreamedGameTrainer:
         global_caps = global_pops = None
         counts_g = None
         atoms = None
+        ladder = None  # PHOTON_RE_PROJECT per-class specs (global path)
         if reuse_layout is not None and reuse_layout.entity_owner is not None:
             # follow the TRAINING plan verbatim — gated on the PREPARED
             # STATE, never a re-read of the knob (a flip between
@@ -752,6 +795,7 @@ class StreamedGameTrainer:
                 plan_from_owner,
                 plan_shard_placement,
                 re_split_factor,
+                re_split_weight,
                 record_placement_metrics,
             )
 
@@ -765,6 +809,70 @@ class StreamedGameTrainer:
             active_g = counts_g
             if c.active_data_upper_bound is not None:
                 active_g = np.minimum(counts_g, c.active_data_upper_bound)
+            # the global capacity ladder, BEFORE placement (pure
+            # deterministic arithmetic — same values the post-plan call
+            # site used to compute): the projection ladder keys off it
+            global_caps, global_pops = capacity_classes(
+                active_g,
+                c.sample_bucket_sizes,
+                target_buckets=c.bucket_target_count,
+                max_padded_ratio=c.bucket_max_padded_ratio,
+            )
+            ent_bytes = None
+            if project_mode != "0" and not drop_unseen and len(global_caps):
+                # global projection ladder (PHOTON_RE_PROJECT), derived
+                # BEFORE the exchange so the byte-weighted placement
+                # below can weigh atoms by their PROJECTED payload:
+                # per-class column activity accumulates over ALL local
+                # rows (keyed by each row's entity's capacity class —
+                # a pure function of the allreduced global counts) and
+                # allreduces, so every process derives the identical
+                # ladder regardless of row layout or process count.
+                # Counting all rows (not just the reservoir-sampled
+                # active ones) yields a SUPERSET support: inactive-in-
+                # sample columns keep zero coefficients (L2-at-zero),
+                # so exactness is unaffected and the ladder stays
+                # layout-independent.
+                from photon_ml_tpu.game.projector import (
+                    projection_ladder,
+                    re_project_dim,
+                )
+
+                caps_arr = np.asarray(global_caps, np.int64)
+                d_full = int(feats.num_features)
+                cls_of_entity = np.minimum(
+                    np.searchsorted(caps_arr, active_g),
+                    len(caps_arr) - 1,
+                )
+                activity = np.zeros((len(caps_arr), d_full), np.int64)
+                local_rows = np.flatnonzero(ids >= 0)
+                if len(local_rows):
+                    np.add.at(
+                        activity,
+                        cls_of_entity[ids[local_rows]],
+                        (np.asarray(feats.X)[local_rows] != 0).astype(
+                            np.int64
+                        ),
+                    )
+                activity = np.asarray(allreduce_sum_host(activity))
+                ladder = projection_ladder(
+                    global_caps, activity, d_full, project_mode,
+                    re_project_dim(),
+                    self.intercept_indices.get(c.feature_shard_id),
+                )
+                if re_split_weight() == "bytes":
+                    # bytes-axis placement weights: one combine-segment
+                    # row of d_e (or m) floats per entity lane
+                    dims_class = np.asarray(
+                        [
+                            float(d_full) if ladder[int(cp)] is None
+                            else float(ladder[int(cp)].dim)
+                            for cp in global_caps
+                        ],
+                        np.float64,
+                    )
+                    ent_bytes = dims_class[cls_of_entity]
+                    ent_bytes[active_g <= 0] = 0.0
             # PHOTON_RE_SPLIT > 0: placement units are the sub-bucket
             # atoms of the capacity-class ladder (each atom co-located,
             # heavy classes split by the deterministic global-bincount
@@ -782,8 +890,13 @@ class StreamedGameTrainer:
                     target_buckets=c.bucket_target_count,
                     max_padded_ratio=c.bucket_max_padded_ratio,
                     split=split,
+                    byte_weights=ent_bytes,
                 )
                 atoms = tuple(atom_members)
+            # bytes mode + projection: LPT weighs each entity by its
+            # projected combine payload (one d_e-float segment row per
+            # lane, row-count independent) instead of raw rows
+            plan_w = counts_g if ent_bytes is None else ent_bytes
             if entity_owner_override is not None:
                 # the re-planner already decided the map (from measured
                 # costs): adopt it verbatim, publishing the same gauges
@@ -794,11 +907,11 @@ class StreamedGameTrainer:
                 entity_owner = plan.owner
             elif atoms is not None:
                 plan = plan_shard_placement(
-                    counts_g, P, groups=[list(a) for a in atoms]
+                    plan_w, P, groups=[list(a) for a in atoms]
                 )
                 entity_owner = plan.owner
             else:
-                plan = plan_entity_placement(counts_g, P)
+                plan = plan_entity_placement(plan_w, P)
                 entity_owner = plan.owner
             owned_global = np.flatnonzero(entity_owner == pid).astype(
                 np.int64
@@ -808,12 +921,6 @@ class StreamedGameTrainer:
                 shard=pid,
                 atoms=None if atoms is None else len(atoms),
                 split_classes=split_classes,
-            )
-            global_caps, global_pops = capacity_classes(
-                active_g,
-                c.sample_bucket_sizes,
-                target_buckets=c.bucket_target_count,
-                max_padded_ratio=c.bucket_max_padded_ratio,
             )
         ent_g, labels, weights, feats_o, grow = self._exchange_to_owners(
             cid, data, grow_in, feats, ids, row_layout,
@@ -919,6 +1026,63 @@ class StreamedGameTrainer:
                     )
                 )
             subspace_cols = tuple(cols_list)
+        project = None
+        if project_mode != "0" and not drop_unseen:
+            # PHOTON_RE_PROJECT: per-bucket projection specs. Under the
+            # global planning path the ladder was derived pre-exchange
+            # from allreduced activity (process-count-independent);
+            # other layouts (P=1, modular routing, layout reuse) derive
+            # it here from the OWNER rows — exact for the local solves
+            # (the support covers every column active in the rows being
+            # solved), with P-independence promised under
+            # PHOTON_RE_SHARD=1 only.
+            from photon_ml_tpu.game.projector import (
+                class_activity,
+                projection_ladder,
+                re_project_dim,
+            )
+            from photon_ml_tpu.parallel.placement import (
+                record_projection_metrics,
+            )
+
+            d_full = int(feats_o.num_features)
+            if ladder is None:
+                classes, activity = class_activity(
+                    np.asarray(feats_o.X),
+                    buckets.capacities,
+                    buckets.row_indices,
+                )
+                ladder = projection_ladder(
+                    classes, activity, d_full, project_mode,
+                    re_project_dim(),
+                    self.intercept_indices.get(c.feature_shard_id),
+                )
+            project = tuple(
+                ladder.get(int(rows.shape[1]))
+                for rows in buckets.row_indices
+            )
+            if any(s is not None for s in project):
+                # the support gather rides the SAME width-p subspace
+                # column machinery the ratio knob built: tile each
+                # class's support across its bucket's lanes
+                subspace_cols = tuple(
+                    None if s is None
+                    else np.broadcast_to(
+                        s.columns, (len(ent), s.support_dim)
+                    )
+                    for s, ent in zip(project, buckets.entity_ids)
+                )
+            record_projection_metrics(
+                [
+                    (len(ent), d_full if s is None else int(s.dim))
+                    for s, ent in zip(project, buckets.entity_ids)
+                ],
+                d_full,
+            )
+            if all(s is None for s in project):
+                # every class is dense-active: identical launches and
+                # bytes to the unprojected path, so drop the specs
+                project = None
         # second placement level (PHOTON_RE_DEVICE_SPLIT): this
         # process's LOCAL buckets onto its local devices, fusion-group-
         # atomic (same keys the launch grouping in _solve_re_buckets
@@ -945,6 +1109,15 @@ class StreamedGameTrainer:
                 lanes = [len(e) for e in buckets.entity_ids]
                 if re_split_weight() == "bytes":
                     wts = [float(k) for k in lanes]
+                    if project is not None:
+                        # projected payloads: lanes x d_e (or m) floats
+                        wts = [
+                            w * (
+                                float(feats_o.num_features)
+                                if s is None else float(s.dim)
+                            )
+                            for w, s in zip(wts, project)
+                        ]
                 else:
                     wts = [
                         float((rows >= 0).sum())
@@ -997,6 +1170,7 @@ class StreamedGameTrainer:
             lane_floor_pad=lane_pad,
             placement_atoms=atoms,
             bucket_device=bucket_device,
+            project=project,
         )
 
     def _offsets_to_owners(
@@ -1352,15 +1526,26 @@ class StreamedGameTrainer:
         all_converged = True
         any_entities = False
         bucket_loss: dict[int, float] = {}
-        pending: tuple[list, np.ndarray, tuple, tuple] | None = None
+        pending: tuple | None = None
         accounting = _DeferredLaunchAccounting()
 
-        def collect(members, ent_ids, cols, out):
+        def collect(members, ent_ids, cols, spec, out):
             nonlocal max_iters, all_converged
             w_b, f_b, it_b, reason_b, var_b = out
             if norm is not None:
                 w_b = jax.vmap(lambda w: norm.model_to_original_space(w)[0])(w_b)
                 var_b = norm.factors**2 * var_b
+            if spec is not None and spec.hash_dim is not None:
+                # expand the m-width hashed solution back to support
+                # width before the scatter (exact pseudo-inverse for
+                # collision-free slots; variances fold by |S|)
+                S = spec.hash_matrix()
+                w_b = hash_expand_coefficients(
+                    np.asarray(w_b, np.float32), S, xp=np
+                )
+                var_b = hash_expand_variances(
+                    np.asarray(var_b, np.float32), S, xp=np
+                )
             if cols is not None:
                 # scatter the width-p solution back to full width
                 full = np.zeros((len(ent_ids), W.shape[1]), np.float32)
@@ -1388,8 +1573,9 @@ class StreamedGameTrainer:
 
         buckets = shard.buckets
         sub_cols = shard.subspace_cols or (None,) * len(buckets.entity_ids)
+        specs = shard.project or (None,) * len(buckets.entity_ids)
         bucket_args = list(
-            zip(buckets.entity_ids, buckets.row_indices, sub_cols)
+            zip(buckets.entity_ids, buckets.row_indices, sub_cols, specs)
         )
         # lane floor (skew-aware sharding): a shard-local 1-entity bucket
         # whose GLOBAL capacity class holds >= 2 entities launches with
@@ -1400,14 +1586,14 @@ class StreamedGameTrainer:
         pads = shard.lane_floor_pad or (0,) * len(bucket_args)
 
         def padded_args(i):
-            ent, rows, cols = bucket_args[i]
+            ent, rows, cols, spec = bucket_args[i]
             if not pads[i]:
-                return ent, rows, cols
+                return ent, rows, cols, spec
             rows = np.concatenate(
                 [rows, np.full((1, rows.shape[1]), -1, rows.dtype)]
             )
             cols = None if cols is None else np.concatenate([cols, cols[:1]])
-            return ent, rows, cols
+            return ent, rows, cols, spec
 
         # PHOTON_RE_FUSE_BUCKETS: same-(C, p)-geometry buckets concatenate
         # along the entity lane into ONE launch unit (the gather below then
@@ -1425,7 +1611,7 @@ class StreamedGameTrainer:
                     rows_i.shape[1],
                     None if cols_i is None else cols_i.shape[1],
                 )
-                for _, rows_i, cols_i in bucket_args
+                for _, rows_i, cols_i, _spec in bucket_args
             ]
             if bdevs is not None:
                 # device-granularity placement: only co-resident
@@ -1438,7 +1624,7 @@ class StreamedGameTrainer:
                 ]
             plan = plan_fusion_groups(
                 fusion_keys,
-                [len(ent) for ent, _, _ in bucket_args],
+                [len(ent) for ent, _, _, _ in bucket_args],
             )
             for idxs, members in plan:
                 if len(idxs) == 1:
@@ -1454,7 +1640,10 @@ class StreamedGameTrainer:
                         [bucket_args[i][2] for i in idxs], axis=0
                     )
                 )
-                units.append((members, (ent, rows, cols)))
+                # same geometry => same capacity class => same spec
+                units.append(
+                    (members, (ent, rows, cols, bucket_args[idxs[0]][3]))
+                )
         else:
             units = [
                 ([(i, 0, len(bucket_args[i][0]))], padded_args(i))
@@ -1489,11 +1678,23 @@ class StreamedGameTrainer:
             # weights, this visit's offsets) — never W, which the ordered
             # collect() below writes — so preparation order is free while
             # solve/collect order (and thus every result) stays identical
-            _, rows_i, cols_i = units[i][1]
+            _, rows_i, cols_i, spec_i = units[i][1]
             b = gather_bucket(
                 shard.features, shard.labels, _offs(), shard.weights,
                 rows_i, columns=cols_i,
             )
+            if spec_i is not None and spec_i.hash_dim is not None:
+                # signed-hash fold of the gathered support columns:
+                # (k, C, d_e) @ (d_e, m) — masked (all-zero) lanes stay
+                # zero, so the fold composes with the lane-pad rules
+                from photon_ml_tpu.ops.batch import DenseBatch
+
+                b = DenseBatch(
+                    X=b.X @ jnp.asarray(spec_i.hash_matrix()),
+                    labels=b.labels,
+                    offsets=b.offsets,
+                    weights=b.weights,
+                )
             if unit_device is not None:
                 target = local_devs[unit_device[i]]
                 b = jax.tree.map(
@@ -1504,7 +1705,8 @@ class StreamedGameTrainer:
         for i, bucket in enumerate(
             prefetch.prefetch_iter(len(units), gather)
         ):
-            members, (ent_ids, rows, cols) = units[i]
+            members, (ent_ids, rows, cols, spec) = units[i]
+            hashed = spec is not None and spec.hash_dim is not None
             n_real = len(ent_ids)
             lane_pad = rows.shape[0] - n_real  # lane-floor dummy lanes
             if cols is not None and lane_pad:
@@ -1524,6 +1726,21 @@ class StreamedGameTrainer:
                     mu_rows = np.take_along_axis(mu_rows, cols, axis=1)
                     if var_rows is not None:
                         var_rows = np.take_along_axis(var_rows, cols, axis=1)
+                if hashed:
+                    S = spec.hash_matrix()
+                    if var_rows is not None:
+                        mu_rows, var_rows = hash_fold_prior(
+                            mu_rows.astype(np.float32),
+                            var_rows.astype(np.float32),
+                            S, xp=np,
+                        )
+                    else:
+                        # means-only prior (variances None keeps the
+                        # solver's plain-L2 strength): fold like a
+                        # warm start
+                        mu_rows = hash_fold_warm_start(
+                            mu_rows.astype(np.float32), S, xp=np
+                        )
                 if lane_pad:
                     # dummy lanes: zero-mean unit-variance prior (the
                     # same inert pad convention as _extract_lanes)
@@ -1545,9 +1762,17 @@ class StreamedGameTrainer:
                 # intercept (always the last full-space column) lands at
                 # the last subspace slot
                 b_intercept = cols.shape[1] - 1
+            if hashed and intercept_index is not None:
+                # the hash fold reserves the last slot for the intercept
+                # alone (sign +1, no collisions)
+                b_intercept = int(spec.hash_dim) - 1
             w0_rows = W[ent_ids]
             if cols is not None:
                 w0_rows = np.take_along_axis(w0_rows, cols, axis=1)
+            if hashed:
+                w0_rows = hash_fold_warm_start(
+                    w0_rows.astype(np.float32), spec.hash_matrix(), xp=np
+                )
             if lane_pad:
                 w0_rows = np.concatenate(
                     [w0_rows,
@@ -1591,7 +1816,7 @@ class StreamedGameTrainer:
                 out = tuple(a[:n_real] for a in out)
             if pending is not None:
                 collect(*pending)  # blocks on the PREVIOUS bucket only
-            pending = (members, ent_ids, cols, out)
+            pending = (members, ent_ids, cols, spec, out)
         if pending is not None:
             collect(*pending)
         accounting.flush()  # one batched readback, all solves now complete

@@ -159,6 +159,16 @@ def _knob_raw_state() -> tuple:
         )
     except Exception:
         shard_state = None
+    try:
+        import sys
+
+        pj_mod = sys.modules.get("photon_ml_tpu.game.projector")
+        project_state = (
+            None if pj_mod is None
+            else (pj_mod.RE_PROJECT, pj_mod.RE_PROJECT_DIM)
+        )
+    except Exception:
+        project_state = None
     return (
         env.get("PHOTON_PREFETCH_DEPTH"),
         env.get("PHOTON_CHUNK_CACHE_BUDGET"),
@@ -166,6 +176,8 @@ def _knob_raw_state() -> tuple:
         env.get("PHOTON_RE_COMPACT_EVERY"),
         env.get("PHOTON_RE_FUSE_BUCKETS"),
         env.get("PHOTON_RE_COMBINE"),
+        env.get("PHOTON_RE_PROJECT"),
+        env.get("PHOTON_RE_PROJECT_DIM"),
         env.get("PHOTON_RE_SHARD"),
         env.get("PHOTON_RE_SPLIT"),
         env.get("PHOTON_RE_REPLAN_IMBALANCE"),
@@ -177,6 +189,7 @@ def _knob_raw_state() -> tuple:
         st.GROUPS_PER_RUN, st.PIPELINE_SEGMENTS, st.KERNEL_DTYPE,
         re_state,
         shard_state,
+        project_state,
     )
 
 

@@ -366,6 +366,30 @@ def summarize_run(path: str, records: list[dict] | None = None) -> dict:
             "wait_s": timer_s("re_combine.wait_s"),
             "mode": run_start.get("knobs", {}).get("re_combine"),
         }
+    # per-entity feature projection (re_project.*, game/projector): the
+    # mean solved-width ratio and the per-lane bytes the subspace solves
+    # shaved off the full-width schedule, plus the ladder narrative
+    # (per-class support/hash widths) from the re_project event. Present
+    # only on projected runs — an unprojected summary stays key-for-key
+    # what it was.
+    project_events = [r for r in records if r["event"] == "re_project"]
+    if (
+        metrics_gauges.get("re_project.mean_ratio") is not None
+        or project_events
+    ):
+        out["re_project"] = {
+            "mean_ratio": metrics_gauges.get("re_project.mean_ratio"),
+            "dims_saved_bytes": metrics_gauges.get(
+                "re_project.dims_saved_bytes"
+            ),
+            "mode": (
+                project_events[-1].get("mode") if project_events else None
+            ),
+            "classes": (
+                project_events[-1].get("classes")
+                if project_events else None
+            ),
+        }
     # telemetry-driven re-planning (re_replan.*, game/streaming): checks
     # per iteration, re-plans fired, entities migrated — plus the event
     # narrative report fleet renders
@@ -505,6 +529,29 @@ def format_summary(s: dict) -> str:
                 f"{_fmt_s(rc['wait_s'])}"
             )
         lines.append(seg)
+    prj = s.get("re_project") or {}
+    if prj.get("mean_ratio") is not None or prj.get("classes"):
+        ratio = prj.get("mean_ratio")
+        saved = prj.get("dims_saved_bytes")
+        lines.append(
+            "  re-project:"
+            + (f" mode {prj['mode']}," if prj.get("mode") else "")
+            + (
+                f" mean width ratio {ratio:.3f}"
+                if isinstance(ratio, (int, float)) else ""
+            )
+            + (
+                f", {_fmt_qty(saved)}B/lane-row saved"
+                if isinstance(saved, (int, float)) else ""
+            )
+        )
+        for c in prj.get("classes") or []:
+            lines.append(
+                f"    class C={int(c.get('capacity', 0))}: "
+                f"support {int(c.get('support_dim', 0))} -> "
+                f"dim {int(c.get('dim', 0))}"
+                + (" (hashed)" if c.get("hashed") else "")
+            )
     rp = s.get("re_replan") or {}
     if rp.get("checks") or rp.get("migrations"):
         lines.append(
@@ -1032,6 +1079,32 @@ def summarize_fleet(paths: list[str]) -> dict:
                  if c.get("mode")), None,
             ),
         }
+    # per-entity projection at fleet granularity: the ladder is
+    # replicated (deterministic arithmetic on allreduced activity), so
+    # any process's section speaks for the fleet; per-process ratios
+    # are surfaced so a disagreeing shard is visible
+    project_pp = {
+        k: (s.get("re_project") or {})
+        for k, s in processes.items()
+        if s.get("re_project")
+    }
+    project = None
+    if project_pp:
+        first = next(iter(project_pp.values()))
+        project = {
+            "mode": first.get("mode"),
+            "classes": first.get("classes"),
+            "per_process_mean_ratio": {
+                k: c.get("mean_ratio") for k, c in project_pp.items()
+            },
+            "mean_ratio": max(
+                (
+                    float(c["mean_ratio"]) for c in project_pp.values()
+                    if isinstance(c.get("mean_ratio"), (int, float))
+                ),
+                default=None,
+            ),
+        }
     head = processes[str(pidxs[0])]
     return {
         "run_id": head["run_id"],
@@ -1063,6 +1136,7 @@ def summarize_fleet(paths: list[str]) -> dict:
         "overlap": overlap,
         "exchange": exchange,
         "re_combine": combine,
+        "re_project": project,
         "replans": replans,
         "processes": processes,
     }
@@ -1220,6 +1294,24 @@ def format_fleet(fs: dict) -> str:
                 for k, v in sorted(rc["per_process"].items())
             )
         )
+    prj = fs.get("re_project") or {}
+    if prj:
+        ratio = prj.get("mean_ratio")
+        lines.append(
+            "  re-project:"
+            + (f" mode {prj['mode']}," if prj.get("mode") else "")
+            + (
+                f" mean width ratio {ratio:.3f}"
+                if isinstance(ratio, (int, float)) else ""
+            )
+        )
+        for c in prj.get("classes") or []:
+            lines.append(
+                f"    class C={int(c.get('capacity', 0))}: "
+                f"support {int(c.get('support_dim', 0))} -> "
+                f"dim {int(c.get('dim', 0))}"
+                + (" (hashed)" if c.get("hashed") else "")
+            )
     for rp in fs.get("replans") or []:
         procs = rp.get("processes") or []
         lines.append(
@@ -1390,6 +1482,12 @@ DEFAULT_GATE_THRESHOLDS: dict[str, dict] = {
     "/imbalance": {"rel": 1.0, "abs": 1.0},
     "exchange_wait_s": {"rel": 2.0, "abs": 5.0},
     "exchange_s": {"rel": 2.0, "abs": 5.0},
+    # projection tier (PHOTON_RE_PROJECT runs only — unprojected runs
+    # never emit these keys): the mean solved-width ratio is exact
+    # deterministic arithmetic on the global activity bincount, so it
+    # gates TIGHT — a >2% widening means the ladder (or the data's
+    # sparsity structure) changed
+    "re_project/": {"rel": 0.02},
     # quality tiers: deltas vs the f32 anchor, absolute headroom at the
     # parity-gate scale (|ΔAUC| ≤ 0.005 is the ladder's own bf16 gate)
     "quality/": {"rel": 0.0, "abs": 0.005},
@@ -1472,6 +1570,12 @@ def gate_metrics_from_summary(s: dict) -> dict[str, float]:
     rc = s.get("re_combine") or {}
     if isinstance(rc.get("bytes_sent"), (int, float)):
         m["re_combine/bytes_sent"] = float(rc["bytes_sent"])
+    prj = s.get("re_project") or {}
+    if isinstance(prj.get("mean_ratio"), (int, float)):
+        # lower-is-better and deterministic: the tight re_project/ tier
+        # catches any widening; dims_saved_bytes is higher-is-better so
+        # it rides the report narrative, not the one-sided gate
+        m["re_project/mean_ratio"] = float(prj["mean_ratio"])
     rp = s.get("re_replan") or {}
     if rp:
         # exact one-sided tier: a migration APPEARING against the
@@ -1620,6 +1724,11 @@ def gate_metrics_from_fleet(fs: dict) -> dict[str, float]:
     rc = fs.get("re_combine") or {}
     if isinstance(rc.get("bytes_sent_total"), (int, float)):
         m["re_combine/bytes_sent"] = float(rc["bytes_sent_total"])
+    # the projection ratio gates the fleet MAX of the per-process gauge
+    # (replicated ladder: a disagreeing shard can only look worse)
+    prj = fs.get("re_project") or {}
+    if isinstance(prj.get("mean_ratio"), (int, float)):
+        m["re_project/mean_ratio"] = float(prj["mean_ratio"])
     mig = [
         (s.get("re_replan") or {}).get("migrations")
         for s in (fs.get("processes") or {}).values()

@@ -359,6 +359,13 @@ def _knob_snapshot() -> dict:
     except Exception:
         pass
     try:
+        from photon_ml_tpu.game import projector
+
+        knobs["re_project"] = str(projector.re_project_mode())
+        knobs["re_project_dim"] = int(projector.re_project_dim())
+    except Exception:
+        pass
+    try:
         from photon_ml_tpu.parallel import placement
 
         knobs["re_shard"] = int(bool(placement.re_shard_enabled()))

@@ -108,6 +108,57 @@ def grad_converged(g_norm: Array, g0_norm: Array, tolerance: float) -> Array:
     return g_norm <= tolerance * jnp.maximum(1.0, g0_norm)
 
 
+# ---------------------------------------------------------------------------
+# Signed-hash subspace folds (PHOTON_RE_PROJECT=hash) — how a full-width
+# warm start / diagonal Gaussian MAP prior become the hashed problem's.
+# Shared by the in-memory and streamed random-effect trainers (xp = jnp or
+# np: the transforms are the same tiny matmuls either way), and kept next
+# to the optimizer machinery because they CONSTRUCT the subspace
+# optimization problem: the folded prior's penalty equals the full MAP
+# penalty restricted to the hashed subspace, and the folded warm start is
+# the exact pseudo-inverse of the coefficient expansion (collision-free
+# slots round-trip bitwise).
+# ---------------------------------------------------------------------------
+def hash_fold_warm_start(w, S, xp=jnp):
+    """Fold full-support warm starts ``w (…, d_e)`` through the signed
+    hash ``S (d_e, m)``: ``w_h[t] = Σ_{j→t} sign_j · w_j / count_t`` —
+    the least-squares pseudo-inverse of ``w = S w_h``, so expanding the
+    fold of an expansion reproduces it exactly. Empty slots stay 0."""
+    counts = xp.abs(S).sum(axis=0)  # (m,)
+    return (w @ S) / xp.maximum(counts, 1.0)
+
+
+def hash_fold_prior(mu, var, S, xp=jnp):
+    """Fold a diagonal Gaussian prior (mu, var) over the support through
+    the signed hash: precision-weighted collapse
+    ``1/v_t = Σ_{j→t} 1/var_j``, ``m_t = v_t · Σ_{j→t} sign_j·mu_j/var_j``
+    — the unique diagonal prior whose penalty on ``w_h`` equals the full
+    penalty ``Σ_j (sign_j·w_h[t(j)] − mu_j)²/(2 var_j)`` up to a
+    w-independent constant, so the hashed MAP objective IS the full MAP
+    objective restricted to the hash subspace. Empty slots get an inert
+    (mean-0, variance-1) prior."""
+    prec = 1.0 / var
+    prec_h = prec @ xp.abs(S)  # (…, m)
+    empty = prec_h <= 0.0
+    var_h = xp.where(empty, 1.0, 1.0 / xp.where(empty, 1.0, prec_h))
+    mu_h = ((mu * prec) @ S) * var_h
+    return xp.where(empty, 0.0, mu_h), var_h
+
+
+def hash_expand_coefficients(w_h, S, xp=jnp):
+    """Expand hashed coefficients ``w_h (…, m)`` back to the support:
+    ``w_j = sign_j · w_h[slot_j]`` (= ``w_h @ S.T``) — exactly
+    score-preserving on the support features: ``(X S) w_h = X (S w_h)``."""
+    return w_h @ S.T
+
+
+def hash_expand_variances(v_h, S, xp=jnp):
+    """Expand hashed posterior variances to the support: each support
+    column reports its slot's variance (``v_h @ |S|.T`` — signs square
+    away)."""
+    return v_h @ xp.abs(S.T)
+
+
 def select_minimize_fn(
     config: OptimizerConfig, l1_weight: float = 0.0, host: bool = False
 ) -> tuple[Callable, dict]:

@@ -502,6 +502,33 @@ def measured_entity_costs(
     return counts * rate[owner]
 
 
+def record_projection_metrics(
+    lane_dims: Sequence[tuple[int, int]],
+    full_dim: int,
+    prefix: str = "re_project",
+) -> None:
+    """Publish the feature-projection payload gauges
+    (``PHOTON_RE_PROJECT``): ``re_project.mean_ratio`` — the
+    lane-weighted mean solved width over the full width
+    (Σ lanes·d_e / Σ lanes·d, the fraction of every byte-denominated
+    cost the projection keeps) — and ``re_project.dims_saved_bytes`` —
+    the float32 coefficient-row bytes one full combine pass no longer
+    ships (Σ lanes·(d − d_e)·4). ``lane_dims`` is one ``(lanes,
+    solved_width)`` pair per bucket this process solves. Both consumers
+    (in-memory prepare, streamed shard build) publish through HERE so
+    the gauge definition can't drift; callers only publish when the
+    projection is active, keeping the gauges ABSENT — and the gate tier
+    silent — on unprojected runs."""
+    from photon_ml_tpu.obs.metrics import REGISTRY
+
+    lanes_total = float(sum(k for k, _ in lane_dims))
+    full = lanes_total * float(full_dim)
+    kept = float(sum(k * d for k, d in lane_dims))
+    ratio = kept / full if full > 0 else 1.0
+    REGISTRY.gauge_set(f"{prefix}.mean_ratio", ratio)
+    REGISTRY.gauge_set(f"{prefix}.dims_saved_bytes", (full - kept) * 4.0)
+
+
 def record_placement_metrics(
     plan: PlacementPlan,
     shard: int | None = None,

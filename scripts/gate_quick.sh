@@ -37,6 +37,16 @@
 # Re-capture with `python bench.py --multichip-r10` when the device
 # placement code intentionally changes, then UPDATE_BASELINE=1.
 #
+# An R11 (PROJECT) leg validates the committed MULTICHIP_r11.json
+# (the PHOTON_RE_PROJECT per-entity feature-projection A/B): acceptance
+# invariants (knob-0 bit-for-bit with knob-unset — models, launches,
+# wire bytes; off-arm launches == owned buckets; support arm cutting
+# mean per-process combine bytes ≥ 30%; held-out quality parity —
+# support exact, hash |ΔAUC| ≤ 0.005) plus a gate of its per-rung
+# byte/ratio/launch/parity metrics against BASELINE_project_cpu.json.
+# Re-capture with `python bench.py --multichip-r11` when the projection
+# code intentionally changes, then UPDATE_BASELINE=1 to re-bless.
+#
 # An R09 (SPLIT) leg then validates the committed MULTICHIP_r09.json
 # (the PHOTON_RE_SPLIT sub-bucket placement A/B): acceptance invariants
 # (bitwise across arms/processes/vs the single-process reference,
@@ -109,6 +119,11 @@ with open("BASELINE_device_cpu.json", "w") as f:
     json.dump(doc["gate_metrics"], f, indent=2)
     f.write("\n")
 print("gate_quick: device baseline re-captured to BASELINE_device_cpu.json")
+doc = json.load(open("MULTICHIP_r11.json"))
+with open("BASELINE_project_cpu.json", "w") as f:
+    json.dump(doc["gate_metrics"], f, indent=2)
+    f.write("\n")
+print("gate_quick: project baseline re-captured to BASELINE_project_cpu.json")
 PY
     exit 0
 fi
@@ -213,6 +228,32 @@ print(
     f"{acc['max_owner_bytes_reduction_at_top_rung']:.1%} >= "
     f"{acc['required_reduction']:.1%}, atom balance "
     f"{acc['balance_split_at_top_rung']:.3f}x <= 1.15x)"
+)
+PY
+
+# ---- r11 (project) leg: per-entity projection A/B invariants + gate -------
+python - <<'PY'
+import json, sys
+
+from photon_ml_tpu.obs.report import gate_run
+
+doc = json.load(open("MULTICHIP_r11.json"))
+acc = doc["acceptance"]
+assert acc["bitwise_identical"], acc
+assert acc["support_reduction_ge_required"], acc
+assert acc["quality_parity_ok"], acc
+baseline = json.load(open("BASELINE_project_cpu.json"))
+failures, lines = gate_run(doc["gate_metrics"], baseline)
+if failures:
+    print("\n".join(lines))
+    sys.exit(f"gate_quick: projection gate FAILED: {failures}")
+print(
+    "gate_quick: r11 project leg OK (support mean-bytes cut "
+    f"{acc['support_bytes_reduction_at_top_rung']:.1%} >= "
+    f"{acc['required_support_bytes_reduction']:.1%}, held-out parity "
+    f"support {acc['support_auc_delta_abs']:.2g} / hash "
+    f"{acc['hash_auc_delta_abs']:.2g} <= "
+    f"{acc['quality_parity_abs_bound']})"
 )
 PY
 

@@ -28,7 +28,10 @@ from photon_ml_tpu.game.models import FixedEffectModel, RandomEffectModel
 from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_ml_tpu.types import RegularizationType, TaskType
 
-OPT = OptimizerConfig(max_iterations=40, tolerance=1e-9)
+# retuned DOWN for the tier-1 budget: every test here asserts resume /
+# reload EQUIVALENCE between identically-configured runs, which holds at
+# any optimizer depth — 12 inner iterations buys the same guarantee as 40
+OPT = OptimizerConfig(max_iterations=12, tolerance=1e-9)
 
 
 def _cd(rng, n=400):
@@ -161,16 +164,17 @@ class TestCheckpointRoundtrip:
 class TestDescentResume:
     def test_resume_matches_uninterrupted(self, tmp_path, rng):
         seq = ("fixed", "per_user")
-        # uninterrupted 3-iteration run
-        full = _cd(rng).run(seq, 3)
+        # uninterrupted 2-iteration run (resume equivalence is
+        # depth-independent: any mid-run checkpoint exercises the path)
+        full = _cd(rng).run(seq, 2)
 
-        # run 2 iterations with checkpointing, then "crash" and resume to 3
+        # run 1 iteration with checkpointing, then "crash" and resume to 2
         rng2 = np.random.default_rng(42)  # same data as rng fixture
         ckpt_dir = str(tmp_path / "ck")
         cd = _cd(rng2)
-        cd.run(seq, 2, checkpoint_dir=ckpt_dir)
+        cd.run(seq, 1, checkpoint_dir=ckpt_dir)
         assert os.path.exists(os.path.join(ckpt_dir, "ckpt.npz"))
-        resumed = _cd(np.random.default_rng(42)).run(seq, 3, checkpoint_dir=ckpt_dir)
+        resumed = _cd(np.random.default_rng(42)).run(seq, 2, checkpoint_dir=ckpt_dir)
 
         np.testing.assert_allclose(
             np.asarray(resumed.model["fixed"].model.coefficients.means),

@@ -27,7 +27,9 @@ from photon_ml_tpu.io import TRAINING_EXAMPLE_SCHEMA, read_avro_file, write_avro
 from photon_ml_tpu.types import RegularizationType, TaskType
 from photon_ml_tpu.utils import PhotonLogger
 
-OPT = OptimizerConfig(max_iterations=40, tolerance=1e-7)
+# driver tests assert round-trip/equivalence properties, not convergence
+# depth; both arms of every comparison share this bound
+OPT = OptimizerConfig(max_iterations=24, tolerance=1e-7)
 
 
 def _quiet(tmp_path):

@@ -32,7 +32,10 @@ from photon_ml_tpu.types import (
     TaskType,
 )
 
-OPT = OptimizerConfig(max_iterations=50, tolerance=1e-8)
+# 30 iterations still converges the tiny GAME fits well past every
+# quality gate below (AUC / lift / dominance); equivalence tests run the
+# same bound on both arms either way
+OPT = OptimizerConfig(max_iterations=30, tolerance=1e-8)
 
 
 def _game_batches(rng, n=600, task=TaskType.LOGISTIC_REGRESSION):
@@ -288,7 +291,10 @@ class TestRandomEffectNormalization:
             {"global": data.X, "per_user": entity_X},
             id_tags={"userId": data.entity_ids["userId"]},
         )
-        opt = OptimizerConfig(max_iterations=40, tolerance=1e-8)
+        # the real invariant below (normalized == manually pre-scaled, L2 in
+        # the normalized space) holds at any depth — both arms run the same
+        # algorithm; 24 iterations still clears the AUC sanity gate
+        opt = OptimizerConfig(max_iterations=24, tolerance=1e-8)
         cfg = GameTrainingConfig(
             task_type=TaskType.LOGISTIC_REGRESSION,
             coordinate_update_sequence=("fixed", "per_user"),

@@ -17,7 +17,9 @@ from photon_ml_tpu.game.streaming import StreamedGameData, StreamedGameTrainer
 from photon_ml_tpu.types import RegularizationType, TaskType
 
 
-def _data(rng, n=600, d=6, E=8, dr=3):
+# n=440 keeps the ragged final chunk at chunk_rows=128 (3 full + 56);
+# streamed-vs-in-memory equivalence is row-count-independent
+def _data(rng, n=440, d=6, E=8, dr=3):
     w_fixed = (rng.normal(size=d) * 0.6).astype(np.float32)
     W_re = (rng.normal(size=(E, dr)) * 0.6).astype(np.float32)
     X = rng.normal(size=(n, d)).astype(np.float32)
@@ -30,7 +32,9 @@ def _data(rng, n=600, d=6, E=8, dr=3):
 
 def _config(iters=2):
     opt = OptimizationConfig(
-        optimizer=OptimizerConfig(max_iterations=60, tolerance=1e-8),
+        # both arms of every equivalence test share this bound, so the
+        # parity is bound-independent; 28 halves the per-coordinate solves
+        optimizer=OptimizerConfig(max_iterations=28, tolerance=1e-8),
         regularization=RegularizationContext(RegularizationType.L2),
         regularization_weight=1.0,
     )
@@ -770,7 +774,9 @@ def test_streamed_game_incremental_prior_matches_in_memory(rng):
     from photon_ml_tpu.game import make_game_batch
     from photon_ml_tpu.types import VarianceComputationType
 
-    X, Xr, ids, y, _ = _data(rng, n=500)
+    # streamed-vs-in-memory equivalence is row-count-independent; 320 rows
+    # at chunk_rows=80 keeps the same 4-chunk structure as 500/128
+    X, Xr, ids, y, _ = _data(rng, n=320)
     base_cfg = dataclasses.replace(
         _config(iters=2),
         variance_computation=VarianceComputationType.SIMPLE,
@@ -786,7 +792,7 @@ def test_streamed_game_incremental_prior_matches_in_memory(rng):
     data = StreamedGameData(
         labels=y, features={"g": X, "r": Xr}, id_tags={"uid": ids}
     )
-    st_model, _ = StreamedGameTrainer(inc_cfg, chunk_rows=128).fit(
+    st_model, _ = StreamedGameTrainer(inc_cfg, chunk_rows=80).fit(
         data, initial_model=gen0
     )
     np.testing.assert_allclose(

@@ -402,7 +402,9 @@ class TestTiledLadderParity:
         )
 
     def test_f32_knob_bitwise_inert_both_kernels(self, rng, monkeypatch):
-        batch = self._batch(rng)
+        # bitwise identity is size-independent: the smallest multi-slab
+        # stream keeps both kernels honest at a fraction of the trace cost
+        batch = self._batch(rng, n=384)
         w = jnp.asarray(rng.normal(size=batch.num_features).astype(np.float32))
         r = jnp.asarray(rng.normal(size=batch.num_rows).astype(np.float32))
         for seg_batched in (True, False):
@@ -543,7 +545,9 @@ class TestLadderQualityGates:
         rng = np.random.default_rng(rng_seed)
         d = 1037  # retuned-down fit shape (tier-1 budget): the gate is
         # about storage error at convergence, not scale
-        idx, val, y = _sparse_fit_problem(rng, n=1024, d=d, k=3)
+        # n=640 keeps the bf16/int8 deltas 10-25x inside the documented
+        # tolerances (measured: dAUC ~4.5e-4 vs 5e-3 / 3.8e-4 vs 1e-2)
+        idx, val, y = _sparse_fit_problem(rng, n=640, d=d, k=3)
         batch = SparseBatch(
             indices=jnp.asarray(idx), values=jnp.asarray(val),
             labels=jnp.asarray(y),

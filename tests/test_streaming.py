@@ -856,11 +856,13 @@ class TestTiledStreamedChunks:
 
         monkeypatch.setattr(st_mod, "GROUPS_PER_STEP", 8)
         monkeypatch.setattr(st_mod, "SEGMENTS_PER_DMA", 2)
-        n, d, k = 2048, 4096, 4
+        # halved rows (same 2-chunk structure): bitwise parity between the
+        # two schedules is size-independent, trace cost is not
+        n, d, k = 1024, 4096, 4
         idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
         val = rng.normal(size=(n, k)).astype(np.float32)
         y = (rng.uniform(size=n) < 0.5).astype(np.float32)
-        chunks = sparse_chunks(idx, val, y, chunk_rows=1024)
+        chunks = sparse_chunks(idx, val, y, chunk_rows=512)
         w = jnp.asarray(rng.normal(size=d), jnp.float32)
         outs = {}
         score_cache_sizes = {}
