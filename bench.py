@@ -120,6 +120,14 @@ RETUNE_ENV_SHARD = {
     # bytes gap to the row-balance ratio).
     "PHOTON_RE_DEVICE_SPLIT": "RE_DEVICE_SPLIT",
     "PHOTON_RE_SPLIT_WEIGHT": "RE_SPLIT_WEIGHT",
+    # FE_SHARD = 1 range-shards the FIXED-effect feature space across
+    # processes (0 = replicated coefficients bit-for-bit); the knobs
+    # live in data/index_map (module_overrides below redirects them).
+    # FE_SPLIT_WEIGHT picks the boundary weight axis: "nnz" (default,
+    # Zipf-aware prefix cut) or "width" (uniform index split, the
+    # naive rule kept for A/B).
+    "PHOTON_FE_SHARD": "FE_SHARD",
+    "PHOTON_FE_SPLIT_WEIGHT": "FE_SPLIT_WEIGHT",
 }
 # No TPU generation exceeds this HBM bandwidth (v5p ~2.8 TB/s); a
 # measurement implying more is a timing artifact, not a fast solve.
@@ -1771,6 +1779,15 @@ def _apply_retune_env() -> None:
                     f"{_SPLIT_WEIGHT_MODES}, got {raw!r}"
                 )
             return raw
+        if var == "PHOTON_FE_SPLIT_WEIGHT":
+            from photon_ml_tpu.data.index_map import _FE_SPLIT_WEIGHT_MODES
+
+            if raw not in _FE_SPLIT_WEIGHT_MODES:
+                raise ValueError(
+                    f"PHOTON_FE_SPLIT_WEIGHT must be one of "
+                    f"{_FE_SPLIT_WEIGHT_MODES}, got {raw!r}"
+                )
+            return raw
         return int(raw)
 
     # the projection knobs ride RETUNE_ENV_RE (they retune the RE solve)
@@ -1778,6 +1795,10 @@ def _apply_retune_env() -> None:
     module_overrides = {
         "PHOTON_RE_PROJECT": "photon_ml_tpu.game.projector",
         "PHOTON_RE_PROJECT_DIM": "photon_ml_tpu.game.projector",
+        # the fixed-effect range-shard knobs ride RETUNE_ENV_SHARD (they
+        # retune cross-process placement) but live with the partitioner
+        "PHOTON_FE_SHARD": "photon_ml_tpu.data.index_map",
+        "PHOTON_FE_SPLIT_WEIGHT": "photon_ml_tpu.data.index_map",
     }
     for env_map, module_name, label in surfaces:
         pending = {
@@ -1995,24 +2016,12 @@ def _multichip_r06_worker(
     telemetry_dir: str | None,
 ) -> None:
     """One harness process of the MULTICHIP_r06 capture (child mode)."""
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""
-    os.environ["PHOTON_RE_SHARD"] = "1" if arm == "skew_aware" else "0"
     import hashlib
 
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    try:
-        from jax._src import xla_bridge as _xb
-
-        _xb._backend_factories.pop("axon", None)
-    except Exception:
-        pass
-    from photon_ml_tpu.parallel.multihost import initialize_multihost
-
-    initialize_multihost(coordinator, num_processes=nproc, process_id=pid)
+    _multichip_worker_setup(
+        coordinator, pid, nproc,
+        knobs={"PHOTON_RE_SHARD": "1" if arm == "skew_aware" else "0"},
+    )
     import photon_ml_tpu.obs as obs
 
     run_path = None
@@ -2098,6 +2107,91 @@ def _multichip_r06_worker(
     finally:
         if telemetry_dir:
             obs.shutdown()
+
+
+def _multichip_worker_setup(
+    coordinator: str, pid: int, nproc: int, knobs: dict | None = None,
+):
+    """Shared child-process prelude for every ``--multichip-rNN-worker``
+    (r06..r12 hand-rolled identical copies of this before it was
+    extracted): pin the CPU platform BEFORE the first jax import, apply
+    the leg's knob environment (a None value UNSETS the variable —
+    "knob absent" is a distinct arm from "knob 0"), select the gloo
+    host-collective transport, drop the axon backend factory (its
+    plugin probe would hang a loopback worker), and join the
+    coordinator. Returns the configured ``jax`` module."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    for k, v in (knobs or {}).items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = str(v)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    from photon_ml_tpu.parallel.multihost import initialize_multihost
+
+    initialize_multihost(coordinator, num_processes=nproc, process_id=pid)
+    return jax
+
+
+def _worker_probes():
+    """The per-worker telemetry/bitwise probes every multichip leg
+    re-declared inline: ``counter`` (registry counter value, 0.0 when
+    absent), ``gauge`` (raw registry gauge, ``default`` when absent —
+    callers that want a float pass ``default=0.0``) and ``sha`` (the
+    canonical contiguous-bytes digest the bitwise contracts compare)."""
+    import hashlib
+
+    from photon_ml_tpu.obs.metrics import REGISTRY
+
+    def counter(name: str) -> float:
+        return float(
+            REGISTRY.snapshot().get("counters", {})
+            .get(name, {}).get("value", 0.0)
+        )
+
+    def gauge(name: str, default=None):
+        return REGISTRY.snapshot().get("gauges", {}).get(name, default)
+
+    def sha(a) -> str:
+        return hashlib.sha256(
+            np.ascontiguousarray(np.asarray(a)).tobytes()
+        ).hexdigest()
+
+    return counter, gauge, sha
+
+
+def _collect_worker_results(
+    worker_flag: str, nproc: int, label: str, timeout_s: int = 900,
+    nproc_arg: int | None = None,
+) -> dict[int, dict]:
+    """Parent-side results collection every ``run_multichip_rNN`` leg
+    hand-rolled: spawn the loopback workers for ``worker_flag`` with the
+    standard ``coordinator pid nproc`` argv tail, unwrap each RESULT
+    line's ``results`` payload, and fail loudly on a missing process
+    (a worker that died after its peers completed their collectives).
+    ``nproc_arg`` overrides the argv nproc (the r09-style single-process
+    reference leg)."""
+    raw = _spawn_loopback_workers(
+        lambda coordinator, pid: (
+            [worker_flag, coordinator, str(pid),
+             str(nproc if nproc_arg is None else nproc_arg)]
+        ),
+        nproc, label, timeout_s=timeout_s,
+    )
+    per_pid = {pid: r["results"] for pid, r in raw.items()}
+    if set(per_pid) != set(range(nproc)):
+        raise RuntimeError(f"missing worker results: have {sorted(per_pid)}")
+    return per_pid
 
 
 def _spawn_loopback_workers(
@@ -2364,43 +2458,24 @@ def _multichip_r08_worker(coordinator: str, pid: int, nproc: int) -> None:
     process holds the full (replicated) in-memory dataset — exactly the
     in-memory trainer's contract — and dispatches only its owned
     buckets; the combine is the code under test."""
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""
-    os.environ["PHOTON_RE_SHARD"] = "1"
+    jax = _multichip_worker_setup(
+        coordinator, pid, nproc, knobs={"PHOTON_RE_SHARD": "1"},
+    )
     import hashlib
 
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    try:
-        from jax._src import xla_bridge as _xb
-
-        _xb._backend_factories.pop("axon", None)
-    except Exception:
-        pass
-    from photon_ml_tpu.parallel.multihost import initialize_multihost
-
-    initialize_multihost(coordinator, num_processes=nproc, process_id=pid)
     import jax.numpy as jnp
 
     from photon_ml_tpu.config import OptimizerConfig
     from photon_ml_tpu.game import bucket_entities, group_by_entity
     from photon_ml_tpu.game.data import DenseFeatures
     from photon_ml_tpu.game.random_effect import train_random_effects
-    from photon_ml_tpu.obs.metrics import REGISTRY
     from photon_ml_tpu.ops.losses import loss_for_task
     from photon_ml_tpu.parallel import data_mesh
     from photon_ml_tpu.types import TaskType, VarianceComputationType
 
     mesh = data_mesh()
     loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
-
-    def counter(name: str) -> float:
-        return float(
-            REGISTRY.snapshot().get("counters", {})
-            .get(name, {}).get("value", 0.0)
-        )
+    counter, _, _ = _worker_probes()
 
     results: dict[str, dict] = {}
     for E in MULTICHIP_R08_LADDER:
@@ -2454,15 +2529,9 @@ def run_multichip_r08(
     (≥ (P−1)/P · 50%) is written against."""
     here = os.path.dirname(os.path.abspath(__file__))
 
-    raw = _spawn_loopback_workers(
-        lambda coordinator, pid: (
-            ["--multichip-r08-worker", coordinator, str(pid), str(nproc)]
-        ),
-        nproc, "multichip_r08",
+    per_pid = _collect_worker_results(
+        "--multichip-r08-worker", nproc, "multichip_r08"
     )
-    per_pid = {pid: r["results"] for pid, r in raw.items()}
-    if set(per_pid) != set(range(nproc)):
-        raise RuntimeError(f"missing worker results: have {sorted(per_pid)}")
 
     rungs: dict[str, dict] = {}
     gate_metrics: dict[str, float] = {}
@@ -2610,25 +2679,10 @@ def _multichip_r09_worker(coordinator: str, pid: int, nproc: int) -> None:
     worker's contract (full replicated dataset, owned-bucket dispatch,
     segments combine) with the PHOTON_RE_SPLIT arm toggle, per-arm
     launch/byte accounting and the warm+prior second pass."""
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""
-    os.environ["PHOTON_RE_SHARD"] = "1"
-    os.environ["PHOTON_RE_COMBINE"] = "segments"
-    import hashlib
-
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    try:
-        from jax._src import xla_bridge as _xb
-
-        _xb._backend_factories.pop("axon", None)
-    except Exception:
-        pass
-    from photon_ml_tpu.parallel.multihost import initialize_multihost
-
-    initialize_multihost(coordinator, num_processes=nproc, process_id=pid)
+    jax = _multichip_worker_setup(
+        coordinator, pid, nproc,
+        knobs={"PHOTON_RE_SHARD": "1", "PHOTON_RE_COMBINE": "segments"},
+    )
     import jax.numpy as jnp
 
     from photon_ml_tpu.config import OptimizerConfig
@@ -2638,29 +2692,16 @@ def _multichip_r09_worker(coordinator: str, pid: int, nproc: int) -> None:
         _plan_bucket_owners,
         train_random_effects,
     )
-    from photon_ml_tpu.obs.metrics import REGISTRY
     from photon_ml_tpu.ops.losses import loss_for_task
     from photon_ml_tpu.parallel import data_mesh
     from photon_ml_tpu.types import TaskType, VarianceComputationType
 
     mesh = data_mesh()
     loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
-
-    def counter(name: str) -> float:
-        return float(
-            REGISTRY.snapshot().get("counters", {})
-            .get(name, {}).get("value", 0.0)
-        )
+    counter, _gauge, sha = _worker_probes()
 
     def gauge(name: str) -> float:
-        return float(
-            REGISTRY.snapshot().get("gauges", {}).get(name, 0.0)
-        )
-
-    def sha(a) -> str:
-        return hashlib.sha256(
-            np.ascontiguousarray(np.asarray(a)).tobytes()
-        ).hexdigest()
+        return float(_gauge(name, 0.0))
 
     results: dict[str, dict] = {}
     for E in MULTICHIP_R08_LADDER:
@@ -2749,25 +2790,15 @@ def run_multichip_r09(
     atom-granularity balance <= 1.15)."""
     here = os.path.dirname(os.path.abspath(__file__))
 
-    raw = _spawn_loopback_workers(
-        lambda coordinator, pid: (
-            ["--multichip-r09-worker", coordinator, str(pid), str(nproc)]
-        ),
-        nproc, "multichip_r09",
+    per_pid = _collect_worker_results(
+        "--multichip-r09-worker", nproc, "multichip_r09"
     )
-    per_pid = {pid: r["results"] for pid, r in raw.items()}
-    if set(per_pid) != set(range(nproc)):
-        raise RuntimeError(f"missing worker results: have {sorted(per_pid)}")
     # single-process unsplit reference: the bitwise anchor every arm
     # must reproduce (owned mode at P=1 dispatches every bucket locally
     # and skips the combine — the plain in-memory solve)
-    ref_raw = _spawn_loopback_workers(
-        lambda coordinator, pid: (
-            ["--multichip-r09-worker", coordinator, str(pid), "1"]
-        ),
-        1, "multichip_r09_ref",
-    )
-    ref = ref_raw[0]["results"]
+    ref = _collect_worker_results(
+        "--multichip-r09-worker", 1, "multichip_r09_ref", nproc_arg=1
+    )[0]
 
     try:
         with open(os.path.join(here, "MULTICHIP_r08.json")) as f:
@@ -2984,25 +3015,10 @@ def _multichip_r10_worker(coordinator: str, pid: int, nproc: int) -> None:
         "--xla_force_host_platform_device_count="
         f"{MULTICHIP_R10_NDEV}"
     )
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""
-    os.environ["PHOTON_RE_SHARD"] = "1"
-    os.environ["PHOTON_RE_COMBINE"] = "segments"
-    import hashlib
-
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    try:
-        from jax._src import xla_bridge as _xb
-
-        _xb._backend_factories.pop("axon", None)
-    except Exception:
-        pass
-    from photon_ml_tpu.parallel.multihost import initialize_multihost
-
-    initialize_multihost(coordinator, num_processes=nproc, process_id=pid)
+    jax = _multichip_worker_setup(
+        coordinator, pid, nproc,
+        knobs={"PHOTON_RE_SHARD": "1", "PHOTON_RE_COMBINE": "segments"},
+    )
     if jax.local_device_count() != MULTICHIP_R10_NDEV:
         raise RuntimeError(
             f"forced host device count did not take: "
@@ -3017,7 +3033,6 @@ def _multichip_r10_worker(coordinator: str, pid: int, nproc: int) -> None:
         _plan_bucket_owners,
         train_random_effects,
     )
-    from photon_ml_tpu.obs.metrics import REGISTRY
     from photon_ml_tpu.ops.losses import loss_for_task
     from photon_ml_tpu.parallel import data_mesh
     from photon_ml_tpu.parallel.placement import re_split_weight
@@ -3025,22 +3040,10 @@ def _multichip_r10_worker(coordinator: str, pid: int, nproc: int) -> None:
 
     mesh = data_mesh()
     loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
-
-    def counter(name: str) -> float:
-        return float(
-            REGISTRY.snapshot().get("counters", {})
-            .get(name, {}).get("value", 0.0)
-        )
+    counter, _gauge, sha = _worker_probes()
 
     def gauge(name: str) -> float:
-        return float(
-            REGISTRY.snapshot().get("gauges", {}).get(name, 0.0)
-        )
-
-    def sha(a) -> str:
-        return hashlib.sha256(
-            np.ascontiguousarray(np.asarray(a)).tobytes()
-        ).hexdigest()
+        return float(_gauge(name, 0.0))
 
     # (arm, PHOTON_RE_SPLIT, PHOTON_RE_DEVICE_SPLIT, PHOTON_RE_SPLIT_WEIGHT)
     arms = (
@@ -3142,15 +3145,9 @@ def run_multichip_r10(
     off arm)."""
     here = os.path.dirname(os.path.abspath(__file__))
 
-    raw = _spawn_loopback_workers(
-        lambda coordinator, pid: (
-            ["--multichip-r10-worker", coordinator, str(pid), str(nproc)]
-        ),
-        nproc, "multichip_r10", timeout_s=1800,
+    per_pid = _collect_worker_results(
+        "--multichip-r10-worker", nproc, "multichip_r10", timeout_s=1800
     )
-    per_pid = {pid: r["results"] for pid, r in raw.items()}
-    if set(per_pid) != set(range(nproc)):
-        raise RuntimeError(f"missing worker results: have {sorted(per_pid)}")
 
     try:
         with open(os.path.join(here, "MULTICHIP_r09.json")) as f:
@@ -3447,27 +3444,15 @@ def _multichip_r11_worker(coordinator: str, pid: int, nproc: int) -> None:
     segments combine) with the PHOTON_RE_PROJECT arm toggle, per-arm
     launch/byte accounting, the projection gauges and the cold-pass
     training AUC (the quality-parity anchor)."""
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""
-    os.environ["PHOTON_RE_SHARD"] = "1"
-    os.environ["PHOTON_RE_COMBINE"] = "segments"
-    os.environ["PHOTON_RE_SPLIT"] = "0"
-    os.environ.pop("PHOTON_RE_SPLIT_WEIGHT", None)
-    import hashlib
-
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    try:
-        from jax._src import xla_bridge as _xb
-
-        _xb._backend_factories.pop("axon", None)
-    except Exception:
-        pass
-    from photon_ml_tpu.parallel.multihost import initialize_multihost
-
-    initialize_multihost(coordinator, num_processes=nproc, process_id=pid)
+    jax = _multichip_worker_setup(
+        coordinator, pid, nproc,
+        knobs={
+            "PHOTON_RE_SHARD": "1",
+            "PHOTON_RE_COMBINE": "segments",
+            "PHOTON_RE_SPLIT": "0",
+            "PHOTON_RE_SPLIT_WEIGHT": None,
+        },
+    )
     import jax.numpy as jnp
 
     from photon_ml_tpu.config import OptimizerConfig
@@ -3478,27 +3463,13 @@ def _multichip_r11_worker(coordinator: str, pid: int, nproc: int) -> None:
         _plan_bucket_owners,
         train_random_effects,
     )
-    from photon_ml_tpu.obs.metrics import REGISTRY
     from photon_ml_tpu.ops.losses import loss_for_task
     from photon_ml_tpu.parallel import data_mesh
     from photon_ml_tpu.types import TaskType, VarianceComputationType
 
     mesh = data_mesh()
     loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
-
-    def counter(name: str) -> float:
-        return float(
-            REGISTRY.snapshot().get("counters", {})
-            .get(name, {}).get("value", 0.0)
-        )
-
-    def gauge(name: str):
-        return REGISTRY.snapshot().get("gauges", {}).get(name)
-
-    def sha(a) -> str:
-        return hashlib.sha256(
-            np.ascontiguousarray(np.asarray(a)).tobytes()
-        ).hexdigest()
+    counter, gauge, sha = _worker_probes()
 
     # (arm, PHOTON_RE_PROJECT value; None = env unset)
     arms = (
@@ -3600,15 +3571,9 @@ def run_multichip_r11(
     within |dAUC| <= 0.005)."""
     here = os.path.dirname(os.path.abspath(__file__))
 
-    raw = _spawn_loopback_workers(
-        lambda coordinator, pid: (
-            ["--multichip-r11-worker", coordinator, str(pid), str(nproc)]
-        ),
-        nproc, "multichip_r11", timeout_s=2400,
+    per_pid = _collect_worker_results(
+        "--multichip-r11-worker", nproc, "multichip_r11", timeout_s=2400
     )
-    per_pid = {pid: r["results"] for pid, r in raw.items()}
-    if set(per_pid) != set(range(nproc)):
-        raise RuntimeError(f"missing worker results: have {sorted(per_pid)}")
 
     arm_names = ("off", "off0", "support", "hash")
     hash_fields = (
@@ -3777,6 +3742,368 @@ def run_multichip_r11(
     return doc
 
 
+# -- MULTICHIP_r12: feature-range-sharded fixed-effect A/B (PHOTON_FE_SHARD)
+#
+# `python bench.py --multichip-r12` runs the gloo loopback harness at
+# P in {1, 2, 4} over ONE wide synthetic sparse GLM (d = 100k, Zipf
+# column popularity — the skew the nnz-weighted partitioner exists
+# for). Three arms per group: knob UNSET (off), knob "0" (off0 — must
+# reproduce off bit-for-bit: knob 0 IS the prior code) and knob "1"
+# (shard — each process holds only its contiguous feature range:
+# range-local optimizer state, column-restricted chunks, per-range
+# packed tile-COO streams). The solve runs the UNTILED streamed path
+# (Pallas interpret mode at d=100k would dominate the capture with
+# simulator time, not bytes); the packed-stream claim is measured
+# where the bytes actually live — the tile-COO layout pack under the
+# retuned 8x2 carve, read from the process-wide tile_cache byte
+# accounting. The load-bearing numbers: per-process packed bytes
+# shrinking ~ (P-1)/P on the shard arm, nnz balance <= 1.15x, and the
+# sharded solve matching the single-process reference (gradient
+# probe at a fixed iterate; model + held scores after 3 L-BFGS
+# iterations under range-global line-search scalars).
+
+MULTICHIP_R12_D = 100_000
+MULTICHIP_R12_N = 4096
+MULTICHIP_R12_K = 16
+MULTICHIP_R12_CHUNK = 512
+MULTICHIP_R12_PROCS = (1, 2, 4)
+MULTICHIP_R12_ITERS = 3
+
+
+def _multichip_r12_chunks():
+    """Deterministic wide sparse chunks: Zipf(1.3) column draws (a few
+    very hot features, a long cold tail) with standard-normal values and
+    a planted linear signal — every process rebuilds the identical
+    dataset from the fixed seed (the replicated-rows contract)."""
+    rng = np.random.default_rng(1217)
+    d, n, k = MULTICHIP_R12_D, MULTICHIP_R12_N, MULTICHIP_R12_K
+    idx = ((rng.zipf(1.3, size=(n, k)).astype(np.int64) - 1) % d).astype(
+        np.int32
+    )
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    w_true = (rng.standard_normal(d) * 0.5).astype(np.float32)
+    margins = (vals * w_true[idx]).sum(axis=1)
+    y = (margins + 0.5 * rng.standard_normal(n) > 0).astype(np.float32)
+    chunks = []
+    for lo in range(0, n, MULTICHIP_R12_CHUNK):
+        hi = lo + MULTICHIP_R12_CHUNK
+        chunks.append({
+            "labels": y[lo:hi],
+            "indices": idx[lo:hi],
+            "values": vals[lo:hi],
+            "offsets": np.zeros(hi - lo, np.float32),
+            "weights": np.ones(hi - lo, np.float32),
+        })
+    return chunks
+
+
+def _multichip_r12_worker(coordinator: str, pid: int, nproc: int) -> None:
+    """One harness process of the fe-shard A/B (child mode): per arm,
+    pack the tile-COO layouts (the packed-byte measurement), run the
+    untiled streamed solve (3 host-L-BFGS iterations), score through
+    the module ``stream_scores`` consumer, and probe value_and_grad at
+    a fixed iterate. Process 0 ships the full vectors (base64 f32
+    bytes) so the parent can compare the sharded arm NUMERICALLY
+    against the single-process reference; every process ships shas so
+    cross-process lockstep is asserted bitwise."""
+    import base64
+
+    _multichip_worker_setup(
+        coordinator, pid, nproc,
+        knobs={
+            # the retuned 8x2 carve (the kernel-shaping constants every
+            # on-chip capture since the carve retune runs under)
+            "PHOTON_GROUPS_PER_STEP": "8",
+            "PHOTON_SEGMENTS_PER_DMA": "2",
+            "PHOTON_FE_SHARD": None,
+            "PHOTON_FE_SPLIT_WEIGHT": None,
+        },
+    )
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.config import OptimizerConfig
+    from photon_ml_tpu.ops import tile_cache
+    from photon_ml_tpu.ops.losses import logistic_loss
+    from photon_ml_tpu.ops.streaming import (
+        StreamingGLMObjective,
+        stream_scores,
+    )
+    from photon_ml_tpu.optim.host_lbfgs import host_lbfgs_minimize
+
+    counter, gauge, sha = _worker_probes()
+    chunks = _multichip_r12_chunks()
+    d, n = MULTICHIP_R12_D, MULTICHIP_R12_N
+    rng = np.random.default_rng(7)
+    w_probe = (rng.standard_normal(d) * 0.01).astype(np.float32)
+
+    def b64(a) -> str:
+        return base64.b64encode(
+            np.ascontiguousarray(np.asarray(a, np.float32)).tobytes()
+        ).decode()
+
+    arms = (("off", None), ("off0", "0"), ("shard", "1"))
+    results: dict[str, dict] = {}
+    for arm, knob in arms:
+        if knob is None:
+            os.environ.pop("PHOTON_FE_SHARD", None)
+        else:
+            os.environ["PHOTON_FE_SHARD"] = knob
+        # packed-stream measurement: a TILED objective packs every
+        # chunk's layout at construction (host pack only; no kernel
+        # runs) — the cache's resident-byte total IS this process's
+        # packed tile-COO stream footprint for one full data pass
+        tile_cache.clear()
+        tobj = StreamingGLMObjective(
+            chunks, logistic_loss, num_features=d, l2_weight=1e-3,
+            tile_sparse=True,
+        )
+        packed_bytes = int(tile_cache.stats()["bytes"])
+        del tobj
+        # the solve: untiled streamed path, same objective contract
+        sobj = StreamingGLMObjective(
+            chunks, logistic_loss, num_features=d, l2_weight=1e-3,
+            tile_sparse=False,
+        )
+        # fixed-iterate probe: one value_and_grad — the parent checks
+        # the concatenated range segments against the reference grad
+        wp = sobj.fe_slice(w_probe) if sobj.fe_active else w_probe
+        pv, pg = sobj.value_and_grad(jnp.asarray(wp, jnp.float32))
+        pg = np.asarray(pg, np.float32)
+        pg_full = sobj.fe_gather(pg) if sobj.fe_active else pg
+        w0 = np.zeros(d, np.float32)
+        w0 = sobj.fe_slice(w0) if sobj.fe_active else w0
+        t0 = time.perf_counter()
+        res = host_lbfgs_minimize(
+            sobj, w0,
+            OptimizerConfig(
+                max_iterations=MULTICHIP_R12_ITERS, tolerance=1e-12
+            ),
+        )
+        wall = time.perf_counter() - t0
+        w_fit = np.asarray(res.w, np.float32)
+        w_full = sobj.fe_gather(w_fit) if sobj.fe_active else w_fit
+        # module scorer: the fourth streamed consumer under test (the
+        # shard arm takes its collective fixed-order-reduction path)
+        scores = np.asarray(
+            stream_scores(
+                chunks, w_full, num_rows=n, num_features=d,
+                tile_sparse=False,
+            ),
+            np.float32,
+        )
+        rec = {
+            "wall_s": round(wall, 4),
+            "packed_stream_bytes": packed_bytes,
+            "probe_value": float(pv),
+            "value": float(res.value),
+            "iterations": int(res.iterations),
+            "w_sha256": sha(w_full),
+            "scores_sha256": sha(scores),
+            "grad_sha256": sha(pg_full),
+        }
+        if arm == "shard":
+            rec["fe"] = {
+                "ranges": gauge("fe_shard.ranges"),
+                "width": gauge("fe_shard.width"),
+                "nnz_local": gauge("fe_shard.nnz_local"),
+                "nnz_balance": gauge("fe_shard.nnz_balance"),
+            }
+        if pid == 0:
+            rec["w_b64"] = b64(w_full)
+            rec["scores_b64"] = b64(scores)
+            rec["grad_b64"] = b64(pg_full)
+        results[arm] = rec
+    print("RESULT " + json.dumps({"pid": pid, "results": results}))
+
+
+def run_multichip_r12(
+    out_path: str = "MULTICHIP_r12.json",
+    procs: tuple = MULTICHIP_R12_PROCS,
+) -> dict:
+    """Drive the fe-shard A/B (parent mode) and write MULTICHIP_r12.json.
+    Asserts, in-harness: off0 reproducing off bit-for-bit per process
+    (model, scores, gradient probe, packed bytes — knob 0 IS the prior
+    code); every arm bitwise-lockstep across its group's processes; the
+    multi-process off arms reproducing the P=1 off reference bitwise
+    (replicated rows, no sharding → the identical computation); the
+    sharded model/scores/gradient numerically matching the reference;
+    and the acceptance bounds (packed-byte reduction >= 40% at P=4,
+    nnz balance <= 1.15)."""
+    import base64
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    # the P=1 off arm is the bitwise/numeric reference every group is
+    # compared against — it is always captured, even for a custom list
+    procs = tuple(sorted(set(int(P) for P in procs) | {1}))
+
+    def de64(s: str) -> "np.ndarray":
+        return np.frombuffer(base64.b64decode(s), np.float32)
+
+    groups = {
+        P: _collect_worker_results(
+            "--multichip-r12-worker", P, f"multichip_r12_P{P}",
+            timeout_s=1800,
+        )
+        for P in procs
+    }
+    ref = groups[1][0]
+    ref_w = de64(ref["off"]["w_b64"])
+    ref_scores = de64(ref["off"]["scores_b64"])
+    ref_grad = de64(ref["off"]["grad_b64"])
+
+    problems: list[str] = []
+    gate_metrics: dict[str, float] = {}
+    rungs: dict[str, dict] = {}
+    sha_fields = ("w_sha256", "scores_sha256", "grad_sha256")
+    for P, per_pid in groups.items():
+        rung: dict = {"nproc": P}
+        for arm in ("off", "off0", "shard"):
+            for field in sha_fields:
+                vals = {per_pid[p][arm][field] for p in range(P)}
+                if len(vals) != 1:
+                    problems.append(
+                        f"P{P}/{arm}: {field} differs across processes"
+                    )
+            # knob-off bit-for-bit: "0" and unset are the same code
+            # path, down to the packed layout bytes
+            if arm == "off0":
+                for p in range(P):
+                    a, b = per_pid[p]["off"], per_pid[p]["off0"]
+                    same = all(
+                        a[f] == b[f] for f in sha_fields
+                    ) and a["packed_stream_bytes"] == b["packed_stream_bytes"]
+                    if not same:
+                        problems.append(
+                            f"P{P} p{p}: off0 != off (knob 0 must be "
+                            f"bit-for-bit the unset path)"
+                        )
+            # replicated rows: the unsharded arms compute the identical
+            # full-space solve regardless of P
+            if arm in ("off", "off0"):
+                for field in sha_fields:
+                    if per_pid[0][arm][field] != ref["off"][field]:
+                        problems.append(
+                            f"P{P}/{arm}: {field} != P=1 off reference"
+                        )
+        off_bytes = per_pid[0]["off"]["packed_stream_bytes"]
+        if len({per_pid[p]["off"]["packed_stream_bytes"]
+                for p in range(P)}) != 1:
+            problems.append(f"P{P}: off packed bytes differ across processes")
+        shard_bytes = [
+            per_pid[p]["shard"]["packed_stream_bytes"] for p in range(P)
+        ]
+        mean_bytes = sum(shard_bytes) / P
+        reduction = 1.0 - mean_bytes / off_bytes if off_bytes else 0.0
+        expected = (P - 1) / P
+        fe0 = per_pid[0]["shard"].get("fe") or {}
+        # numeric parity vs the reference (the sharded arms reassociate
+        # float32 sums per range, so bitwise equality is not the
+        # contract off-P1; the gradient probe is a SINGLE evaluation —
+        # segments are disjoint contractions — while model/scores carry
+        # 3 iterations of line-search amplification)
+        w_s = de64(groups[P][0]["shard"]["w_b64"])
+        sc_s = de64(groups[P][0]["shard"]["scores_b64"])
+        g_s = de64(groups[P][0]["shard"]["grad_b64"])
+        grad_diff = float(np.max(np.abs(g_s - ref_grad)))
+        w_diff = float(np.max(np.abs(w_s - ref_w)))
+        scores_diff = float(np.max(np.abs(sc_s - ref_scores)))
+        if grad_diff > 1e-4:
+            problems.append(
+                f"P{P}: gradient probe max|delta| {grad_diff:.3g} > 1e-4"
+            )
+        if w_diff > 2e-3:
+            problems.append(f"P{P}: model max|delta| {w_diff:.3g} > 2e-3")
+        if scores_diff > 2e-3:
+            problems.append(
+                f"P{P}: scores max|delta| {scores_diff:.3g} > 2e-3"
+            )
+        rung.update({
+            "packed_stream_bytes_off": off_bytes,
+            "packed_stream_bytes_shard_per_process": {
+                str(p): shard_bytes[p] for p in range(P)
+            },
+            "packed_stream_bytes_shard_mean": mean_bytes,
+            "packed_bytes_reduction_fraction": round(reduction, 4),
+            "ideal_reduction_fraction": round(expected, 4),
+            "within_5pct_of_ideal": abs(reduction - expected) <= 0.05,
+            "nnz_balance": fe0.get("nnz_balance"),
+            "ranges": fe0.get("ranges"),
+            "grad_probe_max_abs_delta": grad_diff,
+            "model_max_abs_delta": w_diff,
+            "scores_max_abs_delta": scores_diff,
+            "wall_s_max_shard": max(
+                per_pid[p]["shard"]["wall_s"] for p in range(P)
+            ),
+        })
+        rungs[str(P)] = rung
+        gate_metrics[f"P{P}/packed_stream_bytes/off"] = float(off_bytes)
+        gate_metrics[f"P{P}/packed_stream_bytes/shard_mean"] = float(
+            mean_bytes
+        )
+        if fe0.get("nnz_balance") is not None:
+            gate_metrics[f"P{P}/fe_shard/nnz_balance"] = float(
+                fe0["nnz_balance"]
+            )
+        if fe0.get("ranges") is not None:
+            gate_metrics[f"P{P}/fe_shard/ranges"] = float(fe0["ranges"])
+
+    top = rungs[str(max(procs))]
+    reduction = top["packed_bytes_reduction_fraction"]
+    balance = float(top["nnz_balance"] or 0.0)
+    acceptance = {
+        "bitwise_and_parity_ok": not problems,
+        "packed_bytes_reduction_at_top_P": reduction,
+        "required_reduction": 0.40,
+        "reduction_ge_required": reduction >= 0.40,
+        "within_5pct_of_ideal_at_top_P": bool(top["within_5pct_of_ideal"]),
+        "nnz_balance_at_top_P": round(balance, 4),
+        "balance_le_1_15": bool(balance and balance <= 1.15),
+    }
+    doc = {
+        "round": 12,
+        "what": (
+            "feature-range-sharded fixed-effect A/B (PHOTON_FE_SHARD): "
+            "knob unset vs 0 vs 1 on a wide synthetic sparse logistic "
+            f"GLM (d={MULTICHIP_R12_D}, n={MULTICHIP_R12_N}, "
+            f"k={MULTICHIP_R12_K} Zipf columns), gloo loopback CPU "
+            f"groups at P in {list(procs)}; packed tile-COO stream "
+            "bytes from the process-wide layout cache under the 8x2 "
+            "carve, solves on the untiled streamed path (3 host-L-BFGS "
+            "iterations, range-global line-search scalars)"
+        ),
+        "d": MULTICHIP_R12_D,
+        "n": MULTICHIP_R12_N,
+        "ladder": rungs,
+        "acceptance": acceptance,
+        "gate_metrics": gate_metrics,
+        "problems": problems,
+        "note": (
+            "CPU wall at this scale is host-pack/dispatch bound and "
+            "recorded per the BASELINE protocol; the load-bearing "
+            "numbers are the per-process packed-stream bytes (the "
+            "range slice genuinely shrinks what each process packs, "
+            "ships and pins — raw index/value streams shrink the same "
+            "way via the per-row compaction) and the parity columns. "
+            "The shard arms reassociate float32 reductions per range, "
+            "so parity is numeric (tight bounds above), not bitwise; "
+            "off/off0 ARE bitwise, per process and across P."
+        ),
+    }
+    if problems:
+        raise RuntimeError(
+            f"MULTICHIP_r12: bitwise/parity contract violated: {problems}"
+        )
+    with open(os.path.join(here, out_path), "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    _log(
+        f"[bench] MULTICHIP_r12 capture written to {out_path} "
+        f"(packed-byte reduction {reduction:.1%} at P={max(procs)} vs "
+        f"required 40.0%, nnz balance {balance:.3f}x)"
+    )
+    return doc
+
+
 _BASELINE_BEGIN = "<!-- BEGIN MEASURED (generated by `python bench.py --update-baseline` from BENCH_DETAIL.json; do not hand-edit) -->"
 _BASELINE_END = "<!-- END MEASURED -->"
 
@@ -3911,12 +4238,22 @@ if __name__ == "__main__":
         run_multichip_r11(
             nproc=int(args[1]) if len(args) > 1 else MULTICHIP_R11_NPROC,
         )
+    elif args and args[0] == "--multichip-r12-worker":
+        _multichip_r12_worker(args[1], int(args[2]), int(args[3]))
+    elif args and args[0] == "--multichip-r12":
+        run_multichip_r12(
+            procs=(
+                tuple(int(a) for a in args[1:])
+                if len(args) > 1 else MULTICHIP_R12_PROCS
+            ),
+        )
     elif not args:
         main(telemetry_dir=telemetry_dir)
     else:
         _log(f"usage: bench.py [--quick | --update-baseline | "
              f"--config NAME [--quick] | --multichip-r07 [NPROC] | "
              f"--multichip-r08 [NPROC] | --multichip-r09 [NPROC] | "
-             f"--multichip-r10 [NPROC] | --multichip-r11 [NPROC]] "
+             f"--multichip-r10 [NPROC] | --multichip-r11 [NPROC] | "
+             f"--multichip-r12 [P...]] "
              f"[--telemetry-dir DIR]; got {args}")
         sys.exit(2)

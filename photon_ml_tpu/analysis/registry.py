@@ -247,6 +247,23 @@ KNOBS: tuple[Knob, ...] = (
         retune_global="RE_SPLIT_WEIGHT", retune_table="RETUNE_ENV_SHARD",
         sink_key="re_split_weight",
     ),
+    # -- feature-range-sharded fixed effect (RETUNE_ENV_SHARD) --------------
+    Knob(
+        name="PHOTON_FE_SHARD", kind="flag", parse="strict_int",
+        default="0", owner="photon_ml_tpu/data/index_map.py",
+        doc="1 = range-shard the fixed-effect feature space across processes",
+        accessors=("fe_shard_enabled",),
+        retune_global="FE_SHARD", retune_table="RETUNE_ENV_SHARD",
+        sink_key="fe_shard",
+    ),
+    Knob(
+        name="PHOTON_FE_SPLIT_WEIGHT", kind="enum", parse="enum",
+        default="nnz", owner="photon_ml_tpu/data/index_map.py",
+        doc="feature-range boundary weight axis: nnz | width",
+        accessors=("fe_split_weight",),
+        retune_global="FE_SPLIT_WEIGHT", retune_table="RETUNE_ENV_SHARD",
+        sink_key="fe_split_weight",
+    ),
     # -- observability / selection toggles ---------------------------------
     Knob(
         name="PHOTON_RE_ITER_ACCOUNTING", kind="flag", parse="strict_int",

@@ -25,6 +25,134 @@ import numpy as np
 DELIMITER = "\x01"
 INTERCEPT_KEY = "(INTERCEPT)"
 
+# Feature-range-sharded fixed-effect solves (PHOTON_FE_SHARD): 0 (default)
+# keeps the replicated-coefficient fixed-effect path bit-for-bit — every
+# process packs, caches and solves the full [0, d) feature space. 1
+# partitions the global feature space into P contiguous ranges
+# (``plan_feature_ranges``) and each process holds ONLY its range: packed
+# tile-COO streams, chunk-cache residency and the optimizer's coefficient
+# vector all shrink to ~1/P. The partition rule reads ONLY the global
+# per-feature nnz histogram and the process count — deterministic pure-host
+# arithmetic on inputs identical everywhere, so every process derives the
+# same boundaries with zero communication (the placement.py discipline).
+# Like every fleet knob it must be set identically on all processes.
+FE_SHARD = 0
+
+# Range-split weight axis (PHOTON_FE_SPLIT_WEIGHT): "nnz" (default) places
+# boundaries on the per-feature NNZ prefix sum — real feature frequency is
+# Zipf just like entity traffic, so a hot dense block would otherwise pin
+# one shard's packed bytes at a large multiple of the mean. "width" splits
+# the index space uniformly (the naive rule, kept for A/B).
+FE_SPLIT_WEIGHT = "nnz"
+
+_FE_SPLIT_WEIGHT_MODES = ("nnz", "width")
+
+
+def fe_shard_enabled() -> bool:
+    """``PHOTON_FE_SHARD`` (env > module global), strict parse like the
+    sibling fleet knobs — a typo fails loudly instead of silently benching
+    the replicated path."""
+    env = os.environ.get("PHOTON_FE_SHARD")
+    if env is not None and env != "":
+        return int(env) != 0
+    return int(FE_SHARD) != 0
+
+
+def fe_split_weight() -> str:
+    """``PHOTON_FE_SPLIT_WEIGHT`` (env > module global), strict membership
+    parse — an unknown axis fails loudly instead of silently benching the
+    default split."""
+    env = os.environ.get("PHOTON_FE_SPLIT_WEIGHT")
+    raw = env if (env is not None and env != "") else FE_SPLIT_WEIGHT
+    mode = str(raw)
+    if mode not in _FE_SPLIT_WEIGHT_MODES:
+        raise ValueError(
+            f"PHOTON_FE_SPLIT_WEIGHT must be one of {_FE_SPLIT_WEIGHT_MODES}, "
+            f"got {mode!r}")
+    return mode
+
+
+@dataclass(frozen=True)
+class FeatureRangePlan:
+    """A contiguous partition of the global feature space [0, d) into
+    ``num_ranges`` half-open ranges ``[boundaries[p], boundaries[p+1])``.
+
+    Ranges are DISJOINT and cover [0, d) exactly once, so per-range
+    gradient/coefficient segments concatenate back to the full vector
+    exactly (no arithmetic — the x+0.0-exact combine argument does not
+    even need to apply; it is pure concatenation)."""
+
+    boundaries: tuple[int, ...]  # num_ranges + 1 ascending ints, [0]=0, [-1]=d
+    weights: tuple[float, ...]   # per-range weight (nnz or width)
+
+    @property
+    def num_ranges(self) -> int:
+        return len(self.boundaries) - 1
+
+    @property
+    def num_features(self) -> int:
+        return int(self.boundaries[-1])
+
+    @property
+    def balance(self) -> float:
+        """max/mean per-range weight — the r12 gate's nnz-balance metric."""
+        w = np.asarray(self.weights, dtype=np.float64)
+        mean = float(w.mean()) if len(w) else 0.0
+        return float(w.max() / mean) if mean > 0 else 1.0
+
+    def range_of(self, pid: int) -> tuple[int, int]:
+        return int(self.boundaries[pid]), int(self.boundaries[pid + 1])
+
+
+def plan_feature_ranges(
+    weights: np.ndarray,
+    num_ranges: int,
+    mode: str | None = None,
+) -> FeatureRangePlan:
+    """Partition [0, d) into ``num_ranges`` contiguous ranges.
+
+    ``weights`` is the GLOBAL per-feature weight histogram (nnz counts
+    under the default axis) — identical on every process, so the plan is
+    too. Boundaries sit where the weight prefix sum crosses k·total/P
+    (the contiguous analogue of placement.py's LPT: contiguity is forced
+    by the range representation, so the optimal split is the balanced
+    prefix cut, no greedy bin-packing needed). Zero-weight features are
+    still owned by exactly one range — coverage of [0, d) is structural,
+    not weight-dependent. ``mode`` defaults to ``fe_split_weight()``."""
+    w = np.asarray(weights, dtype=np.float64).ravel()
+    d = len(w)
+    p = int(num_ranges)
+    if p <= 0:
+        raise ValueError(f"num_ranges must be positive, got {num_ranges}")
+    if d < p:
+        raise ValueError(f"cannot split {d} features into {p} ranges")
+    mode = fe_split_weight() if mode is None else mode
+    if mode not in _FE_SPLIT_WEIGHT_MODES:
+        raise ValueError(
+            f"feature split mode must be one of {_FE_SPLIT_WEIGHT_MODES}, "
+            f"got {mode!r}")
+    if mode == "width" or float(w.sum()) <= 0.0:
+        # uniform index split (also the degenerate all-zero-weight case)
+        cuts = [round(k * d / p) for k in range(p + 1)]
+    else:
+        prefix = np.concatenate([[0.0], np.cumsum(w)])
+        total = float(prefix[-1])
+        cuts = [0]
+        for k in range(1, p):
+            target = k * total / p
+            pos = int(np.searchsorted(prefix, target))
+            # pick the neighbour closer to the target
+            if pos > 0 and (pos > d or
+                            target - prefix[pos - 1] <= prefix[pos] - target):
+                pos = pos - 1
+            # monotone + leave room for the remaining p-k cuts
+            pos = min(max(pos, cuts[-1] + 1), d - (p - k))
+            cuts.append(pos)
+        cuts.append(d)
+    bounds = tuple(int(c) for c in cuts)
+    range_w = tuple(float(w[lo:hi].sum()) for lo, hi in zip(bounds, bounds[1:]))
+    return FeatureRangePlan(boundaries=bounds, weights=range_w)
+
 
 def feature_key(name: str, term: str = "") -> str:
     return f"{name}{DELIMITER}{term}" if term else name

@@ -1421,6 +1421,12 @@ class StreamedGameTrainer:
                     is VarianceComputationType.FULL
                     else None
                 ),
+                # GAME already shards the ENTITY axis across processes
+                # (parallel/placement); layering the feature-range shard
+                # on top (entity x feature grid) is future work, so the
+                # fixed-effect coordinate pins the knob OFF here — and
+                # its residual-offset chunk swap above stays legal
+                fe_shard=False,
             )
             self._fixed_objectives[cid] = sobj
         else:

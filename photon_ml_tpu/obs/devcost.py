@@ -169,6 +169,16 @@ def _knob_raw_state() -> tuple:
         )
     except Exception:
         project_state = None
+    try:
+        import sys
+
+        im_mod = sys.modules.get("photon_ml_tpu.data.index_map")
+        fe_state = (
+            None if im_mod is None
+            else (im_mod.FE_SHARD, im_mod.FE_SPLIT_WEIGHT)
+        )
+    except Exception:
+        fe_state = None
     return (
         env.get("PHOTON_PREFETCH_DEPTH"),
         env.get("PHOTON_CHUNK_CACHE_BUDGET"),
@@ -183,6 +193,8 @@ def _knob_raw_state() -> tuple:
         env.get("PHOTON_RE_REPLAN_IMBALANCE"),
         env.get("PHOTON_RE_DEVICE_SPLIT"),
         env.get("PHOTON_RE_SPLIT_WEIGHT"),
+        env.get("PHOTON_FE_SHARD"),
+        env.get("PHOTON_FE_SPLIT_WEIGHT"),
         pf.PREFETCH_DEPTH, pf.CHUNK_CACHE_BUDGET,
         len(pf._device_budget_memo),
         st.GROUPS_PER_STEP, st.SEGMENTS_PER_DMA,
@@ -190,6 +202,7 @@ def _knob_raw_state() -> tuple:
         re_state,
         shard_state,
         project_state,
+        fe_state,
     )
 
 

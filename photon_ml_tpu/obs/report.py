@@ -185,6 +185,16 @@ def summarize_run(path: str, records: list[dict] | None = None) -> dict:
         if k.startswith("re_shard.") and isinstance(v, (int, float))
     } or None
 
+    # fixed-effect feature-range sharding gauges (fe_shard.*,
+    # ops/streaming under PHOTON_FE_SHARD): range count, this process's
+    # range width and local nnz, and the planner's nnz balance ratio —
+    # the FEATURE-axis counterpart of the re_shard row-placement block
+    fe_shard = {
+        k[len("fe_shard."):]: float(v)
+        for k, v in metrics_gauges.items()
+        if k.startswith("fe_shard.") and isinstance(v, (int, float))
+    } or None
+
     optim = [r for r in records if r["event"] == "optim_result"]
     reasons: dict[str, int] = {}
     for r in optim:
@@ -337,6 +347,7 @@ def summarize_run(path: str, records: list[dict] | None = None) -> dict:
         },
         "re_solve": re_solve,
         "re_shard": re_shard,
+        "fe_shard": fe_shard,
         "quality_parity": quality_parity,
         "devcost": devcost,
         "hbm": hbm,
@@ -516,6 +527,14 @@ def format_summary(s: dict) -> str:
                 f"  re-shard devices: {int(rsh.get('devices') or 0)} local, "
                 f"device balance {dbal:.3f}x"
             )
+    fsh = s.get("fe_shard") or {}
+    if fsh.get("ranges"):
+        lines.append(
+            f"  fe-shard: {int(fsh['ranges'])} ranges, width "
+            f"{fsh.get('width', 0):.0f}, local nnz "
+            f"{fsh.get('nnz_local', 0):.0f}, "
+            f"nnz balance {fsh.get('nnz_balance', 1.0):.3f}x"
+        )
     rc = s.get("re_combine") or {}
     if rc.get("exchanges"):
         seg = (
@@ -1294,6 +1313,27 @@ def format_fleet(fs: dict) -> str:
                 for k, v in sorted(rc["per_process"].items())
             )
         )
+    # feature-range sharding at fleet granularity: count/balance are
+    # replicated, widths and local nnz are per-range — show the spread
+    fe_pp = {
+        k: (s.get("fe_shard") or {})
+        for k, s in (fs.get("processes") or {}).items()
+        if (s.get("fe_shard") or {}).get("ranges")
+    }
+    if fe_pp:
+        first = next(iter(fe_pp.values()))
+        widths = [
+            v.get("width") for v in fe_pp.values()
+            if isinstance(v.get("width"), (int, float))
+        ]
+        lines.append(
+            f"  fe-shard: {int(first.get('ranges') or 0)} ranges, "
+            f"nnz balance {float(first.get('nnz_balance') or 1.0):.3f}x"
+            + (
+                f", widths {min(widths):.0f}..{max(widths):.0f}"
+                if widths else ""
+            )
+        )
     prj = fs.get("re_project") or {}
     if prj:
         ratio = prj.get("mean_ratio")
@@ -1488,6 +1528,14 @@ DEFAULT_GATE_THRESHOLDS: dict[str, dict] = {
     # gates TIGHT — a >2% widening means the ladder (or the data's
     # sparsity structure) changed
     "re_project/": {"rel": 0.02},
+    # feature-range sharding tiers (PHOTON_FE_SHARD runs only —
+    # unsharded runs never emit these keys): the range count is exact
+    # planner arithmetic (one extra range is a planner change, not
+    # noise) and the nnz balance is deterministic on the histogram, so
+    # it gates as tight as the placement balances above
+    "fe_shard/": {"rel": 0.05},
+    "fe_shard/ranges": {"rel": 0.0, "abs": 0.0},
+    "fe_shard/nnz_balance": {"rel": 0.02},
     # quality tiers: deltas vs the f32 anchor, absolute headroom at the
     # parity-gate scale (|ΔAUC| ≤ 0.005 is the ladder's own bf16 gate)
     "quality/": {"rel": 0.0, "abs": 0.005},
@@ -1567,6 +1615,14 @@ def gate_metrics_from_summary(s: dict) -> dict[str, float]:
         # committed baselines) are unchanged.
         m["re_shard/atoms"] = float(rsh.get("atoms") or 0)
         m["re_shard/balance_split"] = float(rsh.get("balance") or 1.0)
+    fsh = s.get("fe_shard") or {}
+    if float(fsh.get("ranges") or 0) > 0:
+        # feature-range sharding ran: the range count is exact planner
+        # arithmetic and the nnz balance is deterministic on the
+        # histogram, so both gate tight. Unsharded runs never emit
+        # these keys — their baselines are unchanged.
+        m["fe_shard/ranges"] = float(fsh.get("ranges") or 0)
+        m["fe_shard/nnz_balance"] = float(fsh.get("nnz_balance") or 1.0)
     rc = s.get("re_combine") or {}
     if isinstance(rc.get("bytes_sent"), (int, float)):
         m["re_combine/bytes_sent"] = float(rc["bytes_sent"])
@@ -1621,6 +1677,10 @@ def gate_metrics_from_bench(doc: dict) -> dict[str, float]:
                 "re_shard.device_balance",
             ):
                 m[f"{cfg}/re_shard/{g[len('re_shard.'):]}"] = float(v)
+            elif g in ("fe_shard.ranges", "fe_shard.nnz_balance"):
+                # feature-range sharding readouts (the per-process width
+                # and nnz ride the narrative, not the one-sided gate)
+                m[f"{cfg}/{g.replace('.', '/', 1)}"] = float(v)
         gauges = tmetrics.get("gauges") or {}
         if float(gauges.get("re_shard.split_classes") or 0) > 0:
             # split-granularity tier (mirrors gate_metrics_from_summary)
@@ -1724,6 +1784,17 @@ def gate_metrics_from_fleet(fs: dict) -> dict[str, float]:
     rc = fs.get("re_combine") or {}
     if isinstance(rc.get("bytes_sent_total"), (int, float)):
         m["re_combine/bytes_sent"] = float(rc["bytes_sent_total"])
+    # feature-range sharding: range count and nnz balance are
+    # replicated (deterministic planner on the allreduced histogram),
+    # so gate the fleet MAX — a disagreeing shard can only look worse
+    for name in ("ranges", "nnz_balance"):
+        vals = [
+            (s.get("fe_shard") or {}).get(name)
+            for s in (fs.get("processes") or {}).values()
+        ]
+        vals = [float(v) for v in vals if isinstance(v, (int, float))]
+        if vals:
+            m[f"fe_shard/{name}"] = max(vals)
     # the projection ratio gates the fleet MAX of the per-process gauge
     # (replicated ladder: a disagreeing shard can only look worse)
     prj = fs.get("re_project") or {}

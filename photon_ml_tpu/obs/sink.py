@@ -379,6 +379,13 @@ def _knob_snapshot() -> dict:
         knobs["re_split_weight"] = str(placement.re_split_weight())
     except Exception:
         pass
+    try:
+        from photon_ml_tpu.data import index_map
+
+        knobs["fe_shard"] = int(bool(index_map.fe_shard_enabled()))
+        knobs["fe_split_weight"] = str(index_map.fe_split_weight())
+    except Exception:
+        pass
     return knobs
 
 

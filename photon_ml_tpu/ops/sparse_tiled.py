@@ -917,7 +917,8 @@ class _TileChunk:
 @functools.partial(
     jax.tree_util.register_dataclass,
     data_fields=["chunks", "labels", "offsets", "weights"],
-    meta_fields=["num_features", "num_rows_real", "n_pad_total", "d_pad_total"],
+    meta_fields=["num_features", "num_rows_real", "n_pad_total", "d_pad_total",
+                 "fe_range"],
 )
 @dataclass(frozen=True)
 class TiledSparseBatch:
@@ -934,6 +935,12 @@ class TiledSparseBatch:
     num_rows_real: int = field(metadata=dict(static=True))
     n_pad_total: int = field(metadata=dict(static=True))
     d_pad_total: int = field(metadata=dict(static=True))
+    # Feature-range identity under PHOTON_FE_SHARD: (pid, lo, hi, P) when
+    # this batch's columns are the [lo, hi) slice of the global feature
+    # space, else None. STATIC (a meta field) so the range id + boundaries
+    # ride every jit key that takes the batch — the dtype-ladder
+    # discipline: a re-plan invalidates by key, never by luck.
+    fe_range: tuple | None = field(default=None, metadata=dict(static=True))
 
     @property
     def num_rows(self) -> int:
@@ -1003,7 +1010,8 @@ def _build_chunk(
     )
 
 
-def tile_sparse_batch(batch, keep_empty_chunks: bool = False) -> TiledSparseBatch:
+def tile_sparse_batch(batch, keep_empty_chunks: bool = False,
+                      fe_range: tuple | None = None) -> TiledSparseBatch:
     """Build a ``TiledSparseBatch`` from a padded-sparse ``SparseBatch``
     (host-side one-time transform; zero-valued padding slots are dropped
     before tiling). Shapes beyond the per-kernel VMEM bounds are split
@@ -1058,6 +1066,7 @@ def tile_sparse_batch(batch, keep_empty_chunks: bool = False) -> TiledSparseBatc
         num_rows_real=n,
         n_pad_total=n_pad_total,
         d_pad_total=d_pad_total,
+        fe_range=fe_range,
     )
 
 

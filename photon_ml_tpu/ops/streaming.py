@@ -117,6 +117,61 @@ def _to_batch(chunk: dict, num_features: int | None) -> Batch:
     )
 
 
+def _fe_nnz_histogram(chunks: Sequence[dict], num_features: int) -> np.ndarray:
+    """Global per-feature nnz counts over sparse chunk dicts (padded
+    zero-value slots excluded — they never pack or contribute). Under
+    feature-range sharding rows are replicated, so the LOCAL histogram is
+    the global one and every process derives the identical partition."""
+    nnz = np.zeros(num_features, np.int64)
+    for c in chunks:
+        idx = np.asarray(c["indices"]).ravel()
+        val = np.asarray(c["values"]).ravel()
+        live = idx[val != 0.0]
+        if live.size:
+            nnz += np.bincount(live, minlength=num_features)
+    return nnz
+
+
+def _fe_restrict_chunks(
+    chunks: Sequence[dict], lo: int, hi: int
+) -> tuple[list[dict], int]:
+    """Column-restrict sparse chunk dicts to the feature range [lo, hi):
+    out-of-range entries zero out (index 0, value 0 — inert in both matvec
+    directions), in-range indices shift by -lo, and every chunk compacts
+    to ONE common per-row width (kept entries first, stable order) so the
+    restricted chunks stay uniform-shape for the one-kernel discipline —
+    and so the raw host→device stream shrinks with the range, not just
+    the packed tile-COO stream. labels/offsets/weights are SHARED with
+    the input chunks (same storage: per-pass streaming sees live values,
+    and the prefetch chunk cache keys keep hitting)."""
+    keeps = []
+    k_max = 1
+    for c in chunks:
+        idx = np.asarray(c["indices"])
+        val = np.asarray(c["values"])
+        keep = (idx >= lo) & (idx < hi) & (val != 0.0)
+        if keep.size:
+            k_max = max(k_max, int(keep.sum(axis=1).max()))
+        keeps.append(keep)
+    out = []
+    for c, keep in zip(chunks, keeps):
+        idx = np.asarray(c["indices"])
+        val = np.asarray(c["values"])
+        order = np.argsort(~keep, axis=1, kind="stable")
+        idx_loc = np.take_along_axis(
+            np.where(keep, idx - lo, 0).astype(idx.dtype), order, axis=1
+        )[:, :k_max]
+        val_loc = np.take_along_axis(
+            np.where(keep, val, 0.0).astype(val.dtype), order, axis=1
+        )[:, :k_max]
+        out.append(dict(
+            c,
+            indices=np.ascontiguousarray(idx_loc),
+            values=np.ascontiguousarray(val_loc),
+        ))
+    return out, k_max
+
+
 def device_hbm_budget_bytes(
     default: float = 8e9, fraction: float = 0.75, device=None
 ) -> float:
@@ -194,6 +249,18 @@ class StreamingGLMObjective:
     # preserve indices/values (the GAME trainer's per-visit swap only
     # changes offsets — a fingerprint check rejects anything else).
     tile_sparse: bool | None = None
+    # feature-range sharding (PHOTON_FE_SHARD): None = follow the knob
+    # (sparse chunks only); True/False force it per objective (the GAME
+    # trainer passes False — its entity axis is already sharded, mixed
+    # entity×feature sharding is future work). When active, this process
+    # holds ONLY its contiguous feature range [lo, hi): restricted
+    # column-sliced chunks, a (hi-lo,) coefficient/gradient contract
+    # toward the optimizer, and ONE fixed-ascending-range-order margin
+    # reduction per streamed pass. Requires replicated rows across
+    # processes (every process streams ALL rows; the win is the feature
+    # axis) — the complement of ``cross_process`` row sharding, and
+    # mutually exclusive with it.
+    fe_shard: bool | None = None
 
     def __post_init__(self):
         if not self.chunks and not self.cross_process:
@@ -202,7 +269,8 @@ class StreamingGLMObjective:
         if self.intercept_index is not None:
             mask = mask.at[self.intercept_index].set(0.0)
         # public: the host OWL-QN twin applies scalar L1 over this mask,
-        # exactly like the device objective's reg_mask contract
+        # exactly like the device objective's reg_mask contract (the
+        # LOCAL range slice under feature-range sharding)
         self.reg_mask = mask
         if self.prior_mean is not None:
             self.prior_mean = jnp.asarray(self.prior_mean, jnp.float32)
@@ -211,9 +279,22 @@ class StreamingGLMObjective:
         self._tile_layouts = None
         self._tile_meta = None
         self._tile_fingerprints = None
+        self._fe_plan = None
+        self._fe_range = None  # (pid, lo, hi, P) when sharded
+        self._fe_chunks = None
+        self._fe_dim = self.num_features
         from photon_ml_tpu.ops.sparse_tiled import auto_tile_streaming
 
         sparse = bool(self.chunks) and "indices" in self.chunks[0]
+        from photon_ml_tpu.data.index_map import fe_shard_enabled
+
+        want_fe = (
+            self.fe_shard
+            if self.fe_shard is not None
+            else (sparse and fe_shard_enabled())
+        )
+        if want_fe:
+            self._init_fe_shard(sparse)
         want_tiling = (
             self.tile_sparse
             if self.tile_sparse is not None
@@ -221,6 +302,8 @@ class StreamingGLMObjective:
         )
         if want_tiling and sparse:
             self._build_tile_layouts()
+        if self._fe_range is not None:
+            self._build_fe_kernels()
 
         def chunk_value_grad(batch: Batch, w: Array):
             obj = make_objective(
@@ -288,11 +371,16 @@ class StreamingGLMObjective:
 
         tbs = []
         fps = []
-        for c in self.chunks:
+        # under feature-range sharding the layouts pack the RESTRICTED
+        # column-sliced chunks (zeroed out-of-range entries drop at pack
+        # time, so the packed streams genuinely shrink to ~range nnz) and
+        # the range identity joins both the cache key and the batch meta
+        for c in (self._fe_chunks if self._fe_chunks is not None
+                  else self.chunks):
             sb = SparseBatch(
                 indices=c["indices"], values=c["values"], labels=c["labels"],
                 offsets=c["offsets"], weights=c["weights"],
-                num_features=self.num_features,
+                num_features=self._fe_dim,
             )
             fp = self._chunk_fingerprint(c)
             tbs.append(
@@ -300,7 +388,8 @@ class StreamingGLMObjective:
                     sb, keep_empty_chunks=True,
                     # same hash serves the swap guard (structure) and the
                     # cache key (structure + feature width) — computed once
-                    fingerprint=(fp[0], self.num_features, fp[1], fp[2]),
+                    fingerprint=(fp[0], self._fe_dim, fp[1], fp[2]),
+                    fe_range=self._fe_range,
                 )
             )
             fps.append(fp)
@@ -314,6 +403,171 @@ class StreamingGLMObjective:
             ref.num_rows_real, ref.n_pad_total, ref.d_pad_total
         )
         self._tile_fingerprints = fps
+
+    def _init_fe_shard(self, sparse: bool) -> None:
+        """Partition the feature space and restrict this process to its
+        range (PHOTON_FE_SHARD). The plan reads ONLY the global per-feature
+        nnz histogram and the effective process count — deterministic
+        pure-host arithmetic on inputs identical on every process (rows are
+        replicated under this mode), so every process derives the same
+        boundaries with zero communication. The regularizer surfaces
+        (reg_mask, priors) slice to the range: the ranges are DISJOINT, so
+        local quadratic terms sum to the global regularizer exactly."""
+        from photon_ml_tpu.data.index_map import plan_feature_ranges
+        from photon_ml_tpu.obs.metrics import REGISTRY
+        from photon_ml_tpu.parallel.multihost import (
+            effective_process_count,
+            effective_process_index,
+        )
+
+        if not sparse:
+            raise ValueError(
+                "PHOTON_FE_SHARD requires sparse chunks (dense chunks fit "
+                "one chip's HBM by construction)"
+            )
+        if self.cross_process:
+            raise ValueError(
+                "PHOTON_FE_SHARD shards the FEATURE axis over replicated "
+                "rows; cross_process shards rows — the two are mutually "
+                "exclusive on one objective"
+            )
+        if self.norm is not None:
+            raise NotImplementedError(
+                "PHOTON_FE_SHARD supports identity normalization only "
+                "(norm=None): normalization shifts couple all ranges "
+                "through the margin correction"
+            )
+        p_count = effective_process_count()
+        pid = effective_process_index()
+        plan = plan_feature_ranges(
+            _fe_nnz_histogram(self.chunks, self.num_features), p_count
+        )
+        lo, hi = plan.range_of(pid)
+        self._fe_plan = plan
+        self._fe_range = (pid, lo, hi, p_count)
+        self._fe_dim = hi - lo
+        self._fe_chunks, _ = _fe_restrict_chunks(self.chunks, lo, hi)
+        self.reg_mask = self.reg_mask[lo:hi]
+        if self.prior_mean is not None:
+            self.prior_mean = self.prior_mean[lo:hi]
+        if self.prior_precision is not None:
+            self.prior_precision = self.prior_precision[lo:hi]
+        REGISTRY.gauge_set("fe_shard.ranges", float(p_count))
+        REGISTRY.gauge_set("fe_shard.width", float(self._fe_dim))
+        REGISTRY.gauge_set("fe_shard.nnz_local", float(plan.weights[pid]))
+        REGISTRY.gauge_set("fe_shard.nnz_balance", float(plan.balance))
+
+    def _build_fe_kernels(self) -> None:
+        """The sharded per-chunk programs (ONE compiled kernel per
+        contract, re-entered for every chunk — the same discipline as the
+        replicated kernels). Phase A computes the range-local partial
+        matvec(s); phase B re-streams the chunks against the COMBINED
+        margins, which ride as one device array sliced per chunk (chunk
+        shapes are uniform, so the chunk index is the only per-chunk
+        value and stays a traced scalar)."""
+        loss = self.loss
+        n_chunk = int(np.asarray(self.chunks[0]["labels"]).shape[0])
+
+        def weighted(batch, x):
+            wts = batch.weights
+            return jnp.where(wts != 0.0, wts * x, 0.0)
+
+        def m_at(full, i):
+            return jax.lax.dynamic_slice(full, (i * n_chunk,), (n_chunk,))
+
+        def fe_margin(batch, ws):
+            return jnp.stack([batch.matvec(w) for w in ws])
+
+        def fe_value(batch, mi):
+            m = m_at(mi[0][0], mi[1]) + batch.offsets
+            return jnp.sum(weighted(batch, loss.value(m, batch.labels)))
+
+        def fe_value_grad(batch, mi):
+            m = m_at(mi[0][0], mi[1]) + batch.offsets
+            val = jnp.sum(weighted(batch, loss.value(m, batch.labels)))
+            r = weighted(batch, loss.d1(m, batch.labels))
+            return val, batch.rmatvec(r)
+
+        def fe_hvp(batch, mi):
+            m = m_at(mi[0][0], mi[1]) + batch.offsets
+            q = weighted(batch, loss.d2(m, batch.labels)) * m_at(mi[0][1], mi[1])
+            return batch.rmatvec(q)
+
+        def fe_hessian_diag(batch, mi):
+            m = m_at(mi[0][0], mi[1]) + batch.offsets
+            return batch.rmatvec_sq(
+                weighted(batch, loss.d2(m, batch.labels))
+            )
+
+        self._fe_k_m = jax.jit(fe_margin)
+        self._fe_k_v = jax.jit(fe_value)
+        self._fe_k_vg = jax.jit(fe_value_grad)
+        self._fe_k_hvp = jax.jit(fe_hvp)
+        self._fe_k_hd = jax.jit(fe_hessian_diag)
+
+    def _fe_combine_margins(self, ws: tuple, l2_w=None):
+        """Phase A of a sharded evaluation: stream the range-local partial
+        matvec(s) over the restricted chunks, then ONE cross-range
+        reduction in FIXED ASCENDING RANGE ORDER (``allreduce_sum_host``
+        allgathers and sums in process order — psum-equivalent under a
+        healthy mesh, the framed-P2P raw-ndarray codec when degraded), so
+        every process holds bit-identical combined margins. ``l2_w``
+        piggybacks the local regularizer scalar on the same collective —
+        a sharded pass costs exactly one margin-sized reduction."""
+        from photon_ml_tpu.parallel.multihost import allreduce_sum_host
+
+        ws = tuple(jnp.asarray(w) for w in ws)
+        parts = self._stream(
+            ws, self._fe_k_m, lambda acc, out: acc + [np.asarray(out)], [],
+            devcost_fn=self._fe_k_m, devcost_label="streaming.fe_margins",
+        )
+        partial = np.concatenate(parts, axis=1)
+        if l2_w is None:
+            return jnp.asarray(allreduce_sum_host(partial))
+        l2_local = np.asarray(self._l2_term(jnp.asarray(l2_w)), np.float32)
+        m, l2 = allreduce_sum_host(partial, l2_local)
+        return jnp.asarray(m), jnp.asarray(l2)
+
+    @property
+    def fe_active(self) -> bool:
+        """True when this objective's coefficient contract is a
+        feature-range shard (w, gradients and curvature vectors are the
+        LOCAL (hi-lo,) segment; values and line-search scalars are
+        global)."""
+        return self._fe_range is not None
+
+    def fe_slice(self, w_full) -> np.ndarray:
+        """This process's range segment of a full-space vector (warm
+        starts, priors already sliced at build)."""
+        _pid, lo, hi, _p = self._fe_range
+        return np.asarray(w_full)[lo:hi]
+
+    def fe_gather(self, w_local) -> np.ndarray:
+        """EXACT full-space assembly of per-range segments: an ascending-
+        range-order allgather + concatenation — pure data movement, no
+        arithmetic, so the assembled vector is bitwise the segments.
+        Collective (framed-P2P: segments are variable-width); identity at
+        a single range."""
+        w_local = np.asarray(w_local)
+        if self._fe_range[3] <= 1:
+            return w_local
+        from photon_ml_tpu.parallel.multihost import allgather_obj_p2p
+
+        parts = allgather_obj_p2p(w_local, tag="fe_gather")
+        return np.concatenate([np.asarray(p) for p in parts])
+
+    def fe_dot(self, a, b) -> float:
+        """Global inner product of two range-local vectors: local dot,
+        then a scalar all-reduce — the ONLY wire traffic the optimizers'
+        line searches add. Every process receives the identical sum
+        (fixed-order reduction), so host-side control flow stays in
+        lockstep."""
+        from photon_ml_tpu.parallel.multihost import allreduce_sum_host
+
+        local = np.asarray(
+            np.dot(np.asarray(a, np.float64), np.asarray(b, np.float64))
+        )
+        return float(allreduce_sum_host(local))
 
     @staticmethod
     def _chunk_fingerprint(chunk: dict) -> tuple:
@@ -346,6 +600,19 @@ class StreamingGLMObjective:
         )
 
     def __setattr__(self, name, value):
+        if (
+            name == "chunks"
+            and getattr(self, "_fe_chunks", None) is not None
+        ):
+            # the restricted column slices (and the plan they were cut
+            # by) were derived from the PREVIOUS chunks; no caller swaps
+            # chunks on a sharded objective today (the GAME trainer opts
+            # out with fe_shard=False), so refuse loudly instead of
+            # silently re-deriving a possibly different plan
+            raise ValueError(
+                "chunk swap under feature-range sharding (PHOTON_FE_SHARD); "
+                "rebuild the StreamingGLMObjective"
+            )
         if (
             name == "chunks"
             and getattr(self, "_tile_layouts", None) is not None
@@ -392,14 +659,16 @@ class StreamingGLMObjective:
                 chunks=self._tile_layouts[i],
                 labels=cur["labels"], offsets=cur["offsets"],
                 weights=cur["weights"],
-                num_features=self.num_features,
+                num_features=self._fe_dim,
                 num_rows_real=num_rows_real,
                 n_pad_total=n_pad, d_pad_total=d_pad,
+                fe_range=self._fe_range,
             )
-        return _to_batch(cur, self.num_features)
+        return _to_batch(cur, self._fe_dim)
 
     def _stream(self, params, kernel: Callable, accumulate: Callable, init,
-                devcost_fn=None, devcost_label: str | None = None):
+                devcost_fn=None, devcost_label: str | None = None,
+                params_for: Callable | None = None):
         """Host→device chunk pipeline. Default (``PHOTON_PREFETCH_DEPTH``
         > 0): a bounded-depth background pipeline (``ops/prefetch``)
         prepares chunk ``i+k`` — host staging + ``device_put`` through the
@@ -419,14 +688,23 @@ class StreamingGLMObjective:
         for analytic cost capture (``obs/devcost``) — chunks are
         uniform-shape, so the FIRST chunk's signature covers every chunk
         of every pass, and the capture dedup means passes 2..N emit
-        nothing."""
+        nothing.
+
+        ``params_for`` (feature-range sharding's phase B) supplies
+        PER-CHUNK params (chunk index → params) instead of the shared
+        ``params`` — the combined margins ride as one device array and
+        each chunk's kernel slices its rows by index."""
         slim = (
             (lambda c: {k: c[k] for k in ("labels", "offsets", "weights")})
             if self._tile_layouts is not None
             else (lambda c: c)
         )
+        # under feature-range sharding the stream serves the RESTRICTED
+        # column-sliced chunks; their labels/offsets/weights are the SAME
+        # storage as self.chunks', so live per-pass values still ride
+        src = self._fe_chunks if self._fe_chunks is not None else self.chunks
         acc = init
-        if not self.chunks:
+        if not src:
             return acc
         from photon_ml_tpu.obs import devcost
         from photon_ml_tpu.obs.metrics import REGISTRY
@@ -435,37 +713,39 @@ class StreamingGLMObjective:
         # registry counters (one update per PASS, not per chunk: the
         # telemetry write must never show up on the chunk critical path)
         REGISTRY.counter_inc("stream.passes")
-        REGISTRY.counter_inc("stream.chunks", len(self.chunks))
+        REGISTRY.counter_inc("stream.chunks", len(src))
 
         depth = prefetch.prefetch_depth()
         if depth <= 0:
             # pack_host_chunk: raw feature columns transfer at the
             # precision ladder's storage dtype here too (identity on the
             # f32 rung, so depth 0 stays the pre-prefetch path bit-for-bit)
-            nxt = jax.device_put(prefetch.pack_host_chunk(slim(self.chunks[0])))
-            for i in range(len(self.chunks)):
+            nxt = jax.device_put(prefetch.pack_host_chunk(slim(src[0])))
+            for i in range(len(src)):
                 cur = nxt
-                if i + 1 < len(self.chunks):
+                if i + 1 < len(src):
                     nxt = jax.device_put(
-                        prefetch.pack_host_chunk(slim(self.chunks[i + 1]))
+                        prefetch.pack_host_chunk(slim(src[i + 1]))
                     )
                 b = self._chunk_batch(cur, i)
+                p_i = params_for(i) if params_for is not None else params
                 if i == 0 and devcost_fn is not None:
-                    devcost.capture(devcost_label, devcost_fn, (b, params))
-                out = kernel(b, params)
+                    devcost.capture(devcost_label, devcost_fn, (b, p_i))
+                out = kernel(b, p_i)
                 acc = accumulate(acc, out)
             return acc
 
         def prepare(i):
-            return prefetch.cached_device_put(slim(self.chunks[i]))
+            return prefetch.cached_device_put(slim(src[i]))
 
         for i, cur in enumerate(
-            prefetch.prefetch_iter(len(self.chunks), prepare, depth)
+            prefetch.prefetch_iter(len(src), prepare, depth)
         ):
             b = self._chunk_batch(cur, i)
+            p_i = params_for(i) if params_for is not None else params
             if i == 0 and devcost_fn is not None:
-                devcost.capture(devcost_label, devcost_fn, (b, params))
-            out = kernel(b, params)
+                devcost.capture(devcost_label, devcost_fn, (b, p_i))
+            out = kernel(b, p_i)
             acc = accumulate(acc, out)
         return acc
 
@@ -488,6 +768,8 @@ class StreamingGLMObjective:
         )
 
     def value(self, w: Array) -> Array:
+        if self._fe_range is not None:
+            return self._fe_value(w)
         total = self._stream(
             jnp.asarray(w), self._chunk_v, lambda acc, v: acc + v,
             jnp.float32(0.0),
@@ -503,6 +785,8 @@ class StreamingGLMObjective:
         """Gauss-Newton Hessian-vector product, streamed — TRON's CG inner
         loop costs one full-data pass per step, exactly the reference's
         treeAggregate accounting (SURVEY §2.1 TRON row)."""
+        if self._fe_range is not None:
+            return self._fe_hvp(w, v)
         w = jnp.asarray(w)
         v = jnp.asarray(v)
         init = jnp.zeros((self.num_features,), jnp.float32)
@@ -527,6 +811,8 @@ class StreamingGLMObjective:
         solution costs one extra full-data pass (the in-memory formula is
         linear in the per-chunk data sums, so chunk partials add; the L2
         term lands once, after the cross-process sum)."""
+        if self._fe_range is not None:
+            return self._fe_hessian_diag(w)
         w = jnp.asarray(w)
         init = jnp.zeros((self.num_features,), jnp.float32)
         diag = self._stream(
@@ -559,6 +845,12 @@ class StreamingGLMObjective:
         streamed gradient), then a host-side inverse by the caller. The
         d-bound keeps the accumulator a bounded device buffer; beyond it
         FULL is refused eagerly with the limit in the message."""
+        if self._fe_range is not None:
+            raise NotImplementedError(
+                "FULL variance is not supported under feature-range "
+                "sharding (PHOTON_FE_SHARD) — the d×d Hessian couples all "
+                "ranges; use SIMPLE variances"
+            )
         if self._tile_layouts is not None:
             raise NotImplementedError(
                 "FULL variance is not supported with tile-COO streamed "
@@ -599,9 +891,16 @@ class StreamingGLMObjective:
         layouts the solve used when they exist (the GAME trainer scores
         every coordinate visit; re-running those scores through the XLA
         gather path forfeited the kernel the visit just trained on), else
-        the plain per-chunk matvec."""
+        the plain per-chunk matvec.
+
+        Under feature-range sharding ``w`` is the LOCAL range segment and
+        the returned scores are the COMBINED full margins (identical on
+        every process — the fixed-ascending-range-order reduction)."""
         if not self.chunks:
             return np.zeros(num_rows, np.float32)
+        if self._fe_range is not None:
+            m = self._fe_combine_margins((jnp.asarray(w),))
+            return np.asarray(m[0])[:num_rows]
         w = jnp.asarray(w)
         from photon_ml_tpu.ops import prefetch
 
@@ -640,6 +939,8 @@ class StreamingGLMObjective:
         return np.concatenate(outs)[:num_rows]
 
     def value_and_grad(self, w: Array) -> tuple[Array, Array]:
+        if self._fe_range is not None:
+            return self._fe_value_and_grad(w)
         w = jnp.asarray(w)
         init = (jnp.float32(0.0), jnp.zeros((self.num_features,), jnp.float32))
         v, g = self._stream(
@@ -656,6 +957,77 @@ class StreamingGLMObjective:
             v, g = jnp.asarray(v), jnp.asarray(g)
         g = g + jnp.float32(self.l2_weight) * self.reg_mask * self._reg_delta(w)
         return v + self._l2_term(w), g
+
+    # -- feature-range-sharded consumers (PHOTON_FE_SHARD) -------------------
+    # Every evaluation is two streamed passes: phase A computes the
+    # range-local partial matvec(s) and ONE fixed-ascending-range-order
+    # reduction assembles the full margins (identical bits everywhere);
+    # phase B derives the contract from the combined margins. The data
+    # value is a full-data sum every process computes identically (no
+    # second collective); gradient/curvature contractions are DISJOINT
+    # range segments — the local slice IS this process's result, exact by
+    # construction (pure concatenation reassembles the full vector, no
+    # combine arithmetic at all). The regularizer terms are elementwise
+    # over local slices of mask/priors, equally exact; only the L2 VALUE
+    # scalar crosses the wire, piggybacked on the phase-A reduction.
+
+    def _fe_value(self, w: Array) -> Array:
+        w = jnp.asarray(w)
+        m, l2 = self._fe_combine_margins((w,), l2_w=w)
+        total = self._stream(
+            None, self._fe_k_v, lambda acc, v: acc + v, jnp.float32(0.0),
+            devcost_fn=self._fe_k_v,
+            devcost_label="streaming.fe_chunk_value",
+            params_for=lambda i: (m, jnp.int32(i)),
+        )
+        return total + l2
+
+    def _fe_value_and_grad(self, w: Array) -> tuple[Array, Array]:
+        w = jnp.asarray(w)
+        m, l2 = self._fe_combine_margins((w,), l2_w=w)
+        init = (jnp.float32(0.0), jnp.zeros((self._fe_dim,), jnp.float32))
+        v, g = self._stream(
+            None, self._fe_k_vg,
+            lambda acc, out: (acc[0] + out[0], acc[1] + out[1]), init,
+            devcost_fn=self._fe_k_vg,
+            devcost_label="streaming.fe_chunk_value_grad",
+            params_for=lambda i: (m, jnp.int32(i)),
+        )
+        g = g + jnp.float32(self.l2_weight) * self.reg_mask * self._reg_delta(w)
+        return v + l2, g
+
+    def _fe_hvp(self, w: Array, v: Array) -> Array:
+        # BOTH partial matvecs (margins of w, direction image of v) stack
+        # into one phase-A stream and one reduction
+        w = jnp.asarray(w)
+        v = jnp.asarray(v)
+        m2 = self._fe_combine_margins((w, v))
+        hv = self._stream(
+            None, self._fe_k_hvp, lambda acc, out: acc + out,
+            jnp.zeros((self._fe_dim,), jnp.float32),
+            devcost_fn=self._fe_k_hvp,
+            devcost_label="streaming.fe_chunk_hvp",
+            params_for=lambda i: (m2, jnp.int32(i)),
+        )
+        return hv + (
+            jnp.float32(self.l2_weight) * self.reg_mask
+            * self._reg_curvature(v) * v
+        )
+
+    def _fe_hessian_diag(self, w: Array) -> Array:
+        w = jnp.asarray(w)
+        m = self._fe_combine_margins((w,))
+        diag = self._stream(
+            None, self._fe_k_hd, lambda acc, out: acc + out,
+            jnp.zeros((self._fe_dim,), jnp.float32),
+            devcost_fn=self._fe_k_hd,
+            devcost_label="streaming.fe_chunk_hessian_diag",
+            params_for=lambda i: (m, jnp.int32(i)),
+        )
+        return diag + (
+            jnp.float32(self.l2_weight) * self.reg_mask
+            * self._reg_curvature(diag)
+        )
 
 
 @functools.partial(jax.jit, static_argnames=("constants",))
@@ -738,6 +1110,12 @@ def stream_scores(
     from photon_ml_tpu.ops.sparse_tiled import auto_tile_streaming
 
     sparse = "indices" in chunks[0]
+    from photon_ml_tpu.data.index_map import fe_shard_enabled
+
+    if sparse and num_features is not None and fe_shard_enabled():
+        return _stream_scores_fe(
+            chunks, w, num_rows, num_features, tile_sparse
+        )
     want_tiling = (
         tile_sparse
         if tile_sparse is not None
@@ -779,3 +1157,68 @@ def stream_scores(
         for b in prefetch.prefetch_iter(len(chunks), prepare)
     ]
     return np.concatenate(outs)[:num_rows]
+
+
+def _stream_scores_fe(
+    chunks: Sequence[dict],
+    w: np.ndarray,
+    num_rows: int,
+    num_features: int,
+    tile_sparse: bool | None,
+) -> np.ndarray:
+    """Module scorer under PHOTON_FE_SHARD: ``w`` is the FULL coefficient
+    vector; each process scores its feature range's partial matvec over
+    column-restricted chunks and ONE fixed-ascending-range-order reduction
+    assembles the full margins (identical on every process). COLLECTIVE —
+    every process of the group must call it at the same point. The plan
+    re-derives from the chunk nnz histogram (deterministic, the same rule
+    the objective used), so scoring hits the layouts the solve packed."""
+    from photon_ml_tpu.data.index_map import plan_feature_ranges
+    from photon_ml_tpu.parallel.multihost import (
+        allreduce_sum_host,
+        effective_process_count,
+        effective_process_index,
+    )
+    from photon_ml_tpu.ops import prefetch
+    from photon_ml_tpu.ops.sparse_tiled import auto_tile_streaming
+
+    p_count = effective_process_count()
+    pid = effective_process_index()
+    plan = plan_feature_ranges(
+        _fe_nnz_histogram(chunks, num_features), p_count
+    )
+    lo, hi = plan.range_of(pid)
+    restricted, _k = _fe_restrict_chunks(chunks, lo, hi)
+    d_local = hi - lo
+    fe_range = (pid, lo, hi, p_count)
+    want_tiling = (
+        tile_sparse
+        if tile_sparse is not None
+        else auto_tile_streaming(True, num_features)
+    )
+    w_loc = jnp.asarray(np.asarray(w)[lo:hi])
+
+    def prepare(i):
+        c = restricted[i]
+        if not want_tiling:
+            c = prefetch.pack_host_chunk(c)
+        b = _to_batch(c, d_local)
+        if want_tiling:
+            from photon_ml_tpu.ops import tile_cache
+
+            shape, h_idx, h_val = _chunk_structure_fingerprint(
+                c["indices"], c["values"]
+            )
+            b = tile_cache.tiled_layout_for(
+                b, keep_empty_chunks=True,
+                fingerprint=(shape, d_local, h_idx, h_val),
+                fe_range=fe_range,
+            )
+        return b
+
+    outs = [
+        np.asarray(_score_matvec(b, w_loc))
+        for b in prefetch.prefetch_iter(len(restricted), prepare)
+    ]
+    partial = np.concatenate(outs)
+    return np.asarray(allreduce_sum_host(partial))[:num_rows]

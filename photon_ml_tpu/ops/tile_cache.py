@@ -180,20 +180,25 @@ def _chunks_nbytes(chunks) -> int:
 
 
 def tiled_layout_for(batch, keep_empty_chunks: bool = False,
-                     fingerprint: tuple | None = None):
+                     fingerprint: tuple | None = None,
+                     fe_range: tuple | None = None):
     """A ``TiledSparseBatch`` for ``batch``, reusing the cached layout when
     an identical sparsity structure was already packed under the current
     tuned constants. The returned batch ALWAYS carries the caller's
     labels/offsets/weights (only the packed streams are shared).
     ``fingerprint`` lets callers that already hashed the chunk (the
-    streamed objective's swap guard) skip the second hash."""
+    streamed objective's swap guard) skip the second hash. ``fe_range``
+    is the feature-range identity ((pid, lo, hi, P)) of a range-sliced
+    batch under PHOTON_FE_SHARD — it joins the cache key (a re-plan or
+    P change invalidates by key, never by luck) and rides the built
+    batch as its static ``fe_range`` meta field."""
     import photon_ml_tpu.ops.sparse_tiled as st
 
     if fingerprint is None:
         fingerprint = sparsity_fingerprint(
             batch.indices, batch.values, batch.num_features
         )
-    key = (fingerprint, bool(keep_empty_chunks), tuned_constants())
+    key = (fingerprint, bool(keep_empty_chunks), fe_range, tuned_constants())
     with _lock:
         cached = _entries.get(key)
         if cached is not None:
@@ -212,11 +217,16 @@ def tiled_layout_for(batch, keep_empty_chunks: bool = False,
             num_rows_real=num_rows_real,
             n_pad_total=n_pad_total,
             d_pad_total=d_pad_total,
+            fe_range=fe_range,
         )
     # build OUTSIDE the lock (packing is the expensive part) through the
     # module attribute, so instrumented/monkeypatched builders see misses
     # (and keep the plain one-arg call shape they expect)
-    if keep_empty_chunks:
+    if fe_range is not None:
+        tb = st.tile_sparse_batch(
+            batch, keep_empty_chunks=keep_empty_chunks, fe_range=fe_range
+        )
+    elif keep_empty_chunks:
         tb = st.tile_sparse_batch(batch, keep_empty_chunks=True)
     else:
         tb = st.tile_sparse_batch(batch)

@@ -34,6 +34,19 @@ _BACKTRACK = 0.5
 _CURVATURE_EPS = 1e-10
 
 
+def _global_dot(objective):
+    """The objective's global inner product when its coefficient contract
+    is a feature-range SHARD (``StreamingGLMObjective`` under
+    ``PHOTON_FE_SHARD``): every scalar the optimizer branches on — dots,
+    norms, curvature, Armijo right-hand sides — must be computed over the
+    FULL space and be identical on every process, or the per-process line
+    searches diverge. Returns None for full-space objectives, keeping
+    their plain local numpy arithmetic bit-for-bit."""
+    if getattr(objective, "fe_active", False):
+        return objective.fe_dot
+    return None
+
+
 def _pseudo_gradient(w: np.ndarray, g: np.ndarray, l1w: np.ndarray) -> np.ndarray:
     """OWL-QN pseudo-gradient (minimal-norm subgradient of f + Σ l1ⱼ|wⱼ|)."""
     gp = g + l1w
@@ -71,19 +84,32 @@ def host_lbfgs_minimize(
     use_l1 = l1_weight is not None
     l1w = np.asarray(l1_weight, np.float64) if use_l1 else None
 
+    # scalar reductions: plain local numpy for full-space objectives
+    # (verbatim, bit-for-bit); range-global dots for feature-range-sharded
+    # objectives, so every process's line search branches identically
+    fe_dot = _global_dot(objective)
+    if fe_dot is None:
+        dot = lambda a, b: float(np.dot(a, b))
+        nrm = lambda x: float(np.linalg.norm(x))
+        l1sum = (lambda w_: float(np.sum(l1w * np.abs(w_)))) if use_l1 else None
+    else:
+        dot = fe_dot
+        nrm = lambda x: float(np.sqrt(max(dot(x, x), 0.0)))
+        l1sum = (lambda w_: dot(l1w, np.abs(w_))) if use_l1 else None
+
     def vg(w_):
         v, g = objective.value_and_grad(jnp.asarray(w_, jnp.float32))
         f = float(v)
         g = np.asarray(g, np.float64)
         if use_l1:
-            f += float(np.sum(l1w * np.abs(w_)))
+            f += l1sum(w_)
             pg = _pseudo_gradient(np.asarray(w_, np.float64), g, l1w)
         else:
             pg = g
         return f, g, pg
 
     f, g, pg = vg(w)
-    g0_norm = float(np.linalg.norm(pg))
+    g0_norm = nrm(pg)
     loss_hist = np.full(max_iter + 1, np.nan)
     gnorm_hist = np.full(max_iter + 1, np.nan)
     loss_hist[0], gnorm_hist[0] = f, g0_norm
@@ -109,22 +135,22 @@ def host_lbfgs_minimize(
         alphas = np.zeros(history)
         for j in range(m):
             i = (count - 1 - j) % history
-            alphas[i] = rho[i] * np.dot(S[i], q)
+            alphas[i] = rho[i] * dot(S[i], q)
             q -= alphas[i] * Y[i]
         if m > 0:
             last = (count - 1) % history
-            gamma = np.dot(S[last], Y[last]) / max(np.dot(Y[last], Y[last]), 1e-300)
+            gamma = dot(S[last], Y[last]) / max(dot(Y[last], Y[last]), 1e-300)
             q *= gamma
         for j in range(m - 1, -1, -1):
             i = (count - 1 - j) % history
-            beta = rho[i] * np.dot(Y[i], q)
+            beta = rho[i] * dot(Y[i], q)
             q += (alphas[i] - beta) * S[i]
         p = -q
 
         if use_l1:
             # constrain the search direction to the descent orthant
             p = np.where(p * (-pg) > 0.0, p, 0.0)
-        if np.dot(p, pg) >= 0:  # not a descent direction: steepest descent
+        if dot(p, pg) >= 0:  # not a descent direction: steepest descent
             p = -pg
 
         if use_l1:
@@ -139,7 +165,7 @@ def host_lbfgs_minimize(
                 return w + t * p
 
         # first iteration: identity Hessian guess → unit-length initial step
-        step = 1.0 if count > 0 else 1.0 / max(1.0, float(np.linalg.norm(p)))
+        step = 1.0 if count > 0 else 1.0 / max(1.0, nrm(p))
 
         # Armijo backtracking on the ACTUAL (possibly projected) step.
         # Every trial uses value_and_grad: on the streaming path the
@@ -153,11 +179,11 @@ def host_lbfgs_minimize(
         # optim/lbfgs.py (minimizer of the parabola through f(0), f'(0),
         # f(t), clamped to [t/10, t/2]) — a failed step recovers in 1-3
         # trials instead of plain 0.5^k halvings
-        slope0 = float(np.dot(pg, p))
+        slope0 = dot(pg, p)
         for _ in range(max_ls + 1):
             w_try = trial_point(step)
             f_try, g_try, pg_try = vg(w_try)
-            rhs = f + _ARMIJO_C1 * float(np.dot(pg, w_try - w))
+            rhs = f + _ARMIJO_C1 * dot(pg, w_try - w)
             if f_try <= rhs and not np.isnan(f_try):
                 accepted = True
                 break
@@ -171,7 +197,7 @@ def host_lbfgs_minimize(
             break
 
         s, y = w_try - w, g_try - g
-        sy = np.dot(s, y)
+        sy = dot(s, y)
         if sy > _CURVATURE_EPS:
             i = count % history
             S[i], Y[i], rho[i] = s, y, 1.0 / sy
@@ -179,7 +205,7 @@ def host_lbfgs_minimize(
         f_prev = f
         w, f, g, pg = w_try, f_try, g_try, pg_try
         it += 1
-        gn = float(np.linalg.norm(pg))
+        gn = nrm(pg)
         loss_hist[it], gnorm_hist[it] = f, gn
         # per-iteration telemetry record (run JSONL; no-op without a sink)
         emit_event(
@@ -198,7 +224,7 @@ def host_lbfgs_minimize(
     result = OptimizationResult(
         w=jnp.asarray(w, jnp.float32),
         value=jnp.asarray(f, jnp.float32),
-        grad_norm=jnp.asarray(np.linalg.norm(pg), jnp.float32),
+        grad_norm=jnp.asarray(nrm(pg), jnp.float32),
         iterations=jnp.asarray(it, jnp.int32),
         reason=jnp.asarray(int(reason), jnp.int32),
         loss_history=jnp.asarray(loss_hist, jnp.float32),

@@ -21,6 +21,7 @@ import numpy as np
 from photon_ml_tpu.config import OptimizerConfig
 from photon_ml_tpu.obs import REGISTRY, emit_event
 from photon_ml_tpu.optim.common import ConvergenceReason, OptimizationResult
+from photon_ml_tpu.optim.host_lbfgs import _global_dot
 
 # LIBLINEAR tron.cpp constants (identical to optim/tron.py)
 _ETA0, _ETA1, _ETA2 = 1e-4, 0.25, 0.75
@@ -28,26 +29,35 @@ _SIGMA1, _SIGMA2, _SIGMA3 = 0.25, 0.5, 4.0
 _CG_XI = 0.1
 
 
-def _trcg_host(hvp, g: np.ndarray, delta: float, max_cg: int):
+def _trcg_host(hvp, g: np.ndarray, delta: float, max_cg: int,
+               dot=None, nrm=None):
     """Truncated CG for H·s = -g within ‖s‖ ≤ delta (host twin of
-    ``tron._trcg``; each ``hvp`` call is one streamed data pass)."""
+    ``tron._trcg``; each ``hvp`` call is one streamed data pass).
+
+    ``dot``/``nrm`` override the scalar reductions for feature-range-
+    sharded objectives (every CG branch must be lockstep across
+    processes); None keeps plain local numpy, bit-for-bit."""
+    if dot is None:
+        dot = lambda a, b: float(a @ b)
+    if nrm is None:
+        nrm = lambda x: float(np.linalg.norm(x))
     s = np.zeros_like(g)
     r = -g
     d = r.copy()
-    rtr = float(r @ r)
-    cg_tol = _CG_XI * float(np.linalg.norm(g))
+    rtr = dot(r, r)
+    cg_tol = _CG_XI * nrm(g)
     for _ in range(max_cg):
         if np.sqrt(rtr) <= cg_tol:
             break
         hd = np.asarray(hvp(d), np.float64)
-        dhd = float(d @ hd)
+        dhd = dot(d, hd)
         alpha = rtr / max(dhd, 1e-30)
         s1 = s + alpha * d
-        if np.linalg.norm(s1) > delta:
+        if nrm(s1) > delta:
             # boundary intersection: τ ≥ 0 with ‖s + τ·d‖ = delta
-            std = float(s @ d)
-            dd = float(d @ d)
-            ss = float(s @ s)
+            std = dot(s, d)
+            dd = dot(d, d)
+            ss = dot(s, s)
             rad = np.sqrt(max(std * std + dd * (delta * delta - ss), 0.0))
             if std >= 0.0:
                 tau = (delta * delta - ss) / max(std + rad, 1e-30)
@@ -58,7 +68,7 @@ def _trcg_host(hvp, g: np.ndarray, delta: float, max_cg: int):
             break
         s = s1
         r = r - alpha * hd
-        rtr_new = float(r @ r)
+        rtr_new = dot(r, r)
         beta = rtr_new / max(rtr, 1e-30)
         d = r + beta * d
         rtr = rtr_new
@@ -78,13 +88,24 @@ def host_tron_minimize(
     T = config.max_iterations
     tol = config.tolerance
 
+    # scalar reductions: plain local numpy for full-space objectives
+    # (verbatim, bit-for-bit); range-global dots for feature-range-sharded
+    # objectives, so every process's trust-region logic branches identically
+    fe_dot = _global_dot(objective)
+    if fe_dot is None:
+        dot = lambda a, b: float(np.dot(a, b))
+        nrm = lambda x: float(np.linalg.norm(x))
+    else:
+        dot = fe_dot
+        nrm = lambda x: float(np.sqrt(max(dot(x, x), 0.0)))
+
     def vg(w_):
         v, g = objective.value_and_grad(jnp.asarray(w_, jnp.float32))
         return float(v), np.asarray(g, np.float64)
 
     w = np.asarray(w0, np.float64)
     f, g = vg(w)
-    g0_norm = float(np.linalg.norm(g))
+    g0_norm = nrm(g)
     loss_hist = np.full(T + 1, np.nan)
     gnorm_hist = np.full(T + 1, np.nan)
     loss_hist[0], gnorm_hist[0] = f, g0_norm
@@ -102,13 +123,13 @@ def host_tron_minimize(
     while it < T:
         s, r = _trcg_host(
             lambda v: objective.hvp(jnp.asarray(w, jnp.float32), jnp.asarray(v, jnp.float32)),
-            g, delta, config.max_cg_iterations,
+            g, delta, config.max_cg_iterations, dot=dot, nrm=nrm,
         )
-        gs = float(g @ s)
-        prered = -0.5 * (gs - float(s @ r))
+        gs = dot(g, s)
+        prered = -0.5 * (gs - dot(s, r))
         f_new, g_new = vg(w + s)
         actred = f - f_new
-        snorm = float(np.linalg.norm(s))
+        snorm = nrm(s)
 
         if it == 0:
             delta = min(delta, snorm)
@@ -126,7 +147,7 @@ def host_tron_minimize(
         accept = actred > _ETA0 * prered
         if accept:
             w, f, g = w + s, f_new, g_new
-        gn = float(np.linalg.norm(g))
+        gn = nrm(g)
         it += 1
         loss_hist[it], gnorm_hist[it] = f, gn
         # per-iteration telemetry record (run JSONL; no-op without a sink)
@@ -151,7 +172,7 @@ def host_tron_minimize(
     result = OptimizationResult(
         w=jnp.asarray(w, jnp.float32),
         value=jnp.asarray(f, jnp.float32),
-        grad_norm=jnp.asarray(np.linalg.norm(g), jnp.float32),
+        grad_norm=jnp.asarray(nrm(g), jnp.float32),
         iterations=jnp.asarray(it, jnp.int32),
         reason=jnp.asarray(int(reason), jnp.int32),
         loss_history=jnp.asarray(loss_hist, jnp.float32),

@@ -611,6 +611,28 @@ def train_glm_streamed(
             else None
         ),
     )
+    fe = getattr(sobj, "fe_active", False)
+    if fe:
+        if ckpt is not None:
+            # checkpoints store FULL-space iterates with a fingerprint
+            # over the unsharded chunk set; a per-range resume contract
+            # (and cross-P re-partitioned resume) is future work — fail
+            # loudly rather than write shard-local iterates a later
+            # unsharded run would load as full vectors
+            raise NotImplementedError(
+                "checkpoint_dir with PHOTON_FE_SHARD=1 is not supported; "
+                "disable sharding or drop the checkpoint directory"
+            )
+        if variance_computation is VarianceComputationType.FULL:
+            # the streamed FULL pass densifies a d x d Hessian from raw
+            # chunk indices; the sharded objective only holds its range
+            raise NotImplementedError(
+                "FULL variances with PHOTON_FE_SHARD=1 are not supported; "
+                "use SIMPLE (per-range diagonal, gathered exactly)"
+            )
+        # the optimizer iterates on this process's range shard; model
+        # assembly gathers the full vector per λ below
+        w = sobj.fe_slice(w)
     for lam in sorted(regularization_weights):
         done_w = ckpt.completed_model(lam) if ckpt is not None else None
         if done_w is not None:
@@ -648,7 +670,15 @@ def train_glm_streamed(
             variances = compute_variances(
                 sobj, jnp.asarray(w, jnp.float32), variance_computation
             )
-        w_model = jnp.asarray(w, jnp.float32)
+            if fe and variances is not None:
+                # SIMPLE variances are elementwise in the Hessian
+                # diagonal, and the sharded diagonal is this range's
+                # DISJOINT segment — the gather is exact
+                variances = jnp.asarray(sobj.fe_gather(np.asarray(variances)))
+        # under PHOTON_FE_SHARD the iterate is this process's range
+        # shard; the saved model (and validation scoring) need the full
+        # vector — a fixed ascending-order gather, pure data movement
+        w_model = jnp.asarray(sobj.fe_gather(w) if fe else w, jnp.float32)
         if normalization is not None:
             w_model, _ = normalization.model_to_original_space(w_model)
             if variances is not None:

@@ -47,6 +47,17 @@
 # Re-capture with `python bench.py --multichip-r11` when the projection
 # code intentionally changes, then UPDATE_BASELINE=1 to re-bless.
 #
+# An R12 (FE-SHARD) leg validates the committed MULTICHIP_r12.json
+# (the PHOTON_FE_SHARD feature-range-sharded fixed-effect A/B):
+# acceptance invariants (knob-0 bit-for-bit with knob-unset — solutions,
+# scores, gradients, packed-stream bytes; sharded arms matching the
+# single-process reference per the parity contract; mean per-process
+# packed-byte reduction ≥ 40% at P=4; nnz balance ≤ 1.15×) plus a gate
+# of its per-P packed-byte/balance metrics against
+# BASELINE_feshard_cpu.json. Re-capture with `python bench.py
+# --multichip-r12` when the partitioner/restriction/kernel layout code
+# intentionally changes, then UPDATE_BASELINE=1 to re-bless.
+#
 # An R09 (SPLIT) leg then validates the committed MULTICHIP_r09.json
 # (the PHOTON_RE_SPLIT sub-bucket placement A/B): acceptance invariants
 # (bitwise across arms/processes/vs the single-process reference,
@@ -124,6 +135,11 @@ with open("BASELINE_project_cpu.json", "w") as f:
     json.dump(doc["gate_metrics"], f, indent=2)
     f.write("\n")
 print("gate_quick: project baseline re-captured to BASELINE_project_cpu.json")
+doc = json.load(open("MULTICHIP_r12.json"))
+with open("BASELINE_feshard_cpu.json", "w") as f:
+    json.dump(doc["gate_metrics"], f, indent=2)
+    f.write("\n")
+print("gate_quick: fe-shard baseline re-captured to BASELINE_feshard_cpu.json")
 PY
     exit 0
 fi
@@ -281,5 +297,33 @@ print(
     "max-owner reduction "
     f"{acc['bytes_weight_max_owner_reduction_at_top_rung']:.1%} >= "
     f"{acc['required_bytes_weight_reduction']:.1%})"
+)
+PY
+
+# ---- r12 (fe-shard) leg: feature-range-shard A/B invariants + gate --------
+# within_5pct_of_ideal_at_top_P is RECORDED, not asserted: packed bytes
+# scale with range WIDTH (the feature-major stream's slab count) while
+# the partitioner balances nnz, so a Zipf tail range keeps the mean a
+# few points off the (P-1)/P ideal — see the r12 doc's note field.
+python - <<'PY'
+import json, sys
+
+from photon_ml_tpu.obs.report import gate_run
+
+doc = json.load(open("MULTICHIP_r12.json"))
+acc = doc["acceptance"]
+assert acc["bitwise_and_parity_ok"], acc
+assert acc["reduction_ge_required"], acc
+assert acc["balance_le_1_15"], acc
+baseline = json.load(open("BASELINE_feshard_cpu.json"))
+failures, lines = gate_run(doc["gate_metrics"], baseline)
+if failures:
+    print("\n".join(lines))
+    sys.exit(f"gate_quick: fe-shard gate FAILED: {failures}")
+print(
+    "gate_quick: r12 fe-shard leg OK (mean packed-byte reduction "
+    f"{acc['packed_bytes_reduction_at_top_P']:.1%} >= "
+    f"{acc['required_reduction']:.1%}, nnz balance "
+    f"{acc['nnz_balance_at_top_P']:.3f}x <= 1.15x)"
 )
 PY

@@ -12,7 +12,8 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 # Analytic device-cost capture (obs/devcost) AOT-compiles every fresh
 # executable a second time while a telemetry sink is active. The tier-1
-# suite sits NEAR its 870 s budget, so the suite pins capture OFF and
+# suite sits NEAR its wall-clock budget (1260 s — see ROADMAP's tier-1
+# line), so the suite pins capture OFF and
 # tests that exercise it (tests/test_devcost.py) opt back in by clearing
 # or overriding this variable.
 os.environ.setdefault("PHOTON_DEVCOST", "0")
@@ -22,6 +23,28 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Persistent XLA compilation cache (tier-1 runtime, measured on the 1-core
+# CI box): the suite's dominant idiom is "reference arm vs knob arm,
+# asserted bitwise", which compiles the SAME HLO two or more times per
+# test — and the suite is compile-dominated, not execution-dominated (a
+# warm cache cuts representative modules ~57%; intra-run dedupe alone cuts
+# them ~18% cold). The cache key is content-addressed over the HLO and the
+# jax/XLA versions, so a code change is a clean miss, never a stale hit,
+# and a cache hit returns byte-identical executables — bitwise parity
+# assertions are unaffected. min-compile-time 0 matters: the duplicate
+# mass is many SMALL programs, which the 1 s default would skip.
+# ``setdefault`` so an outer environment (or a test of the cache itself)
+# still wins; gloo loopback worker subprocesses inherit the dir and dedupe
+# their identical per-process programs against it too.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(
+        __import__("tempfile").gettempdir(), "photon_xla_test_cache"
+    ),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
 # The environment's sitecustomize registers an 'axon' TPU-relay PJRT plugin in
 # every interpreter and forces jax_platforms=axon via jax.config (so env vars
 # set here are too late). Initializing that backend blocks on the relay
@@ -30,6 +53,22 @@ import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", True)
 jax.config.update("jax_platforms", "cpu")
+# sitecustomize imported jax before this file ran, so the cache env vars
+# set above were bound too late for THIS process — re-apply them through
+# jax.config (reading the env so an outer override still wins). Worker
+# subprocesses run sitecustomize after the env is set, so env alone
+# suffices there.
+jax.config.update(
+    "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+)
+jax.config.update(
+    "jax_persistent_cache_min_compile_time_secs",
+    float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]),
+)
+jax.config.update(
+    "jax_persistent_cache_min_entry_size_bytes",
+    int(os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"]),
+)
 from jax._src import xla_bridge as _xb  # noqa: E402
 
 _xb._backend_factories.pop("axon", None)
@@ -48,7 +87,8 @@ def pytest_collection_modifyitems(config, items):
     items.sort(key=lambda it: it.get_closest_marker("kernel") is not None)
 
 
-# Tier-1 runtime guard (the suite sits NEAR the 870 s budget): every
+# Tier-1 runtime guard (the suite sits NEAR its wall-clock budget —
+# 1260 s, see ROADMAP's tier-1 line): every
 # kernel-marked test must trace its Pallas kernels at retuned-DOWN
 # constants — interpret-mode cost scales with the DMA-step carve, and one
 # test silently instantiating default-size tiles (GROUPS_PER_STEP=32 x
@@ -91,7 +131,7 @@ def _kernel_test_constants_guard(request):
                 f"{st.SEGMENTS_PER_DMA} = {step_nnz}-nnz DMA steps > "
                 f"{_KERNEL_TEST_MAX_STEP_NNZ}). Interpret-mode kernel cost "
                 f"scales with the step carve and the tier-1 suite sits "
-                f"near its 870 s budget: keep the retuned-down constants "
+                f"near its wall-clock budget: keep the retuned-down constants "
                 f"this fixture installs (or monkeypatch smaller), or drop "
                 f"the kernel marker if no kernel is traced."
             )

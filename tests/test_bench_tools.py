@@ -473,6 +473,44 @@ class TestQuickMode:
         with pytest.raises(ValueError, match="PHOTON_RE_SPLIT_WEIGHT"):
             bench._apply_retune_env()
 
+    def test_retune_env_reaches_fe_shard_knobs(self, monkeypatch):
+        """PHOTON_FE_SHARD / PHOTON_FE_SPLIT_WEIGHT ride the
+        RETUNE_ENV_SHARD surface: env → module global (index_map — the
+        partitioner owns them), call-time readers agree, and the knob
+        snapshot (telemetry block / run_start / devcost key) reflects
+        them."""
+        import photon_ml_tpu.data.index_map as im
+
+        monkeypatch.setattr(im, "FE_SHARD", 0)
+        monkeypatch.setattr(im, "FE_SPLIT_WEIGHT", "nnz")
+        monkeypatch.setenv("PHOTON_FE_SHARD", "1")
+        monkeypatch.setenv("PHOTON_FE_SPLIT_WEIGHT", "width")
+        bench._apply_retune_env()
+        assert im.FE_SHARD == 1
+        assert im.FE_SPLIT_WEIGHT == "width"
+        assert im.fe_shard_enabled() is True
+        assert im.fe_split_weight() == "width"
+        from photon_ml_tpu.obs.sink import _knob_snapshot
+
+        knobs = _knob_snapshot()
+        assert knobs["fe_shard"] == 1
+        assert knobs["fe_split_weight"] == "width"
+        # the devcost capture key tracks both (a shard flip reshapes the
+        # packed streams — costs must re-capture, never reuse)
+        from photon_ml_tpu.obs import devcost
+
+        assert devcost.knob_key()["fe_shard"] == 1
+        assert devcost.knob_key()["fe_split_weight"] == "width"
+        monkeypatch.setenv("PHOTON_FE_SHARD", "0")
+        assert devcost.knob_key()["fe_shard"] == 0
+        monkeypatch.setenv("PHOTON_FE_SPLIT_WEIGHT", "nnz")
+        assert devcost.knob_key()["fe_split_weight"] == "nnz"
+
+    def test_fe_split_weight_retune_rejects_unknown_mode(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_FE_SPLIT_WEIGHT", "rows")
+        with pytest.raises(ValueError, match="PHOTON_FE_SPLIT_WEIGHT"):
+            bench._apply_retune_env()
+
     def test_retune_env_reaches_prefetch_knobs(self, monkeypatch):
         import photon_ml_tpu.ops.prefetch as pf
 
