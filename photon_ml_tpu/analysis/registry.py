@@ -59,6 +59,7 @@ RETUNE_TABLES = (
     "RETUNE_ENV_PREFETCH",
     "RETUNE_ENV_RE",
     "RETUNE_ENV_SHARD",
+    "RETUNE_ENV_SERVE",
 )
 
 
@@ -263,6 +264,39 @@ KNOBS: tuple[Knob, ...] = (
         accessors=("fe_split_weight",),
         retune_global="FE_SPLIT_WEIGHT", retune_table="RETUNE_ENV_SHARD",
         sink_key="fe_split_weight",
+    ),
+    # -- online serving (RETUNE_ENV_SERVE) ----------------------------------
+    Knob(
+        name="PHOTON_SERVE_HOT_BYTES", kind="int", parse="strict_int",
+        default="25% of RE model bytes", owner="photon_ml_tpu/serve/store.py",
+        doc="hot-set byte budget for device-resident model shards",
+        accessors=("serve_hot_budget_bytes",),
+        retune_global="SERVE_HOT_BYTES", retune_table="RETUNE_ENV_SERVE",
+        sink_key="serve_hot_bytes",
+    ),
+    Knob(
+        name="PHOTON_SERVE_MAX_BATCH", kind="int", parse="strict_int",
+        default="32", owner="photon_ml_tpu/serve/router.py",
+        doc="micro-window flush size (also the padded scoring shape)",
+        accessors=("serve_max_batch",),
+        retune_global="SERVE_MAX_BATCH", retune_table="RETUNE_ENV_SERVE",
+        sink_key="serve_max_batch",
+    ),
+    Knob(
+        name="PHOTON_SERVE_MAX_WAIT_MS", kind="float", parse="strict_float",
+        default="2.0", owner="photon_ml_tpu/serve/router.py",
+        doc="oldest-request wait (ms) that forces a partial-window flush",
+        accessors=("serve_max_wait_ms",),
+        retune_global="SERVE_MAX_WAIT_MS", retune_table="RETUNE_ENV_SERVE",
+        sink_key="serve_max_wait_ms",
+    ),
+    Knob(
+        name="PHOTON_SERVE_REFRESH_EVERY", kind="int", parse="strict_int",
+        default="0 (off)", owner="photon_ml_tpu/serve/refresh.py",
+        doc="buffered events per entity that trigger an incremental refresh",
+        accessors=("serve_refresh_every",),
+        retune_global="SERVE_REFRESH_EVERY", retune_table="RETUNE_ENV_SERVE",
+        sink_key="serve_refresh_every",
     ),
     # -- observability / selection toggles ---------------------------------
     Knob(

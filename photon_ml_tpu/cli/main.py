@@ -21,6 +21,7 @@ def _commands() -> dict:
         "name-term-bags": "photon_ml_tpu.cli.name_term_bags",
         "report": "photon_ml_tpu.cli.report",
         "lint": "photon_ml_tpu.cli.lint",
+        "serve": "photon_ml_tpu.cli.serve",
     }
 
 

@@ -15,6 +15,20 @@ Directory layout (mirrors the reference's HDFS output):
 Feature naming: with an ``IndexMap`` the real (name, term) keys are written
 (byte-compatible interchange with the reference); without one, synthetic
 names ``f<index>`` are used and parsed back on load.
+
+**Published-model manifest.** A serving process must load (and hot-swap)
+model snapshots without scraping directory listings — a half-written
+snapshot directory is indistinguishable from a complete one by ``ls``.
+:func:`publish_game_model` therefore writes each snapshot into its own
+``<root>/snapshots/snap-<seq>/`` directory and THEN commits a
+schema-versioned pointer file (``MANIFEST.json``) via the
+``utils/atomic_io`` discipline (fsync → rename → dir fsync): a reader
+either sees the previous complete manifest or the new one, never a
+hybrid, and the snapshot a manifest points at is complete BY
+CONSTRUCTION (the pointer is written last). The manifest carries a
+sha256 fingerprint over the snapshot's coefficient bytes so a serving
+replica can cheaply poll :func:`peek_published_fingerprint` (the
+``checkpoint.peek_fingerprint`` idiom) and reload only on change.
 """
 
 from __future__ import annotations
@@ -262,6 +276,133 @@ def save_game_model(
     os.makedirs(directory, exist_ok=True)
     with open(os.path.join(directory, "metadata.json"), "w") as f:
         json.dump(meta, f, indent=2)
+
+
+# ---------------------------------------------------------------------------
+# published-model manifest (the serving side's snapshot pointer)
+# ---------------------------------------------------------------------------
+
+MODEL_MANIFEST = "MANIFEST.json"
+MANIFEST_SCHEMA_VERSION = 1
+
+
+def model_fingerprint(model: GameModel) -> str:
+    """sha256 over the model's structure and coefficient BYTES (means +
+    variances, in sorted coordinate order) — two models fingerprint equal
+    iff a serving replica would compute identical scores from them."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(model.task_type.value.encode())
+    for cid in sorted(model.models):
+        sub = model.models[cid]
+        if isinstance(sub, FixedEffectModel):
+            h.update(f"|fixed:{cid}:{sub.feature_shard_id}".encode())
+            h.update(np.ascontiguousarray(
+                np.asarray(sub.model.coefficients.means)
+            ).tobytes())
+            if sub.model.coefficients.variances is not None:
+                h.update(np.ascontiguousarray(
+                    np.asarray(sub.model.coefficients.variances)
+                ).tobytes())
+        elif isinstance(sub, RandomEffectModel):
+            h.update(
+                f"|random:{cid}:{sub.feature_shard_id}:"
+                f"{sub.random_effect_type}".encode()
+            )
+            h.update(np.ascontiguousarray(
+                np.asarray(sub.coefficients)
+            ).tobytes())
+            if sub.variances is not None:
+                h.update(np.ascontiguousarray(
+                    np.asarray(sub.variances)
+                ).tobytes())
+    return h.hexdigest()
+
+
+def publish_game_model(
+    model: GameModel,
+    root: str,
+    index_maps: Mapping[str, IndexMap] | None = None,
+    entity_names: Mapping[str, Sequence[str]] | None = None,
+    sparsity_threshold: float = 0.0,
+) -> str:
+    """Publish ``model`` as the next snapshot under ``root`` and commit
+    the manifest pointer atomically. Returns the snapshot directory.
+
+    The snapshot is fully written BEFORE the pointer moves, so a crash
+    at any instant leaves the manifest pointing at a complete snapshot
+    (the previous one, or the new one once the rename lands); orphan
+    ``snap-*`` directories from pre-pointer crashes are inert."""
+    manifest = read_model_manifest(root)
+    seq = int(manifest["seq"]) + 1 if manifest else 1
+    rel = os.path.join("snapshots", f"snap-{seq:06d}")
+    snap_dir = os.path.join(root, rel)
+    save_game_model(
+        model, snap_dir, index_maps=index_maps, entity_names=entity_names,
+        sparsity_threshold=sparsity_threshold,
+    )
+    doc = {
+        "schema_version": MANIFEST_SCHEMA_VERSION,
+        "seq": seq,
+        "snapshot": rel,
+        "fingerprint": model_fingerprint(model),
+        "task_type": model.task_type.value,
+    }
+    from photon_ml_tpu.utils.atomic_io import atomic_replace_bytes
+
+    atomic_replace_bytes(
+        root,
+        os.path.join(root, MODEL_MANIFEST),
+        (json.dumps(doc, indent=2) + "\n").encode(),
+    )
+    return snap_dir
+
+
+def read_model_manifest(root: str) -> dict | None:
+    """The current manifest under ``root``, or None when nothing has been
+    published. A manifest from a FUTURE schema is refused loudly — a
+    serving replica must not guess at pointer semantics it postdates."""
+    path = os.path.join(root, MODEL_MANIFEST)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        doc = json.load(f)
+    version = int(doc.get("schema_version", 0))
+    if version > MANIFEST_SCHEMA_VERSION:
+        raise ValueError(
+            f"{path}: manifest schema v{version} is newer than this "
+            f"reader (v{MANIFEST_SCHEMA_VERSION})"
+        )
+    return doc
+
+
+def peek_published_fingerprint(root: str) -> str | None:
+    """The published snapshot's fingerprint without loading any model
+    arrays — the serving replica's cheap hot-swap poll (the
+    ``checkpoint.peek_fingerprint`` idiom)."""
+    manifest = read_model_manifest(root)
+    return manifest.get("fingerprint") if manifest else None
+
+
+def load_published_model(
+    root: str,
+    index_maps: Mapping[str, IndexMap] | None = None,
+    entity_ids: Mapping[str, Mapping[str, int]] | None = None,
+) -> tuple[GameModel, dict]:
+    """Load the manifest-pointed snapshot. Returns ``(model, manifest)``
+    so the caller keeps the seq/fingerprint it loaded (the hot-swap
+    comparison anchor). Raises when nothing has been published."""
+    manifest = read_model_manifest(root)
+    if manifest is None:
+        raise FileNotFoundError(
+            f"{os.path.join(root, MODEL_MANIFEST)}: no published model"
+        )
+    model = load_game_model(
+        os.path.join(root, manifest["snapshot"]),
+        index_maps=index_maps, entity_ids=entity_ids,
+    )
+    return model, manifest
 
 
 def load_game_model(

@@ -179,7 +179,25 @@ def _knob_raw_state() -> tuple:
         )
     except Exception:
         fe_state = None
+    try:
+        import sys
+
+        sv_store = sys.modules.get("photon_ml_tpu.serve.store")
+        sv_router = sys.modules.get("photon_ml_tpu.serve.router")
+        sv_refresh = sys.modules.get("photon_ml_tpu.serve.refresh")
+        serve_state = (
+            None if sv_store is None else sv_store.SERVE_HOT_BYTES,
+            None if sv_router is None
+            else (sv_router.SERVE_MAX_BATCH, sv_router.SERVE_MAX_WAIT_MS),
+            None if sv_refresh is None else sv_refresh.SERVE_REFRESH_EVERY,
+        )
+    except Exception:
+        serve_state = None
     return (
+        env.get("PHOTON_SERVE_HOT_BYTES"),
+        env.get("PHOTON_SERVE_MAX_BATCH"),
+        env.get("PHOTON_SERVE_MAX_WAIT_MS"),
+        env.get("PHOTON_SERVE_REFRESH_EVERY"),
         env.get("PHOTON_PREFETCH_DEPTH"),
         env.get("PHOTON_CHUNK_CACHE_BUDGET"),
         env.get("PHOTON_KERNEL_DTYPE"),
@@ -203,6 +221,7 @@ def _knob_raw_state() -> tuple:
         shard_state,
         project_state,
         fe_state,
+        serve_state,
     )
 
 

@@ -427,6 +427,33 @@ def summarize_run(path: str, records: list[dict] | None = None) -> dict:
             ),
             "events": replan_events,
         }
+    # online serving (serve.*, photon_ml_tpu/serve): the latency section —
+    # request/window counts, micro-window wall ("serve.window_s") and fill
+    # ("serve.window.occupancy" histogram, mean gauge), the hot working
+    # set's byte traffic ("serve.hot.hit_bytes" / "serve.hot.miss_bytes" /
+    # "serve.hot.evictions") plus its request-count hit rate, cross-owner
+    # forwards ("serve.forwarded"), incremental refreshes
+    # ("serve.refresh.count" / "serve.refresh_s") and the loadgen's
+    # open-loop percentile gauges. Present only on runs that served — a
+    # non-serving summary stays key-for-key what it was.
+    if "serve.requests" in counters or "serve.requests" in base_counters:
+        out["serve"] = {
+            "requests": counter_v("serve.requests"),
+            "windows": counter_v("serve.windows"),
+            "forwarded": counter_v("serve.forwarded"),
+            "window_s": timer_s("serve.window_s"),
+            "hot_hit_bytes": counter_v("serve.hot.hit_bytes"),
+            "hot_miss_bytes": counter_v("serve.hot.miss_bytes"),
+            "hot_evictions": counter_v("serve.hot.evictions"),
+            "refreshes": counter_v("serve.refresh.count"),
+            "refresh_s": timer_s("serve.refresh_s"),
+            "latency_p50_ms": metrics_gauges.get("serve.latency_p50_ms"),
+            "latency_p99_ms": metrics_gauges.get("serve.latency_p99_ms"),
+            "hot_hit_rate": metrics_gauges.get("serve.hot.hit_rate"),
+            "window_occupancy_mean": metrics_gauges.get(
+                "serve.window.occupancy_mean"
+            ),
+        }
     if run_start.get("fleet"):
         out["fleet"] = run_start["fleet"]
     return out
@@ -583,6 +610,43 @@ def format_summary(s: dict) -> str:
                 else ""
             )
         )
+    sv = s.get("serve") or {}
+    if sv.get("requests"):
+        p50, p99 = sv.get("latency_p50_ms"), sv.get("latency_p99_ms")
+        lines.append(
+            f"  serve: {int(sv['requests'])} requests in "
+            f"{int(sv['windows'])} windows"
+            + (
+                f", p50 {p50:.2f} ms / p99 {p99:.2f} ms"
+                if isinstance(p50, (int, float))
+                and isinstance(p99, (int, float)) else ""
+            )
+            + (
+                f", occupancy {sv['window_occupancy_mean']:.2f}"
+                if isinstance(sv.get("window_occupancy_mean"),
+                              (int, float)) else ""
+            )
+        )
+        lines.append(
+            f"    hot set: hit rate "
+            + (
+                f"{sv['hot_hit_rate']:.3f}"
+                if isinstance(sv.get("hot_hit_rate"), (int, float))
+                else _UNRECORDED
+            )
+            + f", {_fmt_qty(sv.get('hot_hit_bytes') or 0.0)}B hit / "
+            f"{_fmt_qty(sv.get('hot_miss_bytes') or 0.0)}B miss, "
+            f"{int(sv.get('hot_evictions') or 0)} evictions"
+        )
+        if sv.get("forwarded") or sv.get("refreshes"):
+            lines.append(
+                f"    {int(sv.get('forwarded') or 0)} cross-owner "
+                f"forwards, {int(sv.get('refreshes') or 0)} refreshes"
+                + (
+                    f" ({_fmt_s(sv['refresh_s'])})"
+                    if sv.get("refresh_s") else ""
+                )
+            )
     if s.get("quality_parity"):
         lines.append(
             f"  quality-parity: {_fmt_quality_parity(s['quality_parity'])}"
@@ -1124,6 +1188,49 @@ def summarize_fleet(paths: list[str]) -> dict:
                 default=None,
             ),
         }
+    # online serving at fleet granularity: request/forward totals over
+    # the processes that served, the WORST per-process tail (an SLO is a
+    # max, not a mean) and the traffic-weighted hot-set hit rate
+    serve_pp = {
+        k: (s.get("serve") or {})
+        for k, s in processes.items()
+        if s.get("serve")
+    }
+    serve = None
+    if serve_pp:
+        reqs = {
+            k: float(c.get("requests") or 0) for k, c in serve_pp.items()
+        }
+        total_req = sum(reqs.values())
+        p99s = [
+            float(c["latency_p99_ms"]) for c in serve_pp.values()
+            if isinstance(c.get("latency_p99_ms"), (int, float))
+        ]
+        p50s = [
+            float(c["latency_p50_ms"]) for c in serve_pp.values()
+            if isinstance(c.get("latency_p50_ms"), (int, float))
+        ]
+        rates = [
+            (reqs[k], float(c["hot_hit_rate"]))
+            for k, c in serve_pp.items()
+            if isinstance(c.get("hot_hit_rate"), (int, float))
+        ]
+        serve = {
+            "requests_total": total_req,
+            "forwarded_total": float(
+                sum(c.get("forwarded") or 0 for c in serve_pp.values())
+            ),
+            "refreshes_total": float(
+                sum(c.get("refreshes") or 0 for c in serve_pp.values())
+            ),
+            "latency_p50_ms_max": max(p50s) if p50s else None,
+            "latency_p99_ms_max": max(p99s) if p99s else None,
+            "hot_hit_rate": (
+                sum(n * r for n, r in rates) / sum(n for n, r in rates)
+                if rates and sum(n for n, r in rates) else None
+            ),
+            "per_process": serve_pp,
+        }
     head = processes[str(pidxs[0])]
     return {
         "run_id": head["run_id"],
@@ -1156,6 +1263,7 @@ def summarize_fleet(paths: list[str]) -> dict:
         "exchange": exchange,
         "re_combine": combine,
         "re_project": project,
+        "serve": serve,
         "replans": replans,
         "processes": processes,
     }
@@ -1352,6 +1460,29 @@ def format_fleet(fs: dict) -> str:
                 f"dim {int(c.get('dim', 0))}"
                 + (" (hashed)" if c.get("hashed") else "")
             )
+    sv = fs.get("serve") or {}
+    if sv.get("requests_total"):
+        p50m, p99m = sv.get("latency_p50_ms_max"), sv.get(
+            "latency_p99_ms_max"
+        )
+        hr = sv.get("hot_hit_rate")
+        lines.append(
+            f"  serve: {int(sv['requests_total'])} requests, "
+            f"{int(sv.get('forwarded_total') or 0)} cross-owner forwards, "
+            f"{int(sv.get('refreshes_total') or 0)} refreshes"
+        )
+        lines.append(
+            "    worst-process tail: "
+            + (
+                f"p50 {p50m:.2f} ms / p99 {p99m:.2f} ms"
+                if isinstance(p50m, (int, float))
+                and isinstance(p99m, (int, float)) else _UNRECORDED
+            )
+            + (
+                f", traffic-weighted hot hit rate {hr:.3f}"
+                if isinstance(hr, (int, float)) else ""
+            )
+        )
     for rp in fs.get("replans") or []:
         procs = rp.get("processes") or []
         lines.append(
@@ -1536,6 +1667,20 @@ DEFAULT_GATE_THRESHOLDS: dict[str, dict] = {
     "fe_shard/": {"rel": 0.05},
     "fe_shard/ranges": {"rel": 0.0, "abs": 0.0},
     "fe_shard/nnz_balance": {"rel": 0.02},
+    # serving tiers (bench --serve / serving runs only): wall-clock
+    # latency percentiles jitter like every wall tier, so they gate
+    # LOOSE; the hot-set hit rate and mean window occupancy are bounded
+    # [0, 1] ratios that gate on PRESENCE (losing the instrument trips,
+    # a value never does — the >= 0.8 acceptance floor is the bench
+    # doc's own assertion, not the gate's); the two parity flags are
+    # bitwise contracts, so they gate EXACT — a refresh that stops
+    # matching its offline solve, or a serve path that stops matching
+    # the batch score driver, is a correctness break, never noise
+    "serve/latency": {"rel": 1.0, "abs": 10.0},
+    "serve/hot_hit_rate": {"abs": 1.0},
+    "serve/window_occupancy": {"abs": 1.0},
+    "serve/refresh_parity": {"rel": 0.0, "abs": 0.0},
+    "serve/score_parity": {"rel": 0.0, "abs": 0.0},
     # quality tiers: deltas vs the f32 anchor, absolute headroom at the
     # parity-gate scale (|ΔAUC| ≤ 0.005 is the ladder's own bf16 gate)
     "quality/": {"rel": 0.0, "abs": 0.005},
@@ -1637,6 +1782,21 @@ def gate_metrics_from_summary(s: dict) -> dict[str, float]:
         # exact one-sided tier: a migration APPEARING against the
         # baseline is a planner-behavior change, not noise
         m["re_replan/migrations"] = float(rp.get("migrations") or 0)
+    sv = s.get("serve") or {}
+    if sv.get("requests"):
+        # serving tiers: latency gates loose (wall), the bounded ratios
+        # gate on presence — losing the instrument trips, a value never
+        # does. Non-serving runs never emit these keys.
+        if isinstance(sv.get("latency_p50_ms"), (int, float)):
+            m["serve/latency_p50_ms"] = float(sv["latency_p50_ms"])
+        if isinstance(sv.get("latency_p99_ms"), (int, float)):
+            m["serve/latency_p99_ms"] = float(sv["latency_p99_ms"])
+        if isinstance(sv.get("hot_hit_rate"), (int, float)):
+            m["serve/hot_hit_rate"] = float(sv["hot_hit_rate"])
+        if isinstance(sv.get("window_occupancy_mean"), (int, float)):
+            m["serve/window_occupancy"] = float(
+                sv["window_occupancy_mean"]
+            )
     m.update(_qp_metrics(s.get("quality_parity") or {}))
     o = s.get("optim") or {}
     if o.get("solves"):
@@ -1681,6 +1841,16 @@ def gate_metrics_from_bench(doc: dict) -> dict[str, float]:
                 # feature-range sharding readouts (the per-process width
                 # and nnz ride the narrative, not the one-sided gate)
                 m[f"{cfg}/{g.replace('.', '/', 1)}"] = float(v)
+            elif g in ("serve.latency_p50_ms", "serve.latency_p99_ms"):
+                # serving latency gauges (loose wall tier via the
+                # serve/latency substring)
+                m[f"{cfg}/serve/latency{g[len('serve.latency'):]}"] = (
+                    float(v)
+                )
+            elif g == "serve.hot.hit_rate":
+                m[f"{cfg}/serve/hot_hit_rate"] = float(v)
+            elif g == "serve.window.occupancy_mean":
+                m[f"{cfg}/serve/window_occupancy"] = float(v)
         gauges = tmetrics.get("gauges") or {}
         if float(gauges.get("re_shard.split_classes") or 0) > 0:
             # split-granularity tier (mirrors gate_metrics_from_summary)
@@ -1807,6 +1977,17 @@ def gate_metrics_from_fleet(fs: dict) -> dict[str, float]:
     mig = [float(v) for v in mig if isinstance(v, (int, float))]
     if mig:
         m["re_replan/migrations"] = max(mig)
+    # serving: the gateable tail is the WORST process's percentile (an
+    # SLO is a max), the hit rate the traffic-weighted fleet value —
+    # both on the per-run serve tiers; non-serving fleets emit nothing
+    sv = fs.get("serve") or {}
+    if sv:
+        if isinstance(sv.get("latency_p50_ms_max"), (int, float)):
+            m["serve/latency_p50_ms"] = float(sv["latency_p50_ms_max"])
+        if isinstance(sv.get("latency_p99_ms_max"), (int, float)):
+            m["serve/latency_p99_ms"] = float(sv["latency_p99_ms_max"])
+        if isinstance(sv.get("hot_hit_rate"), (int, float)):
+            m["serve/hot_hit_rate"] = float(sv["hot_hit_rate"])
     return m
 
 

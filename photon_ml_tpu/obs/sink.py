@@ -386,6 +386,19 @@ def _knob_snapshot() -> dict:
         knobs["fe_split_weight"] = str(index_map.fe_split_weight())
     except Exception:
         pass
+    try:
+        from photon_ml_tpu.serve import refresh as serve_refresh
+        from photon_ml_tpu.serve import router as serve_router
+        from photon_ml_tpu.serve import store as serve_store
+
+        knobs["serve_hot_bytes"] = int(serve_store.serve_hot_budget_bytes())
+        knobs["serve_max_batch"] = int(serve_router.serve_max_batch())
+        knobs["serve_max_wait_ms"] = float(serve_router.serve_max_wait_ms())
+        knobs["serve_refresh_every"] = int(
+            serve_refresh.serve_refresh_every()
+        )
+    except Exception:
+        pass
     return knobs
 
 

@@ -1,0 +1,127 @@
+"""Generic byte-budgeted LRU — the chunk cache's accounting, reusable.
+
+``ops/prefetch.py`` grew a device-resident chunk cache whose useful core
+is not chunk-specific at all: an ordered map of entries with a byte cost,
+a budget read at call time, hit/miss/eviction accounting in BYTES, and
+the two invariants the prefetch tests pin down — an entry larger than the
+whole budget is never pinned (it is simply not admitted), and eviction
+walks strictly least-recently-used until the budget holds. The serving
+subsystem needs exactly that machinery for a different payload (per-entity
+model coefficient shards instead of data chunks), so this module lifts the
+accounting into a standalone class both granularities can state their
+contracts against.
+
+Deliberately metric-agnostic: callers wire the ``on_hit``/``on_miss``/
+``on_evict`` hooks to their own CONSTANT-named registry counters (the
+telemetry-surface lint wants literal emission names at the call site —
+``prefetch.cache.*`` for chunks, ``serve.hot.*`` for model shards), so the
+generic tier never emits under a computed name.
+
+Thread-safety: all mutating operations take the instance lock; hooks are
+called OUTSIDE the lock (a hook that re-enters the cache must not
+deadlock, and registry counters need no ordering guarantees).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Hashable
+
+_NOOP: Callable[[int], None] = lambda nbytes: None
+
+
+class ByteBudgetLRU:
+    """Byte-budgeted LRU map of ``key -> (value, nbytes)``.
+
+    ``budget_fn`` is read at CALL time on every admission (the repo's
+    knob discipline: env-driven retunes must take effect without
+    rebuilding the cache). ``get`` refreshes recency on hit; ``put``
+    admits the entry and then evicts least-recently-used entries until
+    the budget holds again. An entry whose ``nbytes`` exceeds the whole
+    budget is never admitted (the chunk cache's no-pin rule: one
+    over-budget item must not wipe the working set and then pin itself).
+    """
+
+    def __init__(
+        self,
+        budget_fn: Callable[[], int],
+        on_hit: Callable[[int], None] = _NOOP,
+        on_miss: Callable[[int], None] = _NOOP,
+        on_evict: Callable[[int], None] = _NOOP,
+    ) -> None:
+        self._budget_fn = budget_fn
+        self._on_hit = on_hit
+        self._on_miss = on_miss
+        self._on_evict = on_evict
+        self._entries: OrderedDict[Hashable, tuple[Any, int]] = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+
+    # -- queries ------------------------------------------------------------
+    @property
+    def bytes(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: Hashable) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def get(self, key: Hashable):
+        """The cached value (recency refreshed, hit hook in entry bytes),
+        or None. A miss here fires NO hook — only ``put`` knows the byte
+        cost of what was missing, so the miss hook fires at admission."""
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is None:
+                return None
+            self._entries.move_to_end(key)
+            value, nbytes = hit
+        self._on_hit(nbytes)
+        return value
+
+    # -- mutation -----------------------------------------------------------
+    def put(self, key: Hashable, value: Any, nbytes: int) -> Any:
+        """Admit ``key`` (miss hook fires in ``nbytes``), evicting LRU
+        entries over budget. Returns ``value`` so the miss path reads
+        ``cache.put(k, build(), n)``. Re-putting an existing key replaces
+        its entry in place (bytes re-accounted, recency refreshed)."""
+        nbytes = int(nbytes)
+        evicted: list[int] = []
+        budget = max(int(self._budget_fn()), 0)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            # over-budget single entry: never admitted, never pinned
+            if nbytes <= budget:
+                self._entries[key] = (value, nbytes)
+                self._bytes += nbytes
+                while self._bytes > budget and self._entries:
+                    k_old, (_, b_old) = self._entries.popitem(last=False)
+                    self._bytes -= b_old
+                    evicted.append(b_old)
+        self._on_miss(nbytes)
+        for b in evicted:
+            self._on_evict(b)
+        return value
+
+    def drop(self, key: Hashable) -> None:
+        """Remove one entry if present (no hooks — invalidation is not an
+        eviction; refresh publishes replace stale shards through here)."""
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes}

@@ -1,0 +1,216 @@
+"""Incremental per-entity refresh: warm-start ONE entity's solve.
+
+New events for one entity must not re-run a training round; they
+warm-start only that entity's bucket solve through the PR-5 chunked
+solver entry points (``optim.common.select_chunked_solver`` —
+``*_chunk_init`` / ``*_chunk_run`` to increasing absolute bounds /
+``*_chunk_finalize``). Those entry points sit behind the SAME nested-jit
+boundaries as the training-side ``_solve_bucket`` minimize call, which is
+what makes the parity contract bitwise rather than approximate:
+
+- **refresh parity** — the refreshed entity's coefficients are BITWISE
+  equal to a from-warm-start offline solve (the one-shot ``*_minimize``)
+  of the same bucket: same objective construction
+  (``make_objective(batch, loss, l2_weight=...)``, the
+  ``_solve_bucket.solve_one`` recipe), same ``w0``, and the chunked
+  run-to-exhaustion contract ("running the chunks to exhaustion then
+  finalizing reproduces ``*_minimize`` bitwise").
+- **untouched entities** — a refresh replaces exactly one row of the
+  cold-store matrix; every other entity's coefficient bytes are
+  untouched, so their serve-path scores are byte-identical before/after.
+
+``PHOTON_SERVE_REFRESH_EVERY`` is the trigger knob: the serving loop
+buffers labeled events per entity and calls :func:`refresh_entity` once
+an entity accrues that many (0 disables triggering; explicit calls
+always work). Publication is atomic: the updated snapshot is written
+through ``io/model_io.publish_game_model`` (``utils/atomic_io`` manifest
+pointer), then installed into the live store.
+
+Telemetry: counter ``serve.refresh.count``, timer ``serve.refresh_s``,
+span ``serve/refresh``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.config import OptimizerConfig
+from photon_ml_tpu.game.models import GameModel, RandomEffectModel
+from photon_ml_tpu.obs import span
+from photon_ml_tpu.obs.metrics import REGISTRY
+from photon_ml_tpu.ops.batch import DenseBatch
+from photon_ml_tpu.ops.glm import make_objective
+from photon_ml_tpu.ops.losses import loss_for_task
+
+# -- knobs (module globals read at CALL time; env override wins) ----------
+
+SERVE_REFRESH_EVERY = 0  # events per entity that trigger a refresh; 0 = off
+
+#: absolute iteration step between chunk_run bounds — any positive value
+#: yields the same bits (the chunked contract); small keeps readback cadence
+_CHUNK_STEP = 8
+
+
+def serve_refresh_every() -> int:
+    """Refresh trigger threshold, read at CALL time (env > module
+    global); 0 disables event-count triggering."""
+    env = os.environ.get("PHOTON_SERVE_REFRESH_EVERY")
+    if env is not None and env != "":
+        return max(int(env), 0)
+    return max(int(SERVE_REFRESH_EVERY), 0)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length() if n > 1 else 1
+
+
+def entity_event_batch(
+    X: np.ndarray,
+    labels: np.ndarray,
+    offsets: np.ndarray | None = None,
+    weights: np.ndarray | None = None,
+) -> DenseBatch:
+    """One entity's event rows as a pow2-padded bucket batch — the same
+    padding rule as training buckets (zero-weight pad rows are inert to
+    the objective), so 'the same bucket' means the same tensor both the
+    refresh and the offline comparator solve."""
+    X = np.asarray(X, np.float32)
+    n, d = X.shape
+    C = _next_pow2(n)
+    Xp = np.zeros((C, d), np.float32)
+    yp = np.zeros((C,), np.float32)
+    op = np.zeros((C,), np.float32)
+    wp = np.zeros((C,), np.float32)
+    Xp[:n] = X
+    yp[:n] = np.asarray(labels, np.float32)
+    if offsets is not None:
+        op[:n] = np.asarray(offsets, np.float32)
+    wp[:n] = 1.0 if weights is None else np.asarray(weights, np.float32)
+    return DenseBatch(
+        X=jnp.asarray(Xp), labels=jnp.asarray(yp),
+        offsets=jnp.asarray(op), weights=jnp.asarray(wp),
+    )
+
+
+def solve_entity_offline(
+    re_model: RandomEffectModel,
+    entity: int,
+    batch: DenseBatch,
+    config: OptimizerConfig,
+    l2_weight: float = 0.0,
+    l1_weight: float = 0.0,
+):
+    """The offline comparator: the one-shot minimize of the same bucket
+    from the same warm start (``_solve_bucket.solve_one``'s objective
+    construction, no prior/norm — the serving refresh contract's anchor).
+    Returns the ``OptimizationResult``."""
+    from photon_ml_tpu.optim.common import make_optimizer
+
+    loss = loss_for_task(re_model.task_type)
+    obj = make_objective(batch, loss, l2_weight=l2_weight)
+    w0 = jnp.asarray(np.asarray(re_model.coefficients)[int(entity)])
+    return make_optimizer(config, l1_weight)(obj, w0)
+
+
+def refresh_entity(
+    model: GameModel,
+    cid: str,
+    entity: int,
+    batch: DenseBatch,
+    config: OptimizerConfig,
+    l2_weight: float = 0.0,
+    l1_weight: float = 0.0,
+    chunk: int = _CHUNK_STEP,
+):
+    """Warm-start-refresh one entity's coefficients from ``batch`` (its
+    buffered event rows, pow2-padded via :func:`entity_event_batch`).
+
+    Returns ``(updated_model, result)`` where ``result`` is the solver's
+    ``OptimizationResult`` — ``result.w`` is bitwise the offline
+    warm-start solve of the same bucket (:func:`solve_entity_offline`).
+    The model container is rebuilt with ONE row replaced; every other
+    entity's bytes are untouched."""
+    from photon_ml_tpu.optim.common import select_chunked_solver
+
+    re_model = model[cid]
+    assert isinstance(re_model, RandomEffectModel), cid
+    loss = loss_for_task(re_model.task_type)
+    t0 = time.monotonic()
+    with span("serve/refresh", coordinate=cid, entity=int(entity)):
+        obj = make_objective(batch, loss, l2_weight=l2_weight)
+        w0 = jnp.asarray(np.asarray(re_model.coefficients)[int(entity)])
+        solver, extra = select_chunked_solver(config, l1_weight)
+        if solver is None:
+            # NEWTON_CHOLESKY has no chunked twin; the one-shot solve IS
+            # the offline solve, so parity is definitional
+            from photon_ml_tpu.optim.common import make_optimizer
+
+            res = make_optimizer(config, l1_weight)(obj, w0)
+        else:
+            state = solver.init(obj, w0, config, **extra)
+            bound = int(chunk)
+            # absolute bounds c, 2c, 3c, ... until the lane reports done
+            # (the while cond also stops at config.max_iterations, so the
+            # bound ladder terminates)
+            while not bool(state.done):
+                state = solver.run(
+                    obj, state, config, jnp.int32(bound), **extra
+                )
+                if bound > int(config.max_iterations) + int(chunk):
+                    break
+                bound += int(chunk)
+            res = solver.finalize(state)
+    dt = time.monotonic() - t0
+    REGISTRY.counter_inc("serve.refresh.count", 1)
+    REGISTRY.timer_add("serve.refresh_s", dt)
+
+    W = np.array(re_model.coefficients)
+    W[int(entity)] = np.asarray(res.w, W.dtype)
+    updated = RandomEffectModel(
+        coefficients=jnp.asarray(W),
+        variances=re_model.variances,
+        random_effect_type=re_model.random_effect_type,
+        feature_shard_id=re_model.feature_shard_id,
+        task_type=re_model.task_type,
+    )
+    return model.updated(cid, updated), res
+
+
+class RefreshBuffer:
+    """Per-entity event accumulator driving the refresh trigger: the
+    serving loop feeds labeled events in; once an entity holds
+    ``PHOTON_SERVE_REFRESH_EVERY`` of them (and the knob is non-zero),
+    ``pop_ready`` hands back its rows for a :func:`refresh_entity` call
+    and clears the buffer."""
+
+    def __init__(self) -> None:
+        self._events: dict[tuple[str, int], list[tuple]] = {}
+
+    def add(
+        self, cid: str, entity: int, x: np.ndarray, label: float,
+        offset: float = 0.0, weight: float = 1.0,
+    ) -> bool:
+        """Buffer one event; True when the entity just became ready."""
+        key = (cid, int(entity))
+        rows = self._events.setdefault(key, [])
+        rows.append((np.asarray(x, np.float32), float(label),
+                     float(offset), float(weight)))
+        every = serve_refresh_every()
+        return bool(every) and len(rows) >= every
+
+    def count(self, cid: str, entity: int) -> int:
+        return len(self._events.get((cid, int(entity)), ()))
+
+    def pop_ready(self, cid: str, entity: int) -> DenseBatch | None:
+        rows = self._events.pop((cid, int(entity)), None)
+        if not rows:
+            return None
+        X = np.stack([r[0] for r in rows])
+        y = np.asarray([r[1] for r in rows], np.float32)
+        off = np.asarray([r[2] for r in rows], np.float32)
+        w = np.asarray([r[3] for r in rows], np.float32)
+        return entity_event_batch(X, y, offsets=off, weights=w)

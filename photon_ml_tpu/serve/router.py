@@ -1,0 +1,364 @@
+"""Request routing + micro-window batched scoring.
+
+**Micro-windows.** Requests queue in arrival order and flush as one
+scoring launch when the window reaches ``PHOTON_SERVE_MAX_BATCH``
+requests or its OLDEST request has waited ``PHOTON_SERVE_MAX_WAIT_MS``
+milliseconds — the classic latency/throughput knob pair. Every window is
+padded to exactly ``max_batch`` rows before scoring, so the scoring
+programs see ONE (B, d) geometry for the server's lifetime: request
+batching never recompiles.
+
+**Scoring parity.** A window's scores are BYTE-identical to the batch
+``score`` driver (``GameTransformer.transform``) over the same rows:
+
+- fixed effects re-enter the shared ``_score_matvec`` program
+  (``ops/streaming``) on a :class:`DenseBatch` view of the window —
+  ``DenseBatch.matvec`` IS ``DenseFeatures.score``'s ``X @ w``, behind
+  the same jit boundary the streamed scorer uses;
+- random effects compute the same ``einsum("nd,nd->n")`` row-dot as
+  ``random_effect_scores``, over per-entity shards gathered through the
+  :class:`HotModelStore` (each row bit-identical to the training
+  matrix's row), with the same out-of-range masking as
+  ``RandomEffectModel.score``. Padding rows carry invalid ids and zero
+  features, and per-row results are row-independent, so trimming the pad
+  recovers the batch driver's bytes.
+
+**Cross-owner routing.** Under multihost serving each process owns the
+entities the PR-13 atom placement map assigns it (:class:`EntityRouter`
+— ``plan_entity_placement`` at entity/atom granularity). A serving step
+is collective: every process contributes its locally-arrived window,
+rows travel to their owners over the existing framed P2P
+(``exchange_rows``), owners score through THEIR hot working set, and
+scores ride the same transport home. A peer dying mid-serve surfaces as
+``PeerLost``; the caller degrades in place — roll call, survivor group,
+re-planned ownership over the survivors — and the step is retried on the
+degraded mesh (the PR-11/14 availability tier, unchanged).
+
+Telemetry: counters ``serve.requests`` / ``serve.windows`` /
+``serve.forwarded``, timer ``serve.window_s``, histogram
+``serve.window.occupancy`` (fill fraction per window), spans
+``serve/window`` per flush — all rendered by the report's serving
+section.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.models import FixedEffectModel, RandomEffectModel
+from photon_ml_tpu.obs import span
+from photon_ml_tpu.obs.metrics import REGISTRY
+from photon_ml_tpu.ops.batch import DenseBatch
+from photon_ml_tpu.serve.store import HotModelStore
+
+# -- knobs (module globals read at CALL time; env override wins) ----------
+
+SERVE_MAX_BATCH = 32  # micro-window flush size (also the padded shape)
+SERVE_MAX_WAIT_MS = 2.0  # oldest-request wait that forces a flush
+
+
+def serve_max_batch() -> int:
+    """Micro-window max batch, read at CALL time (env > module global)."""
+    env = os.environ.get("PHOTON_SERVE_MAX_BATCH")
+    if env is not None and env != "":
+        return max(int(env), 1)
+    return max(int(SERVE_MAX_BATCH), 1)
+
+
+def serve_max_wait_ms() -> float:
+    """Micro-window max wait (ms), read at CALL time (env > module
+    global). The ONE float-valued serve knob — strict-parsed like
+    ``PHOTON_RE_REPLAN_IMBALANCE``."""
+    env = os.environ.get("PHOTON_SERVE_MAX_WAIT_MS")
+    if env is not None and env != "":
+        return max(float(env), 0.0)
+    return max(float(SERVE_MAX_WAIT_MS), 0.0)
+
+
+@dataclass
+class ScoreRequest:
+    """One scoring request: per-shard feature vectors + entity ids (the
+    request-path view of one ``GameDatum`` row)."""
+
+    rid: int
+    features: dict[str, np.ndarray]  # shard id -> (d_shard,) float
+    id_tags: dict[str, int]  # entity-id tag -> dense entity id
+    offset: float = 0.0
+    arrival_s: float = 0.0  # open-loop scheduled arrival (loadgen clock)
+    submit_s: float = field(default=0.0, repr=False)
+
+
+def _score_window(
+    store: HotModelStore, requests: list[ScoreRequest], max_batch: int
+) -> np.ndarray:
+    """Score one micro-window, padded to ``max_batch`` rows. Returns the
+    (len(requests),) trimmed scores."""
+    from photon_ml_tpu.ops.streaming import _score_matvec
+
+    B = max_batch
+    n = len(requests)
+    model = store.model
+    total = np.zeros((B,), np.float32)
+    total[:n] = [float(r.offset) for r in requests]
+    total = jnp.asarray(total)
+    zeros = jnp.zeros((B,), jnp.float32)
+    for cid, sub in model.models.items():
+        if isinstance(sub, FixedEffectModel):
+            X = _window_features(requests, sub.feature_shard_id, B)
+            batch = DenseBatch(X=X, labels=zeros, offsets=zeros, weights=zeros)
+            total = total + _score_matvec(batch, store.fixed_coefficients[cid])
+        elif isinstance(sub, RandomEffectModel):
+            X = _window_features(requests, sub.feature_shard_id, B)
+            ids = np.full((B,), -1, np.int64)
+            ids[:n] = [
+                int(r.id_tags.get(sub.random_effect_type, -1))
+                for r in requests
+            ]
+            in_range = (ids >= 0) & (ids < store.num_entities(cid))
+            W_rows = store.rows_for(
+                cid, np.where(in_range, ids, 0), valid=in_range
+            )
+            # the SAME row-dot as random_effect_scores' dense branch and
+            # the same masking as RandomEffectModel.score — per-row ops,
+            # so window scores match the full-batch driver bitwise
+            raw = jnp.einsum("nd,nd->n", X, W_rows)
+            total = total + jnp.where(jnp.asarray(in_range), raw, 0.0)
+    return np.asarray(jax.block_until_ready(total))[:n]
+
+
+def _window_features(
+    requests: list[ScoreRequest], shard_id: str, B: int
+) -> jnp.ndarray:
+    d = len(np.asarray(requests[0].features[shard_id]))
+    X = np.zeros((B, d), np.float32)
+    for i, r in enumerate(requests):
+        X[i] = np.asarray(r.features[shard_id], np.float32)
+    return jnp.asarray(X)
+
+
+class MicroWindowServer:
+    """Single-process micro-window scoring loop over a
+    :class:`HotModelStore`.
+
+    ``submit`` enqueues and flushes full windows; ``poll`` flushes a
+    partial window whose oldest request aged past max-wait; ``drain``
+    flushes everything (end of trace / shutdown). ``on_scores(requests,
+    scores)`` receives every flushed window in submit order."""
+
+    def __init__(
+        self,
+        store: HotModelStore,
+        on_scores=None,
+        max_batch: int | None = None,
+        max_wait_ms: float | None = None,
+        clock=time.monotonic,
+    ) -> None:
+        self.store = store
+        self._on_scores = on_scores or (lambda requests, scores: None)
+        self._max_batch = max_batch
+        self._max_wait_ms = max_wait_ms
+        self._clock = clock
+        self._pending: list[ScoreRequest] = []
+        self.windows = 0
+        self.requests = 0
+        self._occupancy_sum = 0.0
+
+    # knob reads go through the accessors unless pinned at construction
+    def max_batch(self) -> int:
+        return self._max_batch if self._max_batch else serve_max_batch()
+
+    def max_wait_ms(self) -> float:
+        if self._max_wait_ms is not None:
+            return self._max_wait_ms
+        return serve_max_wait_ms()
+
+    def submit(self, request: ScoreRequest) -> None:
+        request.submit_s = self._clock()
+        self._pending.append(request)
+        REGISTRY.counter_inc("serve.requests", 1)
+        self.requests += 1
+        # a burst larger than max-batch flushes back-to-back FULL windows
+        while len(self._pending) >= self.max_batch():
+            self._flush(self._pending[: self.max_batch()])
+
+    def poll(self, now: float | None = None) -> None:
+        """Flush a partial window when the oldest request has waited past
+        the max-wait deadline."""
+        if not self._pending:
+            return
+        now = self._clock() if now is None else now
+        # the SAME float expression as next_deadline(): a caller that
+        # sleeps exactly to the deadline must observe the flush as due
+        # (a - b >= w can disagree with b + w <= a under rounding)
+        if now >= self._pending[0].submit_s + self.max_wait_ms() / 1e3:
+            self._flush(self._pending[: self.max_batch()])
+
+    def next_deadline(self) -> float | None:
+        """Absolute clock time the oldest pending request must flush by
+        (None when idle) — the loadgen's sleep bound."""
+        if not self._pending:
+            return None
+        return self._pending[0].submit_s + self.max_wait_ms() / 1e3
+
+    def drain(self) -> None:
+        while self._pending:
+            self._flush(self._pending[: self.max_batch()])
+
+    def occupancy_mean(self) -> float:
+        return self._occupancy_sum / self.windows if self.windows else 0.0
+
+    def _flush(self, window: list[ScoreRequest]) -> None:
+        del self._pending[: len(window)]
+        t0 = self._clock()
+        with span("serve/window", requests=len(window)):
+            scores = _score_window(self.store, window, self.max_batch())
+        dt = self._clock() - t0
+        occupancy = len(window) / self.max_batch()
+        self.windows += 1
+        self._occupancy_sum += occupancy
+        REGISTRY.counter_inc("serve.windows", 1)
+        REGISTRY.timer_add("serve.window_s", dt)
+        REGISTRY.histogram_observe("serve.window.occupancy", occupancy)
+        self._on_scores(window, scores)
+
+
+class EntityRouter:
+    """Entity -> owning process, via the PR-13 atom placement map
+    (entity granularity: each entity is one atom, all its requests land
+    at its owner — the same invariant the per-visit training exchanges
+    rely on). ``entity_rows`` weights the LPT plan; serving feeds it
+    expected traffic (e.g. the Zipf head counts) the way training feeds
+    it sample counts."""
+
+    def __init__(
+        self,
+        entity_rows: np.ndarray,
+        num_processes: int,
+        skew_aware: bool = True,
+    ) -> None:
+        from photon_ml_tpu.parallel.placement import plan_entity_placement
+
+        self.plan = plan_entity_placement(
+            np.asarray(entity_rows, np.float64), num_processes,
+            skew_aware=skew_aware,
+        )
+        self.owner = np.asarray(self.plan.owner, np.int64)
+        self.num_processes = int(num_processes)
+
+    def owner_of(self, entity: int) -> int:
+        if 0 <= entity < len(self.owner):
+            return int(self.owner[entity])
+        # unseen entity: deterministic modular fallback (scores 0 for the
+        # random effect anyway; the fixed effect is replicated)
+        return int(entity) % self.num_processes if entity >= 0 else 0
+
+    def replan(self, entity_rows: np.ndarray, survivors) -> None:
+        """Degrade in place: re-plan ownership over the survivor ranks
+        (the degraded group's effective indices) after a peer loss."""
+        from photon_ml_tpu.parallel.placement import plan_entity_placement
+
+        self.num_processes = len(survivors)
+        self.plan = plan_entity_placement(
+            np.asarray(entity_rows, np.float64), self.num_processes,
+        )
+        self.owner = np.asarray(self.plan.owner, np.int64)
+
+
+def serve_step_collective(
+    server: MicroWindowServer,
+    router: EntityRouter,
+    requests: list[ScoreRequest],
+    re_tag: str,
+    shard_ids: tuple[str, ...],
+    shard_dims: dict[str, int] | None = None,
+    tag: str = "serve_step",
+) -> np.ndarray:
+    """One collective serving step over the current (healthy or degraded)
+    group: every process contributes its locally-arrived requests, rows
+    ride the framed P2P to their owners (``exchange_rows`` — the
+    training-side shuffle, reused verbatim as the request transport),
+    owners score through their hot set, and scores ride the same
+    transport home. Returns this process's scores in ITS submit order.
+
+    Must be called collectively at the same program point on every
+    process of the group (the serving loop's cadence); raises
+    ``PeerLost`` when a peer dies mid-exchange — callers run the degrade
+    recipe (roll call -> survivor group -> ``router.replan``) and retry.
+    """
+    from photon_ml_tpu.parallel.multihost import (
+        effective_process_index,
+        exchange_rows,
+    )
+
+    me = effective_process_index()
+    n = len(requests)
+    dest = np.asarray(
+        [router.owner_of(int(r.id_tags.get(re_tag, -1))) for r in requests],
+        np.int64,
+    )
+    REGISTRY.counter_inc("serve.forwarded", int(np.sum(dest != me)))
+    payload = {
+        "rid": np.asarray([r.rid for r in requests], np.int64),
+        "src": np.full((n,), me, np.int64),
+        "entity": np.asarray(
+            [int(r.id_tags.get(re_tag, -1)) for r in requests], np.int64
+        ),
+        "offset": np.asarray([r.offset for r in requests], np.float32),
+    }
+    for sid in shard_ids:
+        if n:
+            payload[f"x_{sid}"] = np.stack(
+                [np.asarray(r.features[sid], np.float32) for r in requests]
+            )
+        else:
+            # collective shape contract: a request-less process still
+            # needs the true trailing feature dim for the exchange
+            d = (shard_dims or {}).get(sid, 1)
+            payload[f"x_{sid}"] = np.zeros((0, d), np.float32)
+    recv = exchange_rows(payload, dest, tag=tag)
+
+    owned = [
+        ScoreRequest(
+            rid=int(recv["rid"][i]),
+            features={sid: recv[f"x_{sid}"][i] for sid in shard_ids},
+            id_tags={re_tag: int(recv["entity"][i])},
+            offset=float(recv["offset"][i]),
+        )
+        for i in range(len(recv["rid"]))
+    ]
+    scored: dict[int, tuple[int, float]] = {}
+
+    def _collect(window, scores):
+        for r, s in zip(window, scores):
+            scored[r.rid] = (int(r.rid), float(s))
+
+    prev = server._on_scores
+    server._on_scores = _collect
+    try:
+        for r in owned:
+            server.submit(r)
+        server.drain()
+    finally:
+        server._on_scores = prev
+
+    back_dest = np.asarray(recv["src"], np.int64)
+    back = exchange_rows(
+        {
+            "rid": np.asarray(recv["rid"], np.int64),
+            "score": np.asarray(
+                [scored[int(rid)][1] for rid in recv["rid"]], np.float32
+            ),
+        },
+        back_dest,
+        tag=tag + "_return",
+    )
+    by_rid = {
+        int(rid): float(s) for rid, s in zip(back["rid"], back["score"])
+    }
+    return np.asarray([by_rid[r.rid] for r in requests], np.float32)

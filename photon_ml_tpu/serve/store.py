@@ -1,0 +1,220 @@
+"""Device-resident serving view of a published GAME model.
+
+The training side keeps each random effect as ONE (E, d) device matrix;
+a serving process cannot afford that — the north-star model (millions of
+entities) exceeds a chip's HBM, and a serving replica sees only the
+Zipf head of it anyway. The :class:`HotModelStore` therefore splits
+residency by effect kind:
+
+- **fixed effects** — one (d,) coefficient vector per coordinate,
+  device-resident whole for the store's lifetime (they are small and on
+  every request's path);
+- **random effects** — the (E, d) coefficient matrices stay HOST-side
+  (the cold store, loaded from the published snapshot), and a
+  byte-budgeted LRU **hot working set** of per-entity (d,) coefficient
+  shards is kept device-resident (``ops/bytelru`` — the PR-3 chunk
+  cache's accounting generalized from data chunks to model shards).
+
+Budget: ``PHOTON_SERVE_HOT_BYTES`` (env > module global, call-time read);
+0 means the model-derived default — ``_DEFAULT_MODEL_FRACTION`` (25%) of
+the total random-effect coefficient bytes, the serving twin of the chunk
+cache's 25%-of-HBM rule.
+
+Accounting (all in BYTES, at device entry size, through the PR-4
+registry): ``serve.hot.hit_bytes`` / ``serve.hot.miss_bytes`` /
+``serve.hot.evictions`` — plus a ``hit_rate()`` convenience over the
+store's lifetime, the number the Zipf bench gates.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.models import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.obs.metrics import REGISTRY
+from photon_ml_tpu.ops.bytelru import ByteBudgetLRU
+
+# -- knobs (module globals read at CALL time; env override wins) ----------
+
+SERVE_HOT_BYTES = 0  # hot-set byte budget; 0 = 25% of RE model bytes
+
+#: the hot set's default share of the random-effect model bytes when the
+#: knob is unset — deliberately a minority fraction, mirroring the chunk
+#: cache's ``_DEFAULT_HBM_FRACTION``: the bench's acceptance criterion is
+#: written against exactly this (hit rate >= 0.8 under Zipf(1) at 25%).
+_DEFAULT_MODEL_FRACTION = 0.25
+
+
+def serve_hot_budget_bytes() -> int:
+    """Hot-set byte budget, read at CALL time (env > module global);
+    0 = derive from the model (``_DEFAULT_MODEL_FRACTION`` of total
+    random-effect coefficient bytes) at store construction."""
+    env = os.environ.get("PHOTON_SERVE_HOT_BYTES")
+    if env is not None and env != "":
+        return max(int(env), 0)
+    return max(int(SERVE_HOT_BYTES), 0)
+
+
+def _hit(nbytes: int) -> None:
+    REGISTRY.counter_inc("serve.hot.hit_bytes", nbytes)
+
+
+def _miss(nbytes: int) -> None:
+    REGISTRY.counter_inc("serve.hot.miss_bytes", nbytes)
+
+
+def _evict(nbytes: int) -> None:
+    REGISTRY.counter_inc("serve.hot.evictions", 1)
+
+
+class HotModelStore:
+    """Serving residency manager for one :class:`GameModel` snapshot.
+
+    ``rows_for(cid, ids)`` returns the (B, d) device matrix of per-entity
+    coefficient rows for one micro-window — each row bit-identical to the
+    training matrix's row (device transfer preserves bits), gathered
+    through the hot set. Out-of-range ids yield zero rows; the window
+    scorer masks their contribution exactly like
+    ``RandomEffectModel.score``.
+    """
+
+    def __init__(self, model: GameModel, budget_bytes: int | None = None):
+        self.model = model
+        self.fixed_coefficients: dict[str, jnp.ndarray] = {}
+        self._re_host: dict[str, np.ndarray] = {}
+        self._re_models: dict[str, RandomEffectModel] = {}
+        for cid, sub in model.models.items():
+            if isinstance(sub, FixedEffectModel):
+                self.fixed_coefficients[cid] = jnp.asarray(
+                    sub.coefficient_means
+                )
+            elif isinstance(sub, RandomEffectModel):
+                # np.array (not asarray): the cold store must be a
+                # WRITABLE host copy — refresh swaps single rows in place
+                self._re_host[cid] = np.array(sub.coefficients)
+                self._re_models[cid] = sub
+        self.total_re_bytes = int(
+            sum(a.nbytes for a in self._re_host.values())
+        )
+        self._explicit_budget = budget_bytes
+        self._zeros: dict[str, jnp.ndarray] = {}
+        self._lock = threading.Lock()
+        self.hot = ByteBudgetLRU(
+            self.budget_bytes, on_hit=_hit, on_miss=_miss, on_evict=_evict
+        )
+        self._hits = 0
+        self._misses = 0
+
+    # -- budget -------------------------------------------------------------
+    def budget_bytes(self) -> int:
+        """Call-time budget: explicit constructor value > knob > the
+        model-derived 25% default (so a mid-serve env retune takes effect
+        on the next admission, the chunk cache's discipline)."""
+        if self._explicit_budget is not None:
+            return max(int(self._explicit_budget), 0)
+        knob = serve_hot_budget_bytes()
+        if knob > 0:
+            return knob
+        return max(int(self.total_re_bytes * _DEFAULT_MODEL_FRACTION), 1)
+
+    # -- lookups ------------------------------------------------------------
+    def random_effect(self, cid: str) -> RandomEffectModel:
+        return self._re_models[cid]
+
+    def num_entities(self, cid: str) -> int:
+        return int(self._re_host[cid].shape[0])
+
+    def host_row(self, cid: str, entity: int) -> np.ndarray:
+        return self._re_host[cid][entity]
+
+    def _zero_row(self, cid: str) -> jnp.ndarray:
+        z = self._zeros.get(cid)
+        if z is None:
+            host = self._re_host[cid]
+            z = jnp.zeros((host.shape[1],), host.dtype)
+            self._zeros[cid] = z
+        return z
+
+    def shard_for(self, cid: str, entity: int) -> jnp.ndarray:
+        """One entity's (d,) device coefficient shard via the hot set."""
+        entity = int(entity)
+        host = self._re_host[cid]
+        if not (0 <= entity < host.shape[0]):
+            return self._zero_row(cid)
+        key = (cid, entity)
+        row = self.hot.get(key)
+        if row is not None:
+            self._hits += 1
+            return row
+        self._misses += 1
+        dev = jnp.asarray(host[entity])
+        return self.hot.put(key, dev, int(dev.dtype.itemsize * dev.size))
+
+    def rows_for(
+        self, cid: str, ids: np.ndarray, valid: np.ndarray | None = None
+    ) -> jnp.ndarray:
+        """The (B, d) device gather for one micro-window. ``jnp.stack``
+        over B fixed-shape rows is one program per (B, d) — constant
+        across windows because windows are padded to the max batch.
+
+        ``valid`` marks the rows that are real in-range requests; the
+        rest (window padding, out-of-range ids — their contribution is
+        masked to 0 downstream anyway) get the zero row WITHOUT touching
+        the hot set, so the hit rate stays a deterministic function of
+        the request trace, independent of window boundaries."""
+        ids = np.asarray(ids)
+        if valid is None:
+            return jnp.stack([self.shard_for(cid, e) for e in ids])
+        return jnp.stack([
+            self.shard_for(cid, e) if ok else self._zero_row(cid)
+            for e, ok in zip(ids, np.asarray(valid))
+        ])
+
+    # -- refresh publication -------------------------------------------------
+    def install_refreshed_row(
+        self, cid: str, entity: int, row: np.ndarray
+    ) -> None:
+        """Swap one entity's coefficients in place (called by the refresh
+        path after its atomic publish): the cold store row is replaced
+        bit-for-bit and any stale hot shard is dropped, so the next
+        request re-admits the fresh row. Rows of every OTHER entity are
+        untouched — the byte-identical-scores-across-refresh contract."""
+        with self._lock:
+            host = self._re_host[cid]
+            host[entity] = np.asarray(row, host.dtype)
+            self.hot.drop((cid, int(entity)))
+            sub = self._re_models[cid]
+            self._re_models[cid] = RandomEffectModel(
+                coefficients=jnp.asarray(host),
+                variances=sub.variances,
+                random_effect_type=sub.random_effect_type,
+                feature_shard_id=sub.feature_shard_id,
+                task_type=sub.task_type,
+            )
+            self.model = self.model.updated(cid, self._re_models[cid])
+
+    # -- accounting ----------------------------------------------------------
+    def hit_rate(self) -> float:
+        """Lifetime in-range request hit rate of the hot set (count
+        basis; the byte counters are the registry's)."""
+        total = self._hits + self._misses
+        return float(self._hits) / total if total else 0.0
+
+    def stats(self) -> dict:
+        out = self.hot.stats()
+        out.update(
+            budget_bytes=self.budget_bytes(),
+            total_re_bytes=self.total_re_bytes,
+            hits=self._hits,
+            misses=self._misses,
+            hit_rate=self.hit_rate(),
+        )
+        return out

@@ -58,6 +58,17 @@
 # --multichip-r12` when the partitioner/restriction/kernel layout code
 # intentionally changes, then UPDATE_BASELINE=1 to re-bless.
 #
+# A SERVE (r13) leg validates the committed SERVE_r13.json (the online
+# serving capture: open-loop Zipf(1) trace against the HotModelStore at
+# the default 25%-of-RE-bytes hot budget): acceptance invariants (serve
+# scores BITWISE equal to the batch driver, incremental refresh BITWISE
+# equal to the offline warm-start solve, hot-set hit rate >= 0.8) plus
+# a gate of its latency/hit-rate/occupancy/parity metrics against
+# BASELINE_serve_cpu.json (latency tiers loose — CPU dispatch-bound;
+# parity tiers EXACT). Re-capture with `python bench.py --serve
+# --telemetry-dir telemetry_r13` when the serving code intentionally
+# changes, then UPDATE_BASELINE=1 to re-bless.
+#
 # An R09 (SPLIT) leg then validates the committed MULTICHIP_r09.json
 # (the PHOTON_RE_SPLIT sub-bucket placement A/B): acceptance invariants
 # (bitwise across arms/processes/vs the single-process reference,
@@ -140,6 +151,11 @@ with open("BASELINE_feshard_cpu.json", "w") as f:
     json.dump(doc["gate_metrics"], f, indent=2)
     f.write("\n")
 print("gate_quick: fe-shard baseline re-captured to BASELINE_feshard_cpu.json")
+doc = json.load(open("SERVE_r13.json"))
+with open("BASELINE_serve_cpu.json", "w") as f:
+    json.dump(doc["gate_metrics"], f, indent=2)
+    f.write("\n")
+print("gate_quick: serve baseline re-captured to BASELINE_serve_cpu.json")
 PY
     exit 0
 fi
@@ -297,6 +313,31 @@ print(
     "max-owner reduction "
     f"{acc['bytes_weight_max_owner_reduction_at_top_rung']:.1%} >= "
     f"{acc['required_bytes_weight_reduction']:.1%})"
+)
+PY
+
+# ---- serve (r13) leg: online-serving parity invariants + latency gate -----
+python - <<'PY'
+import json, sys
+
+from photon_ml_tpu.obs.report import gate_run
+
+doc = json.load(open("SERVE_r13.json"))
+acc = doc["acceptance"]
+assert acc["score_parity_bitwise"], acc
+assert acc["refresh_parity_bitwise"], acc
+assert acc["hit_rate_ge_required"], acc
+baseline = json.load(open("BASELINE_serve_cpu.json"))
+failures, lines = gate_run(doc["gate_metrics"], baseline)
+if failures:
+    print("\n".join(lines))
+    sys.exit(f"gate_quick: serve gate FAILED: {failures}")
+print(
+    "gate_quick: serve leg OK (hot-set hit rate "
+    f"{acc['hot_hit_rate']:.3f} >= {acc['required_hit_rate']} at "
+    f"{acc['hot_budget_fraction_of_re_bytes']:.0%} budget, p50 "
+    f"{doc['trace']['latency_p50_ms']:.2f} ms / p99 "
+    f"{doc['trace']['latency_p99_ms']:.2f} ms, parity bitwise)"
 )
 PY
 

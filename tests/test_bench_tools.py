@@ -169,6 +169,34 @@ class TestQuickMode:
                 "knobs": {"prefetch_depth": 2},
             },
         },
+        "S_serve_zipf": {
+            "sec_trace": 1.2,
+            "quality_ok": True,
+            "offered_rate_hz": 3000.0,
+            "achieved_rate_hz": 2900.0,
+            "serve_requests": 2400,
+            "serve_windows": 120,
+            "serve_latency_p50_ms": 2.0,
+            "serve_latency_p99_ms": 5.5,
+            "serve_latency_mean_ms": 2.4,
+            "serve_hot_hit_rate": 0.74,
+            "serve_window_occupancy_mean": 0.5,
+            "serve_hot_budget_bytes": 1152,
+            "serve_total_re_bytes": 4608,
+            "score_parity_mismatches": 0,
+            "refresh_parity_mismatches": 0,
+            "telemetry": {
+                "schema_version": 1,
+                "metrics": {
+                    "counters": {
+                        "serve.requests": {"value": 2400.0, "calls": 2400},
+                    },
+                    "gauges": {"serve.hot.hit_rate": 0.74},
+                    "histograms": {}, "timers": {},
+                },
+                "knobs": {"serve_max_batch": 32},
+            },
+        },
     }
 
     def _run_main(self, monkeypatch, capsys, results, quick=True):
@@ -262,6 +290,18 @@ class TestQuickMode:
             ]["value"] == 450.0
         )
         assert r_tel["knobs"]["re_compact_every"] == 0
+        # the serving config rides the same contract: latency percentiles,
+        # hit rate and the parity counts appear verbatim in the single
+        # JSON line (the --serve doc and gate leg consume these fields)
+        s_cfg = payload["configs"]["S_serve_zipf"]
+        assert s_cfg["serve_latency_p50_ms"] == 2.0
+        assert s_cfg["serve_latency_p99_ms"] == 5.5
+        assert s_cfg["serve_hot_hit_rate"] == 0.74
+        assert s_cfg["score_parity_mismatches"] == 0
+        assert s_cfg["refresh_parity_mismatches"] == 0
+        assert s_cfg["telemetry"]["metrics"]["gauges"][
+            "serve.hot.hit_rate"
+        ] == 0.74
         # quick writes NO artifacts (BENCH_DETAIL.json / BASELINE.md)
         assert not baseline_writes and not detail_writes
 
@@ -525,6 +565,122 @@ class TestQuickMode:
         # track even without _apply_retune_env)
         assert pf.prefetch_depth() == 0
         assert pf.chunk_cache_budget_bytes() == 123456
+
+
+class TestServeContract:
+    """``bench.py --serve`` (run_serve_r13) rides the same single-JSON-line
+    stdout contract as ``--quick``: the latency / hit-rate fields the
+    gate_quick serve leg and ``BASELINE_serve_cpu.json`` consume must all
+    be present, and acceptance problems must still print the doc BEFORE
+    raising (the driver's failure diagnosis is the doc itself)."""
+
+    FAKE = {
+        "sec_trace": 1.5,
+        "offered_rate_hz": 2000.0,
+        "achieved_rate_hz": 1900.0,
+        "serve_requests": 2400,
+        "serve_windows": 120,
+        "serve_latency_p50_ms": 2.25,
+        "serve_latency_p99_ms": 6.5,
+        "serve_latency_mean_ms": 2.75,
+        "serve_hot_hit_rate": 0.91,
+        "serve_window_occupancy_mean": 0.55,
+        "serve_hot_budget_bytes": 250,
+        "serve_total_re_bytes": 1000,
+        "score_parity_mismatches": 0,
+        "refresh_parity_mismatches": 0,
+        "quality_ok": True,
+        "shape": {"E_m": 128, "E_i": 16},
+    }
+
+    def _stub_child(self, monkeypatch, result):
+        calls = []
+        monkeypatch.setattr(
+            bench, "_run_config_subprocess",
+            lambda name, quick=False, telemetry_dir=None: (
+                calls.append((name, quick, telemetry_dir)), dict(result)
+            )[1],
+        )
+        return calls
+
+    def test_serve_quick_single_json_line_with_required_fields(
+        self, monkeypatch, capsys
+    ):
+        calls = self._stub_child(monkeypatch, self.FAKE)
+        doc = bench.run_serve_r13(quick=True)
+        assert calls == [("S_serve_zipf", True, None)]
+        lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+        assert len(lines) == 1, f"stdout must be ONE JSON line, got {lines}"
+        payload = json.loads(lines[0])
+        assert payload == doc
+        assert payload["round"] == 13 and payload["quick"] is True
+        for key in (
+            "latency_p50_ms", "latency_p99_ms", "latency_mean_ms",
+            "hot_hit_rate", "window_occupancy_mean", "hot_budget_bytes",
+            "requests", "windows", "offered_rate_hz", "achieved_rate_hz",
+        ):
+            assert key in payload["trace"], key
+        acc = payload["acceptance"]
+        assert acc["score_parity_bitwise"] is True
+        assert acc["refresh_parity_bitwise"] is True
+        assert acc["hot_budget_fraction_of_re_bytes"] == 0.25
+        assert set(payload["gate_metrics"]) == {
+            "serve/latency_p50_ms", "serve/latency_p99_ms",
+            "serve/hot_hit_rate", "serve/window_occupancy",
+            "serve/refresh_parity", "serve/score_parity",
+        }
+        assert payload["problems"] == []
+
+    def test_serve_parity_mismatch_prints_doc_then_raises(
+        self, monkeypatch, capsys
+    ):
+        bad = dict(self.FAKE, score_parity_mismatches=3)
+        self._stub_child(monkeypatch, bad)
+        with pytest.raises(RuntimeError, match="acceptance violated"):
+            bench.run_serve_r13(quick=True)
+        lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+        assert len(lines) == 1
+        payload = json.loads(lines[0])
+        assert payload["problems"], "doc must carry the failure"
+        assert payload["acceptance"]["score_parity_bitwise"] is False
+        assert payload["gate_metrics"]["serve/score_parity"] == 3.0
+
+    def test_serve_full_mode_gates_hit_rate_floor(self, monkeypatch, capsys):
+        low = dict(self.FAKE, serve_hot_hit_rate=0.5)
+        self._stub_child(monkeypatch, low)
+        # quick mode: the floor is NOT asserted (reduced shape)
+        bench.run_serve_r13(quick=True)
+        capsys.readouterr()
+        # full mode: below-floor hit rate is an acceptance violation
+        with pytest.raises(RuntimeError, match="hit rate"):
+            bench.run_serve_r13(quick=False)
+        payload = json.loads(capsys.readouterr().out.splitlines()[0])
+        assert payload["acceptance"]["hit_rate_ge_required"] is False
+
+    def test_serve_full_mode_writes_artifact(
+        self, monkeypatch, capsys, tmp_path
+    ):
+        self._stub_child(monkeypatch, self.FAKE)
+        out = str(tmp_path / "SERVE_r13.json")
+        doc = bench.run_serve_r13(out_path=out, quick=False)
+        capsys.readouterr()
+        with open(out) as f:
+            assert json.load(f) == doc
+
+    def test_committed_serve_artifact_matches_contract(self):
+        """The committed SERVE_r13.json carries the gated fields and its
+        acceptance flags all hold (the gate_quick serve leg's contract)."""
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(here, "SERVE_r13.json")) as f:
+            doc = json.load(f)
+        acc = doc["acceptance"]
+        assert acc["score_parity_bitwise"] and acc["refresh_parity_bitwise"]
+        assert acc["hot_hit_rate"] >= acc["required_hit_rate"]
+        with open(os.path.join(here, "BASELINE_serve_cpu.json")) as f:
+            base = json.load(f)
+        assert set(base) == set(doc["gate_metrics"])
+        assert base["serve/refresh_parity"] == 0.0
+        assert base["serve/score_parity"] == 0.0
 
 
 class TestNarrativeNumberDiscipline:
