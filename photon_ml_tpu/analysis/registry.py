@@ -60,6 +60,7 @@ RETUNE_TABLES = (
     "RETUNE_ENV_RE",
     "RETUNE_ENV_SHARD",
     "RETUNE_ENV_SERVE",
+    "RETUNE_ENV_STREAM",
 )
 
 
@@ -297,6 +298,36 @@ KNOBS: tuple[Knob, ...] = (
         accessors=("serve_refresh_every",),
         retune_global="SERVE_REFRESH_EVERY", retune_table="RETUNE_ENV_SERVE",
         sink_key="serve_refresh_every",
+    ),
+    # -- streaming executor (RETUNE_ENV_STREAM) -----------------------------
+    Knob(
+        name="PHOTON_STREAM_EXECUTOR", kind="flag", parse="strict_int",
+        default="0", owner="photon_ml_tpu/ops/stream_executor.py",
+        doc="1 = route streamed consumers through the shared executor "
+            "(multi-tenant chunk-cache arbiter + cross-stream scheduling)",
+        accessors=("stream_executor_enabled",),
+        retune_global="STREAM_EXECUTOR", retune_table="RETUNE_ENV_STREAM",
+        sink_key="stream_executor",
+    ),
+    Knob(
+        name="PHOTON_STREAM_PRIORITY", kind="spec", parse="spec",
+        default="'' (built-in table: serve=100, refresh=10, rest=50)",
+        owner="photon_ml_tpu/ops/stream_executor.py",
+        doc="per-consumer scheduling priority overrides, "
+            "'name=int,...' — higher preempts lower streams' prefetch depth",
+        accessors=("stream_priority_spec", "priority_of"),
+        retune_global="STREAM_PRIORITY", retune_table="RETUNE_ENV_STREAM",
+        sink_key="stream_priority",
+    ),
+    Knob(
+        name="PHOTON_STREAM_SHARE", kind="spec", parse="spec",
+        default="'' (no per-consumer cap)",
+        owner="photon_ml_tpu/ops/stream_executor.py",
+        doc="per-consumer chunk-cache budget shares, 'name=frac,...' — "
+            "caps a stream's charged bytes at frac x the cache budget",
+        accessors=("stream_share_spec", "share_fraction"),
+        retune_global="STREAM_SHARE", retune_table="RETUNE_ENV_STREAM",
+        sink_key="stream_share",
     ),
     # -- observability / selection toggles ---------------------------------
     Knob(

@@ -1708,9 +1708,18 @@ class StreamedGameTrainer:
                 )
             return b
 
-        for i, bucket in enumerate(
-            prefetch.prefetch_iter(len(units), gather)
-        ):
+        from photon_ml_tpu.ops import stream_executor
+
+        if stream_executor.stream_executor_enabled():
+            # scheduler-only port: gather() already uploads per-bucket
+            # (per-visit offsets make content caching worthless here);
+            # the executor adds the cross-stream priority/yield contract
+            bucket_iter = stream_executor.stream(
+                "re_gather", len(units), gather
+            )
+        else:
+            bucket_iter = prefetch.prefetch_iter(len(units), gather)
+        for i, bucket in enumerate(bucket_iter):
             members, (ent_ids, rows, cols, spec) = units[i]
             hashed = spec is not None and spec.hash_dim is not None
             n_real = len(ent_ids)
@@ -1883,9 +1892,29 @@ class StreamedGameTrainer:
             )
             return (W_rows, feat["indices"], feat["values"])
 
-        for i, args in enumerate(
-            prefetch.prefetch_iter(len(ranges), prepare, depth)
-        ):
+        from photon_ml_tpu.ops import stream_executor
+
+        if stream_executor.stream_executor_enabled():
+
+            def prepare_x(i):
+                lo, hi = ranges[i]
+                W_rows = prefetch.timed_device_put(W[shard.ent_local[lo:hi]])
+                if dense:
+                    feat = stream_executor.cached_device_put(
+                        "re_scores", {"X": X[lo:hi]}
+                    )
+                    return (W_rows, feat["X"])
+                feat = stream_executor.cached_device_put(
+                    "re_scores", {"indices": idx[lo:hi], "values": val[lo:hi]}
+                )
+                return (W_rows, feat["indices"], feat["values"])
+
+            arg_iter = stream_executor.stream(
+                "re_scores", len(ranges), prepare_x, depth
+            )
+        else:
+            arg_iter = prefetch.prefetch_iter(len(ranges), prepare, depth)
+        for i, args in enumerate(arg_iter):
             lo, hi = ranges[i]
             s = (
                 _re_chunk_scores_dense(*args)

@@ -193,7 +193,21 @@ def _knob_raw_state() -> tuple:
         )
     except Exception:
         serve_state = None
+    try:
+        import sys
+
+        se_mod = sys.modules.get("photon_ml_tpu.ops.stream_executor")
+        stream_state = (
+            None if se_mod is None
+            else (se_mod.STREAM_EXECUTOR, se_mod.STREAM_PRIORITY,
+                  se_mod.STREAM_SHARE)
+        )
+    except Exception:
+        stream_state = None
     return (
+        env.get("PHOTON_STREAM_EXECUTOR"),
+        env.get("PHOTON_STREAM_PRIORITY"),
+        env.get("PHOTON_STREAM_SHARE"),
         env.get("PHOTON_SERVE_HOT_BYTES"),
         env.get("PHOTON_SERVE_MAX_BATCH"),
         env.get("PHOTON_SERVE_MAX_WAIT_MS"),
@@ -222,6 +236,7 @@ def _knob_raw_state() -> tuple:
         project_state,
         fe_state,
         serve_state,
+        stream_state,
     )
 
 

@@ -454,6 +454,70 @@ def summarize_run(path: str, records: list[dict] | None = None) -> dict:
                 "serve.window.occupancy_mean"
             ),
         }
+        # traffic-driven ownership migration (serve.replan.*): present
+        # only when the router re-planned — pre-executor serve summaries
+        # stay key-for-key what they were
+        if "serve.replan.count" in counters or \
+                "serve.replan.count" in base_counters:
+            out["serve"]["replans"] = counter_v("serve.replan.count")
+            out["serve"]["replan_migrations"] = counter_v(
+                "serve.replan.migrations"
+            )
+    # streaming executor (stream.cache.* / stream.<consumer>.*,
+    # ops/stream_executor): the multi-tenant arbiter's byte traffic —
+    # "stream.cache.hit_bytes" / "stream.cache.miss_bytes" /
+    # "stream.cache.shared_hit_bytes" (hits on entries ANOTHER consumer
+    # admitted: the cross-stream dedup) / "stream.cache.evictions" — and
+    # a per-consumer breakdown parsed from the wildcard counter family
+    # ("stream.<name>.items" / ".hit_bytes" / ".miss_bytes" / ".yields",
+    # timer "stream.<name>.wait_s", gauge "stream.<name>.charged_bytes").
+    # Present only on executor-on runs — every committed executor-off
+    # summary stays key-for-key what it was.
+    if "stream.cache.hit_bytes" in counters \
+            or "stream.cache.hit_bytes" in base_counters \
+            or "stream.cache.miss_bytes" in counters \
+            or "stream.cache.miss_bytes" in base_counters:
+        consumers: dict = {}
+        skip = {"passes", "chunks", "streams", "cache"}
+        for cname in set(counters) | set(base_counters):
+            parts = cname.split(".")
+            if len(parts) != 3 or parts[0] != "stream":
+                continue
+            name = parts[1]
+            if name in skip:
+                continue
+            c = consumers.setdefault(name, {
+                "items": 0.0, "hit_bytes": 0.0, "miss_bytes": 0.0,
+                "yields": 0.0,
+            })
+            if parts[2] in c:
+                c[parts[2]] = counter_v(cname)
+        for tname in set(timers) | set(base_timers):
+            parts = tname.split(".")
+            if len(parts) == 3 and parts[0] == "stream" \
+                    and parts[2] == "wait_s" and parts[1] not in skip:
+                consumers.setdefault(parts[1], {})["wait_s"] = timer_s(
+                    tname
+                )
+        for gname, gval in metrics_gauges.items():
+            parts = gname.split(".")
+            if len(parts) == 3 and parts[0] == "stream" \
+                    and parts[2] == "charged_bytes" and parts[1] not in skip:
+                consumers.setdefault(parts[1], {})["charged_bytes"] = gval
+        se_cache = (run_end or {}).get("stream_cache") or {}
+        out["stream"] = {
+            "streams": counter_v("stream.streams"),
+            "cache_hit_bytes": counter_v("stream.cache.hit_bytes"),
+            "cache_shared_hit_bytes": counter_v(
+                "stream.cache.shared_hit_bytes"
+            ),
+            "cache_miss_bytes": counter_v("stream.cache.miss_bytes"),
+            "cache_evictions": counter_v("stream.cache.evictions"),
+            "cache_entries": se_cache.get("entries"),
+            "cache_bytes": se_cache.get("bytes"),
+            "charges": se_cache.get("charges"),
+            "consumers": consumers,
+        }
     if run_start.get("fleet"):
         out["fleet"] = run_start["fleet"]
     return out
@@ -646,6 +710,30 @@ def format_summary(s: dict) -> str:
                     f" ({_fmt_s(sv['refresh_s'])})"
                     if sv.get("refresh_s") else ""
                 )
+            )
+        if sv.get("replans"):
+            lines.append(
+                f"    traffic re-plan: {int(sv['replans'])} re-plans, "
+                f"{int(sv.get('replan_migrations') or 0)} entities "
+                f"migrated"
+            )
+    stm = s.get("stream") or {}
+    if stm.get("streams") or stm.get("consumers"):
+        lines.append(
+            f"  stream executor: {int(stm.get('streams') or 0)} streams, "
+            f"{_fmt_qty(stm.get('cache_hit_bytes') or 0.0)}B hit "
+            f"({_fmt_qty(stm.get('cache_shared_hit_bytes') or 0.0)}B "
+            f"shared) / {_fmt_qty(stm.get('cache_miss_bytes') or 0.0)}B "
+            f"miss, {int(stm.get('cache_evictions') or 0)} evictions"
+        )
+        for name, c in sorted((stm.get("consumers") or {}).items()):
+            lines.append(
+                f"    {name}: {int(c.get('items') or 0)} items, "
+                f"{_fmt_qty(c.get('hit_bytes') or 0.0)}B hit / "
+                f"{_fmt_qty(c.get('miss_bytes') or 0.0)}B miss, "
+                f"wait {_fmt_s(c.get('wait_s') or 0.0)}, charged "
+                f"{_fmt_qty(c.get('charged_bytes') or 0.0)}B, "
+                f"{int(c.get('yields') or 0)} yields"
             )
     if s.get("quality_parity"):
         lines.append(
@@ -1231,6 +1319,29 @@ def summarize_fleet(paths: list[str]) -> dict:
             ),
             "per_process": serve_pp,
         }
+    # streaming executor at fleet granularity: arbiter byte totals over
+    # the processes that streamed through it (dedup is per-process — the
+    # arbiter is process-wide — so totals just sum)
+    stream_pp = {
+        k: (s.get("stream") or {})
+        for k, s in processes.items()
+        if s.get("stream")
+    }
+    stream = None
+    if stream_pp:
+        stream = {
+            "cache_hit_bytes_total": float(sum(
+                c.get("cache_hit_bytes") or 0 for c in stream_pp.values()
+            )),
+            "cache_shared_hit_bytes_total": float(sum(
+                c.get("cache_shared_hit_bytes") or 0
+                for c in stream_pp.values()
+            )),
+            "cache_miss_bytes_total": float(sum(
+                c.get("cache_miss_bytes") or 0 for c in stream_pp.values()
+            )),
+            "per_process": stream_pp,
+        }
     head = processes[str(pidxs[0])]
     return {
         "run_id": head["run_id"],
@@ -1264,6 +1375,7 @@ def summarize_fleet(paths: list[str]) -> dict:
         "re_combine": combine,
         "re_project": project,
         "serve": serve,
+        "stream": stream,
         "replans": replans,
         "processes": processes,
     }
@@ -1483,6 +1595,16 @@ def format_fleet(fs: dict) -> str:
                 if isinstance(hr, (int, float)) else ""
             )
         )
+    stm = fs.get("stream") or {}
+    if stm:
+        lines.append(
+            f"  stream executor: "
+            f"{_fmt_qty(stm.get('cache_hit_bytes_total') or 0.0)}B hit "
+            f"({_fmt_qty(stm.get('cache_shared_hit_bytes_total') or 0.0)}B "
+            f"shared) / "
+            f"{_fmt_qty(stm.get('cache_miss_bytes_total') or 0.0)}B miss "
+            f"across {len(stm.get('per_process') or {})} process(es)"
+        )
     for rp in fs.get("replans") or []:
         procs = rp.get("processes") or []
         lines.append(
@@ -1681,6 +1803,15 @@ DEFAULT_GATE_THRESHOLDS: dict[str, dict] = {
     "serve/window_occupancy": {"abs": 1.0},
     "serve/refresh_parity": {"rel": 0.0, "abs": 0.0},
     "serve/score_parity": {"rel": 0.0, "abs": 0.0},
+    # streaming-executor tiers (PHOTON_STREAM_EXECUTOR runs only —
+    # executor-off runs never emit stream/* keys, so every committed
+    # baseline stays valid unchanged): arbiter transfer bytes are
+    # chunk-shape arithmetic but depend on eviction timing under
+    # pressure, so they gate LOOSE; the stream parity flags (bench
+    # X_stream) are bitwise contracts and gate EXACT
+    "stream/": {"rel": 0.5},
+    "stream/cache_evictions": {"rel": 1.0, "abs": 8.0},
+    "stream/parity": {"rel": 0.0, "abs": 0.0},
     # quality tiers: deltas vs the f32 anchor, absolute headroom at the
     # parity-gate scale (|ΔAUC| ≤ 0.005 is the ladder's own bf16 gate)
     "quality/": {"rel": 0.0, "abs": 0.005},
@@ -1797,6 +1928,19 @@ def gate_metrics_from_summary(s: dict) -> dict[str, float]:
             m["serve/window_occupancy"] = float(
                 sv["window_occupancy_mean"]
             )
+    stm = s.get("stream") or {}
+    if stm.get("streams") or stm.get("cache_miss_bytes"):
+        # executor tiers: miss bytes (the actual transfer traffic the
+        # arbiter paid) and evictions are lower-is-better and gate on
+        # the loose stream/ tier; hit bytes are higher-is-better so
+        # they ride the report narrative, not the one-sided gate.
+        # Executor-off runs never emit these keys.
+        m["stream/cache_miss_bytes"] = float(
+            stm.get("cache_miss_bytes") or 0
+        )
+        m["stream/cache_evictions"] = float(
+            stm.get("cache_evictions") or 0
+        )
     m.update(_qp_metrics(s.get("quality_parity") or {}))
     o = s.get("optim") or {}
     if o.get("solves"):

@@ -323,6 +323,15 @@ def _shutdown_locked() -> None:
         record["chunk_cache"] = prefetch.cache_stats()
     except Exception:
         pass
+    try:
+        from photon_ml_tpu.ops import stream_executor
+
+        # only when a stream actually rode the arbiter: an executor-off
+        # run's run_end record stays key-for-key what it was
+        if stream_executor.traffic_seen():
+            record["stream_cache"] = stream_executor.cache_stats()
+    except Exception:
+        pass
     sink.emit(record)
     sink.close()
 
@@ -397,6 +406,18 @@ def _knob_snapshot() -> dict:
         knobs["serve_refresh_every"] = int(
             serve_refresh.serve_refresh_every()
         )
+    except Exception:
+        pass
+    try:
+        from photon_ml_tpu.ops import stream_executor
+
+        knobs["stream_executor"] = int(
+            bool(stream_executor.stream_executor_enabled())
+        )
+        knobs["stream_priority"] = str(
+            stream_executor.stream_priority_spec()
+        )
+        knobs["stream_share"] = str(stream_executor.stream_share_spec())
     except Exception:
         pass
     return knobs

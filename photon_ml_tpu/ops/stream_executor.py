@@ -1,0 +1,504 @@
+"""One streaming executor for every streamed consumer in the package.
+
+The scale story (PAPER.md §: #samples via streaming) grew as four
+hand-wired copies of the same source → prepare → device-window → consume
+loop — ``StreamingGLMObjective._stream``, both ``stream_scores``, the
+``StreamedGameTrainer`` bucket/visit ingest, CV fold ingest — plus the
+serving/refresh streams of PR 19. Each copy wires the prefetch pool and
+the chunk cache separately, so no two streams can share a byte of HBM
+budget or a prepared chunk, and a latency-critical stream cannot ask a
+background stream to get out of the way. This module is the ONE pipeline
+they all ride when ``PHOTON_STREAM_EXECUTOR=1``:
+
+- **Registration** (:func:`register`): each consumer declares a name,
+  a scheduling priority and (optionally) a share of the chunk-cache
+  budget. The registration owns the telemetry surface — the executor
+  emits ``stream/<name>`` spans and per-consumer counters
+  (``stream.<name>.items`` / ``.wait_s`` / ``.hit_bytes`` /
+  ``.miss_bytes`` / ``.yields`` and the ``.charged_bytes`` gauge), so a
+  ported consumer that silently drops its stream span fails the
+  telemetry-surface lint, not review.
+- **Scheduling** (:func:`stream`): the same bounded-depth pipeline as
+  ``prefetch.prefetch_iter`` (same worker pool, same in-order yield, same
+  error propagation), except the effective depth is re-read on every
+  submission: while a strictly higher-priority stream is active
+  (:func:`active_stream` — the serve window marks itself active while it
+  scores), a lower-priority stream submits at depth 1, yielding its
+  prefetch slots to the critical path. Scheduling touches PREPARATION
+  ONLY — kernel calls and accumulation stay on the consumer thread in
+  item order (the PR-3 contract), so outputs are bitwise identical at
+  any priority interleaving.
+- **Arbitration** (:func:`cached_device_put`): one process-wide
+  multi-tenant chunk cache. Entries are keyed by chunk CONTENT
+  fingerprint × pack dtype × fe_range — not by host storage identity —
+  so a validation stream replaying training chunks through a different
+  loader (fresh host arrays, identical bytes) re-uses the resident
+  device buffers instead of re-transferring its own copy. Every
+  consumer holding an entry is charged its full byte size; a consumer
+  exceeding its budget share releases ITS least-recently-used holds
+  first (a shared entry stays device-resident until the LAST holder
+  releases — the refcount rule), so one stream's pressure can never
+  evict a neighbor's working set before its own.
+
+``PHOTON_STREAM_EXECUTOR=0`` (the default) is wired OUT of every
+consumer: each keeps its pre-executor branch verbatim — same transfer
+counters, same span tree, bitwise outputs.
+
+Knobs (env > module global, read at CALL time, strict parse):
+``PHOTON_STREAM_EXECUTOR`` (flag), ``PHOTON_STREAM_PRIORITY``
+(spec: ``name=int,...`` overriding per-consumer priorities) and
+``PHOTON_STREAM_SHARE`` (spec: ``name=fraction,...`` capping a
+consumer's charged bytes at that fraction of the chunk-cache budget;
+unlisted consumers are capped only by the whole budget).
+
+Accounting (BYTES, through the PR-4 registry): constant-named
+``stream.cache.hit_bytes`` / ``stream.cache.shared_hit_bytes`` (hits on
+entries ANOTHER consumer admitted — the cross-stream dedup the X_stream
+bench measures) / ``stream.cache.miss_bytes`` (actual transfer traffic)
+/ ``stream.cache.evictions``, plus the per-consumer wildcard family
+above. All rendered by ``report summarize``'s stream section.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from photon_ml_tpu.obs import span
+from photon_ml_tpu.obs.metrics import REGISTRY
+from photon_ml_tpu.utils import profiling
+
+# -- knobs (module globals read at CALL time; env override wins) ----------
+
+STREAM_EXECUTOR = 0  # 1 = route ported consumers through the executor
+STREAM_PRIORITY = ""  # spec "name=int,...": per-consumer priority override
+STREAM_SHARE = ""  # spec "name=frac,...": per-consumer budget-share cap
+
+
+def stream_executor_enabled() -> bool:
+    """The executor toggle, read at CALL time (env > module global).
+    Off (the default) keeps every ported consumer on its pre-executor
+    branch bit-for-bit."""
+    env = os.environ.get("PHOTON_STREAM_EXECUTOR")
+    if env is not None and env != "":
+        return bool(int(env))
+    return bool(int(STREAM_EXECUTOR))
+
+
+def stream_priority_spec() -> str:
+    """Raw ``name=int,...`` priority-override spec (env > module
+    global). Parsed strictly by :func:`priority_of` — a malformed entry
+    raises, naming the value (never silently default)."""
+    env = os.environ.get("PHOTON_STREAM_PRIORITY")
+    if env is not None:
+        return env
+    return str(STREAM_PRIORITY)
+
+
+def stream_share_spec() -> str:
+    """Raw ``name=fraction,...`` budget-share spec (env > module
+    global); fractions are of the chunk-cache byte budget
+    (``prefetch.chunk_cache_budget_bytes``)."""
+    env = os.environ.get("PHOTON_STREAM_SHARE")
+    if env is not None:
+        return env
+    return str(STREAM_SHARE)
+
+
+def _parse_spec(spec: str, knob: str, cast) -> dict:
+    out: dict = {}
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, sep, raw = item.partition("=")
+        if not sep or not name.strip():
+            raise ValueError(
+                f"{knob}: malformed entry {item!r} — expected "
+                f"'consumer=value[,consumer=value...]'"
+            )
+        out[name.strip()] = cast(raw.strip())
+    return out
+
+
+#: default scheduling priorities for the consumers this PR ports —
+#: serving preempts everything, refresh yields to everything; the four
+#: training-side streams share the middle band (they never overlap in
+#: the current drivers, so relative order among them is inert).
+_DEFAULT_PRIORITY = {
+    "serve": 100,
+    "objective": 50,
+    "scores": 50,
+    "re_gather": 50,
+    "re_scores": 50,
+    "cv": 50,
+    "refresh": 10,
+}
+_FALLBACK_PRIORITY = 50
+
+# -- consumer registration -------------------------------------------------
+
+_reg_lock = threading.Lock()
+_registered: dict[str, int] = {}  # name -> registration-time priority
+# name -> nesting count of live streams / active windows (re-entrant)
+_active: dict[str, int] = {}
+
+
+def register(name: str, priority: int | None = None) -> None:
+    """Declare a stream consumer (idempotent). ``priority`` defaults to
+    the consumer's entry in the default table; the env spec wins over
+    both at call time."""
+    with _reg_lock:
+        if priority is not None:
+            _registered[name] = int(priority)
+        else:
+            _registered.setdefault(
+                name, _DEFAULT_PRIORITY.get(name, _FALLBACK_PRIORITY)
+            )
+
+
+def priority_of(name: str) -> int:
+    """Effective priority: env/global spec > registration > default
+    table > fallback. Read at CALL time like every knob."""
+    overrides = _parse_spec(stream_priority_spec(), "PHOTON_STREAM_PRIORITY", int)
+    if name in overrides:
+        return int(overrides[name])
+    with _reg_lock:
+        if name in _registered:
+            return _registered[name]
+    return _DEFAULT_PRIORITY.get(name, _FALLBACK_PRIORITY)
+
+
+def share_fraction(name: str) -> float:
+    """This consumer's cap on charged cache bytes, as a fraction of the
+    chunk-cache budget; 1.0 (no per-consumer cap) when unlisted."""
+    shares = _parse_spec(stream_share_spec(), "PHOTON_STREAM_SHARE", float)
+    frac = float(shares.get(name, 1.0))
+    if not 0.0 < frac <= 1.0:
+        raise ValueError(
+            f"PHOTON_STREAM_SHARE: share for {name!r} must be in (0, 1], "
+            f"got {frac}"
+        )
+    return frac
+
+
+class active_stream:
+    """Mark ``name`` active for the scheduler's duration checks — the
+    serve window wraps its scoring in this so concurrently-running
+    lower-priority streams yield their prefetch slots. Re-entrant."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+
+    def __enter__(self) -> "active_stream":
+        register(self.name)
+        with _reg_lock:
+            _active[self.name] = _active.get(self.name, 0) + 1
+        return self
+
+    def __exit__(self, *exc) -> None:
+        with _reg_lock:
+            n = _active.get(self.name, 0) - 1
+            if n <= 0:
+                _active.pop(self.name, None)
+            else:
+                _active[self.name] = n
+
+
+def _higher_priority_active(name: str) -> bool:
+    mine = priority_of(name)
+    with _reg_lock:
+        others = [n for n in _active if n != name]
+    return any(priority_of(n) > mine for n in others)
+
+
+# -- the scheduled stream --------------------------------------------------
+
+
+def stream(
+    name: str,
+    num_items: int,
+    prepare: Callable[[int], Any],
+    depth: int | None = None,
+) -> Iterator[Any]:
+    """Yield ``prepare(0..num_items-1)`` IN ORDER through the executor:
+    the prefetch worker pool prepares up to ``depth`` items ahead
+    (knob default), re-checking on every submission whether a strictly
+    higher-priority stream is active — if so this stream tops up to
+    depth 1 only (its slots yield to the critical path; ``.yields``
+    counts the deferrals). Consume order is always item order, so
+    scheduling can never change a consumer's outputs. Error semantics
+    are ``prefetch_iter``'s: a worker exception re-raises at that item's
+    turn and the queued tail is cancelled."""
+    from photon_ml_tpu.ops import prefetch
+
+    register(name)
+    if num_items <= 0:
+        return
+    base = prefetch.prefetch_depth() if depth is None else max(int(depth), 0)
+    if threading.current_thread().name.startswith("photon-prefetch"):
+        base = 0  # nested-consumer guard, same rule as prefetch_iter
+    REGISTRY.counter_inc("stream.streams")
+    REGISTRY.counter_inc(f"stream.{name}.items", num_items)
+    with active_stream(name), span(f"stream/{name}", items=num_items):
+        if base <= 0 or num_items <= 1:
+            for i in range(num_items):
+                yield prepare(i)
+            return
+        pool = prefetch._worker_pool()
+        from collections import deque
+
+        futs: deque = deque()
+        nxt = 0
+
+        def _top_up() -> None:
+            nonlocal nxt
+            eff = base
+            if _higher_priority_active(name):
+                eff = min(base, 1)
+            limited = False
+            while nxt < num_items and len(futs) < eff:
+                futs.append(
+                    pool.submit(prefetch._timed_prepare, prepare, nxt)
+                )
+                nxt += 1
+            if nxt < num_items and eff < base and len(futs) >= eff:
+                limited = True
+            if limited:
+                REGISTRY.counter_inc(f"stream.{name}.yields")
+
+        try:
+            _top_up()
+            while futs:
+                f = futs.popleft()
+                t0 = time.perf_counter()
+                with profiling.stage_timer("prefetch.consumer_wait_s"):
+                    out = f.result()  # re-raises a worker exception here
+                REGISTRY.timer_add(
+                    f"stream.{name}.wait_s", time.perf_counter() - t0
+                )
+                _top_up()
+                yield out
+        finally:
+            for f in futs:  # consumer bailed: drop the prepared tail
+                f.cancel()
+
+
+# -- the multi-tenant chunk-cache arbiter ----------------------------------
+
+# content-fingerprint memo keyed by host STORAGE identity: repeat passes
+# over unchanged arrays must not re-hash chunk bytes. Holding the array
+# reference makes the data-pointer key safe (a held array's address can
+# never be reused by the allocator — the ops/streaming _FP_MEMO argument).
+_fp_lock = threading.Lock()
+_FP_MEMO_CAP = 4096
+_fp_memo: "OrderedDict[tuple, tuple]" = OrderedDict()  # skey -> (ref, digest)
+
+
+def _content_fingerprint(a: np.ndarray) -> bytes:
+    from photon_ml_tpu.ops import prefetch
+
+    skey = prefetch._storage_key(a)
+    with _fp_lock:
+        hit = _fp_memo.get(skey)
+        if hit is not None:
+            _fp_memo.move_to_end(skey)
+            return hit[1]
+    h = hashlib.blake2b(digest_size=16)
+    h.update(repr((str(a.dtype), a.shape)).encode())
+    h.update(np.ascontiguousarray(a).tobytes())
+    digest = h.digest()
+    with _fp_lock:
+        _fp_memo[skey] = (a, digest)
+        while len(_fp_memo) > _FP_MEMO_CAP:
+            _fp_memo.popitem(last=False)
+    return digest
+
+
+class _Entry:
+    __slots__ = ("dev", "nbytes", "holders", "admitted_by")
+
+    def __init__(self, dev, nbytes: int, admitted_by: str) -> None:
+        self.dev = dev
+        self.nbytes = int(nbytes)
+        self.holders: "OrderedDict[str, None]" = OrderedDict()
+        self.admitted_by = admitted_by
+
+
+_arb_lock = threading.Lock()
+_entries: "OrderedDict[tuple, _Entry]" = OrderedDict()  # global LRU
+_total_bytes = 0
+_charges: dict[str, int] = {}  # consumer -> charged bytes
+_holder_lru: dict[str, "OrderedDict[tuple, None]"] = {}
+_arb_stats = {"hits": 0, "shared_hits": 0, "misses": 0, "evictions": 0}
+_saw_traffic = False
+
+
+def _share_bytes(name: str) -> int:
+    from photon_ml_tpu.ops import prefetch
+
+    budget = prefetch.chunk_cache_budget_bytes()
+    return int(budget * share_fraction(name))
+
+
+def _release_locked(name: str, key: tuple) -> None:
+    """Drop ``name``'s hold on ``key``; the entry leaves the device only
+    when its LAST holder releases (the shared-entry refcount rule)."""
+    global _total_bytes
+    e = _entries.get(key)
+    if e is None or name not in e.holders:
+        return
+    del e.holders[name]
+    _charges[name] = _charges.get(name, 0) - e.nbytes
+    _holder_lru.get(name, OrderedDict()).pop(key, None)
+    if not e.holders:
+        del _entries[key]
+        _total_bytes -= e.nbytes
+        _arb_stats["evictions"] += 1
+        REGISTRY.counter_inc("stream.cache.evictions")
+
+
+def _enforce_locked(name: str) -> None:
+    """Budget enforcement after an admission/hold by ``name``: first the
+    per-consumer share (release ``name``'s own LRU holds — a neighbor's
+    entries are untouched), then the global budget (walk the global LRU,
+    releasing EVERY holder of the victim)."""
+    from photon_ml_tpu.ops import prefetch
+
+    share = _share_bytes(name)
+    lru = _holder_lru.setdefault(name, OrderedDict())
+    while _charges.get(name, 0) > share and lru:
+        _release_locked(name, next(iter(lru)))
+    budget = prefetch.chunk_cache_budget_bytes()
+    while _total_bytes > budget and _entries:
+        victim = next(iter(_entries))
+        for h in list(_entries[victim].holders):
+            _release_locked(h, victim)
+
+
+def _hold_locked(name: str, key: tuple, e: _Entry) -> None:
+    if name not in e.holders:
+        e.holders[name] = None
+        _charges[name] = _charges.get(name, 0) + e.nbytes
+    lru = _holder_lru.setdefault(name, OrderedDict())
+    lru[key] = None
+    lru.move_to_end(key)
+    _entries.move_to_end(key)
+
+
+def _arb_put_one(name: str, arr_name: str, a, context) -> Any:
+    """One host array → its device twin through the shared arbiter."""
+    global _total_bytes
+    from photon_ml_tpu.ops import prefetch
+
+    a = np.asarray(a)
+    tdt = prefetch.transfer_dtype()
+    packs = (
+        tdt != "f32"
+        and arr_name in prefetch._PACK_KEYS
+        and a.dtype == np.float32
+    )
+    # pack dtype is part of the key exactly like the PR-3 cache: a
+    # bf16-rung entry must never serve an f32 pass after a mid-process
+    # knob toggle
+    key = (_content_fingerprint(a), tdt if packs else "raw", context)
+    with _arb_lock:
+        e = _entries.get(key)
+        if e is not None:
+            _arb_stats["hits"] += 1
+            REGISTRY.counter_inc("stream.cache.hit_bytes", e.nbytes)
+            if name not in e.holders:
+                _arb_stats["shared_hits"] += 1
+                REGISTRY.counter_inc(
+                    "stream.cache.shared_hit_bytes", e.nbytes
+                )
+            REGISTRY.counter_inc(f"stream.{name}.hit_bytes", e.nbytes)
+            _hold_locked(name, key, e)
+            _enforce_locked(name)
+            dev = e.dev
+            charged = _charges.get(name, 0)
+            REGISTRY.gauge_set(f"stream.{name}.charged_bytes", charged)
+            return dev
+        _arb_stats["misses"] += 1
+    # transfer OUTSIDE the lock (the expensive part; concurrent misses
+    # for the same key both transfer — last insert wins, both correct)
+    staged = prefetch._pack_for_transfer(a) if packs else a
+    dev = prefetch.timed_device_put(staged)
+    nbytes = int(dev.nbytes)
+    REGISTRY.counter_inc("stream.cache.miss_bytes", nbytes)
+    REGISTRY.counter_inc(f"stream.{name}.miss_bytes", nbytes)
+    with _arb_lock:
+        e = _entries.get(key)
+        if e is None:
+            e = _Entry(dev, nbytes, name)
+            _entries[key] = e
+            _total_bytes += nbytes
+        _hold_locked(name, key, e)
+        _enforce_locked(name)
+        dev = e.dev
+        REGISTRY.gauge_set(
+            f"stream.{name}.charged_bytes", _charges.get(name, 0)
+        )
+    return dev
+
+
+def cached_device_put(
+    name: str, host_tree: dict, context: Any = None
+) -> dict:
+    """Device-resident arrays for a prepared host chunk through the
+    MULTI-TENANT arbiter: entries key on content fingerprint × pack
+    dtype × ``context`` (the fe_range under feature sharding), so a
+    second stream replaying the same chunk CONTENT — even through fresh
+    host arrays — re-uses the resident buffers, charged to both
+    holders. Thread-safe; prefetch workers for different chunks race
+    here by design."""
+    global _saw_traffic
+    _saw_traffic = True
+    register(name)
+    return {
+        k: _arb_put_one(name, k, v, context) for k, v in host_tree.items()
+    }
+
+
+def cache_stats() -> dict:
+    """Arbiter snapshot for the telemetry sink's ``run_end`` record —
+    per-consumer charges next to the aggregate, mirroring
+    ``prefetch.cache_stats()``."""
+    with _arb_lock:
+        return dict(
+            _arb_stats,
+            entries=len(_entries),
+            bytes=_total_bytes,
+            charges={k: v for k, v in sorted(_charges.items()) if v},
+        )
+
+
+def traffic_seen() -> bool:
+    """True once any stream routed through the arbiter this process —
+    the sink's gate for embedding ``stream_cache`` stats (executor-off
+    runs keep their run_end record key-for-key unchanged)."""
+    return _saw_traffic
+
+
+def clear() -> None:
+    """Drop every arbiter entry, charge and fingerprint memo (tests and
+    bench arms; the worker pool and registrations survive)."""
+    global _total_bytes, _saw_traffic
+    with _arb_lock:
+        _entries.clear()
+        _charges.clear()
+        _holder_lru.clear()
+        _total_bytes = 0
+        for k in _arb_stats:
+            _arb_stats[k] = 0
+        _saw_traffic = False
+    with _fp_lock:
+        _fp_memo.clear()
+    with _reg_lock:
+        _active.clear()

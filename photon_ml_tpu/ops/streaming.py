@@ -715,6 +715,29 @@ class StreamingGLMObjective:
         REGISTRY.counter_inc("stream.passes")
         REGISTRY.counter_inc("stream.chunks", len(src))
 
+        from photon_ml_tpu.ops import stream_executor
+
+        if stream_executor.stream_executor_enabled():
+            # executor path: same pool, same in-order consume; device
+            # residency rides the MULTI-TENANT arbiter keyed by chunk
+            # CONTENT × pack dtype × fe_range, so a validation stream
+            # replaying these chunks re-uses the resident buffers
+            def prepare_x(i):
+                return stream_executor.cached_device_put(
+                    "objective", slim(src[i]), context=self._fe_range
+                )
+
+            for i, cur in enumerate(
+                stream_executor.stream("objective", len(src), prepare_x)
+            ):
+                b = self._chunk_batch(cur, i)
+                p_i = params_for(i) if params_for is not None else params
+                if i == 0 and devcost_fn is not None:
+                    devcost.capture(devcost_label, devcost_fn, (b, p_i))
+                out = kernel(b, p_i)
+                acc = accumulate(acc, out)
+            return acc
+
         depth = prefetch.prefetch_depth()
         if depth <= 0:
             # pack_host_chunk: raw feature columns transfer at the
@@ -921,6 +944,26 @@ class StreamingGLMObjective:
             outs = [
                 np.asarray(_score_matvec(self._chunk_batch(pack(c), i), w))
                 for i, c in enumerate(self.chunks)
+            ]
+            return np.concatenate(outs)[:num_rows]
+
+        from photon_ml_tpu.ops import stream_executor
+
+        if stream_executor.stream_executor_enabled():
+
+            def prepare_x(i):
+                c = self.chunks[i]
+                if self._tile_layouts is not None:
+                    c = {k: c[k] for k in ("labels", "offsets", "weights")}
+                return self._chunk_batch(
+                    stream_executor.cached_device_put("scores", c), i
+                )
+
+            outs = [
+                np.asarray(_score_matvec(b, w))
+                for b in stream_executor.stream(
+                    "scores", len(self.chunks), prepare_x, depth
+                )
             ]
             return np.concatenate(outs)[:num_rows]
 
@@ -1146,7 +1189,28 @@ def stream_scores(
             )
         return b
 
-    from photon_ml_tpu.ops import prefetch
+    from photon_ml_tpu.ops import prefetch, stream_executor
+
+    if stream_executor.stream_executor_enabled():
+        # tiled chunks keep the tile_cache prepare verbatim (the layout
+        # cache already owns their device residency); raw chunks ride
+        # the multi-tenant arbiter so a replay of the training stream's
+        # chunk CONTENT re-uses resident buffers
+        if want_tiling and sparse:
+            prepare_x = prepare
+        else:
+
+            def prepare_x(i):
+                return _to_batch(
+                    stream_executor.cached_device_put("scores", chunks[i]),
+                    num_features,
+                )
+
+        outs = [
+            np.asarray(_score_matvec(b, w))
+            for b in stream_executor.stream("scores", len(chunks), prepare_x)
+        ]
+        return np.concatenate(outs)[:num_rows]
 
     # background prefetch prepares chunk i+k's batch (fingerprint memo +
     # layout-cache lookup — the host-pack cost) while the device scores
@@ -1216,9 +1280,33 @@ def _stream_scores_fe(
             )
         return b
 
-    outs = [
-        np.asarray(_score_matvec(b, w_loc))
-        for b in prefetch.prefetch_iter(len(restricted), prepare)
-    ]
+    from photon_ml_tpu.ops import stream_executor
+
+    if stream_executor.stream_executor_enabled():
+        if want_tiling:
+            prepare_x = prepare
+        else:
+
+            def prepare_x(i):
+                # fe_range rides the arbiter key: a column-restricted
+                # chunk must never alias another range's resident entry
+                return _to_batch(
+                    stream_executor.cached_device_put(
+                        "scores", restricted[i], context=fe_range
+                    ),
+                    d_local,
+                )
+
+        outs = [
+            np.asarray(_score_matvec(b, w_loc))
+            for b in stream_executor.stream(
+                "scores", len(restricted), prepare_x
+            )
+        ]
+    else:
+        outs = [
+            np.asarray(_score_matvec(b, w_loc))
+            for b in prefetch.prefetch_iter(len(restricted), prepare)
+        ]
     partial = np.concatenate(outs)
     return np.asarray(allreduce_sum_host(partial))[:num_rows]

@@ -180,6 +180,46 @@ def refresh_entity(
     return model.updated(cid, updated), res
 
 
+def refresh_stream(
+    model: GameModel,
+    items: list,
+    config: OptimizerConfig,
+    l2_weight: float = 0.0,
+    l1_weight: float = 0.0,
+    chunk: int = _CHUNK_STEP,
+):
+    """Drain a batch of ready refreshes as ONE low-priority stream:
+    ``items`` is a list of ``(cid, entity, X, labels, offsets, weights)``
+    host tuples (e.g. everything ``RefreshBuffer`` reported ready this
+    window). Under ``PHOTON_STREAM_EXECUTOR=1`` the pow2 pad + staging
+    (:func:`entity_event_batch`) for item i+k runs on prefetch workers
+    through the executor's ``refresh`` stream — priority 10, so an
+    active serve window throttles it to one item ahead — while item i
+    solves; solves stay on THIS thread in item order and the model
+    threads through sequentially, so the final model is bitwise the
+    per-item :func:`refresh_entity` loop at any scheduling. Executor-off
+    IS that loop. Returns ``(updated_model, [result, ...])``."""
+    from photon_ml_tpu.ops import stream_executor
+
+    def _prep(i):
+        cid_i, ent_i, X, y, off, w = items[i]
+        return entity_event_batch(X, y, offsets=off, weights=w)
+
+    if stream_executor.stream_executor_enabled():
+        batch_iter = stream_executor.stream("refresh", len(items), _prep)
+    else:
+        batch_iter = (_prep(i) for i in range(len(items)))
+    results = []
+    for i, batch in enumerate(batch_iter):
+        cid_i, ent_i = items[i][0], items[i][1]
+        model, res = refresh_entity(
+            model, cid_i, ent_i, batch, config,
+            l2_weight=l2_weight, l1_weight=l1_weight, chunk=chunk,
+        )
+        results.append(res)
+    return model, results
+
+
 class RefreshBuffer:
     """Per-entity event accumulator driving the refresh trigger: the
     serving loop feeds labeled events in; once an entity holds

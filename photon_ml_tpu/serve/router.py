@@ -214,10 +214,22 @@ class MicroWindowServer:
         return self._occupancy_sum / self.windows if self.windows else 0.0
 
     def _flush(self, window: list[ScoreRequest]) -> None:
+        from photon_ml_tpu.ops import stream_executor
+
         del self._pending[: len(window)]
         t0 = self._clock()
-        with span("serve/window", requests=len(window)):
-            scores = _score_window(self.store, window, self.max_batch())
+        if stream_executor.stream_executor_enabled():
+            # mark the serve stream ACTIVE for the window's duration:
+            # the executor's scheduler sees it and throttles any
+            # concurrently-preparing lower-priority stream (refresh,
+            # background scoring) to depth 1 until the window lands
+            with stream_executor.active_stream("serve"), span(
+                "serve/window", requests=len(window)
+            ):
+                scores = _score_window(self.store, window, self.max_batch())
+        else:
+            with span("serve/window", requests=len(window)):
+                scores = _score_window(self.store, window, self.max_batch())
         dt = self._clock() - t0
         occupancy = len(window) / self.max_batch()
         self.windows += 1
@@ -250,6 +262,97 @@ class EntityRouter:
         )
         self.owner = np.asarray(self.plan.owner, np.int64)
         self.num_processes = int(num_processes)
+        self._reset_traffic()
+
+    def _reset_traffic(self) -> None:
+        E, P = len(self.owner), self.num_processes
+        # per-entity × arrival-source request counts (the locality
+        # signal), plus per-OWNER forwarded/hit request counts (the
+        # measured-cost signal): a window's worth of both is what
+        # replan_from_traffic consumes, then zeroes
+        self._arrivals = np.zeros((E, P), np.float64)
+        self._fwd_by_owner = np.zeros(P, np.float64)
+        self._hit_by_owner = np.zeros(P, np.float64)
+
+    def note_traffic(self, entities, sources) -> None:
+        """Record one window's scored requests: ``entities[i]`` arrived
+        at process ``sources[i]``. A request whose arrival process is
+        not the entity's owner counted as FORWARDED (it rode the P2P
+        exchange both ways); out-of-range entities (the modular
+        fallback) are not plannable and are skipped."""
+        ents = np.asarray(entities, np.int64).ravel()
+        srcs = np.asarray(sources, np.int64).ravel()
+        ok = (ents >= 0) & (ents < len(self.owner))
+        ents, srcs = ents[ok], srcs[ok]
+        if not len(ents):
+            return
+        np.add.at(self._arrivals, (ents, srcs), 1.0)
+        own = self.owner[ents]
+        fwd = own != srcs
+        np.add.at(self._fwd_by_owner, own[fwd], 1.0)
+        np.add.at(self._hit_by_owner, own[~fwd], 1.0)
+
+    def forwarded_fraction(self) -> float:
+        """Forwarded share of the recorded traffic (the quantity the
+        traffic-driven re-plan exists to shrink)."""
+        total = float(self._arrivals.sum())
+        return float(self._fwd_by_owner.sum()) / total if total else 0.0
+
+    def replan_from_traffic(
+        self, slack: float = 0.25, forward_cost: float = 2.0
+    ) -> int:
+        """Migrate ownership toward the measured traffic at a window
+        boundary (ROADMAP serving item (a)): each entity's measured cost
+        is its recorded request count scaled by its current owner's
+        per-request rate (``measured_entity_costs`` over per-owner
+        walls = hits + ``forward_cost`` × forwards — a forwarded request
+        rode the exchange both ways, so owners serving mostly-forwarded
+        traffic measure expensive and LPT spreads their entities off).
+        Entities place in cost-descending order at their MODAL arrival
+        source unless that process is already past ``(1 + slack) ×``
+        the balanced load, else at the least-loaded process — so a
+        shifting Zipf head migrates to where its requests arrive while
+        load stays balanced. Zero-traffic entities keep their owner
+        (their placement evidence is the original row counts).
+
+        Pure host arithmetic: multi-process callers must feed IDENTICAL
+        (allreduced) traffic on every process, like every other plan.
+        Resets the traffic window; returns the number of migrations."""
+        from photon_ml_tpu.parallel.placement import (
+            measured_entity_costs,
+            plan_from_owner,
+        )
+
+        traffic = self._arrivals.sum(axis=1)
+        total = float(traffic.sum())
+        P = self.num_processes
+        if total <= 0.0 or P <= 1:
+            self._reset_traffic()
+            return 0
+        walls = self._hit_by_owner + forward_cost * self._fwd_by_owner
+        costs = measured_entity_costs(traffic, self.owner, walls)
+        new_owner = self.owner.copy()
+        loads = np.zeros(P, np.float64)
+        seen = traffic > 0.0
+        cap = (1.0 + float(slack)) * float(costs[seen].sum()) / P
+        seen_ids = np.flatnonzero(seen)
+        # stable cost-descending order: ties place lower entity id first
+        for e in np.argsort(-costs[seen], kind="stable"):
+            ent = int(seen_ids[e])
+            pref = int(np.argmax(self._arrivals[ent]))
+            if loads[pref] + costs[ent] > cap:
+                pref = int(np.argmin(loads))
+            loads[pref] += costs[ent]
+            new_owner[ent] = pref
+        migrated = int(np.sum(new_owner != self.owner))
+        REGISTRY.counter_inc("serve.replan.count", 1)
+        REGISTRY.counter_inc("serve.replan.migrations", migrated)
+        self.owner = new_owner
+        self.plan = plan_from_owner(
+            new_owner, np.maximum(traffic, 1e-12), P
+        )
+        self._reset_traffic()
+        return migrated
 
     def owner_of(self, entity: int) -> int:
         if 0 <= entity < len(self.owner):
@@ -268,6 +371,9 @@ class EntityRouter:
             np.asarray(entity_rows, np.float64), self.num_processes,
         )
         self.owner = np.asarray(self.plan.owner, np.int64)
+        # the degraded group has new ranks: a stale traffic window would
+        # attribute requests to processes that no longer exist
+        self._reset_traffic()
 
 
 def serve_step_collective(
@@ -298,11 +404,14 @@ def serve_step_collective(
 
     me = effective_process_index()
     n = len(requests)
-    dest = np.asarray(
-        [router.owner_of(int(r.id_tags.get(re_tag, -1))) for r in requests],
-        np.int64,
+    ents = np.asarray(
+        [int(r.id_tags.get(re_tag, -1)) for r in requests], np.int64
     )
+    dest = np.asarray([router.owner_of(int(e)) for e in ents], np.int64)
     REGISTRY.counter_inc("serve.forwarded", int(np.sum(dest != me)))
+    # feed the traffic-driven re-planner this step's LOCAL arrivals
+    # (multi-process replan callers allreduce before replanning)
+    router.note_traffic(ents, np.full((n,), me, np.int64))
     payload = {
         "rid": np.asarray([r.rid for r in requests], np.int64),
         "src": np.full((n,), me, np.int64),

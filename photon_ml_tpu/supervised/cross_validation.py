@@ -139,12 +139,21 @@ def cross_validate_glm(
     # near-full ingested training batch — the default depth would hold
     # three of them live and triple peak memory. One fold ahead overlaps
     # the whole ingest with the previous fold's sweep already.
-    for i, train_batch in enumerate(
-        prefetch.prefetch_iter(
-            len(folds), ingest_fold,
-            depth=min(prefetch.prefetch_depth(), 1),
+    from photon_ml_tpu.ops import stream_executor
+
+    cv_depth = min(prefetch.prefetch_depth(), 1)
+    if stream_executor.stream_executor_enabled():
+        # scheduler-only port (ingest builds a fresh near-full training
+        # batch per fold — nothing content-cacheable); the depth-1 cap
+        # above still bounds peak memory on the executor path
+        fold_iter = stream_executor.stream(
+            "cv", len(folds), ingest_fold, depth=cv_depth
         )
-    ):
+    else:
+        fold_iter = prefetch.prefetch_iter(
+            len(folds), ingest_fold, depth=cv_depth
+        )
+    for i, train_batch in enumerate(fold_iter):
         held_out = folds[i]
         with span("cv/fold", fold=i, k=k):
             result = train_glm(

@@ -69,6 +69,17 @@
 # --telemetry-dir telemetry_r13` when the serving code intentionally
 # changes, then UPDATE_BASELINE=1 to re-bless.
 #
+# A STREAM (r14) leg validates the committed BENCH_r14_stream_cpu.json
+# (the PHOTON_STREAM_EXECUTOR A/B: an L-BFGS fit with per-iteration
+# validation replaying the training chunks through fresh host arrays):
+# acceptance invariants (executor-on BITWISE equal to executor-off on
+# weights + every per-visit validation value; cross-stream transfer
+# bytes reduced by the shared-chunk fraction) plus a gate of its
+# transfer-byte/eviction/parity metrics against
+# BASELINE_stream_cpu.json (parity tier EXACT). Re-capture with
+# `python bench.py --stream` when the executor/arbiter code
+# intentionally changes, then UPDATE_BASELINE=1 to re-bless.
+#
 # An R09 (SPLIT) leg then validates the committed MULTICHIP_r09.json
 # (the PHOTON_RE_SPLIT sub-bucket placement A/B): acceptance invariants
 # (bitwise across arms/processes/vs the single-process reference,
@@ -156,6 +167,11 @@ with open("BASELINE_serve_cpu.json", "w") as f:
     json.dump(doc["gate_metrics"], f, indent=2)
     f.write("\n")
 print("gate_quick: serve baseline re-captured to BASELINE_serve_cpu.json")
+doc = json.load(open("BENCH_r14_stream_cpu.json"))
+with open("BASELINE_stream_cpu.json", "w") as f:
+    json.dump(doc["gate_metrics"], f, indent=2)
+    f.write("\n")
+print("gate_quick: stream baseline re-captured to BASELINE_stream_cpu.json")
 PY
     exit 0
 fi
@@ -366,5 +382,27 @@ print(
     f"{acc['packed_bytes_reduction_at_top_P']:.1%} >= "
     f"{acc['required_reduction']:.1%}, nnz balance "
     f"{acc['nnz_balance_at_top_P']:.3f}x <= 1.15x)"
+)
+PY
+
+# ---- stream (r14) leg: streaming-executor A/B invariants + gate -----------
+python - <<'PY'
+import json, sys
+
+from photon_ml_tpu.obs.report import gate_run
+
+doc = json.load(open("BENCH_r14_stream_cpu.json"))
+acc = doc["acceptance"]
+assert acc["bitwise_identical"], acc
+assert acc["transfer_bytes_reduced"], acc
+baseline = json.load(open("BASELINE_stream_cpu.json"))
+failures, lines = gate_run(doc["gate_metrics"], baseline)
+if failures:
+    print("\n".join(lines))
+    sys.exit(f"gate_quick: stream gate FAILED: {failures}")
+print(
+    "gate_quick: r14 stream leg OK (cross-stream transfer dedup "
+    f"{acc['dedup_fraction']:.1%} — {acc['transfer_bytes_off']} B off "
+    f"vs {acc['transfer_bytes_on']} B on, parity bitwise)"
 )
 PY

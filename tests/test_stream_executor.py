@@ -1,0 +1,595 @@
+"""The unified streaming executor (``ops/stream_executor``).
+
+Determinism contract (the PR-3 rule, inherited verbatim): the executor
+reorders PREPARATION only — kernel calls and accumulation stay on the
+consumer thread in item order — so every ported consumer must be BITWISE
+identical (assert_array_equal, never allclose) executor-on vs its
+pre-executor wiring, cold cache AND warm (replaying device-resident
+entries). Covered per consumer: the chunk objective's value / grad / HVP
+/ diag streams, both scorers, the streamed GAME fit (bucket ingest +
+visit scoring), CV fold ingest, the serve micro-window and the refresh
+stream. Plus the multi-tenant arbiter's edges — shared-entry refcounts
+(an entry leaves the device only when its LAST holder releases), a
+consumer over its budget share spilling its OWN holds before a
+neighbor's, priority preemption throttling a stream's look-ahead without
+ever reordering its items — and the traffic-driven serve re-plan drill
+(a shifted Zipf head migrates ownership; the forwarded-row fraction
+falls; scores stay bitwise through the migration)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.config import OptimizerConfig
+from photon_ml_tpu.obs.metrics import REGISTRY
+from photon_ml_tpu.ops import prefetch, stream_executor
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.ops.streaming import (
+    StreamingGLMObjective,
+    dense_chunks,
+    sparse_chunks,
+    stream_scores,
+)
+from photon_ml_tpu.types import TaskType
+
+LOSS = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+
+
+@pytest.fixture(autouse=True)
+def _clean_caches():
+    prefetch.clear_cache()
+    stream_executor.clear()
+    REGISTRY.reset(prefix="stream")
+    yield
+    prefetch.clear_cache()
+    stream_executor.clear()
+
+
+def _counter(name: str) -> float:
+    c = REGISTRY.snapshot(prefix="stream")["counters"].get(name)
+    return float(c["value"]) if c else 0.0
+
+
+def _dense_problem(rng, n=400, d=8):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    X[:, d - 1] = 1.0
+    w_true = (rng.normal(size=d) * 0.5).astype(np.float32)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-X @ w_true))).astype(
+        np.float32
+    )
+    return X, y
+
+
+def _copy_chunks(chunks):
+    """Content-equal chunks through FRESH host arrays (a different
+    loader's copy of the same data) — storage-identity caching cannot
+    dedup these; the content-keyed arbiter must."""
+    return [{k: np.array(v) for k, v in c.items()} for c in chunks]
+
+
+# ---------------------------------------------------------------------------
+# per-consumer bitwise parity: executor-on (cold + warm) vs executor-off
+
+
+class TestGLMConsumerParity:
+    def _outputs(self, chunks, d, w, num_rows):
+        sobj = StreamingGLMObjective(
+            chunks, LOSS, num_features=d, l2_weight=0.7,
+            intercept_index=d - 1,
+        )
+        v, g = sobj.value_and_grad(w)
+        return (
+            float(v),
+            np.asarray(g),
+            np.asarray(sobj.hvp(w, w + 0.5)),
+            np.asarray(sobj.hessian_diag(w)),
+            float(sobj.value(w)),
+            sobj.stream_scores(np.asarray(w), num_rows=num_rows),
+            stream_scores(chunks, np.asarray(w), num_rows=num_rows),
+        )
+
+    def _assert_bitwise(self, a, b):
+        for x, y in zip(a, b):
+            if isinstance(x, float):
+                assert x == y
+            else:
+                np.testing.assert_array_equal(x, y)
+
+    def test_dense_bitwise_cold_and_warm(self, rng, monkeypatch):
+        X, y = _dense_problem(rng)
+        chunks = dense_chunks(X, y, chunk_rows=128)
+        w = jnp.asarray(rng.normal(size=8), jnp.float32)
+        monkeypatch.setenv("PHOTON_STREAM_EXECUTOR", "0")
+        ref = self._outputs(chunks, 8, w, 400)
+        monkeypatch.setenv("PHOTON_STREAM_EXECUTOR", "1")
+        stream_executor.clear()  # cold: every chunk transfers
+        self._assert_bitwise(self._outputs(chunks, 8, w, 400), ref)
+        assert stream_executor.cache_stats()["misses"] > 0
+        hits_cold = stream_executor.cache_stats()["hits"]
+        # warm: the replay hits resident entries, values unchanged
+        self._assert_bitwise(self._outputs(chunks, 8, w, 400), ref)
+        s = stream_executor.cache_stats()
+        assert s["hits"] > hits_cold
+
+    def test_sparse_bitwise_cold_and_warm(self, rng, monkeypatch):
+        n, d, k = 300, 50, 5
+        idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        val = rng.normal(size=(n, k)).astype(np.float32)
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        chunks = sparse_chunks(idx, val, y, chunk_rows=97)
+        w = jnp.asarray(rng.normal(size=d), jnp.float32)
+        monkeypatch.setenv("PHOTON_STREAM_EXECUTOR", "0")
+        ref = self._outputs(chunks, d, w, n)
+        monkeypatch.setenv("PHOTON_STREAM_EXECUTOR", "1")
+        stream_executor.clear()
+        self._assert_bitwise(self._outputs(chunks, d, w, n), ref)
+        self._assert_bitwise(self._outputs(chunks, d, w, n), ref)
+
+    def test_content_dedup_across_fresh_host_copies(self, rng, monkeypatch):
+        """A validation stream replaying training chunks through FRESH
+        host arrays (identical bytes, different storage) re-uses the
+        resident device entries: shared hits, no second transfer."""
+        X, y = _dense_problem(rng)
+        chunks = dense_chunks(X, y, chunk_rows=128)
+        w = np.zeros(8, np.float32)
+        monkeypatch.setenv("PHOTON_STREAM_EXECUTOR", "1")
+        train = StreamingGLMObjective(chunks, LOSS, num_features=8)
+        train.value(jnp.asarray(w))
+        miss_after_train = stream_executor.cache_stats()["misses"]
+        ref = stream_scores(chunks, w, num_rows=400)
+        got = stream_scores(_copy_chunks(chunks), w, num_rows=400)
+        np.testing.assert_array_equal(got, ref)
+        s = stream_executor.cache_stats()
+        assert s["misses"] == miss_after_train  # zero new transfers
+        assert s["shared_hits"] > 0
+
+
+class TestGameConsumerParity:
+    def _fit(self, n=320, seed=7):
+        from photon_ml_tpu.config import (
+            FixedEffectCoordinateConfig,
+            GameTrainingConfig,
+            OptimizationConfig,
+            RandomEffectCoordinateConfig,
+            RegularizationContext,
+        )
+        from photon_ml_tpu.game.streaming import (
+            StreamedGameData,
+            StreamedGameTrainer,
+        )
+        from photon_ml_tpu.types import RegularizationType
+
+        rng = np.random.default_rng(seed)
+        d, dr, E = 6, 3, 8
+        w_fixed = (rng.normal(size=d) * 0.6).astype(np.float32)
+        W_re = (rng.normal(size=(E, dr)) * 0.6).astype(np.float32)
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        Xr = rng.normal(size=(n, dr)).astype(np.float32)
+        ids = rng.integers(0, E, size=n).astype(np.int32)
+        margin = X @ w_fixed + np.sum(W_re[ids] * Xr, axis=1)
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(
+            np.float32
+        )
+        opt = OptimizationConfig(
+            optimizer=OptimizerConfig(max_iterations=40, tolerance=1e-8),
+            regularization=RegularizationContext(RegularizationType.L2),
+            regularization_weight=1.0,
+        )
+        cfg = GameTrainingConfig(
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            coordinate_update_sequence=("fixed", "user"),
+            coordinate_descent_iterations=1,
+            fixed_effect_coordinates={
+                "fixed": FixedEffectCoordinateConfig(
+                    feature_shard_id="g", optimization=opt
+                )
+            },
+            random_effect_coordinates={
+                "user": RandomEffectCoordinateConfig(
+                    feature_shard_id="r", random_effect_type="uid",
+                    optimization=opt,
+                )
+            },
+        )
+        data = StreamedGameData(
+            labels=y, features={"g": X, "r": Xr}, id_tags={"uid": ids}
+        )
+        model, _info = StreamedGameTrainer(cfg, chunk_rows=64).fit(data)
+        return model
+
+    def test_streamed_game_fit_bitwise(self, monkeypatch):
+        """The whole streamed GAME fit — chunk-objective solves, bucket
+        ingest (``re_gather``), per-visit scoring (``re_scores``),
+        residual exchange — bitwise executor-on vs off."""
+        monkeypatch.setenv("PHOTON_STREAM_EXECUTOR", "0")
+        ref = self._fit()
+        monkeypatch.setenv("PHOTON_STREAM_EXECUTOR", "1")
+        stream_executor.clear()
+        got = self._fit()
+        np.testing.assert_array_equal(
+            np.asarray(got.models["fixed"].model.coefficients.means),
+            np.asarray(ref.models["fixed"].model.coefficients.means),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.models["user"].coefficients),
+            np.asarray(ref.models["user"].coefficients),
+        )
+        # warm replay: resident entries, same bytes out
+        warm = self._fit()
+        np.testing.assert_array_equal(
+            np.asarray(warm.models["user"].coefficients),
+            np.asarray(ref.models["user"].coefficients),
+        )
+
+
+class TestCVConsumerParity:
+    def test_cv_folds_bitwise(self, rng, monkeypatch):
+        from photon_ml_tpu.ops.batch import DenseBatch
+        from photon_ml_tpu.supervised.cross_validation import (
+            cross_validate_glm,
+        )
+
+        d = 6
+        w_true = (rng.normal(size=d) * 0.8).astype(np.float32)
+        X = rng.normal(size=(240, d)).astype(np.float32)
+        y = (rng.uniform(size=240) < 1 / (1 + np.exp(-(X @ w_true)))).astype(
+            np.float32
+        )
+        batch = DenseBatch(
+            X=jnp.asarray(X), labels=jnp.asarray(y),
+            offsets=jnp.zeros((240,), jnp.float32),
+            weights=jnp.ones((240,), jnp.float32),
+        )
+
+        def run():
+            return cross_validate_glm(
+                batch, TaskType.LOGISTIC_REGRESSION, k=4,
+                regularization_weights=[0.5, 5.0],
+                optimizer_config=OptimizerConfig(
+                    max_iterations=40, tolerance=1e-8
+                ),
+                seed=3,
+            )
+
+        monkeypatch.setenv("PHOTON_STREAM_EXECUTOR", "0")
+        ref = run()
+        monkeypatch.setenv("PHOTON_STREAM_EXECUTOR", "1")
+        stream_executor.clear()
+        got = run()
+        assert got.best_weight == ref.best_weight
+        for lam in (0.5, 5.0):
+            assert got.metric_values[lam] == ref.metric_values[lam]
+        np.testing.assert_array_equal(
+            np.asarray(got.final.models[got.best_weight].coefficients.means),
+            np.asarray(ref.final.models[ref.best_weight].coefficients.means),
+        )
+
+
+# ---------------------------------------------------------------------------
+# serve-side consumers: micro-window scoring + the refresh stream
+
+
+def _game_model(E: int = 16, d_fe: int = 4, d_re: int = 3, seed: int = 0):
+    from photon_ml_tpu.game.models import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+
+    rng = np.random.default_rng(seed)
+    return GameModel(models={
+        "fixed": FixedEffectModel(
+            model=GeneralizedLinearModel(Coefficients(
+                jnp.asarray((rng.normal(size=d_fe) * 0.5).astype(np.float32))
+            )),
+            feature_shard_id="global",
+        ),
+        "per_member": RandomEffectModel(
+            coefficients=jnp.asarray(
+                (rng.normal(size=(E, d_re)) * 0.5).astype(np.float32)
+            ),
+            variances=None,
+            random_effect_type="member",
+            feature_shard_id="member_f",
+        ),
+    })
+
+
+def _requests(model, n: int, seed: int, entities=None):
+    from photon_ml_tpu.serve.router import ScoreRequest
+
+    E = int(np.asarray(model["per_member"].coefficients).shape[0])
+    d_fe = int(model["fixed"].coefficient_means.shape[0])
+    d_re = int(np.asarray(model["per_member"].coefficients).shape[1])
+    rng = np.random.default_rng(seed)
+    ents = (
+        np.asarray(entities)
+        if entities is not None
+        else rng.integers(0, E, size=n)
+    )
+    return [
+        ScoreRequest(
+            rid=i,
+            features={
+                "global": rng.normal(size=d_fe).astype(np.float32),
+                "member_f": rng.normal(size=d_re).astype(np.float32),
+            },
+            id_tags={"member": int(ents[i])},
+            offset=float((i % 5) * 0.1),
+        )
+        for i in range(n)
+    ]
+
+
+def _serve_scores(model, reqs, max_batch=8):
+    from photon_ml_tpu.serve.router import MicroWindowServer
+    from photon_ml_tpu.serve.store import HotModelStore
+
+    out = {}
+    server = MicroWindowServer(
+        HotModelStore(model),
+        on_scores=lambda w, s: out.update(
+            {r.rid: v for r, v in zip(w, np.asarray(s))}
+        ),
+        max_batch=max_batch, max_wait_ms=1e9,
+    )
+    for r in reqs:
+        server.submit(r)
+    server.drain()
+    return np.asarray([out[i] for i in range(len(reqs))], np.float32)
+
+
+class TestServeConsumerParity:
+    def test_serve_window_bitwise(self, monkeypatch):
+        model = _game_model()
+        reqs_a = _requests(model, 37, seed=1)
+        reqs_b = _requests(model, 37, seed=1)
+        monkeypatch.setenv("PHOTON_STREAM_EXECUTOR", "0")
+        ref = _serve_scores(model, reqs_a)
+        monkeypatch.setenv("PHOTON_STREAM_EXECUTOR", "1")
+        got = _serve_scores(model, reqs_b)
+        np.testing.assert_array_equal(
+            got.view(np.uint32), ref.view(np.uint32)
+        )
+        # the window ran under the serve stream's active marker: a
+        # concurrent lower-priority stream would have seen it
+        assert stream_executor.priority_of("serve") == 100
+
+    def test_refresh_stream_bitwise(self, monkeypatch):
+        from photon_ml_tpu.serve.refresh import refresh_stream
+
+        model = _game_model()
+        rng = np.random.default_rng(11)
+        items = []
+        for j, ent in enumerate((2, 5, 5, 9)):
+            k = 6 + j
+            items.append((
+                "per_member", ent,
+                rng.normal(size=(k, 3)).astype(np.float32),
+                (rng.uniform(size=k) < 0.5).astype(np.float32),
+                None, None,
+            ))
+        cfg = OptimizerConfig(max_iterations=30, tolerance=1e-7)
+
+        monkeypatch.setenv("PHOTON_STREAM_EXECUTOR", "0")
+        m_ref, r_ref = refresh_stream(model, items, cfg, l2_weight=1.0)
+        monkeypatch.setenv("PHOTON_STREAM_EXECUTOR", "1")
+        stream_executor.clear()
+        m_got, r_got = refresh_stream(model, items, cfg, l2_weight=1.0)
+        np.testing.assert_array_equal(
+            np.asarray(m_got["per_member"].coefficients),
+            np.asarray(m_ref["per_member"].coefficients),
+        )
+        for a, b in zip(r_got, r_ref):
+            np.testing.assert_array_equal(np.asarray(a.w), np.asarray(b.w))
+
+
+# ---------------------------------------------------------------------------
+# the multi-tenant arbiter's edges
+
+
+def _put(name, arr, context=None):
+    return stream_executor.cached_device_put(name, {"x": arr}, context)
+
+
+class TestMultiTenantArbiter:
+    def _arrays(self, count, nbytes=256, seed=0):
+        rng = np.random.default_rng(seed)
+        return [
+            rng.normal(size=nbytes // 4).astype(np.float32)
+            for _ in range(count)
+        ]
+
+    def test_shared_entry_refcount_on_eviction(self, monkeypatch):
+        """A shared entry leaves the device only when its LAST holder
+        releases: one consumer's budget pressure drops its HOLD, not the
+        entry; the neighbor keeps hitting resident bytes."""
+        monkeypatch.setattr(prefetch, "CHUNK_CACHE_BUDGET", 4096)
+        monkeypatch.setenv(
+            "PHOTON_STREAM_SHARE", "a=0.25,b=0.0625"
+        )  # a: 1024 B, b: 256 B
+        arrs = self._arrays(5, seed=3)
+        shared = arrs[0]
+        _put("a", shared)
+        _put("b", np.array(shared))  # fresh storage, same content
+        s = stream_executor.cache_stats()
+        assert s["shared_hits"] == 1 and s["entries"] == 1
+        # a admits 4 more -> over its 1024 B share -> releases its OWN
+        # LRU hold (the shared entry). b still holds it: NOT evicted.
+        for arr in arrs[1:]:
+            _put("a", arr)
+        s = stream_executor.cache_stats()
+        assert s["evictions"] == 0
+        assert s["charges"]["a"] <= 1024
+        miss_before = s["misses"]
+        _put("b", np.array(shared))  # b's replay: resident, no transfer
+        s = stream_executor.cache_stats()
+        assert s["misses"] == miss_before
+        # b over ITS share -> releases the shared entry as LAST holder:
+        # only now does the entry leave the device
+        _put("b", self._arrays(1, seed=9)[0])
+        s = stream_executor.cache_stats()
+        assert s["evictions"] >= 1
+        assert s["charges"].get("b", 0) <= 256
+
+    def test_budget_exhaustion_spills_own_before_neighbor(
+        self, monkeypatch
+    ):
+        monkeypatch.setattr(prefetch, "CHUNK_CACHE_BUDGET", 1 << 20)
+        monkeypatch.setenv("PHOTON_STREAM_SHARE", "a=0.001")  # ~1048 B
+        b_arrs = self._arrays(3, seed=1)
+        for arr in b_arrs:
+            _put("b", arr)
+        charges_b = stream_executor.cache_stats()["charges"]["b"]
+        for arr in self._arrays(8, seed=2):  # 2048 B > a's share
+            _put("a", arr)
+        s = stream_executor.cache_stats()
+        # a spilled its own LRU holds; b's working set is untouched
+        assert s["charges"]["a"] <= 1048
+        assert s["charges"]["b"] == charges_b
+        miss_before = s["misses"]
+        for arr in b_arrs:  # b replays resident bytes
+            _put("b", arr)
+        assert stream_executor.cache_stats()["misses"] == miss_before
+
+    def test_global_budget_evicts_every_holder(self, monkeypatch):
+        monkeypatch.setattr(prefetch, "CHUNK_CACHE_BUDGET", 512)
+        arrs = self._arrays(4, seed=4)
+        _put("a", arrs[0])
+        _put("b", np.array(arrs[0]))
+        _put("a", arrs[1])
+        _put("a", arrs[2])  # 768 B total > 512: global LRU walk
+        s = stream_executor.cache_stats()
+        assert s["bytes"] <= 512
+        assert s["evictions"] >= 1
+        # charges stay consistent with the surviving holds
+        assert sum(s["charges"].values()) >= s["bytes"]
+
+    def test_priority_preemption_never_reorders_items(self, monkeypatch):
+        """With a higher-priority stream active, a low-priority stream's
+        look-ahead throttles to depth 1 (counted as yields) — but its
+        items still arrive strictly in order."""
+        monkeypatch.setenv("PHOTON_PREFETCH_DEPTH", "4")
+        monkeypatch.setenv("PHOTON_STREAM_EXECUTOR", "1")
+        with stream_executor.active_stream("serve"):
+            out = list(
+                stream_executor.stream("refresh", 12, lambda i: i * i)
+            )
+        assert out == [i * i for i in range(12)]
+        assert _counter("stream.refresh.yields") > 0
+        # and without the critical stream active: full depth, no yields
+        REGISTRY.reset(prefix="stream")
+        out = list(stream_executor.stream("refresh", 12, lambda i: i * i))
+        assert out == [i * i for i in range(12)]
+        assert _counter("stream.refresh.yields") == 0
+
+    def test_priority_spec_env_override(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_STREAM_PRIORITY", "refresh=200")
+        assert stream_executor.priority_of("refresh") == 200
+        assert stream_executor.priority_of("serve") == 100
+        monkeypatch.setenv("PHOTON_STREAM_PRIORITY", "garbage")
+        with pytest.raises(ValueError, match="PHOTON_STREAM_PRIORITY"):
+            stream_executor.priority_of("refresh")
+
+    def test_share_spec_validation(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_STREAM_SHARE", "a=0.5")
+        assert stream_executor.share_fraction("a") == 0.5
+        assert stream_executor.share_fraction("other") == 1.0
+        monkeypatch.setenv("PHOTON_STREAM_SHARE", "a=1.5")
+        with pytest.raises(ValueError, match="PHOTON_STREAM_SHARE"):
+            stream_executor.share_fraction("a")
+
+    def test_worker_exception_propagates(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_PREFETCH_DEPTH", "2")
+
+        def prep(i):
+            if i == 3:
+                raise RuntimeError("prep failed")
+            return i
+
+        got = []
+        with pytest.raises(RuntimeError, match="prep failed"):
+            for v in stream_executor.stream("objective", 6, prep):
+                got.append(v)
+        assert got == [0, 1, 2]
+
+
+# ---------------------------------------------------------------------------
+# traffic-driven serve re-planning (the Zipf head-shift drill)
+
+
+class TestTrafficReplan:
+    def _feed(self, router, arrivals_per_entity, head, head_src):
+        """One traffic window: head entities arrive at ``head_src``;
+        tail entities arrive at their CURRENT owner (local traffic)."""
+        ents, srcs = [], []
+        for e, cnt in enumerate(arrivals_per_entity):
+            src = head_src if e in head else int(router.owner[e])
+            ents.extend([e] * cnt)
+            srcs.extend([src] * cnt)
+        router.note_traffic(
+            np.asarray(ents, np.int64), np.asarray(srcs, np.int64)
+        )
+
+    def test_zipf_head_shift_migrates_and_reduces_forwarding(self):
+        from photon_ml_tpu.serve.router import EntityRouter
+
+        E, P = 50, 2
+        router = EntityRouter(np.ones(E), P)
+        weights = 1.0 / (np.arange(E) + 1.0)
+        arrivals = np.maximum(
+            (weights / weights.sum() * 2000).astype(int), 1
+        )
+        head = set(np.argsort(-arrivals)[:8].tolist())
+        self._feed(router, arrivals, head, head_src=0)
+        f_before = router.forwarded_fraction()
+        owner_before = router.owner.copy()
+        migrations = router.replan_from_traffic()
+        assert migrations > 0
+        assert not np.array_equal(router.owner, owner_before)
+        # every head entity landed at the process its traffic arrives at
+        # ... unless the load cap forced a spill; the DOMINANT head rows
+        # must be local now
+        self._feed(router, arrivals, head, head_src=0)
+        f_after = router.forwarded_fraction()
+        assert f_after < f_before
+        assert int(router.owner[int(np.argmax(arrivals))]) == 0
+
+    def test_replan_scores_stay_bitwise(self, monkeypatch):
+        """Ownership migration moves ROUTING only: the same requests
+        score byte-identically before and after a re-plan."""
+        from photon_ml_tpu.serve.router import EntityRouter
+
+        model = _game_model(E=20)
+        reqs_a = _requests(model, 24, seed=5)
+        reqs_b = _requests(model, 24, seed=5)
+        router = EntityRouter(np.ones(20), 2)
+        ref = _serve_scores(model, reqs_a)
+        ents = np.asarray([r.id_tags["member"] for r in reqs_a], np.int64)
+        router.note_traffic(ents, np.zeros_like(ents))
+        router.replan_from_traffic()
+        got = _serve_scores(model, reqs_b)
+        np.testing.assert_array_equal(
+            got.view(np.uint32), ref.view(np.uint32)
+        )
+
+    def test_replan_resets_traffic_window(self):
+        from photon_ml_tpu.serve.router import EntityRouter
+
+        router = EntityRouter(np.ones(10), 2)
+        ents = np.arange(10, dtype=np.int64)
+        router.note_traffic(ents, np.zeros(10, np.int64))
+        router.replan_from_traffic()
+        assert router.forwarded_fraction() == 0.0  # fresh window
+
+    def test_replan_no_traffic_is_noop(self):
+        from photon_ml_tpu.serve.router import EntityRouter
+
+        router = EntityRouter(np.ones(10), 2)
+        owner = router.owner.copy()
+        assert router.replan_from_traffic() == 0
+        np.testing.assert_array_equal(router.owner, owner)
